@@ -1,0 +1,75 @@
+"""spark_gp_tpu — a TPU-native Gaussian Process framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the reference
+Spark/Breeze library (akopich/spark-gp): linear-time Gaussian Process
+regression and classification at scale via
+
+* **Bayesian Committee Machine (product-of-experts)** hyperparameter fitting —
+  the dataset is split into small "expert" chunks and the approximate negative
+  log marginal likelihood is the sum of per-expert NLLs
+  (reference: GaussianProcessCommons.scala:66-92), and
+
+* **Projected Process Approximation** prediction — the posterior is projected
+  onto an m-point active set so model size and predict cost are independent of
+  N (reference: GaussianProcessCommons.scala:40-59, Rasmussen & Williams
+  ch. 8.3.4).
+
+The TPU-first design differs deliberately from the reference's architecture:
+
+* experts live on a leading array axis ``[E, s, ...]`` sharded across chips
+  (``jax.sharding.Mesh`` + ``shard_map``) instead of Spark RDD partitions;
+* cross-device reductions are XLA ``psum`` collectives over ICI instead of
+  ``treeAggregate``;
+* kernels are pure functions of a flat hyperparameter vector — gradients come
+  from autodiff (``jax.value_and_grad``), not hand-written matrix calculus;
+* all dense linear algebra is Cholesky-based (no LU + ``dgetri``, no explicit
+  inverses, no ``eigSym`` positive-definiteness sweeps).
+"""
+
+from spark_gp_tpu.kernels import (
+    ARDRBFKernel,
+    Const,
+    EyeKernel,
+    Kernel,
+    RBFKernel,
+    Scalar,
+    SumKernel,
+    WhiteNoiseKernel,
+)
+from spark_gp_tpu.models.gpr import (
+    GaussianProcessRegression,
+    GaussianProcessRegressionModel,
+)
+from spark_gp_tpu.models.gpc import (
+    GaussianProcessClassifier,
+    GaussianProcessClassificationModel,
+)
+from spark_gp_tpu.models.active_set import (
+    ActiveSetProvider,
+    GreedilyOptimizingActiveSetProvider,
+    KMeansActiveSetProvider,
+    RandomActiveSetProvider,
+)
+from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Kernel",
+    "RBFKernel",
+    "ARDRBFKernel",
+    "EyeKernel",
+    "WhiteNoiseKernel",
+    "SumKernel",
+    "Scalar",
+    "Const",
+    "GaussianProcessRegression",
+    "GaussianProcessRegressionModel",
+    "GaussianProcessClassifier",
+    "GaussianProcessClassificationModel",
+    "ActiveSetProvider",
+    "RandomActiveSetProvider",
+    "KMeansActiveSetProvider",
+    "GreedilyOptimizingActiveSetProvider",
+    "NotPositiveDefiniteException",
+]

@@ -1,0 +1,17 @@
+"""Dataset loaders for the examples / acceptance tests."""
+
+from spark_gp_tpu.data.datasets import (
+    load_airfoil,
+    load_iris,
+    load_mnist_binary,
+    make_benchmark_data,
+    make_synthetics,
+)
+
+__all__ = [
+    "make_synthetics",
+    "load_airfoil",
+    "load_iris",
+    "load_mnist_binary",
+    "make_benchmark_data",
+]

@@ -1,0 +1,33 @@
+"""Covariance kernels and the composition DSL.
+
+Functional re-design of the reference's ``commons/kernel/`` package — see
+``base.py`` for the contract and the deliberate departures from the mutable
+object design.
+"""
+
+from spark_gp_tpu.kernels.base import (
+    Const,
+    ConstScaleKernel,
+    EyeKernel,
+    Kernel,
+    Scalar,
+    StationaryKernel,
+    SumKernel,
+    TrainableScaleKernel,
+    WhiteNoiseKernel,
+)
+from spark_gp_tpu.kernels.rbf import ARDRBFKernel, RBFKernel
+
+__all__ = [
+    "Kernel",
+    "StationaryKernel",
+    "EyeKernel",
+    "SumKernel",
+    "TrainableScaleKernel",
+    "ConstScaleKernel",
+    "Scalar",
+    "Const",
+    "WhiteNoiseKernel",
+    "RBFKernel",
+    "ARDRBFKernel",
+]

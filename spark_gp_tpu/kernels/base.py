@@ -1,0 +1,317 @@
+"""Functional covariance-kernel algebra.
+
+The reference models kernels as *mutable objects* carrying hyperparameters and
+training vectors set in place (kernel/Kernel.scala:12-98), with hand-derived
+``trainingKernelAndDerivative`` methods per kernel.  That design cannot work
+under JAX tracing and would forfeit autodiff.  Here a kernel is an immutable
+*spec*:
+
+* hyperparameters live in one flat vector ``theta`` (the exact layout the
+  reference's L-BFGS-B consumes: composite kernels concatenate children,
+  trainable scalars prepend their coefficient — SumOfKernels.scala:19-26,
+  ScalarTimesKernel.scala:78-84);
+* ``gram`` / ``cross`` / ``diag`` / ``self_diag`` are pure functions of
+  ``(theta, X)``, safe under ``jit``, ``vmap``, ``shard_map`` and ``grad``;
+* derivatives w.r.t. ``theta`` come from autodiff — there is no analogue of
+  ``trainingKernelAndDerivative``'s hand algebra to maintain (the reference's
+  finite-difference kernel tests are kept as oracles in ``tests/``).
+
+The composition DSL mirrors the reference's
+(``1 * k1 + 0.5.const * k2``, kernel/package.scala:3-9):
+
+>>> k = 1.0 * RBFKernel(0.1, 1e-6, 10) + WhiteNoiseKernel(0.5, 0, 1)
+>>> k = Scalar(1.0).between(0).and_(30) * ARDRBFKernel(5)
+>>> k = Const(1.0) * EyeKernel()
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Kernel:
+    """Covariance-function spec.  Immutable; all compute methods are pure.
+
+    Contract (the functional analogue of kernel/Kernel.scala:12-98):
+
+    * ``n_hypers`` — number of trainable hyperparameters.
+    * ``init_theta()`` — initial hyperparameter vector, shape ``[n_hypers]``.
+    * ``bounds()`` — elementwise box ``(lower, upper)`` for L-BFGS-B.
+    * ``gram(theta, x)`` — ``[n, n]`` training kernel matrix.
+    * ``cross(theta, x_test, x_train)`` — ``[t, n]`` cross kernel.
+    * ``diag(theta, x)`` — ``[n]`` diagonal of ``gram`` (cheaper than gram).
+    * ``self_diag(theta, x)`` — ``[t]`` of ``k(x_i, x_i)`` for *test* points
+      (the batched ``selfKernel``, kernel/Kernel.scala:91).
+    * ``white_noise_var(theta)`` — scalar white-noise variance presumed by
+      the kernel (kernel/Kernel.scala:97); may depend on ``theta`` when a
+      trainable scalar scales an ``EyeKernel``.
+    * ``describe(theta)`` — human-readable form for instrumentation logs.
+    """
+
+    n_hypers: int = 0
+
+    def init_theta(self) -> np.ndarray:
+        return np.zeros((0,), dtype=np.float64)
+
+    def bounds(self) -> Tuple[np.ndarray, np.ndarray]:
+        zero = np.zeros((0,), dtype=np.float64)
+        return zero, zero
+
+    def gram(self, theta: jax.Array, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def cross(self, theta: jax.Array, x_test: jax.Array, x_train: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def diag(self, theta: jax.Array, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def self_diag(self, theta: jax.Array, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def white_noise_var(self, theta: jax.Array) -> jax.Array:
+        return jnp.zeros((), dtype=theta.dtype if hasattr(theta, "dtype") else jnp.float32)
+
+    def describe(self, theta) -> str:
+        return type(self).__name__
+
+    # --- composition DSL -------------------------------------------------
+    def __add__(self, other: "Kernel") -> "SumKernel":
+        return SumKernel(self, other)
+
+    def __rmul__(self, coeff: float) -> "Kernel":
+        """``c * kernel`` makes the coefficient *trainable* in ``[0, inf)``,
+        matching the reference's implicit ``toScalar`` (kernel/package.scala:4)."""
+        return Scalar(float(coeff)) * self
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            return Scalar(float(other)) * self
+        return NotImplemented
+
+
+class StationaryKernel(Kernel):
+    """Base for unit-variance stationary kernels: ``k(x, x) = 1``."""
+
+    def diag(self, theta, x):
+        return jnp.ones(x.shape[0], dtype=x.dtype)
+
+    def self_diag(self, theta, x):
+        return jnp.ones(x.shape[0], dtype=x.dtype)
+
+
+class EyeKernel(Kernel):
+    """Identity-matrix kernel: ``K = I`` on training points, 0 across sets,
+    unit white-noise variance (kernel/Kernel.scala:142-163)."""
+
+    n_hypers = 0
+
+    def gram(self, theta, x):
+        return jnp.eye(x.shape[0], dtype=x.dtype)
+
+    def cross(self, theta, x_test, x_train):
+        return jnp.zeros((x_test.shape[0], x_train.shape[0]), dtype=x_train.dtype)
+
+    def diag(self, theta, x):
+        return jnp.ones(x.shape[0], dtype=x.dtype)
+
+    def self_diag(self, theta, x):
+        # selfKernel(test) = 1 in the reference (kernel/Kernel.scala:161) —
+        # the white-noise variance applies to any single point.
+        return jnp.ones(x.shape[0], dtype=x.dtype)
+
+    def white_noise_var(self, theta):
+        return jnp.asarray(1.0)
+
+    def describe(self, theta) -> str:
+        return "I"
+
+
+class SumKernel(Kernel):
+    """``k1 + k2`` with concatenated hyperparameter vectors
+    (SumOfKernels.scala:15-65).  Children share no hyperparameters."""
+
+    def __init__(self, k1: Kernel, k2: Kernel) -> None:
+        self.k1 = k1
+        self.k2 = k2
+        self.n_hypers = k1.n_hypers + k2.n_hypers
+
+    def _split(self, theta):
+        return theta[: self.k1.n_hypers], theta[self.k1.n_hypers :]
+
+    def init_theta(self):
+        return np.concatenate([self.k1.init_theta(), self.k2.init_theta()])
+
+    def bounds(self):
+        lo1, hi1 = self.k1.bounds()
+        lo2, hi2 = self.k2.bounds()
+        return np.concatenate([lo1, lo2]), np.concatenate([hi1, hi2])
+
+    def gram(self, theta, x):
+        t1, t2 = self._split(theta)
+        return self.k1.gram(t1, x) + self.k2.gram(t2, x)
+
+    def cross(self, theta, x_test, x_train):
+        t1, t2 = self._split(theta)
+        return self.k1.cross(t1, x_test, x_train) + self.k2.cross(t2, x_test, x_train)
+
+    def diag(self, theta, x):
+        t1, t2 = self._split(theta)
+        return self.k1.diag(t1, x) + self.k2.diag(t2, x)
+
+    def self_diag(self, theta, x):
+        t1, t2 = self._split(theta)
+        return self.k1.self_diag(t1, x) + self.k2.self_diag(t2, x)
+
+    def white_noise_var(self, theta):
+        t1, t2 = self._split(theta)
+        return self.k1.white_noise_var(t1) + self.k2.white_noise_var(t2)
+
+    def describe(self, theta) -> str:
+        t1, t2 = np.asarray(theta)[: self.k1.n_hypers], np.asarray(theta)[self.k1.n_hypers :]
+        parts = [self.k1.describe(t1), self.k2.describe(t2)]
+        return " + ".join(p for p in parts if p)
+
+
+class TrainableScaleKernel(Kernel):
+    """``C * k`` with trainable ``C`` prepended to the hyperparameter vector
+    (ScalarTimesKernel.scala:71-98)."""
+
+    def __init__(self, kernel: Kernel, c: float, lower: float = 0.0, upper: float = math.inf):
+        if c < 0:
+            raise ValueError("C should be non-negative")
+        self.kernel = kernel
+        self.c0 = float(c)
+        self.lower = float(lower)
+        self.upper = float(upper)
+        self.n_hypers = 1 + kernel.n_hypers
+
+    def init_theta(self):
+        return np.concatenate([[self.c0], self.kernel.init_theta()])
+
+    def bounds(self):
+        lo, hi = self.kernel.bounds()
+        return (
+            np.concatenate([[self.lower], lo]),
+            np.concatenate([[self.upper], hi]),
+        )
+
+    def gram(self, theta, x):
+        return theta[0] * self.kernel.gram(theta[1:], x)
+
+    def cross(self, theta, x_test, x_train):
+        return theta[0] * self.kernel.cross(theta[1:], x_test, x_train)
+
+    def diag(self, theta, x):
+        return theta[0] * self.kernel.diag(theta[1:], x)
+
+    def self_diag(self, theta, x):
+        return theta[0] * self.kernel.self_diag(theta[1:], x)
+
+    def white_noise_var(self, theta):
+        return theta[0] * self.kernel.white_noise_var(theta[1:])
+
+    def describe(self, theta) -> str:
+        t = np.asarray(theta)
+        return f"{float(t[0]):.1e} * {self.kernel.describe(t[1:])}"
+
+
+class ConstScaleKernel(Kernel):
+    """``C * k`` with a fixed, non-trainable ``C``
+    (ScalarTimesKernel.scala:41-59)."""
+
+    def __init__(self, kernel: Kernel, c: float):
+        if c < 0:
+            raise ValueError("C should be non-negative")
+        self.kernel = kernel
+        self.c = float(c)
+        self.n_hypers = kernel.n_hypers
+
+    def init_theta(self):
+        return self.kernel.init_theta()
+
+    def bounds(self):
+        return self.kernel.bounds()
+
+    def gram(self, theta, x):
+        return self.c * self.kernel.gram(theta, x)
+
+    def cross(self, theta, x_test, x_train):
+        return self.c * self.kernel.cross(theta, x_test, x_train)
+
+    def diag(self, theta, x):
+        return self.c * self.kernel.diag(theta, x)
+
+    def self_diag(self, theta, x):
+        return self.c * self.kernel.self_diag(theta, x)
+
+    def white_noise_var(self, theta):
+        return self.c * self.kernel.white_noise_var(theta)
+
+    def describe(self, theta) -> str:
+        if self.c == 0:
+            return ""
+        return f"{self.c:.1e} * {self.kernel.describe(np.asarray(theta))}"
+
+
+class Scalar:
+    """Scalar-coefficient builder mirroring the reference DSL
+    (ScalarTimesKernel.scala:100-141):
+
+    >>> Scalar(1.0) * k                      # trainable in [0, inf)
+    >>> Scalar(1.0).between(0).and_(30) * k  # trainable in [0, 30]
+    >>> Scalar(1.0).below(10) * k            # trainable in [0, 10]
+    >>> Scalar(1.0).const * k                # fixed
+    """
+
+    def __init__(self, c: float, lower: float = 0.0, upper: float = math.inf, trainable: bool = True):
+        if trainable and not lower < upper:
+            raise ValueError(
+                "The scalar should either have its lower limit below its upper "
+                "limit or not be trainable"
+            )
+        self.c = float(c)
+        self.lower = lower
+        self.upper = upper
+        self.trainable = trainable
+
+    def __mul__(self, kernel: Kernel) -> Kernel:
+        if self.trainable:
+            return TrainableScaleKernel(kernel, self.c, self.lower, self.upper)
+        return ConstScaleKernel(kernel, self.c)
+
+    def between(self, lower: float) -> "_Between":
+        return _Between(self.c, lower, self.trainable)
+
+    def below(self, upper: float) -> "Scalar":
+        return Scalar(self.c, self.lower, upper, self.trainable)
+
+    @property
+    def const(self) -> "Scalar":
+        return Scalar(self.c, self.c, self.c, trainable=False)
+
+
+class _Between:
+    def __init__(self, c: float, lower: float, trainable: bool):
+        self._c = c
+        self._lower = lower
+        self._trainable = trainable
+
+    def and_(self, upper: float) -> Scalar:
+        return Scalar(self._c, self._lower, upper, self._trainable)
+
+
+def Const(c: float) -> Scalar:
+    """``Const(0.5) * k`` — a fixed scalar times a kernel (``0.5.const * k``)."""
+    return Scalar(c).const
+
+
+def WhiteNoiseKernel(initial: float, lower: float, upper: float) -> Kernel:
+    """Trainable white noise: ``(initial between lower and upper) * EyeKernel``
+    (kernel/Kernel.scala:166-169)."""
+    return Scalar(initial, lower, upper) * EyeKernel()

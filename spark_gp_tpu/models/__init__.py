@@ -1,0 +1,3 @@
+"""Model layer: estimators, likelihoods, the PPA solver and active-set
+providers — the TPU-native counterparts of the reference's L3-L5 layers
+(SURVEY.md §1)."""

@@ -1,0 +1,108 @@
+"""Active-set (inducing point) providers.
+
+Counterpart of commons/ActiveSetProvider.scala:13-139.  The SPI takes the
+full training data (host numpy), the kernel spec and the optimal
+hyperparameters, and returns the m active points ``[m, p]``.
+
+* :class:`RandomActiveSetProvider` — uniform sample without replacement
+  (ASP.scala:48-56; the default, GaussianProcessParams.scala:33).
+* :class:`KMeansActiveSetProvider` — centroids of a jitted Lloyd iteration
+  (ASP.scala:26-43 delegates to Spark ML KMeans; here ``lax.scan`` over
+  Lloyd steps, distance matrices on the MXU, k-means++-style seeding by
+  random choice as Spark does by default maxIter 20).
+* :class:`GreedilyOptimizingActiveSetProvider` — Seeger et al. 2003 fast
+  forward selection (ASP.scala:59-136), implemented in ``greedy.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.ops.distance import sq_dist
+
+
+class ActiveSetProvider:
+    """SPI: ``(active_set_size, x, y, kernel, theta_opt, seed) -> [m, p]``."""
+
+    def __call__(
+        self,
+        active_set_size: int,
+        x: np.ndarray,
+        y: np.ndarray,
+        kernel: Kernel,
+        theta_opt: np.ndarray,
+        seed: int,
+    ) -> np.ndarray:
+        raise NotImplementedError
+
+
+class _RandomActiveSetProvider(ActiveSetProvider):
+    """Uniform sample of m training points (ASP.scala:48-56)."""
+
+    def __call__(self, active_set_size, x, y, kernel, theta_opt, seed):
+        rng = np.random.default_rng(seed)
+        n = x.shape[0]
+        m = min(active_set_size, n)
+        idx = rng.choice(n, size=m, replace=False)
+        return np.asarray(x)[idx]
+
+
+RandomActiveSetProvider = _RandomActiveSetProvider()
+
+
+class KMeansActiveSetProvider(ActiveSetProvider):
+    """K-means centroids as the active set (ASP.scala:26-43).
+
+    Jitted Lloyd iterations: the point-to-centroid distance matrix is one
+    MXU matmul per step; assignments via argmin; centroid update via
+    one-hot matmul (segment mean without scatter — TPU-friendly).  Empty
+    clusters keep their previous centroid.
+    """
+
+    def __init__(self, max_iter: int = 20):
+        self.max_iter = max_iter
+
+    def __call__(self, active_set_size, x, y, kernel, theta_opt, seed):
+        x = np.asarray(x)
+        n = x.shape[0]
+        k = min(active_set_size, n)
+        rng = np.random.default_rng(seed)
+        init_idx = rng.choice(n, size=k, replace=False)
+        centroids = jnp.asarray(x[init_idx])
+        xj = jnp.asarray(x)
+
+        centroids = _lloyd(xj, centroids, self.max_iter)
+        return np.asarray(centroids)
+
+
+def _lloyd(x, centroids, max_iter):
+    k = centroids.shape[0]
+
+    def step(c, _):
+        d = sq_dist(x, c)  # [n, k]
+        assign = jnp.argmin(d, axis=1)
+        onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)  # [n, k]
+        counts = jnp.sum(onehot, axis=0)  # [k]
+        sums = jax.lax.dot_general(
+            onehot, x, (((0,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [k, p]
+        new_c = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), c
+        )
+        return new_c, None
+
+    out, _ = jax.lax.scan(jax.jit(step), centroids, None, length=max_iter)
+    return out
+
+
+class GreedilyOptimizingActiveSetProvider(ActiveSetProvider):
+    """Seeger et al. 2003 fast forward selection (ASP.scala:59-136)."""
+
+    def __call__(self, active_set_size, x, y, kernel, theta_opt, seed):
+        from spark_gp_tpu.models.greedy import greedy_active_set
+
+        return greedy_active_set(active_set_size, x, y, kernel, theta_opt, seed)

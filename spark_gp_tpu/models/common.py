@@ -1,0 +1,208 @@
+"""Shared estimator plumbing: the param/flag system and the training skeleton.
+
+Counterparts of commons/GaussianProcessParams.scala (param definitions,
+defaults and fluent setters — names preserved verbatim per the API contract)
+and commons/GaussianProcessCommons.scala (noise-augmented kernel factory,
+expert grouping, hyperparameter optimization driver, PPA model production).
+
+TPU-specific additions: ``setMesh`` (a ``jax.sharding.Mesh`` to shard the
+expert axis over; ``None`` = single device) and ``setCheckpointDir``
+(periodic L-BFGS state checkpointing — the reference has no resume story,
+SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+from spark_gp_tpu.kernels.base import Const, EyeKernel, Kernel
+from spark_gp_tpu.models.active_set import ActiveSetProvider, RandomActiveSetProvider
+from spark_gp_tpu.models import ppa
+from spark_gp_tpu.optimize.lbfgsb import minimize_lbfgsb
+from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+from spark_gp_tpu.parallel.mesh import shard_experts
+from spark_gp_tpu.utils.instrumentation import Instrumentation
+
+
+class GaussianProcessParams:
+    """Fluent parameter mixin; defaults match GaussianProcessParams.scala:32-53."""
+
+    def __init__(self) -> None:
+        self._kernel_factory: Callable[[], Kernel] = _default_kernel_factory
+        self._dataset_size_for_expert: int = 100
+        self._active_set_size: int = 100
+        self._sigma2: float = 1e-3
+        self._active_set_provider: ActiveSetProvider = RandomActiveSetProvider
+        self._max_iter: int = 100
+        self._tol: float = 1e-6
+        self._seed: int = 0
+        self._mesh = None
+        self._checkpoint_dir: Optional[str] = None
+
+    # --- reference setter names (GaussianProcessParams.scala:32-53) -------
+    def setKernel(self, value: Union[Kernel, Callable[[], Kernel]]):
+        """A kernel *factory* (zero-arg callable), or a Kernel spec directly —
+        kernels here are immutable so sharing one spec is safe."""
+        if isinstance(value, Kernel):
+            self._kernel_factory = lambda: value
+        else:
+            self._kernel_factory = value
+        return self
+
+    def setDatasetSizeForExpert(self, value: int):
+        self._dataset_size_for_expert = int(value)
+        return self
+
+    def setActiveSetSize(self, value: int):
+        self._active_set_size = int(value)
+        return self
+
+    def setSigma2(self, value: float):
+        self._sigma2 = float(value)
+        return self
+
+    def setActiveSetProvider(self, value: ActiveSetProvider):
+        self._active_set_provider = value
+        return self
+
+    def setMaxIter(self, value: int):
+        self._max_iter = int(value)
+        return self
+
+    def setTol(self, value: float):
+        self._tol = float(value)
+        return self
+
+    def setSeed(self, value: int):
+        self._seed = int(value)
+        return self
+
+    # --- TPU-native extensions -------------------------------------------
+    def setMesh(self, mesh):
+        """Shard the expert axis over this ``jax.sharding.Mesh`` (1-D)."""
+        self._mesh = mesh
+        return self
+
+    def setCheckpointDir(self, path: Optional[str]):
+        self._checkpoint_dir = path
+        return self
+
+    # snake_case aliases for pythonic call sites
+    set_kernel = setKernel
+    set_dataset_size_for_expert = setDatasetSizeForExpert
+    set_active_set_size = setActiveSetSize
+    set_sigma2 = setSigma2
+    set_active_set_provider = setActiveSetProvider
+    set_max_iter = setMaxIter
+    set_tol = setTol
+    set_seed = setSeed
+    set_mesh = setMesh
+
+    def get_params(self) -> dict:
+        return {
+            "datasetSizeForExpert": self._dataset_size_for_expert,
+            "activeSetSize": self._active_set_size,
+            "sigma2": self._sigma2,
+            "maxIter": self._max_iter,
+            "tol": self._tol,
+            "seed": self._seed,
+        }
+
+
+def _default_kernel_factory() -> Kernel:
+    from spark_gp_tpu.kernels.rbf import RBFKernel
+
+    return RBFKernel()
+
+
+class GaussianProcessCommons(GaussianProcessParams):
+    """Shared training skeleton (GaussianProcessCommons.scala:15-115)."""
+
+    def _get_kernel(self) -> Kernel:
+        """User kernel + sigma2 * I — the noise-augmented model kernel
+        (GaussianProcessCommons.scala:18)."""
+        return self._kernel_factory() + Const(self._sigma2) * EyeKernel()
+
+    def _group(self, x: np.ndarray, y: np.ndarray) -> ExpertData:
+        data = group_for_experts(x, y, self._dataset_size_for_expert)
+        if self._mesh is not None:
+            data = shard_experts(data, self._mesh)
+        return data
+
+    def _optimize_hypers(
+        self,
+        instr: Instrumentation,
+        kernel: Kernel,
+        value_and_grad: Callable,
+        callback=None,
+    ) -> np.ndarray:
+        """L-BFGS-B over the box-constrained hyperparameters
+        (GaussianProcessCommons.scala:66-92)."""
+        instr.log_info("Optimising the kernel hyperparameters")
+        theta0 = kernel.init_theta()
+        lower, upper = kernel.bounds()
+        with instr.phase("optimize_hypers"):
+            res = minimize_lbfgsb(
+                value_and_grad,
+                theta0,
+                lower,
+                upper,
+                max_iter=self._max_iter,
+                tol=self._tol,
+                callback=callback,
+            )
+        instr.log_metric("lbfgs_iters", res.nit)
+        instr.log_metric("lbfgs_nfev", res.nfev)
+        instr.log_metric("final_nll", res.fun)
+        instr.log_info("Optimal kernel: " + kernel.describe(res.theta))
+        return res.theta
+
+    def _projected_process(
+        self,
+        instr: Instrumentation,
+        kernel: Kernel,
+        theta_opt: np.ndarray,
+        x: np.ndarray,
+        y_targets: np.ndarray,
+        data: ExpertData,
+    ) -> ppa.ProjectedProcessRawPredictor:
+        """Active set -> distributed (U1, u2) -> magic solve -> predictor
+        (GaussianProcessCommons.scala:40-59)."""
+        import jax.numpy as jnp
+
+        with instr.phase("active_set"):
+            # The provider receives the noise-augmented model kernel, as the
+            # reference passes getKernel (GaussianProcessCommons.scala:43) —
+            # the greedy provider's Seeger scores divide by its whiteNoiseVar.
+            active = self._active_set_provider(
+                self._active_set_size, x, y_targets, kernel, theta_opt, self._seed,
+            )
+        active = np.asarray(active)
+
+        theta_dev = jnp.asarray(theta_opt, dtype=data.x.dtype)
+        active_dev = jnp.asarray(active, dtype=data.x.dtype)
+        with instr.phase("kmn_stats"):
+            if self._mesh is not None:
+                stats_fn = ppa.make_sharded_kmn_stats(kernel, self._mesh)
+                u1, u2 = stats_fn(theta_dev, active_dev, data)
+            else:
+                import jax
+
+                u1, u2 = jax.jit(
+                    lambda t, a, d: ppa.kmn_stats(kernel, t, a, d)
+                )(theta_dev, active_dev, data)
+
+        with instr.phase("magic_solve"):
+            magic_vector, magic_matrix = ppa.magic_solve(
+                kernel, theta_opt, active, u1, u2
+            )
+
+        return ppa.ProjectedProcessRawPredictor(
+            kernel=kernel,
+            theta=np.asarray(theta_opt, dtype=np.float64),
+            active=active.astype(np.float64),
+            magic_vector=magic_vector,
+            magic_matrix=magic_matrix,
+        )

@@ -1,0 +1,92 @@
+"""Greedy (Seeger et al. 2003) active-set forward selection.
+
+Counterpart of GreedilyOptimizingActiveSetProvider (ASP.scala:59-136): grow
+the active set one point at a time, scoring every candidate with the
+information-gain delta of *Fast Forward Selection to Speed Up Sparse Gaussian
+Process Regression*.
+
+Re-design vs the reference:
+
+* the reference broadcasts ``inv(Kmm)`` and ``inv(sigma2 Kmm + Kmn Knm)`` and
+  loops per-candidate per-expert on executors (ASP.scala:84-136); here each
+  round is dense linear algebra over *all* candidates at once — the expert
+  partition is irrelevant to the math (experts partition the points), so the
+  scores are three batched quadratic forms on the MXU;
+* no explicit inverses: both quadratic forms go through Cholesky solves of
+  the two m x m systems (factor reuse, SURVEY.md §7 hard-part 7).
+
+NaN candidate scores (li^2 <= 0 under float error) are excluded, matching the
+reference's NaN filter (ASP.scala:130-132).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.ops.linalg import chol_solve
+
+
+def greedy_active_set(
+    active_set_size: int,
+    x: np.ndarray,
+    y: np.ndarray,
+    kernel: Kernel,
+    theta_opt: np.ndarray,
+    seed: int,
+) -> np.ndarray:
+    """Select ``m`` active points greedily.  ``kernel`` must be the
+    noise-augmented model kernel (the reference passes ``getKernel``,
+    GaussianProcessCommons.scala:43)."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    n = x.shape[0]
+    m = min(active_set_size, n)
+    rng = np.random.default_rng(seed)
+
+    theta = jnp.asarray(np.asarray(theta_opt, dtype=np.float64))
+    xj = jnp.asarray(x)
+    yj = jnp.asarray(y)
+
+    sigma2 = float(np.asarray(kernel.white_noise_var(theta)))
+    sigma = np.sqrt(sigma2)
+    k_diag_all = kernel.diag(theta, xj)  # includes the +sigma2 noise diagonal
+
+    chosen = [int(rng.integers(n))]
+
+    while len(chosen) < m:
+        active = xj[jnp.asarray(chosen)]
+        kmm = kernel.gram(theta, active)  # [k, k], noise-augmented diagonal
+        cross = kernel.cross(theta, active, xj)  # [k, N]
+
+        kmn_knm = cross @ cross.T
+        kmn_y = cross @ yj
+        pd_mat = sigma2 * kmm + kmn_knm
+
+        l_mm = jnp.linalg.cholesky(kmm)
+        l_pd = jnp.linalg.cholesky(pd_mat)
+
+        kinv_cross = chol_solve(l_mm, cross)  # [k, N]
+        pdinv_cross = chol_solve(l_pd, cross)  # [k, N]
+        magic_vector = chol_solve(l_pd, kmn_y)
+
+        p_i = jnp.sum(cross * kinv_cross, axis=0)
+        q_i = jnp.sum(cross * pdinv_cross, axis=0)
+        mu_i = cross.T @ magic_vector
+
+        li2 = k_diag_all - p_i
+        li = jnp.sqrt(li2)
+        ratio2 = sigma2 / li2  # (sigma / li)^2
+        ksi = 1.0 / (ratio2 + 1.0 - q_i)
+        kappa = ksi * (1.0 + 2.0 * ratio2)
+        delta = -jnp.log(sigma / li) - 0.5 * (
+            jnp.log(ksi) + ksi * (1.0 - kappa) / sigma2 * (yj - mu_i) ** 2 - kappa + 2.0
+        )
+
+        delta = jnp.where(jnp.isnan(delta), -jnp.inf, delta)
+        # exclude already-chosen points (their li^2 ~ 0 usually NaNs anyway)
+        delta = delta.at[jnp.asarray(chosen)].set(-jnp.inf)
+        chosen.append(int(jnp.argmax(delta)))
+
+    return x[np.asarray(chosen)]

@@ -1,0 +1,201 @@
+"""Laplace approximation for binary GP classification.
+
+Per-expert semantics follow GaussianProcessClassifier.likelihoodAndGradient
+(GaussianProcessClassifier.scala:74-129):
+
+* Newton optimization of the latent posterior mode f (R&W Algorithm 3.1)
+  with objective-increase checking and step halving — here a
+  ``lax.while_loop`` whose termination matches the reference's
+  ``|oldObj - newObj| > tol && step > tol``;
+* the approximate log marginal likelihood log Z and its hyperparameter
+  gradient via R&W Algorithm 5.1, including the third-derivative implicit
+  correction (s2/s3 terms).
+
+TPU re-design notes:
+
+* experts are vmapped: ``vmap`` of ``while_loop`` runs all experts until the
+  slowest converges with masked updates — the hardware-friendly equivalent
+  of Spark's independent per-partition loops;
+* dK/dtheta comes from ``jax.jacfwd`` of the (masked) Gram function —
+  exactly the quantities the reference assembles kernel-by-kernel by hand
+  (trainingKernelAndDerivative) but for any composite kernel for free;
+* the Newton loop needs no autodiff through it: Algorithm 5.1's gradient only
+  uses the converged state (implicit-function theorem), so the while_loop is
+  never differentiated;
+* W, gradients and objective terms are masked so padded points contribute
+  exactly nothing (B has unit rows at padding -> logdet contribution 0).
+
+The latent warm start (the reference mutates f inside its cached RDD across
+L-BFGS evaluations, GPClf.scala:53-60) is explicit carried state here: the
+objective returns the new ``f`` stack and the optimizer closure feeds it back.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.ops.linalg import chol_solve as _chol_solve
+from spark_gp_tpu.ops.linalg import masked_kernel_matrix
+from spark_gp_tpu.parallel.experts import ExpertData
+from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+
+
+class _NewtonState(NamedTuple):
+    f: jax.Array
+    old_obj: jax.Array
+    new_obj: jax.Array
+    step: jax.Array
+
+
+def _posterior_terms(kmat, y, mask, f):
+    """Quantities of Algorithms 3.1/5.1 evaluated at latent f."""
+    pi = jax.nn.sigmoid(f)
+    w = pi * (1.0 - pi) * mask
+    sqw = jnp.sqrt(w)
+    b_mat = jnp.eye(kmat.shape[0], dtype=kmat.dtype) + sqw[:, None] * kmat * sqw[None, :]
+    chol_l = jnp.linalg.cholesky(b_mat)
+    grad_log_p = (y - pi) * mask
+    return pi, w, sqw, chol_l, grad_log_p
+
+
+def _newton_a(kmat, w, sqw, chol_l, grad_log_p, f):
+    """a = b - sqrtW B^-1 sqrtW K b with b = W f + grad_log_p
+    (GPClf.scala:100-101)."""
+    b = w * f + grad_log_p
+    return b - sqw * _chol_solve(chol_l, sqw * (kmat @ b))
+
+
+def _objective(a, f_new, y, mask):
+    """-a^T f / 2 + sum log sigmoid((2y-1) * f) over real points
+    (GPClf.scala:102)."""
+    return -0.5 * jnp.dot(a, f_new) + jnp.sum(
+        mask * jax.nn.log_sigmoid((2.0 * y - 1.0) * f_new)
+    )
+
+
+def laplace_mode(kmat, y, mask, f0, tol):
+    """Newton loop with step halving; returns (f_mode, new_obj).
+
+    Termination and acceptance mirror GPClf.scala:91-111: a candidate is
+    accepted iff its objective beats ``old_obj``; otherwise the step halves.
+    """
+    dtype = kmat.dtype
+    init = _NewtonState(
+        f=f0,
+        old_obj=jnp.asarray(-jnp.inf, dtype=dtype),
+        new_obj=jnp.asarray(jnp.finfo(dtype).min, dtype=dtype),
+        step=jnp.asarray(1.0, dtype=dtype),
+    )
+
+    def cond(state: _NewtonState):
+        return jnp.logical_and(
+            jnp.abs(state.old_obj - state.new_obj) > tol, state.step > tol
+        )
+
+    def body(state: _NewtonState):
+        _, w, sqw, chol_l, grad_log_p = _posterior_terms(kmat, y, mask, state.f)
+        a = _newton_a(kmat, w, sqw, chol_l, grad_log_p, state.f)
+        f_cand = (1.0 - state.step) * state.f + state.step * (kmat @ a)
+        obj_cand = _objective(a, f_cand, y, mask)
+        accept = obj_cand > state.old_obj
+        return _NewtonState(
+            f=jnp.where(accept, f_cand, state.f),
+            old_obj=jnp.where(accept, state.new_obj, state.old_obj),
+            new_obj=jnp.where(accept, obj_cand, state.new_obj),
+            step=jnp.where(accept, state.step, state.step / 2.0),
+        )
+
+    final = jax.lax.while_loop(cond, body, init)
+    return final.f, final.new_obj
+
+
+def expert_neg_logz_and_grad(kernel: Kernel, tol, theta, x, y, mask, f0):
+    """One expert's (-log Z, -dlogZ/dtheta, f_mode) — GPClf.scala:74-129."""
+
+    def gram_fn(t):
+        return masked_kernel_matrix(kernel.gram(t, x), mask)
+
+    kmat = gram_fn(theta)
+    f, new_obj = laplace_mode(kmat, y, mask, f0, tol)
+
+    # Recompute converged-state quantities (identical to the reference's
+    # final-iteration values: f no longer changes).
+    pi, w, sqw, chol_l, grad_log_p = _posterior_terms(kmat, y, mask, f)
+    a = _newton_a(kmat, w, sqw, chol_l, grad_log_p, f)
+
+    log_z = new_obj - jnp.sum(jnp.log(jnp.diagonal(chol_l)))
+
+    # Algorithm 5.1 auxiliaries (GPClf.scala:115-126).
+    r_mat = sqw[:, None] * _chol_solve(chol_l, jnp.diag(sqw))
+    c_mat = jax.scipy.linalg.solve_triangular(
+        chol_l, sqw[:, None] * kmat, lower=True
+    )
+    # d^3/df^3 log p(y|f) = -(2 pi - 1) pi (1 - pi)  (GPClf.scala:118 in the
+    # algebraically equivalent pi^2 exp(-f) form).
+    d3_log_p = -(2.0 * pi - 1.0) * pi * (1.0 - pi) * mask
+    s2 = -0.5 * (jnp.diagonal(kmat) - jnp.sum(c_mat * c_mat, axis=0)) * d3_log_p
+
+    dk = jax.jacfwd(gram_fn)(theta)  # [s, s, h]
+
+    s1 = 0.5 * jnp.einsum("s,sth,t->h", a, dk, a) - 0.5 * jnp.einsum(
+        "sth,st->h", dk, r_mat
+    )
+    b_vecs = jnp.einsum("sth,t->sh", dk, grad_log_p)
+    s3 = b_vecs - kmat @ (r_mat @ b_vecs)
+    grad_log_z = s1 + s2 @ s3
+
+    return -log_z, -grad_log_z, f
+
+
+def batched_neg_logz(kernel: Kernel, tol, theta, data: ExpertData, f0):
+    """Sum over the local expert stack; returns (nll, grad, f_stack)."""
+    neg_z, neg_grad, f = jax.vmap(
+        partial(expert_neg_logz_and_grad, kernel, tol),
+        in_axes=(None, 0, 0, 0, 0),
+    )(theta, data.x, data.y, data.mask, f0)
+    return jnp.sum(neg_z), jnp.sum(neg_grad, axis=0), f
+
+
+def make_laplace_objective(kernel: Kernel, data: ExpertData, tol):
+    """Single-device jitted ``(theta, f0) -> (nll, grad, f_new)``."""
+
+    @jax.jit
+    def obj(theta, f0):
+        return batched_neg_logz(kernel, tol, theta, data, f0)
+
+    return lambda theta, f0: obj(theta, f0)
+
+
+def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
+    """Sharded objective: experts and latent state sharded, (value, grad)
+    psum-reduced over ICI — the treeAggregate of GPC.scala:73-78."""
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            P(EXPERT_AXIS),
+            P(EXPERT_AXIS),
+            P(EXPERT_AXIS),
+            P(EXPERT_AXIS),
+        ),
+        out_specs=(P(), P(), P(EXPERT_AXIS)),
+    )
+    def sharded(theta, x, y, mask, f0):
+        local = ExpertData(x=x, y=y, mask=mask)
+        value, grad, f = batched_neg_logz(kernel, tol, theta, local, f0)
+        return (
+            jax.lax.psum(value, EXPERT_AXIS),
+            jax.lax.psum(grad, EXPERT_AXIS),
+            f,
+        )
+
+    return lambda theta, f0: sharded(theta, data.x, data.y, data.mask, f0)

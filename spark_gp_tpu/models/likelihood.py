@@ -1,0 +1,101 @@
+"""BCM (product-of-experts) marginal likelihood for GP regression.
+
+Semantics of GaussianProcessRegression.likelihoodAndGradient
+(GPR.scala:55-68): per expert, with noise-augmented kernel K,
+
+    NLL_e  = 1/2 y^T K^-1 y + 1/2 log|K|          (constant term dropped,
+                                                   as in the reference)
+
+and the BCM objective is the sum over experts
+(GaussianProcessCommons.scala:73-78).  Differences by design:
+
+* one Cholesky replaces the LU + dgetri of util/logDetAndInv.scala — alpha
+  comes from triangular solves, never an explicit inverse;
+* the gradient is ``jax.value_and_grad`` through the Cholesky, replacing the
+  hand-derived trace formula (GPR.scala:63-67) *and* the memoization cache
+  (util/DiffFunctionMemoized.scala) — value and gradient are one fused XLA
+  program, so a line-search re-evaluation costs one call, not two cluster
+  round-trips;
+* experts are a vmapped leading axis; across chips the sum is a ``psum``
+  over ICI inside ``shard_map`` (see :func:`make_sharded_value_and_grad`),
+  replacing Spark ``treeAggregate``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.ops.linalg import (
+    chol_logdet,
+    chol_solve,
+    cholesky,
+    masked_kernel_matrix,
+)
+from spark_gp_tpu.parallel.experts import ExpertData
+from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+
+
+def expert_nll(kernel: Kernel, theta, x, y, mask):
+    """NLL of a single (padded) expert: ``[s, p], [s], [s] -> scalar``."""
+    kmat = masked_kernel_matrix(kernel.gram(theta, x), mask)
+    chol_l = cholesky(kmat)
+    ym = y * mask
+    alpha = chol_solve(chol_l, ym)
+    return 0.5 * jnp.dot(ym, alpha) + 0.5 * chol_logdet(chol_l)
+
+
+def batched_nll(kernel: Kernel, theta, data: ExpertData):
+    """Sum of per-expert NLLs over the local ``[E, s, ...]`` stack (vmap)."""
+    per_expert = jax.vmap(expert_nll, in_axes=(None, None, 0, 0, 0))(
+        kernel, theta, data.x, data.y, data.mask
+    )
+    return jnp.sum(per_expert)
+
+
+def make_value_and_grad(kernel: Kernel, data: ExpertData):
+    """Single-device jitted ``theta -> (nll, grad)``."""
+
+    @jax.jit
+    def vag(theta):
+        return jax.value_and_grad(
+            lambda t: batched_nll(kernel, t, data)
+        )(theta)
+
+    return vag
+
+
+def make_sharded_value_and_grad(kernel: Kernel, data: ExpertData, mesh):
+    """Multi-chip ``theta -> (nll, grad)`` via ``shard_map`` + ``psum``.
+
+    ``theta`` is replicated; the expert stack is sharded on its leading axis;
+    each device reduces its local experts and one ``psum`` over ICI yields the
+    replicated global (scalar, gradient) — the exact communication pattern of
+    the reference's ``treeAggregate`` of ``(Double, BDV)``
+    (GaussianProcessCommons.scala:73-78), minus the driver round-trip.
+    """
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS)),
+        out_specs=(P(), P()),
+    )
+    def sharded(theta, x, y, mask):
+        local = ExpertData(x=x, y=y, mask=mask)
+        value, grad = jax.value_and_grad(
+            lambda t: batched_nll(kernel, t, local)
+        )(theta)
+        return (
+            jax.lax.psum(value, EXPERT_AXIS),
+            jax.lax.psum(grad, EXPERT_AXIS),
+        )
+
+    def vag(theta):
+        return sharded(theta, data.x, data.y, data.mask)
+
+    return vag

@@ -1,0 +1,7 @@
+"""Math building blocks: pairwise distances, Cholesky-based linear algebra,
+Gauss–Hermite integration, feature scaling.
+
+TPU-native replacements for the reference's L1 utilities
+(``commons/util/`` — logDetAndInv.scala, Integrator.scala, Scaling.scala)
+and its linked-in LAPACK/BLAS muscle.
+"""

@@ -1,0 +1,44 @@
+"""Pairwise squared distances, MXU-friendly.
+
+The reference computes squared distances with O(n^2) scalar loops on the JVM
+(RBFKernel.scala:37-48, ARDRBFKernel.scala:43-46).  On TPU the right shape is
+one big matmul: ``|x - y|^2 = |x|^2 + |y|^2 - 2<x, y>``, so the O(n^2 p) work
+rides the 128x128 systolic array instead of scalar units.
+
+``precision=HIGHEST`` keeps the dominant -2<x,y> term in full float32 (six
+bf16 passes on TPU); without it, cancellation between the three terms destroys
+small distances and, downstream, Cholesky stability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sq_dist(x1: jax.Array, x2: jax.Array) -> jax.Array:
+    """``[n1, p], [n2, p] -> [n1, n2]`` matrix of squared Euclidean distances.
+
+    Clamped at zero: the matmul identity can go slightly negative under
+    floating point, and a negative squared distance would poison ``exp``-based
+    kernels' gradients.
+    """
+    # Promote to at least f32: the MXU path for the inner products.
+    n1 = jnp.sum(x1 * x1, axis=-1)[:, None]
+    n2 = jnp.sum(x2 * x2, axis=-1)[None, :]
+    inner = jax.lax.dot_general(
+        x1,
+        x2,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return jnp.maximum(n1 + n2 - 2.0 * inner, 0.0)
+
+
+def weighted_sq_dist(x1: jax.Array, x2: jax.Array, w: jax.Array) -> jax.Array:
+    """Squared distances after scaling each feature dimension by ``w``.
+
+    ``|(x1_i - x2_j) * w|^2`` — the ARD metric (ARDRBFKernel.scala:43-46),
+    computed by pre-scaling rows so the heavy lifting is still one matmul.
+    """
+    return sq_dist(x1 * w, x2 * w)

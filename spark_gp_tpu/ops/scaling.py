@@ -1,0 +1,30 @@
+"""Z-score feature standardization.
+
+Replacement for util/Scaling.scala:9-26, whose two RDD reduce passes (mean,
+then variance of the centered data) become two jnp reductions; the
+cache/unpersist choreography disappears because arrays are device-resident.
+Zero-variance dimensions are clamped to 1 exactly as the reference does
+(Scaling.scala:18).
+
+Like the reference, scaling is *not* applied automatically by the estimators —
+examples opt in (Airfoil.scala:16, MNIST.scala:22).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fit_scaler(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Return ``(mean, std)`` so the same transform can be applied to test data."""
+    mean = jnp.mean(x, axis=0)
+    var = jnp.mean((x - mean) ** 2, axis=0)
+    var = jnp.where(var > 0.0, var, 1.0)
+    return mean, jnp.sqrt(var)
+
+
+def scale(x: jax.Array) -> jax.Array:
+    """Standardize features column-wise: ``(x - mean) / std``."""
+    mean, std = fit_scaler(x)
+    return (x - mean) / std

@@ -1,0 +1,5 @@
+"""Hyperparameter optimizers (box-constrained L-BFGS)."""
+
+from spark_gp_tpu.optimize.lbfgsb import minimize_lbfgsb
+
+__all__ = ["minimize_lbfgsb"]

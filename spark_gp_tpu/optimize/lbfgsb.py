@@ -1,0 +1,101 @@
+"""Box-constrained L-BFGS-B driving a jitted device objective.
+
+The reference uses Breeze's driver-side ``LBFGSB(lower, upper, maxIter, tol)``
+where every objective evaluation is a full Spark cluster round-trip, memoized
+so line-search re-evaluations don't re-launch jobs
+(GaussianProcessCommons.scala:66-92, util/DiffFunctionMemoized.scala).
+
+Here the objective is one fused XLA ``value_and_grad`` executable: an
+evaluation moves (1 + |theta|) floats host<->device — negligible next to the
+compute — so SciPy's L-BFGS-B on the host is the right v0 architecture, and
+memoization is pointless (value+grad is a single pass).  An on-device
+projected L-BFGS (``lax.while_loop``) is the planned v1 for pod-scale runs
+where even the host sync per step matters; the interface below is already
+shaped for that swap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+import scipy.optimize
+
+
+@dataclass
+class OptimizeResult:
+    theta: np.ndarray
+    fun: float
+    nit: int
+    nfev: int
+    success: bool
+    message: str
+    trace: list = field(default_factory=list)
+
+
+def minimize_lbfgsb(
+    value_and_grad: Callable,
+    theta0: np.ndarray,
+    lower: np.ndarray,
+    upper: np.ndarray,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    callback: Optional[Callable] = None,
+) -> OptimizeResult:
+    """Minimize ``value_and_grad`` subject to ``lower <= theta <= upper``.
+
+    ``value_and_grad(theta) -> (float, grad)`` may return device arrays; they
+    are pulled to host (tiny transfers).  ``tol`` maps to both scipy's
+    ``ftol`` and ``gtol`` — the closest match to Breeze LBFGSB's convergence
+    ``tolerance`` (GaussianProcessCommons.scala:84-86).
+    """
+    theta0 = np.asarray(theta0, dtype=np.float64)
+    bounds = list(
+        zip(
+            [None if np.isneginf(lo) else float(lo) for lo in lower],
+            [None if np.isposinf(hi) else float(hi) for hi in upper],
+        )
+    )
+
+    nfev = 0
+
+    def fun(theta):
+        nonlocal nfev
+        nfev += 1
+        value, grad = value_and_grad(theta)
+        value = float(np.asarray(value))
+        grad = np.asarray(grad, dtype=np.float64)
+        if not np.isfinite(value):
+            if nfev == 1:
+                # A non-finite NLL at theta0 means the kernel matrix is not
+                # PD at the *initial* hyperparameters — returning a masked
+                # value here would make L-BFGS-B declare instant convergence.
+                # Surface it like the reference does (MatrixSingularException
+                # -> NotPositiveDefiniteException advice).
+                from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+
+                raise NotPositiveDefiniteException()
+            # Mid-line-search non-PD trial point: return a large finite value
+            # with zero gradient so the Wolfe decrease test fails and the
+            # search backtracks (never accepted as an iterate).
+            return 1e25, np.zeros_like(grad)
+        return value, grad
+
+    res = scipy.optimize.minimize(
+        fun,
+        theta0,
+        jac=True,
+        method="L-BFGS-B",
+        bounds=bounds,
+        callback=callback,
+        options={"maxiter": max_iter, "ftol": tol, "gtol": tol},
+    )
+    return OptimizeResult(
+        theta=np.asarray(res.x, dtype=np.float64),
+        fun=float(res.fun),
+        nit=int(res.nit),
+        nfev=int(res.nfev),
+        success=bool(res.success),
+        message=str(res.message),
+    )

@@ -1,0 +1,18 @@
+"""Distribution layer: expert grouping, device meshes, sharded reductions.
+
+TPU-native replacement for the reference's entire Spark runtime usage
+(SURVEY.md §2.4): the ``groupByKey`` shuffle becomes a pad+reshape, RDD
+partitions become a sharded leading array axis, ``treeAggregate`` becomes
+``psum`` over ICI, and ``broadcast`` becomes replicated sharding.
+"""
+
+from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+from spark_gp_tpu.parallel.mesh import EXPERT_AXIS, expert_mesh, shard_experts
+
+__all__ = [
+    "ExpertData",
+    "group_for_experts",
+    "EXPERT_AXIS",
+    "expert_mesh",
+    "shard_experts",
+]

@@ -1,0 +1,125 @@
+"""Round-robin expert grouping as a pad + reshape.
+
+The reference shuffles points into E = round(N / datasetSizeForExpert) experts
+with a ``zipWithIndex -> key = index % E -> groupByKey`` Spark shuffle
+(GaussianProcessCommons.scala:26-31) — a process-boundary data movement.  On
+TPU the same assignment is a *layout transform*: point ``i`` belongs to expert
+``i % E``, so sorting indices by ``(i % E, i // E)`` and padding the ragged
+tail yields a dense ``[E, s, p]`` stack whose leading axis shards across
+chips.  No communication happens at all until the likelihood reduction.
+
+Per-expert sizes in the reference differ by at most one (mod split); the pad
+mask makes every expert exactly ``ceil(N/E)`` wide and the masked Gram
+embedding (``ops.linalg.masked_kernel_matrix``) keeps padding out of every
+logdet / quadratic form / cross-kernel sum.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class ExpertData:
+    """Dense expert stack.
+
+    ``x``: ``[E, s, p]`` features, padded with copies of the expert's first
+    point (benign values — masked out of every reduction).
+    ``y``: ``[E, s]`` labels, zero-padded.
+    ``mask``: ``[E, s]`` 1.0 for real points, 0.0 for padding.
+    """
+
+    x: jax.Array
+    y: jax.Array
+    mask: jax.Array
+
+    @property
+    def num_experts(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def expert_size(self) -> int:
+        return self.x.shape[1]
+
+    def pad_experts(self, multiple: int) -> "ExpertData":
+        """Pad the expert axis up to a multiple (for even sharding across
+        devices).  Padded experts are fully masked and contribute nothing."""
+        e = self.x.shape[0]
+        target = math.ceil(e / multiple) * multiple
+        if target == e:
+            return self
+        pad = target - e
+        x = jnp.concatenate([self.x, jnp.tile(self.x[:1], (pad, 1, 1))], axis=0)
+        y = jnp.concatenate([self.y, jnp.zeros_like(self.y[:1]).repeat(pad, 0)], axis=0)
+        mask = jnp.concatenate(
+            [self.mask, jnp.zeros_like(self.mask[:1]).repeat(pad, 0)], axis=0
+        )
+        return ExpertData(x=x, y=y, mask=mask)
+
+
+def num_experts_for(n_points: int, dataset_size_for_expert: int) -> int:
+    """E = round(N / s), at least 1 — GaussianProcessCommons.scala:27 uses
+    ``Math.round`` (half-up)."""
+    return max(1, int(math.floor(n_points / dataset_size_for_expert + 0.5)))
+
+
+def group_for_experts(
+    x: np.ndarray,
+    y: np.ndarray,
+    dataset_size_for_expert: int,
+    dtype=None,
+) -> ExpertData:
+    """Group ``(x [N,p], y [N])`` into the ``[E, s, ...]`` expert stack.
+
+    Host-side numpy (this is data layout, not compute): gather indices in
+    round-robin order — expert ``e`` receives points ``e, e+E, e+2E, ...`` —
+    then pad each expert to the common width ``s = ceil(N/E)``.
+    """
+    x = np.asarray(x)
+    y = np.asarray(y)
+    n = x.shape[0]
+    e = num_experts_for(n, dataset_size_for_expert)
+    s = math.ceil(n / e)
+
+    # Number of real points in each expert under `index % E` assignment:
+    # experts 0 .. (n % e - 1) get ceil(n/e), the rest floor(n/e) ... careful:
+    # point i -> expert i % e; expert j holds indices j, j+e, ..., count =
+    # ceil((n - j) / e).
+    counts = np.array([math.ceil((n - j) / e) for j in range(e)])
+
+    xg = np.zeros((e, s, x.shape[1]), dtype=x.dtype)
+    yg = np.zeros((e, s), dtype=y.dtype)
+    mask = np.zeros((e, s), dtype=x.dtype)
+    for j in range(e):
+        idx = np.arange(j, n, e)
+        xg[j, : counts[j]] = x[idx]
+        yg[j, : counts[j]] = y[idx]
+        mask[j, : counts[j]] = 1.0
+        if counts[j] < s and counts[j] > 0:
+            # benign padding features: repeat the first real point
+            xg[j, counts[j] :] = x[idx[0]]
+
+    if dtype is not None:
+        xg = xg.astype(dtype)
+        yg = yg.astype(dtype)
+        mask = mask.astype(dtype)
+    return ExpertData(x=jnp.asarray(xg), y=jnp.asarray(yg), mask=jnp.asarray(mask))
+
+
+def ungroup(values: np.ndarray, n_points: int) -> np.ndarray:
+    """Invert the round-robin grouping: ``[E, s] -> [N]`` in original point
+    order.  Expert ``j`` slot ``t`` holds point ``j + t*E``; padded slots are
+    dropped."""
+    values = np.asarray(values)
+    e, s = values.shape
+    out = np.zeros(n_points, dtype=values.dtype)
+    for j in range(e):
+        idx = np.arange(j, n_points, e)
+        out[idx] = values[j, : len(idx)]
+    return out

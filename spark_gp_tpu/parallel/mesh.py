@@ -1,0 +1,50 @@
+"""Device meshes and sharding helpers for the expert axis.
+
+The reference's parallelism (SURVEY.md §2.3) is exactly one strategy — data
+parallelism over experts with all-reduce — plus broadcast.  Mapping:
+
+* Spark executors          -> devices of a 1-D ``jax.sharding.Mesh``
+* RDD of experts           -> ``[E, ...]`` arrays sharded on ``EXPERT_AXIS``
+* ``treeAggregate``        -> ``jax.lax.psum`` over ICI inside ``shard_map``
+* ``broadcast(activeSet)`` -> replicated sharding (every chip holds the m
+  active points and the m x m factors)
+
+``aggregationDepth`` (declared but never forwarded in the reference,
+GaussianProcessParams.scala:9) has no analogue: the all-reduce tree shape is
+XLA's problem.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+EXPERT_AXIS = "experts"
+
+
+def expert_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or the given) devices, axis name ``experts``."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (EXPERT_AXIS,))
+
+
+def shard_experts(data, mesh: Mesh):
+    """Place an :class:`ExpertData`-like pytree with leading expert axes onto
+    the mesh, sharded on the leading axis, padding E to a device multiple."""
+    from spark_gp_tpu.parallel.experts import ExpertData
+
+    n_dev = mesh.devices.size
+    data = data.pad_experts(n_dev)
+
+    def put(a):
+        spec = P(EXPERT_AXIS, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return ExpertData(x=put(data.x), y=put(data.y), mask=put(data.mask))
+
+
+def replicated(a, mesh: Mesh):
+    """Replicate an array on every device of the mesh (the ``broadcast``)."""
+    return jax.device_put(a, NamedSharding(mesh, P()))

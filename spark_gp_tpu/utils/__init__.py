@@ -1,0 +1,2 @@
+"""Framework utilities: instrumentation, model selection harness,
+serialization, checkpointing."""

@@ -1,0 +1,49 @@
+"""L-BFGS training-state checkpointing.
+
+JAX has no Spark-style lineage recomputation: if a long multi-host fit dies,
+the optimizer state is gone (SURVEY.md §5, failure detection).  This hook
+persists the current hyperparameter iterate each L-BFGS iteration so a
+restarted fit can resume from the best theta via
+``GaussianProcessRegression.setKernel(restored-kernel-with-theta0)`` or by
+passing ``theta0`` directly to the optimizer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+class LbfgsCheckpointer:
+    """Callback for ``scipy.optimize.minimize``: saves theta every iteration."""
+
+    def __init__(self, directory: str, kernel) -> None:
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "lbfgs_state.json")
+        self.kernel = kernel
+        self.iteration = 0
+
+    def __call__(self, theta) -> None:
+        theta = np.asarray(theta, dtype=np.float64)
+        self.iteration += 1
+        payload = {
+            "iteration": self.iteration,
+            "theta": theta.tolist(),
+            "kernel": self.kernel.describe(theta),
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, self.path)
+
+
+def load_checkpoint(directory: str):
+    """Returns ``(iteration, theta)`` or ``None`` if no checkpoint exists."""
+    path = os.path.join(directory, "lbfgs_state.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        payload = json.load(fh)
+    return payload["iteration"], np.asarray(payload["theta"], dtype=np.float64)

@@ -1,0 +1,58 @@
+"""Structured training instrumentation.
+
+Upgrade over the reference's Spark ``Instrumentation`` usage
+(GaussianProcessCommons.scala:69,89,108 — three log lines): named, timed
+phases with a metrics dict, standard :mod:`logging` output, and an optional
+``jax.profiler`` trace context for TPU timeline capture.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+logger = logging.getLogger("spark_gp_tpu")
+
+
+@dataclass
+class Instrumentation:
+    """Collects per-phase wall-clock timings and scalar metrics for one fit."""
+
+    name: str = "gp"
+    timings: Dict[str, float] = field(default_factory=dict)
+    metrics: Dict[str, float] = field(default_factory=dict)
+
+    def log_info(self, msg: str) -> None:
+        logger.info("[%s] %s", self.name, msg)
+
+    @contextlib.contextmanager
+    def phase(self, phase_name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            self.timings[phase_name] = self.timings.get(phase_name, 0.0) + elapsed
+            logger.info("[%s] phase %s: %.3fs", self.name, phase_name, elapsed)
+
+    def log_metric(self, key: str, value: float) -> None:
+        self.metrics[key] = value
+        logger.info("[%s] %s = %s", self.name, key, value)
+
+    def log_success(self) -> None:
+        logger.info("[%s] training succeeded; timings=%s", self.name, self.timings)
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: Optional[str]):
+    """``jax.profiler`` trace context when a directory is given, no-op else."""
+    if trace_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

@@ -1,0 +1,56 @@
+"""Model persistence.
+
+The reference has none (its models are not MLWritable — SURVEY.md §5); this
+is a deliberate capability addition.  A fitted PPA model is small and
+self-contained: theta [h], active set [m, p], magicVector [m],
+magicMatrix [m, m] plus the kernel spec — saved as a single ``.npz`` with the
+kernel spec pickled alongside (kernel specs are plain immutable Python
+objects).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
+
+
+def _normalize(path: str) -> str:
+    """np.savez appends '.npz' to bare paths; keep save/load symmetric."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_model(path: str, model, kind: str) -> None:
+    raw = model.raw_predictor
+    np.savez(
+        _normalize(path),
+        kind=np.array(kind),
+        theta=raw.theta,
+        active=raw.active,
+        magic_vector=raw.magic_vector,
+        magic_matrix=raw.magic_matrix,
+        kernel_pickle=np.frombuffer(
+            pickle.dumps(raw.kernel), dtype=np.uint8
+        ),
+    )
+
+
+def load_model(path: str):
+    from spark_gp_tpu.models.gpc import GaussianProcessClassificationModel
+    from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
+
+    with np.load(_normalize(path), allow_pickle=False) as data:
+        kind = str(data["kind"])
+        kernel = pickle.loads(data["kernel_pickle"].tobytes())
+        raw = ProjectedProcessRawPredictor(
+            kernel=kernel,
+            theta=data["theta"],
+            active=data["active"],
+            magic_vector=data["magic_vector"],
+            magic_matrix=data["magic_matrix"],
+        )
+    if kind == "classification":
+        return GaussianProcessClassificationModel(raw)
+    return GaussianProcessRegressionModel(raw)

@@ -1,0 +1,103 @@
+"""Model-selection harness: cross-validation, train/validation split,
+one-vs-rest multiclass, evaluators.
+
+Thin numpy counterparts of the stock Spark ML meta-algorithms the reference
+examples lean on: ``CrossValidator`` (GPExample.scala:18-24), ``OneVsRest``
+(Iris.scala:27-33), ``TrainValidationSplit`` (MNIST.scala:34-38) and the
+RegressionEvaluator / MulticlassClassificationEvaluator metrics.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Sequence
+
+import numpy as np
+
+
+def rmse(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    err = np.asarray(y_true) - np.asarray(y_pred)
+    return float(np.sqrt(np.mean(err * err)))
+
+
+def accuracy(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    return float(np.mean(np.asarray(y_true) == np.asarray(y_pred)))
+
+
+def kfold_indices(n: int, num_folds: int, seed: int = 0):
+    """Shuffled k-fold split; yields (train_idx, test_idx)."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    folds = np.array_split(perm, num_folds)
+    for i in range(num_folds):
+        test = folds[i]
+        train = np.concatenate([folds[j] for j in range(num_folds) if j != i])
+        yield train, test
+
+
+def cross_validate(
+    estimator,
+    x: np.ndarray,
+    y: np.ndarray,
+    num_folds: int = 10,
+    metric=rmse,
+    seed: int = 0,
+) -> float:
+    """Mean metric over k folds (CrossValidator with an empty param grid —
+    exactly how every reference example uses it)."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    scores = []
+    for train_idx, test_idx in kfold_indices(x.shape[0], num_folds, seed):
+        est = copy.copy(estimator)
+        model = est.fit(x[train_idx], y[train_idx])
+        scores.append(metric(y[test_idx], model.predict(x[test_idx])))
+    return float(np.mean(scores))
+
+
+def train_validation_split(
+    estimator,
+    x: np.ndarray,
+    y: np.ndarray,
+    train_ratio: float = 0.8,
+    metric=accuracy,
+    seed: int = 0,
+) -> float:
+    """Single split fit/eval (TrainValidationSplit, MNIST.scala:34-38)."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(x.shape[0])
+    cut = int(train_ratio * x.shape[0])
+    train_idx, test_idx = perm[:cut], perm[cut:]
+    model = estimator.fit(x[train_idx], y[train_idx])
+    return metric(y[test_idx], model.predict(x[test_idx]))
+
+
+class OneVsRest:
+    """Multiclass reduction over a binary classifier exposing
+    ``predict_raw`` — the counterpart of Spark ML's OneVsRest
+    (Iris.scala:26-27).  Picks the class whose binary model emits the largest
+    positive raw score."""
+
+    def __init__(self, classifier_factory):
+        """``classifier_factory() -> estimator`` (a fresh estimator per class)."""
+        self.classifier_factory = classifier_factory
+        self.models_ = None
+        self.classes_ = None
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> "OneVsRest":
+        x = np.asarray(x)
+        y = np.asarray(y)
+        self.classes_ = np.unique(y)
+        self.models_ = []
+        for cls in self.classes_:
+            est = self.classifier_factory()
+            self.models_.append(est.fit(x, (y == cls).astype(np.float64)))
+        return self
+
+    def predict(self, x_test: np.ndarray) -> np.ndarray:
+        scores = np.stack(
+            [m.predict_raw(x_test)[:, 1] for m in self.models_], axis=1
+        )
+        return self.classes_[np.argmax(scores, axis=1)]

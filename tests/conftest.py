@@ -1,0 +1,38 @@
+"""Test harness configuration.
+
+The TPU analogue of the reference's ``master("local[10]")`` single-JVM
+multi-threaded cluster (GPExample.scala:11): 8 virtual CPU devices via
+``--xla_force_host_platform_device_count`` so every ``psum``-sharded code
+path is exercised without hardware.  float64 is enabled — tests are accuracy
+oracles; the TPU f32 path is covered by dtype-specific tests and the bench.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+# Persistent compile cache: repeated test runs skip recompilation.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    from spark_gp_tpu.parallel.mesh import expert_mesh
+
+    assert len(jax.devices()) == 8, "expected 8 forced host devices"
+    return expert_mesh()

@@ -1,0 +1,58 @@
+"""Expert grouping (pad + reshape) vs the reference's round-robin semantics
+(GaussianProcessCommons.scala:26-31)."""
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu.parallel.experts import group_for_experts, num_experts_for
+
+
+def test_num_experts_rounding():
+    """E = Math.round(N / s) — half-up (GPC.scala:27)."""
+    assert num_experts_for(2000, 100) == 20
+    assert num_experts_for(1503, 100) == 15
+    assert num_experts_for(149, 100) == 1
+    assert num_experts_for(150, 100) == 2  # 1.5 rounds half-up
+    assert num_experts_for(50, 100) == 1  # never 0
+
+
+def test_round_robin_assignment():
+    n, p = 103, 2
+    x = np.arange(n * p, dtype=np.float64).reshape(n, p)
+    y = np.arange(n, dtype=np.float64)
+    data = group_for_experts(x, y, 10)
+    e = data.num_experts
+    assert e == 10
+    # expert j holds points j, j+E, j+2E, ...
+    for j in range(e):
+        idx = np.arange(j, n, e)
+        real = int(np.sum(np.asarray(data.mask)[j]))
+        assert real == len(idx)
+        np.testing.assert_allclose(np.asarray(data.y)[j, :real], y[idx])
+        np.testing.assert_allclose(np.asarray(data.x)[j, :real], x[idx])
+    # all points accounted for exactly once
+    assert int(np.sum(np.asarray(data.mask))) == n
+
+
+def test_padding_masked():
+    x = np.random.default_rng(0).normal(size=(7, 3))
+    y = np.random.default_rng(1).normal(size=7)
+    data = group_for_experts(x, y, 2)  # E = round(3.5) = 4, s = 2
+    assert data.num_experts == 4
+    assert data.expert_size == 2
+    mask = np.asarray(data.mask)
+    assert mask.sum() == 7
+    # padded labels are zero
+    yg = np.asarray(data.y)
+    np.testing.assert_allclose(yg[mask == 0.0], 0.0)
+
+
+def test_pad_experts_to_device_multiple():
+    x = np.random.default_rng(0).normal(size=(30, 2))
+    y = np.zeros(30)
+    data = group_for_experts(x, y, 10)  # E = 3
+    padded = data.pad_experts(8)
+    assert padded.num_experts == 8
+    np.testing.assert_allclose(np.asarray(padded.mask)[3:], 0.0)
+    # original experts intact
+    np.testing.assert_allclose(np.asarray(padded.x)[:3], np.asarray(data.x))

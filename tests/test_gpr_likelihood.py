@@ -1,0 +1,97 @@
+"""BCM likelihood vs an exact-GP oracle.
+
+SURVEY.md §7 step 2: with E = 1 (one expert holding everything) the BCM NLL
+must equal the exact GP marginal likelihood; with E > 1 it must equal the sum
+of independent per-chunk exact NLLs; padding must not change values; autodiff
+gradients must match finite differences of the oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu.kernels import Const, EyeKernel, RBFKernel, WhiteNoiseKernel
+from spark_gp_tpu.models.likelihood import batched_nll, make_value_and_grad
+from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+
+
+def _exact_nll(kernel, theta, x, y):
+    """0.5 y^T K^-1 y + 0.5 log|K| — GPR.scala:55-61 (no constant term)."""
+    kmat = np.asarray(kernel.gram(jnp.asarray(theta), jnp.asarray(x)))
+    sign, logdet = np.linalg.slogdet(kmat)
+    alpha = np.linalg.solve(kmat, y)
+    return 0.5 * float(y @ alpha) + 0.5 * float(logdet)
+
+
+@pytest.fixture
+def problem(rng):
+    n, p = 60, 3
+    x = rng.normal(size=(n, p))
+    y = np.sin(x.sum(axis=1)) + 0.1 * rng.normal(size=n)
+    kernel = RBFKernel(1.2) + Const(1e-2) * EyeKernel()
+    return x, y, kernel
+
+
+def test_single_expert_equals_exact_gp(problem):
+    x, y, kernel = problem
+    theta = kernel.init_theta()
+    data = group_for_experts(x, y, dataset_size_for_expert=1000)  # E = 1
+    assert data.num_experts == 1
+    ours = float(batched_nll(kernel, jnp.asarray(theta), data))
+    oracle = _exact_nll(kernel, theta, x, y)
+    np.testing.assert_allclose(ours, oracle, rtol=1e-9)
+
+
+def test_multi_expert_equals_sum_of_chunk_oracles(problem):
+    x, y, kernel = problem
+    theta = kernel.init_theta()
+    data = group_for_experts(x, y, dataset_size_for_expert=13)
+    e = data.num_experts
+    ours = float(batched_nll(kernel, jnp.asarray(theta), data))
+    oracle = sum(
+        _exact_nll(kernel, theta, x[np.arange(j, x.shape[0], e)], y[np.arange(j, x.shape[0], e)])
+        for j in range(e)
+    )
+    np.testing.assert_allclose(ours, oracle, rtol=1e-9)
+
+
+def test_padding_invariance(problem):
+    """Fully-masked extra experts and padded tails change nothing."""
+    x, y, kernel = problem
+    theta = jnp.asarray(kernel.init_theta())
+    data = group_for_experts(x, y, dataset_size_for_expert=13)
+    padded = data.pad_experts(8)
+    v1 = float(batched_nll(kernel, theta, data))
+    v2 = float(batched_nll(kernel, theta, padded))
+    np.testing.assert_allclose(v1, v2, rtol=1e-12)
+
+
+def test_value_and_grad_matches_fd(problem):
+    x, y, kernel = problem
+    data = group_for_experts(x, y, dataset_size_for_expert=20)
+    vag = make_value_and_grad(kernel, data)
+    theta0 = kernel.init_theta()
+    value, grad = vag(jnp.asarray(theta0))
+
+    h = 1e-6
+    fd = np.zeros_like(theta0)
+    for i in range(theta0.size):
+        tp, tm = theta0.copy(), theta0.copy()
+        tp[i] += h
+        tm[i] -= h
+        fd[i] = (float(vag(jnp.asarray(tp))[0]) - float(vag(jnp.asarray(tm))[0])) / (
+            2 * h
+        )
+    np.testing.assert_allclose(np.asarray(grad), fd, rtol=1e-5, atol=1e-8)
+
+
+def test_trainable_noise_gradient(problem):
+    """Gradient flows into WhiteNoise coefficient and scalar amplitude."""
+    x, y, _ = problem
+    kernel = 1.0 * RBFKernel(0.8) + WhiteNoiseKernel(0.5, 0, 1) + Const(1e-3) * EyeKernel()
+    data = group_for_experts(x, y, dataset_size_for_expert=20)
+    vag = make_value_and_grad(kernel, data)
+    _, grad = vag(jnp.asarray(kernel.init_theta()))
+    assert np.all(np.isfinite(np.asarray(grad)))
+    assert np.any(np.abs(np.asarray(grad)) > 0)

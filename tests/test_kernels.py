@@ -1,0 +1,170 @@
+"""Kernel algebra tests.
+
+Ports the reference's test strategy (RBFKernelTest.scala,
+ARDRBFKernelTest.scala — SURVEY.md §4): golden 3x3 matrices on the same
+3-point 2-d fixture, finite-difference derivative oracles (now through
+``jax.test_util.check_grads`` + explicit FD), cross-kernel values, plus new
+coverage the reference lacks: composition DSL bounds/slicing, white-noise
+accounting, Eye behavior.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu.kernels import (
+    ARDRBFKernel,
+    Const,
+    EyeKernel,
+    RBFKernel,
+    Scalar,
+    WhiteNoiseKernel,
+)
+
+# The reference's fixture: RBFKernelTest.scala:27
+DATASET = np.array([[1.0, 2.0], [2.0, 3.0], [5.0, 7.0]])
+
+
+def test_rbf_golden_matrix():
+    """Golden values from RBFKernelTest.scala:33-38 (sigma = sqrt(0.2))."""
+    k = RBFKernel(np.sqrt(0.2))
+    gram = np.asarray(k.gram(jnp.asarray(k.init_theta()), jnp.asarray(DATASET)))
+    expected = np.array(
+        [
+            [1.000000e00, 6.737947e-03, 3.053624e-45],
+            [6.737947e-03, 1.000000e00, 7.187782e-28],
+            [3.053624e-45, 7.187782e-28, 1.000000e00],
+        ]
+    )
+    np.testing.assert_allclose(gram, expected, atol=1e-4)
+
+
+def test_rbf_cross_golden():
+    """RBFKernelTest.scala:62-76: cross kernel of first point vs rest."""
+    k = RBFKernel(np.sqrt(0.2))
+    theta = jnp.asarray(k.init_theta())
+    cross = np.asarray(
+        k.cross(theta, jnp.asarray(DATASET[:1]), jnp.asarray(DATASET[1:]))
+    )
+    np.testing.assert_allclose(
+        cross, np.array([[6.737947e-03, 3.053624e-45]]), atol=1e-4
+    )
+
+
+def _fd_grad(fn, theta, h=1e-6):
+    theta = np.asarray(theta, dtype=np.float64)
+    grad = np.zeros_like(theta)
+    for i in range(theta.size):
+        tp, tm = theta.copy(), theta.copy()
+        tp[i] += h
+        tm[i] -= h
+        grad[i] = (fn(tp) - fn(tm)) / (2 * h)
+    return grad
+
+
+@pytest.mark.parametrize(
+    "kernel",
+    [
+        RBFKernel(0.2),
+        ARDRBFKernel(np.array([0.2, 0.3])),
+        1.0 * RBFKernel(0.5),
+        1.0 * ARDRBFKernel(2, beta=0.7) + WhiteNoiseKernel(0.5, 0, 1),
+        Scalar(2.0).between(0).and_(30) * RBFKernel(0.3) + Const(0.1) * EyeKernel(),
+    ],
+    ids=["rbf", "ard", "scaled-rbf", "composite", "dsl-composite"],
+)
+def test_gram_autodiff_matches_finite_difference(kernel):
+    """The FD oracle of RBFKernelTest.scala:41-60 / ARDRBFKernelTest.scala:11-31,
+    applied to autodiff gradients of a scalar functional of the Gram matrix."""
+    x = jnp.asarray(DATASET)
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(3, 3)))
+
+    def functional(theta):
+        return float(jnp.sum(w * kernel.gram(jnp.asarray(theta), x)))
+
+    theta0 = kernel.init_theta()
+    auto = np.asarray(
+        jax.grad(lambda t: jnp.sum(w * kernel.gram(t, x)))(jnp.asarray(theta0))
+    )
+    fd = _fd_grad(functional, theta0)
+    np.testing.assert_allclose(auto, fd, rtol=1e-5, atol=1e-7)
+
+
+def test_eye_kernel():
+    k = EyeKernel()
+    theta = jnp.zeros((0,))
+    x = jnp.asarray(DATASET)
+    np.testing.assert_allclose(np.asarray(k.gram(theta, x)), np.eye(3))
+    np.testing.assert_allclose(
+        np.asarray(k.cross(theta, x[:2], x)), np.zeros((2, 3))
+    )
+    assert float(k.white_noise_var(theta)) == 1.0
+    np.testing.assert_allclose(np.asarray(k.self_diag(theta, x)), np.ones(3))
+
+
+def test_white_noise_kernel_dsl():
+    """WhiteNoiseKernel(init, lo, hi) = (init between lo and hi) * Eye
+    (kernel/Kernel.scala:166-169)."""
+    k = WhiteNoiseKernel(0.5, 0.0, 1.0)
+    assert k.n_hypers == 1
+    np.testing.assert_allclose(k.init_theta(), [0.5])
+    lo, hi = k.bounds()
+    np.testing.assert_allclose(lo, [0.0])
+    np.testing.assert_allclose(hi, [1.0])
+    theta = jnp.asarray([0.25])
+    x = jnp.asarray(DATASET)
+    np.testing.assert_allclose(np.asarray(k.gram(theta, x)), 0.25 * np.eye(3))
+    assert float(k.white_noise_var(theta)) == 0.25
+
+
+def test_composite_theta_layout():
+    """Sum concatenates children; trainable scalar prepends its coefficient
+    (SumOfKernels.scala:19-26, ScalarTimesKernel.scala:78-84)."""
+    k = 1.0 * RBFKernel(0.1, 1e-6, 10) + WhiteNoiseKernel(0.5, 0, 1)
+    assert k.n_hypers == 3
+    np.testing.assert_allclose(k.init_theta(), [1.0, 0.1, 0.5])
+    lo, hi = k.bounds()
+    np.testing.assert_allclose(lo, [0.0, 1e-6, 0.0])
+    np.testing.assert_allclose(hi, [np.inf, 10.0, 1.0])
+
+
+def test_const_scale_has_no_hypers():
+    k = Const(0.5) * RBFKernel(0.2)
+    assert k.n_hypers == 1  # only the RBF sigma
+    x = jnp.asarray(DATASET)
+    theta = jnp.asarray(k.init_theta())
+    inner = RBFKernel(0.2)
+    np.testing.assert_allclose(
+        np.asarray(k.gram(theta, x)),
+        0.5 * np.asarray(inner.gram(theta, x)),
+    )
+
+
+def test_negative_scalar_rejected():
+    with pytest.raises(ValueError):
+        Scalar(-1.0) * RBFKernel()
+
+
+def test_white_noise_var_composes():
+    """whiteNoiseVar sums across Sum and scales through Scalar
+    (SumOfKernels.scala:62, ScalarTimesKernel.scala:28)."""
+    k = RBFKernel(1.0) + Const(1e-3) * EyeKernel()
+    theta = jnp.asarray(k.init_theta())
+    assert float(k.white_noise_var(theta)) == pytest.approx(1e-3)
+    k2 = RBFKernel(1.0) + WhiteNoiseKernel(0.5, 0, 1) + Const(1e-3) * EyeKernel()
+    theta2 = jnp.asarray(k2.init_theta())
+    assert float(k2.white_noise_var(theta2)) == pytest.approx(0.5 + 1e-3)
+
+
+def test_ard_matches_reference_convention():
+    """ARD uses exp(-|(xi-xj)*beta|^2) — beta multiplies, no 1/2 factor
+    (ARDRBFKernel.scala:43-46)."""
+    beta = np.array([0.2, 0.3])
+    k = ARDRBFKernel(beta)
+    x = jnp.asarray(DATASET)
+    gram = np.asarray(k.gram(jnp.asarray(beta), x))
+    diff = DATASET[0] - DATASET[1]
+    expected01 = np.exp(-np.sum((diff * beta) ** 2))
+    np.testing.assert_allclose(gram[0, 1], expected01, rtol=1e-12)
+    np.testing.assert_allclose(np.diag(gram), np.ones(3), rtol=1e-12)

@@ -1,0 +1,85 @@
+"""Cholesky linalg + masking tests — coverage the reference lacks entirely
+(its logDetAndInv is tested only transitively, SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu.ops.linalg import (
+    NotPositiveDefiniteException,
+    check_pd_status,
+    chol_logdet,
+    chol_solve,
+    cholesky,
+    is_pd,
+    masked_kernel_matrix,
+    posdef_inverse,
+)
+
+
+def _random_spd(n, rng, jitter=1e-3):
+    a = rng.normal(size=(n, n))
+    return a @ a.T + jitter * np.eye(n)
+
+
+def test_logdet_matches_numpy(rng):
+    mat = _random_spd(20, rng)
+    chol_l = cholesky(jnp.asarray(mat))
+    sign, logdet = np.linalg.slogdet(mat)
+    assert sign > 0
+    np.testing.assert_allclose(float(chol_logdet(chol_l)), logdet, rtol=1e-10)
+
+
+def test_chol_solve_matches_numpy(rng):
+    mat = _random_spd(20, rng)
+    b = rng.normal(size=20)
+    chol_l = cholesky(jnp.asarray(mat))
+    np.testing.assert_allclose(
+        np.asarray(chol_solve(chol_l, jnp.asarray(b))),
+        np.linalg.solve(mat, b),
+        rtol=1e-8,
+    )
+
+
+def test_posdef_inverse(rng):
+    mat = _random_spd(15, rng)
+    inv, ok = posdef_inverse(jnp.asarray(mat))
+    assert bool(ok)
+    np.testing.assert_allclose(np.asarray(inv), np.linalg.inv(mat), rtol=1e-7)
+
+
+def test_non_pd_detected():
+    mat = jnp.asarray(np.array([[1.0, 2.0], [2.0, 1.0]]))  # indefinite
+    chol_l = cholesky(mat)
+    assert not bool(is_pd(chol_l))
+    with pytest.raises(NotPositiveDefiniteException):
+        check_pd_status(is_pd(chol_l))
+
+
+def test_masked_kernel_matrix_identity_padding(rng):
+    mat = _random_spd(6, rng)
+    mask = np.array([1.0, 1.0, 1.0, 1.0, 0.0, 0.0])
+    masked = np.asarray(masked_kernel_matrix(jnp.asarray(mat), jnp.asarray(mask)))
+    np.testing.assert_allclose(masked[:4, :4], mat[:4, :4])
+    np.testing.assert_allclose(masked[4:, :4], 0.0)
+    np.testing.assert_allclose(masked[:4, 4:], 0.0)
+    np.testing.assert_allclose(masked[4:, 4:], np.eye(2))
+
+
+def test_masked_logdet_equals_submatrix(rng):
+    """Padded embedding must not change logdet or solves — SURVEY.md §7
+    hard-part 5."""
+    mat = _random_spd(6, rng)
+    mask = np.array([1.0] * 4 + [0.0] * 2)
+    masked = masked_kernel_matrix(jnp.asarray(mat), jnp.asarray(mask))
+    chol_full = cholesky(masked)
+    chol_sub = cholesky(jnp.asarray(mat[:4, :4]))
+    np.testing.assert_allclose(
+        float(chol_logdet(chol_full)), float(chol_logdet(chol_sub)), rtol=1e-10
+    )
+    b = rng.normal(size=6)
+    bm = b * mask
+    sol = np.asarray(chol_solve(chol_full, jnp.asarray(bm)))
+    sol_sub = np.asarray(chol_solve(chol_sub, jnp.asarray(b[:4])))
+    np.testing.assert_allclose(sol[:4], sol_sub, rtol=1e-8)
+    np.testing.assert_allclose(sol[4:], 0.0, atol=1e-12)

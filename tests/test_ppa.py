@@ -1,0 +1,125 @@
+"""Projected Process Approximation vs a dense oracle.
+
+The reference never unit-tests this algebra (SURVEY.md §4); here every piece
+is checked against a straight dense-numpy transcription of
+ProjectedGaussianProcessHelper.scala / R&W ch. 8.3.4 formulas.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu.kernels import Const, EyeKernel, RBFKernel
+from spark_gp_tpu.models import ppa
+from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
+from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+from spark_gp_tpu.parallel.experts import group_for_experts
+
+
+@pytest.fixture
+def setup(rng):
+    n, p, m = 80, 2, 12
+    x = rng.normal(size=(n, p))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=n)
+    active = x[rng.choice(n, m, replace=False)]
+    sigma2 = 1e-2
+    kernel = RBFKernel(1.0) + Const(sigma2) * EyeKernel()
+    theta = kernel.init_theta()
+    return x, y, active, kernel, theta, sigma2
+
+
+def _dense_cross(kernel, theta, a, x):
+    return np.asarray(kernel.cross(jnp.asarray(theta), jnp.asarray(a), jnp.asarray(x)))
+
+
+def test_kmn_stats_match_dense(setup):
+    x, y, active, kernel, theta, _ = setup
+    data = group_for_experts(x, y, dataset_size_for_expert=17)
+    u1, u2 = ppa.kmn_stats(
+        kernel, jnp.asarray(theta), jnp.asarray(active), data
+    )
+    kmn = _dense_cross(kernel, theta, active, x)  # [m, n]
+    np.testing.assert_allclose(np.asarray(u1), kmn @ kmn.T, rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(u2), kmn @ y, rtol=1e-9)
+
+
+def test_magic_solve_matches_dense_formulas(setup):
+    x, y, active, kernel, theta, sigma2 = setup
+    kmn = _dense_cross(kernel, theta, active, x)
+    u1, u2 = kmn @ kmn.T, kmn @ y
+    magic_vector, magic_matrix = ppa.magic_solve(kernel, theta, active, u1, u2)
+
+    # dense oracle — PGPH.scala:49-60 with the noise-augmented K_mm
+    kmm = np.asarray(kernel.gram(jnp.asarray(theta), jnp.asarray(active)))
+    sn2 = float(np.asarray(kernel.white_noise_var(jnp.asarray(theta))))
+    assert sn2 == pytest.approx(sigma2)
+    pd = sn2 * kmm + u1
+    np.testing.assert_allclose(magic_vector, np.linalg.solve(pd, u2), rtol=1e-8)
+    np.testing.assert_allclose(
+        magic_matrix,
+        sn2 * np.linalg.inv(pd) - np.linalg.inv(kmm),
+        rtol=1e-7,
+        atol=1e-10,
+    )
+
+
+def test_predictor_mean_var_match_dense(setup):
+    x, y, active, kernel, theta, _ = setup
+    kmn = _dense_cross(kernel, theta, active, x)
+    magic_vector, magic_matrix = ppa.magic_solve(
+        kernel, theta, active, kmn @ kmn.T, kmn @ y
+    )
+    raw = ProjectedProcessRawPredictor(
+        kernel=kernel,
+        theta=theta,
+        active=active,
+        magic_vector=magic_vector,
+        magic_matrix=magic_matrix,
+    )
+    x_test = x[:7]
+    mean, var = raw(x_test)
+    cross = _dense_cross(kernel, theta, x_test, active)  # [t, m]
+    self_k = np.asarray(
+        kernel.self_diag(jnp.asarray(theta), jnp.asarray(x_test))
+    )
+    np.testing.assert_allclose(np.asarray(mean), cross @ magic_vector, rtol=1e-8)
+    np.testing.assert_allclose(
+        np.asarray(var),
+        self_k + np.einsum("tm,mk,tk->t", cross, magic_matrix, cross),
+        rtol=1e-7,
+    )
+
+
+def test_ppa_approaches_exact_gp_as_active_grows(rng):
+    """With the active set = the full training set, the PPA posterior mean
+    approaches the exact GP posterior mean.  Not exactly: the reference (and
+    we, for parity) use the noise-augmented K_mm in the normal equations
+    (PGPH.scala:54-55), which perturbs the system by O(sigma^4) relative to
+    R&W 8.3.4 — hence the loose tolerance."""
+    n, p = 40, 1
+    x = np.linspace(0, 1, n).reshape(n, 1)
+    y = np.sin(3 * x[:, 0]) + 0.01 * rng.normal(size=n)
+    sigma2 = 1e-2
+    kernel = RBFKernel(0.3) + Const(sigma2) * EyeKernel()
+    theta = kernel.init_theta()
+
+    kmn = _dense_cross(kernel, theta, x, x)
+    magic_vector, _ = ppa.magic_solve(kernel, theta, x, kmn @ kmn.T, kmn @ y)
+    raw = ProjectedProcessRawPredictor(
+        kernel=kernel, theta=theta, active=x,
+        magic_vector=magic_vector, magic_matrix=np.zeros((n, n)),
+    )
+    mean, _ = raw(x)
+
+    # exact GP: K_noisy^-1 y against the *noiseless* cross kernel
+    k_noisy = np.asarray(kernel.gram(jnp.asarray(theta), jnp.asarray(x)))
+    cross_nf = _dense_cross(kernel, theta, x, x)  # Eye contributes 0 cross
+    exact_mean = cross_nf @ np.linalg.solve(k_noisy, y)
+    np.testing.assert_allclose(np.asarray(mean), exact_mean, rtol=5e-3, atol=5e-3)
+
+
+def test_non_pd_raises_with_advice(setup):
+    x, y, active, kernel, theta, _ = setup
+    u1 = -np.eye(active.shape[0])  # force a non-PD system
+    with pytest.raises(NotPositiveDefiniteException, match="sigma2"):
+        ppa.magic_solve(kernel, theta, active, u1, np.zeros(active.shape[0]))
