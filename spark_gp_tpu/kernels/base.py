@@ -55,6 +55,19 @@ class Kernel:
 
     n_hypers: int = 0
 
+    def _spec(self) -> tuple:
+        """Hashable identity of this kernel spec.  Kernels are immutable, so
+        (type, spec) equality lets them be ``static_argnums`` of module-level
+        ``jax.jit`` functions — compiled executables are then shared across
+        estimator instances and repeated fits."""
+        return ()
+
+    def __hash__(self) -> int:
+        return hash((type(self), self._spec()))
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and self._spec() == other._spec()
+
     def init_theta(self) -> np.ndarray:
         return np.zeros((0,), dtype=np.float64)
 
@@ -141,6 +154,9 @@ class SumKernel(Kernel):
         self.k2 = k2
         self.n_hypers = k1.n_hypers + k2.n_hypers
 
+    def _spec(self) -> tuple:
+        return (self.k1, self.k2)
+
     def _split(self, theta):
         return theta[: self.k1.n_hypers], theta[self.k1.n_hypers :]
 
@@ -191,6 +207,9 @@ class TrainableScaleKernel(Kernel):
         self.upper = float(upper)
         self.n_hypers = 1 + kernel.n_hypers
 
+    def _spec(self) -> tuple:
+        return (self.kernel, self.c0, self.lower, self.upper)
+
     def init_theta(self):
         return np.concatenate([[self.c0], self.kernel.init_theta()])
 
@@ -231,6 +250,9 @@ class ConstScaleKernel(Kernel):
         self.kernel = kernel
         self.c = float(c)
         self.n_hypers = kernel.n_hypers
+
+    def _spec(self) -> tuple:
+        return (self.kernel, self.c)
 
     def init_theta(self):
         return self.kernel.init_theta()

@@ -34,6 +34,9 @@ class RBFKernel(StationaryKernel):
         self.lower = float(lower)
         self.upper = float(upper)
 
+    def _spec(self) -> tuple:
+        return (self.sigma0, self.lower, self.upper)
+
     def init_theta(self):
         return np.array([self.sigma0], dtype=np.float64)
 
@@ -79,6 +82,13 @@ class ARDRBFKernel(StationaryKernel):
         self.upper_b = np.broadcast_to(
             np.asarray(upper, dtype=np.float64), beta0.shape
         ).copy()
+
+    def _spec(self) -> tuple:
+        return (
+            tuple(self.beta0.tolist()),
+            tuple(self.lower_b.tolist()),
+            tuple(self.upper_b.tolist()),
+        )
 
     def init_theta(self):
         return self.beta0.copy()

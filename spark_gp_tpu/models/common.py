@@ -188,11 +188,9 @@ class GaussianProcessCommons(GaussianProcessParams):
                 stats_fn = ppa.make_sharded_kmn_stats(kernel, self._mesh)
                 u1, u2 = stats_fn(theta_dev, active_dev, data)
             else:
-                import jax
-
-                u1, u2 = jax.jit(
-                    lambda t, a, d: ppa.kmn_stats(kernel, t, a, d)
-                )(theta_dev, active_dev, data)
+                u1, u2 = ppa.kmn_stats_jit(
+                    kernel, theta_dev, active_dev, data.x, data.y, data.mask
+                )
 
         with instr.phase("magic_solve"):
             magic_vector, magic_matrix = ppa.magic_solve(

@@ -86,11 +86,16 @@ def laplace_mode(kmat, y, mask, f0, tol):
     accepted iff its objective beats ``old_obj``; otherwise the step halves.
     """
     dtype = kmat.dtype
+    # Deriving the scalar carry from f0 (0 * sum) keeps its device-variance
+    # type consistent with the data under shard_map: a literal constant is
+    # "replicated" while the body's outputs are "varying", and lax.while_loop
+    # requires the carry types to match.
+    zero = jnp.zeros((), dtype=dtype) + 0.0 * jnp.sum(f0)
     init = _NewtonState(
         f=f0,
-        old_obj=jnp.asarray(-jnp.inf, dtype=dtype),
-        new_obj=jnp.asarray(jnp.finfo(dtype).min, dtype=dtype),
-        step=jnp.asarray(1.0, dtype=dtype),
+        old_obj=zero - jnp.inf,
+        new_obj=zero + jnp.finfo(dtype).min,
+        step=zero + 1.0,
     )
 
     def cond(state: _NewtonState):
@@ -162,21 +167,25 @@ def batched_neg_logz(kernel: Kernel, tol, theta, data: ExpertData, f0):
     return jnp.sum(neg_z), jnp.sum(neg_grad, axis=0), f
 
 
+@partial(jax.jit, static_argnums=(0, 1))
+def _laplace_impl(kernel: Kernel, tol, theta, x, y, mask, f0):
+    data = ExpertData(x=x, y=y, mask=mask)
+    return batched_neg_logz(kernel, tol, theta, data, f0)
+
+
 def make_laplace_objective(kernel: Kernel, data: ExpertData, tol):
-    """Single-device jitted ``(theta, f0) -> (nll, grad, f_new)``."""
+    """Single-device jitted ``(theta, f0) -> (nll, grad, f_new)``.  Kernel and
+    tol are static args of a module-level jit (executable reuse across fits)."""
 
-    @jax.jit
     def obj(theta, f0):
-        return batched_neg_logz(kernel, tol, theta, data, f0)
+        theta = jnp.asarray(theta, dtype=data.x.dtype)
+        return _laplace_impl(kernel, float(tol), theta, data.x, data.y, data.mask, f0)
 
-    return lambda theta, f0: obj(theta, f0)
+    return obj
 
 
-def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
-    """Sharded objective: experts and latent state sharded, (value, grad)
-    psum-reduced over ICI — the treeAggregate of GPC.scala:73-78."""
-
-    @jax.jit
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _sharded_laplace_impl(kernel: Kernel, tol, mesh, theta, x, y, mask, f0):
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -189,13 +198,29 @@ def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
         ),
         out_specs=(P(), P(), P(EXPERT_AXIS)),
     )
-    def sharded(theta, x, y, mask, f0):
-        local = ExpertData(x=x, y=y, mask=mask)
-        value, grad, f = batched_neg_logz(kernel, tol, theta, local, f0)
+    def sharded(theta_, x_, y_, mask_, f0_):
+        local = ExpertData(x=x_, y=y_, mask=mask_)
+        value, grad, f = batched_neg_logz(kernel, tol, theta_, local, f0_)
+        # The Laplace gradient is assembled manually (Alg 5.1), not by
+        # differentiating w.r.t. the replicated theta, so unlike the GPR
+        # path it DOES need its own psum.
         return (
             jax.lax.psum(value, EXPERT_AXIS),
             jax.lax.psum(grad, EXPERT_AXIS),
             f,
         )
 
-    return lambda theta, f0: sharded(theta, data.x, data.y, data.mask, f0)
+    return sharded(theta, x, y, mask, f0)
+
+
+def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
+    """Sharded objective: experts and latent state sharded, (value, grad)
+    psum-reduced over ICI — the treeAggregate of GPC.scala:73-78."""
+
+    def obj(theta, f0):
+        theta = jnp.asarray(theta, dtype=data.x.dtype)
+        return _sharded_laplace_impl(
+            kernel, float(tol), mesh, theta, data.x, data.y, data.mask, f0
+        )
+
+    return obj

@@ -57,16 +57,47 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData):
     return jnp.sum(per_expert)
 
 
-def make_value_and_grad(kernel: Kernel, data: ExpertData):
-    """Single-device jitted ``theta -> (nll, grad)``."""
+@partial(jax.jit, static_argnums=0)
+def _vag_impl(kernel: Kernel, theta, x, y, mask):
+    data = ExpertData(x=x, y=y, mask=mask)
+    return jax.value_and_grad(lambda t: batched_nll(kernel, t, data))(theta)
 
-    @jax.jit
+
+def make_value_and_grad(kernel: Kernel, data: ExpertData):
+    """Single-device jitted ``theta -> (nll, grad)``.
+
+    The kernel spec is a static (hashable) argument of a module-level jit, so
+    the compiled executable is reused across estimator instances and fits —
+    this matters on runtimes with high per-dispatch/retrace latency.
+    """
+
     def vag(theta):
-        return jax.value_and_grad(
-            lambda t: batched_nll(kernel, t, data)
-        )(theta)
+        theta = jnp.asarray(theta, dtype=data.x.dtype)
+        return _vag_impl(kernel, theta, data.x, data.y, data.mask)
 
     return vag
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _sharded_vag_impl(kernel: Kernel, mesh, theta, x, y, mask):
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS)),
+        out_specs=(P(), P()),
+    )
+    def sharded(theta_, x_, y_, mask_):
+        local = ExpertData(x=x_, y=y_, mask=mask_)
+        value, grad = jax.value_and_grad(
+            lambda t: batched_nll(kernel, t, local)
+        )(theta_)
+        # theta is replicated (P()): shard_map's transpose already inserts
+        # the cross-device psum for its gradient, so only the value needs an
+        # explicit all-reduce here (psum-ing grad too would multiply it by
+        # the device count).
+        return jax.lax.psum(value, EXPERT_AXIS), grad
+
+    return sharded(theta, x, y, mask)
 
 
 def make_sharded_value_and_grad(kernel: Kernel, data: ExpertData, mesh):
@@ -78,24 +109,9 @@ def make_sharded_value_and_grad(kernel: Kernel, data: ExpertData, mesh):
     the reference's ``treeAggregate`` of ``(Double, BDV)``
     (GaussianProcessCommons.scala:73-78), minus the driver round-trip.
     """
-    @jax.jit
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS)),
-        out_specs=(P(), P()),
-    )
-    def sharded(theta, x, y, mask):
-        local = ExpertData(x=x, y=y, mask=mask)
-        value, grad = jax.value_and_grad(
-            lambda t: batched_nll(kernel, t, local)
-        )(theta)
-        return (
-            jax.lax.psum(value, EXPERT_AXIS),
-            jax.lax.psum(grad, EXPERT_AXIS),
-        )
 
     def vag(theta):
-        return sharded(theta, data.x, data.y, data.mask)
+        theta = jnp.asarray(theta, dtype=data.x.dtype)
+        return _sharded_vag_impl(kernel, mesh, theta, data.x, data.y, data.mask)
 
     return vag

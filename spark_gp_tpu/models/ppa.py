@@ -69,26 +69,37 @@ def kmn_stats(kernel: Kernel, theta, active, data: ExpertData):
     return jnp.sum(u1, axis=0), jnp.sum(u2, axis=0)
 
 
-def make_sharded_kmn_stats(kernel: Kernel, mesh):
-    """Sharded (U1, u2) accumulation: active set replicated (the broadcast,
-    PGPH.scala:23), experts sharded, one psum over ICI (PGPH.scala:25-35)."""
+@partial(jax.jit, static_argnums=0)
+def kmn_stats_jit(kernel: Kernel, theta, active, x, y, mask):
+    return kmn_stats(kernel, theta, active, ExpertData(x=x, y=y, mask=mask))
 
-    @jax.jit
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _sharded_kmn_stats_impl(kernel: Kernel, mesh, theta, active, x, y, mask):
     @partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS)),
         out_specs=(P(), P()),
     )
-    def sharded(theta, active, x, y, mask):
-        local = ExpertData(x=x, y=y, mask=mask)
-        u1, u2 = kmn_stats(kernel, theta, active, local)
+    def sharded(theta_, active_, x_, y_, mask_):
+        local = ExpertData(x=x_, y=y_, mask=mask_)
+        u1, u2 = kmn_stats(kernel, theta_, active_, local)
         return (
             jax.lax.psum(u1, EXPERT_AXIS),
             jax.lax.psum(u2, EXPERT_AXIS),
         )
 
-    return lambda theta, active, data: sharded(theta, active, data.x, data.y, data.mask)
+    return sharded(theta, active, x, y, mask)
+
+
+def make_sharded_kmn_stats(kernel: Kernel, mesh):
+    """Sharded (U1, u2) accumulation: active set replicated (the broadcast,
+    PGPH.scala:23), experts sharded, one psum over ICI (PGPH.scala:25-35)."""
+
+    return lambda theta, active, data: _sharded_kmn_stats_impl(
+        kernel, mesh, theta, active, data.x, data.y, data.mask
+    )
 
 
 def magic_solve(
@@ -201,28 +212,29 @@ class ProjectedProcessRawPredictor:
 
     def predict_fn(self):
         """Returns a jittable ``x_test [t, p] -> (mean [t], var [t])``."""
-        kernel = self.kernel
-
-        def predict(theta, active, magic_vector, magic_matrix, x_test):
-            cross = kernel.cross(theta, x_test, active)  # [t, m]
-            mean = cross @ magic_vector
-            var = kernel.self_diag(theta, x_test) + jnp.einsum(
-                "tm,mk,tk->t", cross, magic_matrix, cross
-            )
-            return mean, var
-
-        return predict
+        return partial(_predict_impl, self.kernel)
 
     def __call__(self, x_test):
-        if getattr(self, "_jitted", None) is None:
-            # cache the jitted apply across calls (dataclass: lazy attribute)
-            object.__setattr__(self, "_jitted", jax.jit(self.predict_fn()))
         dtype = jnp.result_type(jnp.asarray(x_test).dtype)
-        args = (
+        return _predict_jit(
+            self.kernel,
             jnp.asarray(self.theta, dtype=dtype),
             jnp.asarray(self.active, dtype=dtype),
             jnp.asarray(self.magic_vector, dtype=dtype),
             jnp.asarray(self.magic_matrix, dtype=dtype),
             jnp.asarray(x_test, dtype=dtype),
         )
-        return self._jitted(*args)
+
+
+def _predict_impl(kernel, theta, active, magic_vector, magic_matrix, x_test):
+    """mean = cross . magicVector ; var = k(x,x) + cross . magicMatrix . crossT
+    (GaussianProcessCommons.scala:121-125), batched over test points."""
+    cross = kernel.cross(theta, x_test, active)  # [t, m]
+    mean = cross @ magic_vector
+    var = kernel.self_diag(theta, x_test) + jnp.einsum(
+        "tm,mk,tk->t", cross, magic_matrix, cross
+    )
+    return mean, var
+
+
+_predict_jit = jax.jit(_predict_impl, static_argnums=0)

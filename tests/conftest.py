@@ -16,6 +16,10 @@ os.environ["XLA_FLAGS"] = (
 
 import jax
 
+# The axon TPU site hook overrides JAX_PLATFORMS at import time; the config
+# update below wins over it and pins the test session to the 8 virtual CPU
+# devices requested above.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 # Persistent compile cache: repeated test runs skip recompilation.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")

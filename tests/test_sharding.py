@@ -1,0 +1,113 @@
+"""Multi-device sharding parity — the test the reference approximates with
+``master("local[10]")`` (SURVEY.md §4): sharded computations on the forced
+8-device CPU mesh must agree with the single-device path up to reduction
+order.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu.kernels import Const, EyeKernel, RBFKernel
+from spark_gp_tpu.models import ppa
+from spark_gp_tpu.models.laplace import (
+    make_laplace_objective,
+    make_sharded_laplace_objective,
+)
+from spark_gp_tpu.models.likelihood import (
+    make_sharded_value_and_grad,
+    make_value_and_grad,
+)
+from spark_gp_tpu.parallel.experts import group_for_experts
+from spark_gp_tpu.parallel.mesh import shard_experts
+
+
+@pytest.fixture
+def problem(rng):
+    n, p = 220, 3
+    x = rng.normal(size=(n, p))
+    y = np.sin(x.sum(axis=1)) + 0.1 * rng.normal(size=n)
+    kernel = RBFKernel(1.0) + Const(1e-2) * EyeKernel()
+    return x, y, kernel
+
+
+def test_sharded_nll_matches_single_device(problem, eight_device_mesh):
+    x, y, kernel = problem
+    data = group_for_experts(x, y, dataset_size_for_expert=20)  # E = 11
+    theta = jnp.asarray(kernel.init_theta())
+
+    v1, g1 = make_value_and_grad(kernel, data)(theta)
+
+    sharded_data = shard_experts(data, eight_device_mesh)
+    assert sharded_data.num_experts % 8 == 0
+    v2, g2 = make_sharded_value_and_grad(kernel, sharded_data, eight_device_mesh)(theta)
+
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-9)
+
+
+def test_sharded_kmn_stats_match(problem, eight_device_mesh, rng):
+    x, y, kernel = problem
+    data = group_for_experts(x, y, dataset_size_for_expert=20)
+    theta = jnp.asarray(kernel.init_theta())
+    active = jnp.asarray(x[rng.choice(x.shape[0], 16, replace=False)])
+
+    u1a, u2a = ppa.kmn_stats(kernel, theta, active, data)
+
+    sharded_data = shard_experts(data, eight_device_mesh)
+    stats_fn = ppa.make_sharded_kmn_stats(kernel, eight_device_mesh)
+    u1b, u2b = stats_fn(theta, active, sharded_data)
+
+    np.testing.assert_allclose(np.asarray(u1a), np.asarray(u1b), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(u2a), np.asarray(u2b), rtol=1e-9)
+
+
+def test_sharded_laplace_matches_single_device(eight_device_mesh, rng):
+    n, p = 120, 2
+    x = rng.normal(size=(n, p))
+    y = (x.sum(axis=1) > 0).astype(np.float64)
+    kernel = RBFKernel(1.0) + Const(1e-3) * EyeKernel()
+    data = group_for_experts(x, y, dataset_size_for_expert=20)
+    theta = jnp.asarray(kernel.init_theta())
+    f0 = jnp.zeros_like(data.y)
+
+    v1, g1, f1 = make_laplace_objective(kernel, data, 1e-6)(theta, f0)
+
+    sharded = shard_experts(data, eight_device_mesh)
+    f0s = jnp.zeros_like(sharded.y)
+    v2, g2, f2 = make_sharded_laplace_objective(kernel, sharded, 1e-6, eight_device_mesh)(
+        theta, f0s
+    )
+
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-8)
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2)[: data.num_experts], rtol=1e-9
+    )
+
+
+def test_estimator_with_mesh_end_to_end(eight_device_mesh):
+    """Full fit with setMesh: same model quality as single-device."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel as RBF
+    from spark_gp_tpu.data import make_synthetics
+    from spark_gp_tpu.utils.validation import rmse
+
+    x, y = make_synthetics(n=500)
+
+    def make():
+        return (
+            GaussianProcessRegression()
+            .setKernel(lambda: 1.0 * RBF(0.1, 1e-6, 10))
+            .setDatasetSizeForExpert(50)
+            .setActiveSetSize(50)
+            .setSigma2(1e-3)
+            .setSeed(13)
+        )
+
+    m_single = make().fit(x, y)
+    m_sharded = make().setMesh(eight_device_mesh).fit(x, y)
+    r1 = rmse(y, m_single.predict(x))
+    r2 = rmse(y, m_sharded.predict(x))
+    assert r2 < 0.11
+    np.testing.assert_allclose(r1, r2, atol=5e-3)
