@@ -1,0 +1,98 @@
+"""Active-set provider tests (ASP.scala counterparts)."""
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import (
+    Const,
+    EyeKernel,
+    GreedilyOptimizingActiveSetProvider,
+    KMeansActiveSetProvider,
+    RBFKernel,
+    RandomActiveSetProvider,
+)
+
+
+@pytest.fixture
+def points(rng):
+    # two well-separated clusters in 2-d
+    a = rng.normal(size=(60, 2)) * 0.2
+    b = rng.normal(size=(60, 2)) * 0.2 + np.array([5.0, 5.0])
+    x = np.concatenate([a, b])
+    y = np.concatenate([np.zeros(60), np.ones(60)])
+    return x, y
+
+
+def _kernel():
+    return RBFKernel(1.0) + Const(1e-2) * EyeKernel()
+
+
+def test_random_provider_samples_points(points):
+    x, y = points
+    k = _kernel()
+    active = RandomActiveSetProvider(10, x, y, k, k.init_theta(), seed=7)
+    assert active.shape == (10, 2)
+    # every active point is an actual training point
+    for row in active:
+        assert np.any(np.all(np.isclose(x, row), axis=1))
+    # deterministic under the same seed (ASP.scala uses the seed param)
+    again = RandomActiveSetProvider(10, x, y, k, k.init_theta(), seed=7)
+    np.testing.assert_allclose(active, again)
+
+
+def test_kmeans_provider_finds_clusters(points):
+    x, y = points
+    k = _kernel()
+    active = KMeansActiveSetProvider(max_iter=20)(2, x, y, k, k.init_theta(), seed=0)
+    assert active.shape == (2, 2)
+    centers = np.sort(active, axis=0)
+    np.testing.assert_allclose(centers[0], [0.0, 0.0], atol=0.5)
+    np.testing.assert_allclose(centers[1], [5.0, 5.0], atol=0.5)
+
+
+def test_kmeans_more_clusters_than_needed(points):
+    x, y = points
+    k = _kernel()
+    active = KMeansActiveSetProvider()(30, x, y, k, k.init_theta(), seed=0)
+    assert active.shape == (30, 2)
+    assert np.all(np.isfinite(active))
+
+
+def test_greedy_provider_selects_informative_points(points, rng):
+    """Greedy Seeger selection spreads across both clusters and is
+    deterministic given the seed."""
+    x, y = points
+    k = _kernel()
+    active = GreedilyOptimizingActiveSetProvider()(8, x, y, k, k.init_theta(), seed=3)
+    assert active.shape == (8, 2)
+    # both clusters represented
+    near_a = np.sum(np.linalg.norm(active, axis=1) < 2.0)
+    near_b = np.sum(np.linalg.norm(active - np.array([5.0, 5.0]), axis=1) < 2.0)
+    assert near_a > 0 and near_b > 0
+    # no duplicate selections
+    assert np.unique(np.round(active, 9), axis=0).shape[0] == 8
+
+
+def test_greedy_improves_over_random_on_fit(rng):
+    """The greedy active set should not be (much) worse than random for the
+    same m on a 1-d regression task."""
+    from spark_gp_tpu import GaussianProcessRegression
+    from spark_gp_tpu.data import make_synthetics
+    from spark_gp_tpu.utils.validation import rmse
+
+    x, y = make_synthetics(n=300)
+
+    def fit_with(provider):
+        gp = (
+            GaussianProcessRegression()
+            .setKernel(lambda: RBFKernel(0.3, 1e-6, 10))
+            .setActiveSetSize(10)
+            .setActiveSetProvider(provider)
+            .setSeed(5)
+        )
+        model = gp.fit(x, y)
+        return rmse(y, model.predict(x))
+
+    r_greedy = fit_with(GreedilyOptimizingActiveSetProvider())
+    r_random = fit_with(RandomActiveSetProvider)
+    assert r_greedy < r_random * 1.5

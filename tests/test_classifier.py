@@ -1,0 +1,61 @@
+"""End-to-end classification acceptance — Iris (the reference's example,
+Iris.scala) with asserted thresholds the reference only prints."""
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessClassifier
+from spark_gp_tpu.data import load_iris
+from spark_gp_tpu.utils.validation import OneVsRest, accuracy, kfold_indices
+
+
+def _gpc():
+    return GaussianProcessClassifier().setDatasetSizeForExpert(20).setActiveSetSize(30)
+
+
+def test_binary_setosa_accuracy():
+    x, y = load_iris()
+    y_bin = (y == 1.0).astype(np.float64)  # setosa is linearly separable
+    model = _gpc().fit(x, y_bin)
+    assert accuracy(y_bin, model.predict(x)) > 0.98
+
+
+def test_predict_raw_and_proba_shapes():
+    x, y = load_iris()
+    y_bin = (y == 2.0).astype(np.float64)
+    model = _gpc().fit(x, y_bin)
+    raw = model.predict_raw(x[:7])
+    assert raw.shape == (7, 2)
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1])  # (-f, f), GPClf.scala:155
+    proba = model.predict_proba(x[:7])
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    assert np.all((proba >= 0) & (proba <= 1))
+    # averaged (Gauss-Hermite) probabilities are also valid and shrink towards
+    # 0.5 relative to the MAP sigmoid (variance widens the link)
+    av = model.predict_proba(x[:7], averaged=True)
+    np.testing.assert_allclose(av.sum(axis=1), 1.0, rtol=1e-6)
+    assert np.all(np.abs(av[:, 1] - 0.5) <= np.abs(proba[:, 1] - 0.5) + 1e-6)
+
+
+def test_iris_ovr_cv_accuracy():
+    """3-class OvR 3-fold accuracy; the reference prints ~0.95 with CV 10."""
+    x, y = load_iris()
+    scores = []
+    for train_idx, test_idx in kfold_indices(x.shape[0], 3, seed=13):
+        ovr = OneVsRest(_gpc).fit(x[train_idx], y[train_idx])
+        scores.append(accuracy(y[test_idx], ovr.predict(x[test_idx])))
+    assert float(np.mean(scores)) > 0.9
+
+
+def test_classifier_save_load(tmp_path):
+    x, y = load_iris()
+    y_bin = (y == 1.0).astype(np.float64)
+    model = _gpc().fit(x, y_bin)
+    path = str(tmp_path / "clf")
+    model.save(path)
+    from spark_gp_tpu import GaussianProcessClassificationModel
+
+    restored = GaussianProcessClassificationModel.load(path)
+    np.testing.assert_allclose(
+        restored.predict_proba(x[:9]), model.predict_proba(x[:9]), rtol=1e-12
+    )

@@ -1,0 +1,127 @@
+"""Component tests: Gauss–Hermite integrator (the reference's IntegratorTest
+oracle), scaling, the L-BFGS-B driver, checkpointing, validation harness."""
+
+import jax.nn
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu.ops.integrator import Integrator
+from spark_gp_tpu.ops.scaling import fit_scaler, scale
+
+
+def test_integrator_vs_monte_carlo(rng):
+    """E[sigmoid(X)], X ~ N(0.5, 3) vs 100k-sample MC within 3 SE —
+    util/IntegratorTest.scala:11-26."""
+    mean, variance = 0.5, 3.0
+    integrator = Integrator(100)
+    result = float(
+        integrator.expected_of_function_of_normal(mean, variance, jax.nn.sigmoid)
+    )
+    samples = rng.normal(mean, np.sqrt(variance), size=100_000)
+    vals = 1.0 / (1.0 + np.exp(-samples))
+    mc = vals.mean()
+    se = vals.std() / np.sqrt(len(vals))
+    assert abs(mc - result) < 3 * se
+
+
+def test_integrator_batched():
+    integrator = Integrator(32)
+    means = jnp.asarray([0.0, 1.0, -2.0])
+    variances = jnp.asarray([1.0, 0.5, 2.0])
+    out = integrator.expected_of_function_of_normal(means, variances, jax.nn.sigmoid)
+    assert out.shape == (3,)
+    # linear function: E[aX+b] = a mu + b regardless of variance
+    lin = integrator.expected_of_function_of_normal(means, variances, lambda x: 2 * x + 1)
+    np.testing.assert_allclose(np.asarray(lin), 2 * np.asarray(means) + 1, rtol=1e-10)
+
+
+def test_scale_zscores(rng):
+    x = jnp.asarray(rng.normal(loc=5.0, scale=3.0, size=(200, 4)))
+    s = np.asarray(scale(x))
+    np.testing.assert_allclose(s.mean(axis=0), 0.0, atol=1e-10)
+    np.testing.assert_allclose(s.std(axis=0), 1.0, rtol=1e-10)
+
+
+def test_scale_constant_column(rng):
+    """Zero-variance dims clamp to 1 (Scaling.scala:18) — no division by 0."""
+    x = np.ones((50, 2))
+    x[:, 1] = rng.normal(size=50)
+    s = np.asarray(scale(jnp.asarray(x)))
+    np.testing.assert_allclose(s[:, 0], 0.0)
+    assert np.all(np.isfinite(s))
+
+
+def test_fit_scaler_roundtrip(rng):
+    x = rng.normal(size=(100, 3))
+    mean, std = fit_scaler(jnp.asarray(x))
+    np.testing.assert_allclose(
+        np.asarray((jnp.asarray(x) - mean) / std), np.asarray(scale(jnp.asarray(x)))
+    )
+
+
+def test_lbfgsb_respects_bounds():
+    from spark_gp_tpu.optimize.lbfgsb import minimize_lbfgsb
+
+    def vag(theta):
+        # minimum at theta = (-3, 7), outside the box [0,1] x [0,5]
+        g = 2 * (theta - np.array([-3.0, 7.0]))
+        return float(np.sum((theta - np.array([-3.0, 7.0])) ** 2)), g
+
+    res = minimize_lbfgsb(
+        vag, np.array([0.5, 0.5]), np.array([0.0, 0.0]), np.array([1.0, 5.0])
+    )
+    np.testing.assert_allclose(res.theta, [0.0, 5.0], atol=1e-8)
+    assert res.success
+
+
+def test_lbfgsb_nonfinite_first_eval_raises():
+    from spark_gp_tpu.optimize.lbfgsb import minimize_lbfgsb
+    from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+
+    def vag(theta):
+        return float("nan"), np.zeros_like(theta)
+
+    with pytest.raises(NotPositiveDefiniteException):
+        minimize_lbfgsb(vag, np.array([1.0]), np.array([0.0]), np.array([2.0]))
+
+
+def test_checkpointer_roundtrip(tmp_path):
+    from spark_gp_tpu.kernels import RBFKernel
+    from spark_gp_tpu.utils.checkpoint import LbfgsCheckpointer, load_checkpoint
+
+    ck = LbfgsCheckpointer(str(tmp_path), RBFKernel(1.0))
+    ck(np.array([0.7]))
+    ck(np.array([0.9]))
+    it, theta = load_checkpoint(str(tmp_path))
+    assert it == 2
+    np.testing.assert_allclose(theta, [0.9])
+
+
+def test_checkpoint_resume_through_estimator(tmp_path):
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+    from spark_gp_tpu.data import make_synthetics
+    from spark_gp_tpu.utils.checkpoint import load_checkpoint
+
+    x, y = make_synthetics(n=200)
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(0.5, 1e-6, 10))
+        .setActiveSetSize(20)
+        .setCheckpointDir(str(tmp_path))
+    )
+    gp.fit(x, y)
+    state = load_checkpoint(str(tmp_path))
+    assert state is not None
+    assert state[0] >= 1
+
+
+def test_kfold_partitions_everything():
+    from spark_gp_tpu.utils.validation import kfold_indices
+
+    seen = []
+    for train, test in kfold_indices(103, 10, seed=3):
+        assert set(train) & set(test) == set()
+        assert len(train) + len(test) == 103
+        seen.extend(test)
+    assert sorted(seen) == list(range(103))
