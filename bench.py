@@ -76,6 +76,7 @@ def main() -> None:
             .setSeed(13)
             .setSigma2(1e-3)
             .setMaxIter(max_iter)
+            .setOptimizer(os.environ.get("BENCH_OPTIMIZER", "device"))
         )
 
     # Warm-up on a slice: pays one-time jit compilation so the measured fit

@@ -40,6 +40,7 @@ class GaussianProcessParams:
         self._seed: int = 0
         self._mesh = None
         self._checkpoint_dir: Optional[str] = None
+        self._optimizer: str = "host"
 
     # --- reference setter names (GaussianProcessParams.scala:32-53) -------
     def setKernel(self, value: Union[Kernel, Callable[[], Kernel]]):
@@ -89,6 +90,17 @@ class GaussianProcessParams:
         self._checkpoint_dir = path
         return self
 
+    def setOptimizer(self, value: str):
+        """``"host"`` — SciPy L-BFGS-B driving the jitted objective (one
+        device dispatch per evaluation; bitwise closest to the reference's
+        Breeze LBFGSB).  ``"device"`` — the entire projected-L-BFGS loop runs
+        on device in one XLA program (``optimize/lbfgs_device.py``); fastest
+        on high-dispatch-latency runtimes and multi-host pods."""
+        if value not in ("host", "device"):
+            raise ValueError("optimizer must be 'host' or 'device'")
+        self._optimizer = value
+        return self
+
     # snake_case aliases for pythonic call sites
     set_kernel = setKernel
     set_dataset_size_for_expert = setDatasetSizeForExpert
@@ -99,6 +111,7 @@ class GaussianProcessParams:
     set_tol = setTol
     set_seed = setSeed
     set_mesh = setMesh
+    set_optimizer = setOptimizer
 
     def get_params(self) -> dict:
         return {

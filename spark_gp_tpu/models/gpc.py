@@ -55,31 +55,33 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             data = self._group(x, y)
         instr.log_metric("num_experts", data.num_experts)
 
-        if self._mesh is not None:
-            objective = make_sharded_laplace_objective(
-                kernel, data, self._tol, self._mesh
-            )
+        if self._optimizer == "device":
+            theta_opt, f_final = self._fit_device(instr, kernel, data)
         else:
-            objective = make_laplace_objective(kernel, data, self._tol)
+            if self._mesh is not None:
+                objective = make_sharded_laplace_objective(
+                    kernel, data, self._tol, self._mesh
+                )
+            else:
+                objective = make_laplace_objective(kernel, data, self._tol)
 
-        # Latent warm start carried across L-BFGS evaluations — the explicit
-        # functional version of the reference's in-place RDD mutation
-        # (GPClf.scala:53-60).
-        f_state = jnp.zeros_like(data.y)
-        state = {"f": f_state}
+            # Latent warm start carried across L-BFGS evaluations — the
+            # explicit functional version of the reference's in-place RDD
+            # mutation (GPClf.scala:53-60).
+            state = {"f": jnp.zeros_like(data.y)}
 
-        def value_and_grad(theta):
-            theta_dev = jnp.asarray(theta, dtype=data.x.dtype)
-            value, grad, f_new = objective(theta_dev, state["f"])
-            state["f"] = f_new
-            return value, grad
+            def value_and_grad(theta):
+                theta_dev = jnp.asarray(theta, dtype=data.x.dtype)
+                value, grad, f_new = objective(theta_dev, state["f"])
+                state["f"] = f_new
+                return value, grad
 
-        theta_opt = self._optimize_hypers(instr, kernel, value_and_grad)
+            theta_opt = self._optimize_hypers(instr, kernel, value_and_grad)
 
-        # Final evaluation at theta*: settles f at the optimum
-        # (GPClf.scala:60's foreach).
-        theta_dev = jnp.asarray(theta_opt, dtype=data.x.dtype)
-        _, _, f_final = objective(theta_dev, state["f"])
+            # Final evaluation at theta*: settles f at the optimum
+            # (GPClf.scala:60's foreach).
+            theta_dev = jnp.asarray(theta_opt, dtype=data.x.dtype)
+            _, _, f_final = objective(theta_dev, state["f"])
 
         # PPA over the latent modes as targets (GPClf.scala:62-65).  The
         # active-set provider also sees the latents, not the 0/1 labels —
@@ -94,6 +96,41 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         model = GaussianProcessClassificationModel(raw)
         model.instr = instr
         return model
+
+    def _fit_device(self, instr: Instrumentation, kernel, data):
+        """One-dispatch on-device classifier optimization."""
+        import numpy as _np
+
+        from spark_gp_tpu.models.laplace import (
+            fit_gpc_device,
+            fit_gpc_device_sharded,
+        )
+
+        dtype = data.x.dtype
+        theta0 = jnp.asarray(kernel.init_theta(), dtype=dtype)
+        lower, upper = kernel.bounds()
+        lower = jnp.asarray(lower, dtype=dtype)
+        upper = jnp.asarray(upper, dtype=dtype)
+        max_iter = jnp.asarray(self._max_iter, dtype=jnp.int32)
+
+        instr.log_info("Optimising the kernel hyperparameters (on-device)")
+        with instr.phase("optimize_hypers"):
+            if self._mesh is not None:
+                theta, f_final, f, n_iter, n_fev = fit_gpc_device_sharded(
+                    kernel, float(self._tol), self._mesh, theta0, lower, upper,
+                    data.x, data.y, data.mask, max_iter,
+                )
+            else:
+                theta, f_final, f, n_iter, n_fev = fit_gpc_device(
+                    kernel, float(self._tol), theta0, lower, upper,
+                    data.x, data.y, data.mask, max_iter,
+                )
+            theta_opt = _np.asarray(theta, dtype=_np.float64)
+        instr.log_metric("lbfgs_iters", int(n_iter))
+        instr.log_metric("lbfgs_nfev", int(n_fev))
+        instr.log_metric("final_nll", float(f))
+        instr.log_info("Optimal kernel: " + kernel.describe(theta_opt))
+        return theta_opt, f_final
 
 
 class GaussianProcessClassificationModel:

@@ -56,19 +56,58 @@ class GaussianProcessRegression(GaussianProcessCommons):
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("expert_size", data.expert_size)
 
-        if self._mesh is not None:
-            vag = make_sharded_value_and_grad(kernel, data, self._mesh)
+        if self._optimizer == "device":
+            theta_opt = self._fit_device(instr, kernel, data)
         else:
-            vag = make_value_and_grad(kernel, data)
+            if self._mesh is not None:
+                vag = make_sharded_value_and_grad(kernel, data, self._mesh)
+            else:
+                vag = make_value_and_grad(kernel, data)
 
-        checkpointer = self._make_checkpointer(kernel)
-        theta_opt = self._optimize_hypers(instr, kernel, vag, callback=checkpointer)
+            checkpointer = self._make_checkpointer(kernel)
+            theta_opt = self._optimize_hypers(instr, kernel, vag, callback=checkpointer)
 
         raw = self._projected_process(instr, kernel, theta_opt, x, y, data)
         instr.log_success()
         model = GaussianProcessRegressionModel(raw)
         model.instr = instr
         return model
+
+    def _fit_device(self, instr: Instrumentation, kernel, data) -> np.ndarray:
+        """One-dispatch on-device optimization (optimize/lbfgs_device.py)."""
+        import jax.numpy as jnp
+
+        from spark_gp_tpu.models.likelihood import (
+            fit_gpr_device,
+            fit_gpr_device_sharded,
+        )
+
+        dtype = data.x.dtype
+        theta0 = jnp.asarray(kernel.init_theta(), dtype=dtype)
+        lower, upper = kernel.bounds()
+        lower = jnp.asarray(lower, dtype=dtype)
+        upper = jnp.asarray(upper, dtype=dtype)
+        max_iter = jnp.asarray(self._max_iter, dtype=jnp.int32)
+        tol = jnp.asarray(self._tol, dtype=dtype)
+
+        instr.log_info("Optimising the kernel hyperparameters (on-device)")
+        with instr.phase("optimize_hypers"):
+            if self._mesh is not None:
+                theta, f, n_iter, n_fev = fit_gpr_device_sharded(
+                    kernel, self._mesh, theta0, lower, upper,
+                    data.x, data.y, data.mask, max_iter, tol,
+                )
+            else:
+                theta, f, n_iter, n_fev = fit_gpr_device(
+                    kernel, theta0, lower, upper,
+                    data.x, data.y, data.mask, max_iter, tol,
+                )
+            theta = np.asarray(theta, dtype=np.float64)
+        instr.log_metric("lbfgs_iters", int(n_iter))
+        instr.log_metric("lbfgs_nfev", int(n_fev))
+        instr.log_metric("final_nll", float(f))
+        instr.log_info("Optimal kernel: " + kernel.describe(theta))
+        return theta
 
     def _make_checkpointer(self, kernel):
         if self._checkpoint_dir is None:

@@ -224,3 +224,64 @@ def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
         )
 
     return obj
+
+
+# --- fully on-device fits (see likelihood.py counterparts) ----------------
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def fit_gpc_device(kernel: Kernel, tol, theta0, lower, upper, x, y, mask, max_iter):
+    """Single-chip on-device classifier fit; the latent warm-start stack is
+    the optimizer's auxiliary carry.  Returns (theta, f_latents, nll, n_iter,
+    n_fev)."""
+    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_minimize_device
+
+    data = ExpertData(x=x, y=y, mask=mask)
+
+    def vag(theta, f_carry):
+        value, grad, f_new = batched_neg_logz(kernel, tol, theta, data, f_carry)
+        return value, grad, f_new
+
+    f0 = jnp.zeros_like(y)
+    theta, f, f_final, n_iter, n_fev = lbfgs_minimize_device(
+        vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
+    )
+    return theta, f_final, f, n_iter, n_fev
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def fit_gpc_device_sharded(
+    kernel: Kernel, tol, mesh, theta0, lower, upper, x, y, mask, max_iter
+):
+    """Multi-chip on-device classifier fit inside one shard_map: latent
+    stacks stay device-resident and sharded for the entire optimization."""
+    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_minimize_device
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(), P(), P(),
+            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+            P(),
+        ),
+        out_specs=(P(), P(EXPERT_AXIS), P(), P(), P()),
+    )
+    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_):
+        local = ExpertData(x=x_, y=y_, mask=mask_)
+
+        def vag(theta, f_carry):
+            value, grad, f_new = batched_neg_logz(kernel, tol, theta, local, f_carry)
+            return (
+                jax.lax.psum(value, EXPERT_AXIS),
+                jax.lax.psum(grad, EXPERT_AXIS),
+                f_new,
+            )
+
+        f0 = jnp.zeros_like(y_)
+        theta, f, f_final, n_iter, n_fev = lbfgs_minimize_device(
+            vag, theta0_, lower_, upper_, f0, max_iter=max_iter_, tol=tol
+        )
+        return theta, f_final, f, n_iter, n_fev
+
+    return run(theta0, lower, upper, x, y, mask, max_iter)

@@ -1,0 +1,280 @@
+"""On-device box-constrained L-BFGS in a single XLA program.
+
+The v1 optimizer promised in ``lbfgsb.py``: the entire hyperparameter
+optimization — objective, gradient, line search, history updates — runs
+inside one ``lax.while_loop`` under jit, so a fit costs ONE device dispatch
+instead of one per L-BFGS evaluation.  On dispatch-latency-heavy runtimes
+(remote TPU tunnels, multi-host pods where every host sync stalls the ICI
+collective) this is the difference between latency-bound and compute-bound
+training.
+
+Algorithm: projected L-BFGS with backtracking Armijo line search over the
+clipped path — the standard compromise replacing Breeze's full LBFGSB
+(generalized Cauchy point + subspace minimization, GPC.scala:84-86): the
+two-loop recursion builds a quasi-Newton direction, candidate iterates are
+projected onto the box ``clip(theta + t*d, lower, upper)``, and curvature
+pairs are only stored when s.y > eps.  For the handful of smooth, box-bounded
+hyperparameters of a GP kernel its iterate path is not identical to LBFGSB's
+but converges to the same optima (the e2e parity tests hold with either
+optimizer).
+
+Generic over an auxiliary carry threaded through objective evaluations: GPR
+passes none; the Laplace objective carries its latent warm-start stack
+(the functional analogue of GPClf.scala:53-60).
+
+All state is fixed-shape: [m_hist, h] circular history buffers with masks —
+no dynamic shapes, fully MXU/VPU-friendly, differentiably irrelevant (the
+loop is never differentiated through).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class _LbfgsState(NamedTuple):
+    theta: jax.Array  # [h]
+    f: jax.Array  # scalar
+    grad: jax.Array  # [h]
+    aux: object  # pytree carried through objective evals
+    s_hist: jax.Array  # [m, h]
+    y_hist: jax.Array  # [m, h]
+    rho: jax.Array  # [m]
+    hist_count: jax.Array  # int32
+    hist_head: jax.Array  # int32 (next write slot)
+    n_iter: jax.Array  # int32
+    n_fev: jax.Array  # int32
+    done: jax.Array  # bool
+
+
+def _two_loop_direction(grad, s_hist, y_hist, rho, count, head, m_hist):
+    """Standard L-BFGS two-loop recursion over the (masked) circular history."""
+
+    def newest_to_oldest(i):
+        # i = 0 is newest
+        return (head - 1 - i) % m_hist
+
+    def first_loop(i, carry):
+        q, alphas = carry
+        slot = newest_to_oldest(i)
+        valid = i < count
+        alpha = rho[slot] * jnp.dot(s_hist[slot], q)
+        alpha = jnp.where(valid, alpha, 0.0)
+        q = q - alpha * y_hist[slot]
+        alphas = alphas.at[slot].set(alpha)
+        return q, alphas
+
+    q, alphas = jax.lax.fori_loop(
+        0, m_hist, first_loop, (grad, jnp.zeros_like(rho))
+    )
+
+    # initial Hessian scaling from the newest pair
+    newest = newest_to_oldest(0)
+    sy = jnp.dot(s_hist[newest], y_hist[newest])
+    yy = jnp.dot(y_hist[newest], y_hist[newest])
+    gamma = jnp.where((count > 0) & (yy > 0), sy / jnp.maximum(yy, 1e-30), 1.0)
+    r = gamma * q
+
+    def second_loop(i, r):
+        # oldest to newest
+        slot = newest_to_oldest(count - 1 - i) % m_hist
+        valid = i < count
+        beta = rho[slot] * jnp.dot(y_hist[slot], r)
+        upd = r + s_hist[slot] * (alphas[slot] - beta)
+        return jnp.where(valid, upd, r)
+
+    r = jax.lax.fori_loop(0, m_hist, second_loop, r)
+    return -r
+
+
+def lbfgs_minimize_device(
+    value_and_grad_aux,
+    theta0,
+    lower,
+    upper,
+    aux0,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    m_hist: int = 10,
+    max_ls: int = 25,
+    armijo_c1: float = 1e-4,
+):
+    """Minimize on device.  ``value_and_grad_aux(theta, aux) -> (f, g, aux)``
+    must be jit-traceable.  Returns ``(theta, f, aux, n_iter, n_fev)``.
+
+    Convergence mirrors the scipy/Breeze pair of tests used by the host
+    driver: projected-gradient inf-norm < tol, or relative objective change
+    < tol between accepted iterates.
+    """
+    theta0 = jnp.asarray(theta0)
+    dtype = theta0.dtype
+    lower = jnp.asarray(lower, dtype=dtype)
+    upper = jnp.asarray(upper, dtype=dtype)
+    h = theta0.shape[0]
+
+    def proj(t):
+        return jnp.clip(t, lower, upper)
+
+    def proj_grad_norm(theta, grad):
+        # norm of the projected gradient: zero at a KKT point of the box
+        step = proj(theta - grad) - theta
+        return jnp.max(jnp.abs(step)) if h else jnp.zeros((), dtype)
+
+    f0, g0, aux1 = value_and_grad_aux(theta0, aux0)
+
+    init = _LbfgsState(
+        theta=theta0,
+        f=f0,
+        grad=g0,
+        aux=aux1,
+        s_hist=jnp.zeros((m_hist, h), dtype=dtype),
+        y_hist=jnp.zeros((m_hist, h), dtype=dtype),
+        rho=jnp.zeros((m_hist,), dtype=dtype),
+        hist_count=jnp.zeros((), jnp.int32),
+        hist_head=jnp.zeros((), jnp.int32),
+        n_iter=jnp.zeros((), jnp.int32),
+        n_fev=jnp.ones((), jnp.int32),
+        done=jnp.zeros((), jnp.bool_),
+    )
+
+    def cond(state: _LbfgsState):
+        return jnp.logical_and(~state.done, state.n_iter < max_iter)
+
+    def body(state: _LbfgsState):
+        direction = _two_loop_direction(
+            state.grad, state.s_hist, state.y_hist, state.rho,
+            state.hist_count, state.hist_head, m_hist,
+        )
+        # safeguard: fall back to steepest descent if not a descent direction
+        descent = jnp.dot(direction, state.grad) < 0
+        direction = jnp.where(descent, direction, -state.grad)
+
+        # Weak-Wolfe bracketing line search along the projected path.
+        # Armijo alone stalls L-BFGS: it happily accepts steps far shorter
+        # than the local curvature scale, the resulting (s, y) pairs violate
+        # s.y > 0, the history freezes, and the direction collapses (observed
+        # on Rosenbrock).  Bisection bracketing on the pair
+        #   A: f(t) <= f + c1 t g.d       (sufficient decrease)
+        #   C: g(t).d >= c2 g.d           (curvature / step-not-too-short)
+        # guarantees curvature-consistent pairs on smooth objectives.
+        c2 = jnp.asarray(0.9, dtype)
+        g_dot_d = jnp.dot(state.grad, direction)
+
+        class LS(NamedTuple):
+            t: jax.Array
+            low: jax.Array
+            high: jax.Array  # inf until an upper bracket is found
+            f_new: jax.Array
+            g_new: jax.Array
+            aux_new: object
+            theta_new: jax.Array
+            accepted: jax.Array  # full Wolfe pair found
+            armijo_seen: jax.Array  # fallback: some Armijo point found
+            n_ls: jax.Array
+            n_fev: jax.Array
+
+        def ls_cond(ls: LS):
+            return jnp.logical_and(~ls.accepted, ls.n_ls < max_ls)
+
+        def ls_body(ls: LS):
+            theta_cand = proj(state.theta + ls.t * direction)
+            f_cand, g_cand, aux_cand = value_and_grad_aux(theta_cand, state.aux)
+            delta = theta_cand - state.theta
+            armijo = (
+                f_cand <= state.f + armijo_c1 * jnp.dot(state.grad, delta)
+            ) & jnp.isfinite(f_cand)
+            curv = jnp.dot(g_cand, direction) >= c2 * g_dot_d
+            moved = jnp.max(jnp.abs(delta)) > 0
+            accept = armijo & curv & moved
+            # keep any Armijo point as the fallback iterate
+            keep = accept | (armijo & moved)
+            # bracket update: no Armijo -> shrink from above; Armijo but
+            # too-short -> grow from below (double until an upper bracket
+            # exists, then bisect)
+            high = jnp.where(armijo, ls.high, ls.t)
+            low = jnp.where(armijo & ~curv, ls.t, ls.low)
+            t_next = jnp.where(
+                armijo & ~curv,
+                jnp.where(jnp.isinf(high), ls.t * 2.0, 0.5 * (low + high)),
+                0.5 * (low + high),
+            )
+            return LS(
+                t=jnp.where(accept, ls.t, t_next),
+                low=low,
+                high=high,
+                f_new=jnp.where(keep, f_cand, ls.f_new),
+                g_new=jnp.where(keep, g_cand, ls.g_new),
+                aux_new=jax.tree.map(
+                    lambda new, old: jnp.where(keep, new, old), aux_cand, ls.aux_new
+                ),
+                theta_new=jnp.where(keep, theta_cand, ls.theta_new),
+                accepted=accept,
+                armijo_seen=ls.armijo_seen | (armijo & moved),
+                n_ls=ls.n_ls + 1,
+                n_fev=ls.n_fev + 1,
+            )
+
+        ls0 = LS(
+            t=jnp.ones((), dtype),
+            low=jnp.zeros((), dtype),
+            high=jnp.asarray(jnp.inf, dtype),
+            f_new=state.f,
+            g_new=state.grad,
+            aux_new=state.aux,
+            theta_new=state.theta,
+            accepted=jnp.zeros((), jnp.bool_),
+            armijo_seen=jnp.zeros((), jnp.bool_),
+            n_ls=jnp.zeros((), jnp.int32),
+            n_fev=jnp.zeros((), jnp.int32),
+        )
+        ls = jax.lax.while_loop(ls_cond, ls_body, ls0)
+        ls = ls._replace(accepted=ls.accepted | ls.armijo_seen)
+
+        # curvature pair update (only when accepted and s.y > eps)
+        s_vec = ls.theta_new - state.theta
+        y_vec = ls.g_new - state.grad
+        sy = jnp.dot(s_vec, y_vec)
+        store = ls.accepted & (sy > 1e-10)
+        slot = state.hist_head
+        s_hist = jnp.where(
+            store, state.s_hist.at[slot].set(s_vec), state.s_hist
+        )
+        y_hist = jnp.where(
+            store, state.y_hist.at[slot].set(y_vec), state.y_hist
+        )
+        rho = jnp.where(
+            store, state.rho.at[slot].set(1.0 / jnp.maximum(sy, 1e-30)), state.rho
+        )
+        head = jnp.where(store, (slot + 1) % m_hist, slot)
+        count = jnp.where(
+            store, jnp.minimum(state.hist_count + 1, m_hist), state.hist_count
+        )
+
+        f_change = jnp.abs(state.f - ls.f_new) <= tol * jnp.maximum(
+            1.0, jnp.abs(ls.f_new)
+        )
+        g_small = proj_grad_norm(ls.theta_new, ls.g_new) <= tol
+        converged = ls.accepted & (f_change | g_small)
+        stalled = ~ls.accepted  # line search exhausted
+
+        return _LbfgsState(
+            theta=ls.theta_new,
+            f=ls.f_new,
+            grad=ls.g_new,
+            aux=ls.aux_new,
+            s_hist=s_hist,
+            y_hist=y_hist,
+            rho=rho,
+            hist_count=count,
+            hist_head=head,
+            n_iter=state.n_iter + 1,
+            n_fev=state.n_fev + ls.n_fev,
+            done=converged | stalled,
+        )
+
+    final = jax.lax.while_loop(cond, body, init)
+    return final.theta, final.f, final.aux, final.n_iter, final.n_fev

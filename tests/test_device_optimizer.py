@@ -1,0 +1,111 @@
+"""On-device L-BFGS parity with the host SciPy driver."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu import (
+    GaussianProcessClassifier,
+    GaussianProcessRegression,
+    RBFKernel,
+    WhiteNoiseKernel,
+)
+from spark_gp_tpu.data import load_iris, make_synthetics
+from spark_gp_tpu.optimize.lbfgs_device import lbfgs_minimize_device
+from spark_gp_tpu.utils.validation import accuracy, rmse
+
+
+def test_quadratic_with_box():
+    """Minimum outside the box lands on the boundary (L-BFGS-B semantics)."""
+    target = jnp.asarray([-3.0, 7.0])
+
+    def vag(theta, aux):
+        return jnp.sum((theta - target) ** 2), 2 * (theta - target), aux
+
+    theta, f, _, n_iter, _ = lbfgs_minimize_device(
+        vag,
+        jnp.asarray([0.5, 0.5]),
+        jnp.asarray([0.0, 0.0]),
+        jnp.asarray([1.0, 5.0]),
+        jnp.zeros(()),
+        max_iter=jnp.asarray(100),
+        tol=jnp.asarray(1e-10),
+    )
+    np.testing.assert_allclose(np.asarray(theta), [0.0, 5.0], atol=1e-6)
+
+
+def test_rosenbrock_unbounded():
+    def vag(theta, aux):
+        a, b = theta[0], theta[1]
+        f = (1 - a) ** 2 + 100 * (b - a**2) ** 2
+        g = jnp.asarray(
+            [-2 * (1 - a) - 400 * a * (b - a**2), 200 * (b - a**2)]
+        )
+        return f, g, aux
+
+    theta, f, _, n_iter, _ = lbfgs_minimize_device(
+        vag,
+        jnp.asarray([-1.2, 1.0]),
+        jnp.asarray([-jnp.inf, -jnp.inf]),
+        jnp.asarray([jnp.inf, jnp.inf]),
+        jnp.zeros(()),
+        max_iter=jnp.asarray(300),
+        tol=jnp.asarray(1e-14),
+    )
+    np.testing.assert_allclose(np.asarray(theta), [1.0, 1.0], atol=1e-4)
+
+
+def _gpr(opt, mesh=None):
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(0.1, 1e-6, 10) + WhiteNoiseKernel(0.5, 0, 1))
+        .setDatasetSizeForExpert(60)
+        .setActiveSetSize(60)
+        .setSeed(13)
+        .setSigma2(1e-3)
+        .setOptimizer(opt)
+    )
+    if mesh is not None:
+        gp.setMesh(mesh)
+    return gp
+
+
+def test_gpr_device_matches_host_quality():
+    x, y = make_synthetics(n=500)
+    r_host = rmse(y, _gpr("host").fit(x, y).predict(x))
+    r_dev = rmse(y, _gpr("device").fit(x, y).predict(x))
+    assert r_dev < 0.11
+    np.testing.assert_allclose(r_dev, r_host, atol=2e-3)
+
+
+def test_gpr_device_sharded(eight_device_mesh):
+    x, y = make_synthetics(n=500)
+    r = rmse(y, _gpr("device", eight_device_mesh).fit(x, y).predict(x))
+    assert r < 0.11
+
+
+def test_gpc_device_matches_host_quality(eight_device_mesh):
+    x, y = load_iris()
+    yb = (y == 2.0).astype(np.float64)
+
+    def gpc(opt, mesh=None):
+        g = (
+            GaussianProcessClassifier()
+            .setDatasetSizeForExpert(20)
+            .setActiveSetSize(30)
+            .setOptimizer(opt)
+        )
+        if mesh is not None:
+            g.setMesh(mesh)
+        return g
+
+    a_host = accuracy(yb, gpc("host").fit(x, yb).predict(x))
+    a_dev = accuracy(yb, gpc("device").fit(x, yb).predict(x))
+    a_dev_sh = accuracy(yb, gpc("device", eight_device_mesh).fit(x, yb).predict(x))
+    assert a_dev >= a_host - 0.02
+    assert a_dev_sh >= a_host - 0.02
+
+
+def test_invalid_optimizer_rejected():
+    with pytest.raises(ValueError):
+        GaussianProcessRegression().setOptimizer("banana")
