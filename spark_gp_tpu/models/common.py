@@ -41,6 +41,7 @@ class GaussianProcessParams:
         self._mesh = None
         self._checkpoint_dir: Optional[str] = None
         self._optimizer: str = "host"
+        self._hyper_space: str = "auto"
 
     # --- reference setter names (GaussianProcessParams.scala:32-53) -------
     def setKernel(self, value: Union[Kernel, Callable[[], Kernel]]):
@@ -101,6 +102,35 @@ class GaussianProcessParams:
         self._optimizer = value
         return self
 
+    def setHyperSpace(self, value: str):
+        """Coordinate system for hyperparameter optimization.
+
+        ``"log"`` — optimize u = log(theta) (requires positive initial values
+        and non-negative lower bounds).  ``"linear"`` — raw coordinates, the
+        reference's exact setup (GaussianProcessCommons.scala:84-86).
+        ``"auto"`` (default) — log when applicable, else linear: GP marginal
+        likelihoods are badly scaled in linear coordinates (the amplitude
+        hyperparameter dominates and L-BFGS can collapse into the
+        constant-kernel optimum, as the airfoil config does in any
+        precision), and log-domain optimization is the standard remedy.
+        """
+        if value not in ("auto", "log", "linear"):
+            raise ValueError("hyper space must be 'auto', 'log' or 'linear'")
+        self._hyper_space = value
+        return self
+
+    def _use_log_space(self, kernel) -> bool:
+        from spark_gp_tpu.optimize.lbfgsb import log_space_applicable
+
+        if self._hyper_space == "linear":
+            return False
+        applicable = log_space_applicable(kernel.init_theta(), kernel.bounds()[0])
+        if self._hyper_space == "log" and not applicable:
+            raise ValueError(
+                "log hyper space requires theta0 > 0 and lower bounds >= 0"
+            )
+        return applicable
+
     # snake_case aliases for pythonic call sites
     set_kernel = setKernel
     set_dataset_size_for_expert = setDatasetSizeForExpert
@@ -112,6 +142,7 @@ class GaussianProcessParams:
     set_seed = setSeed
     set_mesh = setMesh
     set_optimizer = setOptimizer
+    set_hyper_space = setHyperSpace
 
     def get_params(self) -> dict:
         return {
@@ -165,6 +196,7 @@ class GaussianProcessCommons(GaussianProcessParams):
                 max_iter=self._max_iter,
                 tol=self._tol,
                 callback=callback,
+                log_space=self._use_log_space(kernel),
             )
         instr.log_metric("lbfgs_iters", res.nit)
         instr.log_metric("lbfgs_nfev", res.nfev)
@@ -194,16 +226,37 @@ class GaussianProcessCommons(GaussianProcessParams):
             )
         active = np.asarray(active)
 
-        theta_dev = jnp.asarray(theta_opt, dtype=data.x.dtype)
-        active_dev = jnp.asarray(active, dtype=data.x.dtype)
-        with instr.phase("kmn_stats"):
+        # The (U1, u2) accumulation runs in float64 (XLA emulates f64 on TPU;
+        # this stage is one-time, not the per-iteration hot loop).  In f32 the
+        # ~1e-7 relative entry noise of U1, amplified by the
+        # condition-squaring of the normal equations (sigma2 as small as 1e-4,
+        # Airfoil.scala:21), costs real accuracy: airfoil 10-fold RMSE
+        # degrades from 2.0 to 2.8.
+        import jax
+
+        with instr.phase("kmn_stats"), jax.enable_x64():
+            theta_dev = jnp.asarray(
+                np.asarray(theta_opt, dtype=np.float64), dtype=jnp.float64
+            )
+            active_dev = jnp.asarray(
+                np.asarray(active, dtype=np.float64), dtype=jnp.float64
+            )
+            x64 = data.x.astype(jnp.float64)
+            y64 = data.y.astype(jnp.float64)
+            mask64 = data.mask.astype(jnp.float64)
             if self._mesh is not None:
+                from spark_gp_tpu.parallel.experts import ExpertData
+
                 stats_fn = ppa.make_sharded_kmn_stats(kernel, self._mesh)
-                u1, u2 = stats_fn(theta_dev, active_dev, data)
+                u1, u2 = stats_fn(
+                    theta_dev, active_dev, ExpertData(x=x64, y=y64, mask=mask64)
+                )
             else:
                 u1, u2 = ppa.kmn_stats_jit(
-                    kernel, theta_dev, active_dev, data.x, data.y, data.mask
+                    kernel, theta_dev, active_dev, x64, y64, mask64
                 )
+            u1 = np.asarray(u1)
+            u2 = np.asarray(u2)
 
         with instr.phase("magic_solve"):
             magic_vector, magic_matrix = ppa.magic_solve(

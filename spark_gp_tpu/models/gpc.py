@@ -113,16 +113,17 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         upper = jnp.asarray(upper, dtype=dtype)
         max_iter = jnp.asarray(self._max_iter, dtype=jnp.int32)
 
+        log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
         with instr.phase("optimize_hypers"):
             if self._mesh is not None:
                 theta, f_final, f, n_iter, n_fev = fit_gpc_device_sharded(
-                    kernel, float(self._tol), self._mesh, theta0, lower, upper,
-                    data.x, data.y, data.mask, max_iter,
+                    kernel, float(self._tol), self._mesh, log_space, theta0,
+                    lower, upper, data.x, data.y, data.mask, max_iter,
                 )
             else:
                 theta, f_final, f, n_iter, n_fev = fit_gpc_device(
-                    kernel, float(self._tol), theta0, lower, upper,
+                    kernel, float(self._tol), log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter,
                 )
             theta_opt = _np.asarray(theta, dtype=_np.float64)

@@ -90,16 +90,17 @@ class GaussianProcessRegression(GaussianProcessCommons):
         max_iter = jnp.asarray(self._max_iter, dtype=jnp.int32)
         tol = jnp.asarray(self._tol, dtype=dtype)
 
+        log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
         with instr.phase("optimize_hypers"):
             if self._mesh is not None:
                 theta, f, n_iter, n_fev = fit_gpr_device_sharded(
-                    kernel, self._mesh, theta0, lower, upper,
+                    kernel, self._mesh, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
                 )
             else:
                 theta, f, n_iter, n_fev = fit_gpr_device(
-                    kernel, theta0, lower, upper,
+                    kernel, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
                 )
             theta = np.asarray(theta, dtype=np.float64)

@@ -229,12 +229,17 @@ def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
 # --- fully on-device fits (see likelihood.py counterparts) ----------------
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def fit_gpc_device(kernel: Kernel, tol, theta0, lower, upper, x, y, mask, max_iter):
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def fit_gpc_device(
+    kernel: Kernel, tol, log_space, theta0, lower, upper, x, y, mask, max_iter
+):
     """Single-chip on-device classifier fit; the latent warm-start stack is
     the optimizer's auxiliary carry.  Returns (theta, f_latents, nll, n_iter,
     n_fev)."""
-    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_minimize_device
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_minimize_device,
+        log_reparam,
+    )
 
     data = ExpertData(x=x, y=y, mask=mask)
 
@@ -242,20 +247,28 @@ def fit_gpc_device(kernel: Kernel, tol, theta0, lower, upper, x, y, mask, max_it
         value, grad, f_new = batched_neg_logz(kernel, tol, theta, data, f_carry)
         return value, grad, f_new
 
+    if log_space:
+        vag, theta0, lower, upper, from_u = log_reparam(vag, theta0, lower, upper)
+    else:
+        from_u = lambda t: t
+
     f0 = jnp.zeros_like(y)
     theta, f, f_final, n_iter, n_fev = lbfgs_minimize_device(
         vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
     )
-    return theta, f_final, f, n_iter, n_fev
+    return from_u(theta), f_final, f, n_iter, n_fev
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def fit_gpc_device_sharded(
-    kernel: Kernel, tol, mesh, theta0, lower, upper, x, y, mask, max_iter
+    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask, max_iter
 ):
     """Multi-chip on-device classifier fit inside one shard_map: latent
     stacks stay device-resident and sharded for the entire optimization."""
-    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_minimize_device
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_minimize_device,
+        log_reparam,
+    )
 
     @partial(
         jax.shard_map,
@@ -278,10 +291,15 @@ def fit_gpc_device_sharded(
                 f_new,
             )
 
+        if log_space:
+            vag, t0, lo, hi, from_u = log_reparam(vag, theta0_, lower_, upper_)
+        else:
+            vag, t0, lo, hi, from_u = vag, theta0_, lower_, upper_, (lambda t: t)
+
         f0 = jnp.zeros_like(y_)
         theta, f, f_final, n_iter, n_fev = lbfgs_minimize_device(
-            vag, theta0_, lower_, upper_, f0, max_iter=max_iter_, tol=tol
+            vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
         )
-        return theta, f_final, f, n_iter, n_fev
+        return from_u(theta), f_final, f, n_iter, n_fev
 
     return run(theta0, lower, upper, x, y, mask, max_iter)

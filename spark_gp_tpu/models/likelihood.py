@@ -120,11 +120,16 @@ def make_sharded_value_and_grad(kernel: Kernel, data: ExpertData, mesh):
 # --- fully on-device fits: the entire L-BFGS loop is ONE dispatch ---------
 
 
-@partial(jax.jit, static_argnums=0)
-def fit_gpr_device(kernel: Kernel, theta0, lower, upper, x, y, mask, max_iter, tol):
+@partial(jax.jit, static_argnums=(0, 1))
+def fit_gpr_device(
+    kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter, tol
+):
     """Single-chip on-device fit: objective + projected L-BFGS in one XLA
     program.  Returns (theta_opt, final_nll, n_iter, n_fev)."""
-    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_minimize_device
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_minimize_device,
+        log_reparam,
+    )
 
     data = ExpertData(x=x, y=y, mask=mask)
 
@@ -132,20 +137,28 @@ def fit_gpr_device(kernel: Kernel, theta0, lower, upper, x, y, mask, max_iter, t
         value, grad = jax.value_and_grad(lambda t: batched_nll(kernel, t, data))(theta)
         return value, grad, aux
 
+    if log_space:
+        vag, theta0, lower, upper, from_u = log_reparam(vag, theta0, lower, upper)
+    else:
+        from_u = lambda t: t
+
     theta, f, _, n_iter, n_fev = lbfgs_minimize_device(
         vag, theta0, lower, upper, jnp.zeros(()), max_iter=max_iter, tol=tol
     )
-    return theta, f, n_iter, n_fev
+    return from_u(theta), f, n_iter, n_fev
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(jax.jit, static_argnums=(0, 1, 2))
 def fit_gpr_device_sharded(
-    kernel: Kernel, mesh, theta0, lower, upper, x, y, mask, max_iter, tol
+    kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask, max_iter, tol
 ):
     """Multi-chip on-device fit: the WHOLE optimizer runs inside shard_map —
     per-iteration communication is exactly one psum of the scalar NLL plus
     the implicit gradient all-reduce, all over ICI, with zero host syncs."""
-    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_minimize_device
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_minimize_device,
+        log_reparam,
+    )
 
     @partial(
         jax.shard_map,
@@ -169,10 +182,14 @@ def fit_gpr_device_sharded(
             # shard_map's transpose rule.
             return jax.lax.psum(value, EXPERT_AXIS), grad, aux
 
+        if log_space:
+            vag, t0, lo, hi, from_u = log_reparam(vag, theta0_, lower_, upper_)
+        else:
+            vag, t0, lo, hi, from_u = vag, theta0_, lower_, upper_, (lambda t: t)
+
         theta, f, _, n_iter, n_fev = lbfgs_minimize_device(
-            vag, theta0_, lower_, upper_, jnp.zeros(()),
-            max_iter=max_iter_, tol=tol_,
+            vag, t0, lo, hi, jnp.zeros(()), max_iter=max_iter_, tol=tol_,
         )
-        return theta, f, n_iter, n_fev
+        return from_u(theta), f, n_iter, n_fev
 
     return run(theta0, lower, upper, x, y, mask, max_iter, tol)

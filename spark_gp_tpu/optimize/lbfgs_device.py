@@ -36,6 +36,27 @@ import jax
 import jax.numpy as jnp
 
 
+def log_reparam(value_and_grad_aux, theta0, lower, upper):
+    """Map a box-constrained objective to log-domain coordinates u = log(theta).
+
+    Returns ``(vag_u, u0, lower_u, upper_u, from_u)``.  See
+    ``optimize.lbfgsb.minimize_lbfgsb(log_space=True)`` for why GP marginal
+    likelihoods want this.  Caller guarantees theta0 > 0, lower >= 0.
+    """
+
+    def vag_u(u, aux):
+        theta = jnp.exp(u)
+        value, grad, aux2 = value_and_grad_aux(theta, aux)
+        return value, grad * theta, aux2
+
+    u0 = jnp.log(theta0)
+    lower_u = jnp.where(lower > 0, jnp.log(jnp.maximum(lower, 1e-300)), -jnp.inf)
+    upper_u = jnp.where(
+        jnp.isposinf(upper), jnp.inf, jnp.log(jnp.maximum(upper, 1e-300))
+    )
+    return vag_u, u0, lower_u, upper_u, jnp.exp
+
+
 class _LbfgsState(NamedTuple):
     theta: jax.Array  # [h]
     f: jax.Array  # scalar

@@ -34,6 +34,13 @@ class OptimizeResult:
     trace: list = field(default_factory=list)
 
 
+def log_space_applicable(theta0, lower) -> bool:
+    """Log-domain optimization needs strictly-positive initial values and
+    non-negative lower bounds (every GP scale/length hyperparameter in
+    practice)."""
+    return bool(np.all(np.asarray(theta0) > 0) and np.all(np.asarray(lower) >= 0))
+
+
 def minimize_lbfgsb(
     value_and_grad: Callable,
     theta0: np.ndarray,
@@ -42,6 +49,7 @@ def minimize_lbfgsb(
     max_iter: int = 100,
     tol: float = 1e-6,
     callback: Optional[Callable] = None,
+    log_space: bool = False,
 ) -> OptimizeResult:
     """Minimize ``value_and_grad`` subject to ``lower <= theta <= upper``.
 
@@ -49,8 +57,40 @@ def minimize_lbfgsb(
     are pulled to host (tiny transfers).  ``tol`` maps to both scipy's
     ``ftol`` and ``gtol`` — the closest match to Breeze LBFGSB's convergence
     ``tolerance`` (GaussianProcessCommons.scala:84-86).
+
+    ``log_space=True`` optimizes u = log(theta) (chain rule applied to the
+    gradient, bounds mapped through log).  GP marginal likelihoods are
+    notoriously ill-scaled in the linear domain — e.g. with uncentered
+    labels the amplitude hyperparameter's gradient dwarfs the length-scales',
+    L-BFGS-B inflates the amplitude first, and the fit collapses into the
+    constant-kernel local optimum (observed on the airfoil config; the same
+    collapse occurs in float64, so it is a landscape problem, not precision).
+    Log-domain coordinates equalize the scales and reach the good basin.
     """
     theta0 = np.asarray(theta0, dtype=np.float64)
+
+    if log_space:
+        if not log_space_applicable(theta0, lower):
+            raise ValueError(
+                "log-space optimization requires theta0 > 0 and lower >= 0"
+            )
+        inner = value_and_grad
+        u0 = np.log(theta0)
+        with np.errstate(divide="ignore"):
+            lo_u = np.where(lower > 0, np.log(np.maximum(lower, 1e-300)), -np.inf)
+            hi_u = np.where(np.isposinf(upper), np.inf, np.log(np.maximum(upper, 1e-300)))
+
+        def value_and_grad_u(u):
+            theta = np.exp(u)
+            value, grad = inner(theta)
+            return value, np.asarray(grad, dtype=np.float64) * theta
+
+        res = minimize_lbfgsb(
+            value_and_grad_u, u0, lo_u, hi_u,
+            max_iter=max_iter, tol=tol, callback=callback, log_space=False,
+        )
+        res.theta = np.exp(res.theta)
+        return res
     bounds = list(
         zip(
             [None if np.isneginf(lo) else float(lo) for lo in lower],
