@@ -4,6 +4,8 @@ from spark_gp_tpu.data.datasets import (
     load_airfoil,
     load_iris,
     load_mnist_binary,
+    load_protein,
+    load_year_msd,
     make_benchmark_data,
     make_synthetics,
 )
@@ -13,5 +15,7 @@ __all__ = [
     "load_airfoil",
     "load_iris",
     "load_mnist_binary",
+    "load_protein",
+    "load_year_msd",
     "make_benchmark_data",
 ]

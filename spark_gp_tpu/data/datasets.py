@@ -11,6 +11,9 @@
   synthetic stand-in shaped 784-d for pipeline/perf testing).
 * :func:`make_benchmark_data` — sin(sum(x)/1000), 3 uniform features
   (regression/benchmark/PerformanceBenchmark.scala:19-36).
+* :func:`load_protein` / :func:`load_year_msd` — the BASELINE.json UCI
+  stress configs (46k CASP, 515k Year-Prediction-MSD); real CSV when a path
+  is given, synthetic stand-ins of the same shape otherwise.
 """
 
 from __future__ import annotations
@@ -67,7 +70,7 @@ def load_mnist_binary(path: str | None = None, digits=(6, 8), seed: int = 0):
     deterministic synthetic 784-d two-class problem of the same shape is
     generated so the pipeline and benchmarks remain runnable.
     """
-    if path is not None and os.path.exists(path):
+    if path is not None:
         raw = np.loadtxt(path, delimiter=",")
         labels = raw[:, 0]
         keep = np.isin(labels, digits)
@@ -92,3 +95,59 @@ def make_benchmark_data(n: int, n_features: int = 3, seed: int = 13):
     x = rng.uniform(size=(n, n_features))
     y = np.sin(x.sum(axis=1) / 1000.0)
     return x, y
+
+
+def _synthetic_regression(n: int, p: int, seed: int, noise: float = 0.1):
+    """Nonlinear multi-scale regression surface used as the stand-in for the
+    UCI stress datasets when the real CSVs are unavailable (zero-egress
+    environment): y = sin(w1.x) + 0.5 cos(w2.x) + quadratic + noise."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, p))
+    w1 = rng.normal(size=p) / np.sqrt(p)
+    w2 = rng.normal(size=p) / np.sqrt(p)
+    y = (
+        np.sin(x @ w1)
+        + 0.5 * np.cos(3.0 * (x @ w2))
+        + 0.1 * (x @ w1) ** 2
+        + noise * rng.normal(size=n)
+    )
+    return x, y
+
+
+def _subsample(x, y, n, seed):
+    """Subsample n rows, preserving row order (Year-MSD's canonical
+    train/test split is positional)."""
+    if n is None or n >= x.shape[0]:
+        return x, y
+    idx = np.random.default_rng(seed).choice(x.shape[0], size=n, replace=False)
+    idx.sort()
+    return x[idx], y[idx]
+
+
+def load_protein(path: str | None = None, n: int | None = None, seed: int = 7):
+    """UCI Physicochemical-Properties-of-Protein-Tertiary-Structure (CASP):
+    45730 rows, 9 features, target RMSD — the BASELINE.json 46k stress
+    config for the product-of-experts reduction.
+
+    Reads the UCI ``RMSD,F1..F9`` CSV (one header row) when ``path`` is
+    given; without one, generates a synthetic stand-in of the same shape.
+    ``n`` subsamples either source.
+    """
+    if path is not None:
+        raw = np.loadtxt(path, delimiter=",", skiprows=1)
+        return _subsample(raw[:, 1:], raw[:, 0], n, seed)
+    return _synthetic_regression(n or 45730, 9, seed)
+
+
+def load_year_msd(path: str | None = None, n: int | None = None, seed: int = 11):
+    """Year-Prediction-MSD: 515345 rows, 90 timbre features, target year —
+    the BASELINE.json pod-scale inducing-point stress config.
+
+    Reads the UCI header-less ``year,F1..F90`` CSV when ``path`` is given;
+    without one, generates a synthetic stand-in of the same shape.  ``n``
+    subsamples either source.
+    """
+    if path is not None:
+        raw = np.loadtxt(path, delimiter=",")
+        return _subsample(raw[:, 1:], raw[:, 0], n, seed)
+    return _synthetic_regression(n or 515345, 90, seed)

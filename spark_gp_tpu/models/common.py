@@ -245,8 +245,6 @@ class GaussianProcessCommons(GaussianProcessParams):
             y64 = data.y.astype(jnp.float64)
             mask64 = data.mask.astype(jnp.float64)
             if self._mesh is not None:
-                from spark_gp_tpu.parallel.experts import ExpertData
-
                 stats_fn = ppa.make_sharded_kmn_stats(kernel, self._mesh)
                 u1, u2 = stats_fn(
                     theta_dev, active_dev, ExpertData(x=x64, y=y64, mask=mask64)

@@ -85,9 +85,12 @@ def minimize_lbfgsb(
             value, grad = inner(theta)
             return value, np.asarray(grad, dtype=np.float64) * theta
 
+        # Callbacks (checkpointers) must observe linear-domain theta, not the
+        # log-domain iterate the inner solver walks.
+        callback_u = None if callback is None else (lambda u: callback(np.exp(u)))
         res = minimize_lbfgsb(
             value_and_grad_u, u0, lo_u, hi_u,
-            max_iter=max_iter, tol=tol, callback=callback, log_space=False,
+            max_iter=max_iter, tol=tol, callback=callback_u, log_space=False,
         )
         res.theta = np.exp(res.theta)
         return res

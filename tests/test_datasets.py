@@ -1,0 +1,85 @@
+"""Dataset loader/generator contracts (shapes, determinism, CSV hooks)."""
+
+import numpy as np
+
+from spark_gp_tpu.data import (
+    load_airfoil,
+    load_iris,
+    load_mnist_binary,
+    load_protein,
+    load_year_msd,
+    make_benchmark_data,
+    make_synthetics,
+)
+
+
+def test_synthetics_shape_and_noise():
+    x, y = make_synthetics()
+    assert x.shape == (2000, 1) and y.shape == (2000,)
+    # y = sin(x) + N(0, 0.01): residuals should look like the noise
+    resid = y - np.sin(x[:, 0])
+    assert abs(resid.std() - 0.1) < 0.02
+
+
+def test_airfoil_shape():
+    x, y = load_airfoil()
+    assert x.shape == (1503, 5) and y.shape == (1503,)
+
+
+def test_iris_shape_and_classes():
+    x, y = load_iris()
+    assert x.shape == (150, 4)
+    assert sorted(np.unique(y)) == [0.0, 1.0, 2.0]
+    assert np.bincount(y.astype(int)).tolist() == [50, 50, 50]
+
+
+def test_mnist_binary_synthetic_standin():
+    x, y = load_mnist_binary()
+    assert x.shape[1] == 784
+    assert set(np.unique(y)) == {0.0, 1.0}
+    x2, y2 = load_mnist_binary()
+    np.testing.assert_array_equal(x, x2)  # deterministic
+
+
+def test_mnist_binary_csv(tmp_path):
+    """Label-first CSV path — the reference's mnist68.csv format
+    (MNIST.scala:22-26) with non-target digits filtered out."""
+    rows = np.array(
+        [
+            [6.0, 0.1, 0.2],
+            [8.0, 0.3, 0.4],
+            [3.0, 9.9, 9.9],  # dropped: not in (6, 8)
+            [6.0, 0.5, 0.6],
+        ]
+    )
+    path = tmp_path / "mnist.csv"
+    np.savetxt(path, rows, delimiter=",")
+    x, y = load_mnist_binary(str(path))
+    assert x.shape == (3, 2)
+    np.testing.assert_array_equal(y, [0.0, 1.0, 0.0])
+
+
+def test_protein_standin_and_subsample():
+    x, y = load_protein(n=500)
+    assert x.shape == (500, 9) and y.shape == (500,)
+
+
+def test_year_msd_standin_and_subsample():
+    x, y = load_year_msd(n=300)
+    assert x.shape == (300, 90) and y.shape == (300,)
+
+
+def test_missing_csv_path_raises():
+    """An explicitly-passed but absent CSV must not silently fall back to
+    synthetic data."""
+    import pytest
+
+    for loader in (load_mnist_binary, load_protein, load_year_msd):
+        with pytest.raises((FileNotFoundError, OSError)):
+            loader("/no/such/file.csv")
+
+
+def test_benchmark_data():
+    x, y = make_benchmark_data(1000)
+    assert x.shape == (1000, 3)
+    np.testing.assert_allclose(y, np.sin(x.sum(axis=1) / 1000.0))

@@ -114,6 +114,11 @@ def test_checkpoint_resume_through_estimator(tmp_path):
     state = load_checkpoint(str(tmp_path))
     assert state is not None
     assert state[0] >= 1
+    # The default hyper space is log-domain; the checkpoint must nonetheless
+    # hold LINEAR-domain theta (inside the kernel's box bounds), so a resume
+    # can seed theta0 from it directly.
+    _, theta = state
+    assert np.all(theta >= 1e-6) and np.all(theta <= 10.0)
 
 
 def test_kfold_partitions_everything():
