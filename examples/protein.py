@@ -17,7 +17,7 @@ import numpy as np
 
 from spark_gp_tpu import ARDRBFKernel, GaussianProcessRegression, WhiteNoiseKernel
 from spark_gp_tpu.data import load_protein
-from spark_gp_tpu.ops.scaling import scale
+from spark_gp_tpu.ops.scaling import fit_scaler
 from spark_gp_tpu.utils.validation import rmse
 
 
@@ -31,14 +31,18 @@ def main():
     args = parser.parse_args()
 
     x, y = load_protein(args.csv, n=args.n)
-    x = np.asarray(scale(x))
-    y_mean, y_std = y.mean(), y.std()
-    y_scaled = (y - y_mean) / y_std
 
     rng = np.random.default_rng(13)
     perm = rng.permutation(x.shape[0])
     cut = int(0.8 * x.shape[0])
     tr, te = perm[:cut], perm[cut:]
+
+    # Normalization statistics from the training split only — no test
+    # leakage into the reported RMSE.
+    mean, std = (np.asarray(s) for s in fit_scaler(x[tr]))
+    x = (x - mean) / std
+    y_mean, y_std = y[tr].mean(), y[tr].std()
+    y_scaled = (y - y_mean) / y_std
 
     gp = (
         GaussianProcessRegression()

@@ -205,12 +205,24 @@ def lbfgs_minimize_device(
             theta_cand = proj(state.theta + ls.t * direction)
             f_cand, g_cand, aux_cand = value_and_grad_aux(theta_cand, state.aux)
             delta = theta_cand - state.theta
+            # Non-finite value OR gradient marks the candidate unusable (an
+            # overflowed theta can yield a finite plateau f with NaN grad —
+            # accepting it would poison the next direction): treat as "too
+            # far" so the bracket shrinks.
+            finite = jnp.isfinite(f_cand) & jnp.all(jnp.isfinite(g_cand))
             armijo = (
                 f_cand <= state.f + armijo_c1 * jnp.dot(state.grad, delta)
-            ) & jnp.isfinite(f_cand)
+            ) & finite
             curv = jnp.dot(g_cand, direction) >= c2 * g_dot_d
             moved = jnp.max(jnp.abs(delta)) > 0
-            accept = armijo & curv & moved
+            # Box saturation: if growing t cannot move the projected iterate
+            # any further, the curvature test can never pass along this
+            # direction — accept the Armijo point instead of doubling t until
+            # max_ls (the clipped path is constant from here on).
+            saturated = jnp.all(
+                proj(state.theta + 2.0 * ls.t * direction) == theta_cand
+            )
+            accept = armijo & moved & (curv | saturated)
             # keep any Armijo point as the fallback iterate
             keep = accept | (armijo & moved)
             # bracket update: no Armijo -> shrink from above; Armijo but
@@ -239,8 +251,22 @@ def lbfgs_minimize_device(
                 n_fev=ls.n_fev + 1,
             )
 
+        # First iteration has no curvature history: the raw steepest-descent
+        # direction is unnormalized (its magnitude is the gradient's, which
+        # for a summed-over-experts NLL can be ~1e4), so a unit step would
+        # overflow log-domain coordinates.  Standard remedy: initial trial
+        # step min(1, 1/|d|_inf).  Once history exists, gamma scaling makes
+        # t=1 the right trial.
+        t_init = jnp.where(
+            state.hist_count == 0,
+            jnp.minimum(
+                jnp.ones((), dtype),
+                1.0 / jnp.maximum(jnp.max(jnp.abs(direction)), 1e-30),
+            ),
+            jnp.ones((), dtype),
+        )
         ls0 = LS(
-            t=jnp.ones((), dtype),
+            t=t_init,
             low=jnp.zeros((), dtype),
             high=jnp.asarray(jnp.inf, dtype),
             f_new=state.f,
