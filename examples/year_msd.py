@@ -33,11 +33,11 @@ def main():
 
     x, y = load_year_msd(args.csv, n=args.n)
 
-    if args.csv is not None and args.n is None:
+    if args.csv is not None and args.n is None and x.shape[0] > 463715:
         # UCI mandates a positional split (first 463715 train / last 51630
         # test) so no artist appears on both sides.  Only exact on the full
-        # file — a subsample cannot preserve the boundary, so subsampled
-        # smoke runs use a random split instead.
+        # file — a partial file or a subsample cannot preserve the boundary,
+        # so those fall through to the random split below.
         cut = 463715
         tr = np.arange(cut)
         te = np.arange(cut, x.shape[0])

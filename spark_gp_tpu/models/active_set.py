@@ -25,7 +25,15 @@ from spark_gp_tpu.ops.distance import sq_dist
 
 
 class ActiveSetProvider:
-    """SPI: ``(active_set_size, x, y, kernel, theta_opt, seed) -> [m, p]``."""
+    """SPI: ``(active_set_size, x, y, kernel, theta_opt, seed) -> [m, p]``.
+
+    ``uses_fit_outputs`` tells the training driver whether the provider reads
+    the fitted hyperparameters / targets at all: providers that only look at
+    ``x`` (random sampling, k-means) let the driver keep theta on device and
+    defer every host sync to one final fetch.
+    """
+
+    uses_fit_outputs = True
 
     def __call__(
         self,
@@ -41,6 +49,8 @@ class ActiveSetProvider:
 
 class _RandomActiveSetProvider(ActiveSetProvider):
     """Uniform sample of m training points (ASP.scala:48-56)."""
+
+    uses_fit_outputs = False
 
     def __call__(self, active_set_size, x, y, kernel, theta_opt, seed):
         rng = np.random.default_rng(seed)
@@ -61,6 +71,8 @@ class KMeansActiveSetProvider(ActiveSetProvider):
     one-hot matmul (segment mean without scatter — TPU-friendly).  Empty
     clusters keep their previous centroid.
     """
+
+    uses_fit_outputs = False
 
     def __init__(self, max_iter: int = 20):
         self.max_iter = max_iter

@@ -40,7 +40,7 @@ class GaussianProcessParams:
         self._seed: int = 0
         self._mesh = None
         self._checkpoint_dir: Optional[str] = None
-        self._optimizer: str = "host"
+        self._optimizer: str = "auto"
         self._hyper_space: str = "auto"
 
     # --- reference setter names (GaussianProcessParams.scala:32-53) -------
@@ -96,11 +96,27 @@ class GaussianProcessParams:
         device dispatch per evaluation; bitwise closest to the reference's
         Breeze LBFGSB).  ``"device"`` — the entire projected-L-BFGS loop runs
         on device in one XLA program (``optimize/lbfgs_device.py``); fastest
-        on high-dispatch-latency runtimes and multi-host pods."""
-        if value not in ("host", "device"):
-            raise ValueError("optimizer must be 'host' or 'device'")
+        on high-dispatch-latency runtimes and multi-host pods.  ``"auto"``
+        (default) — device on TPU, host elsewhere: every host-driven
+        objective evaluation costs a full host<->device round trip, which on
+        remote/tunneled TPU runtimes is ~100x the evaluation itself."""
+        if value not in ("auto", "host", "device"):
+            raise ValueError("optimizer must be 'auto', 'host' or 'device'")
         self._optimizer = value
         return self
+
+    def _resolved_optimizer(self) -> str:
+        if self._optimizer != "auto":
+            return self._optimizer
+        if self._checkpoint_dir is not None:
+            # L-BFGS state checkpointing hooks the host driver's per-step
+            # callback; the one-dispatch device loop has no step boundary to
+            # checkpoint at, so an explicit checkpoint dir keeps the host
+            # optimizer.
+            return "host"
+        import jax
+
+        return "device" if jax.default_backend() == "tpu" else "host"
 
     def setHyperSpace(self, value: str):
         """Coordinate system for hyperparameter optimization.
@@ -256,15 +272,98 @@ class GaussianProcessCommons(GaussianProcessParams):
             u1 = np.asarray(u1)
             u2 = np.asarray(u2)
 
+        return self._build_predictor(instr, kernel, theta_opt, active, u1, u2)
+
+    def _build_predictor(
+        self, instr: Instrumentation, kernel: Kernel, theta, active, u1, u2
+    ) -> ppa.ProjectedProcessRawPredictor:
+        """Shared tail of both fit paths: the host f64 magic solve
+        (PGPH.scala:49-60) and the serializable raw predictor."""
+        active64 = np.asarray(active, dtype=np.float64)
         with instr.phase("magic_solve"):
             magic_vector, magic_matrix = ppa.magic_solve(
-                kernel, theta_opt, active, u1, u2
+                kernel, theta, active64, u1, u2
             )
-
         return ppa.ProjectedProcessRawPredictor(
             kernel=kernel,
-            theta=np.asarray(theta_opt, dtype=np.float64),
-            active=active.astype(np.float64),
+            theta=np.asarray(theta, dtype=np.float64),
+            active=active64,
             magic_vector=magic_vector,
             magic_matrix=magic_matrix,
         )
+
+    def _finalize_device_fit(
+        self,
+        instr: Instrumentation,
+        kernel: Kernel,
+        theta_dev,
+        pending: dict,
+        x: np.ndarray,
+        targets_fn: Callable[[], np.ndarray],
+        data: ExpertData,
+    ):
+        """Device-pipelined PPA build: the optimizer's *device* theta chains
+        straight into the f64 (U1, u2) statistics program, and everything —
+        theta, the statistics, and the ``pending`` optimizer scalars — comes
+        back to the host in ONE ``device_get``.
+
+        On runtimes where every host<->device sync costs a full RTT (tunneled
+        TPU, multi-host pods where the driver sync stalls the ICI collective)
+        this turns the ~8 blocking transfers of the naive fit into one.
+
+        ``targets_fn`` lazily materializes the provider's y-targets (the
+        classifier's latent modes live on device; fetching them is a sync we
+        skip unless the provider actually reads them).
+
+        Returns ``(raw_predictor, fetched)`` with ``fetched`` mapping the
+        pending keys to host values.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        provider = self._active_set_provider
+        with instr.phase("active_set"):
+            if getattr(provider, "uses_fit_outputs", True):
+                # e.g. greedy Seeger scores read theta and the targets: a
+                # host sync is unavoidable for this provider family.
+                theta_host = np.asarray(theta_dev, dtype=np.float64)
+                active = provider(
+                    self._active_set_size, x, targets_fn(), kernel,
+                    theta_host, self._seed,
+                )
+            else:
+                active = provider(
+                    self._active_set_size, x, None, kernel, None, self._seed,
+                )
+        active64 = np.asarray(active, dtype=np.float64)
+
+        with instr.phase("kmn_stats"), jax.enable_x64():
+            active_dev = jnp.asarray(active64)
+            if self._mesh is not None:
+                u1_dev, u2_dev, theta64_dev = (
+                    ppa._sharded_kmn_stats_x64_from32_impl(
+                        kernel, self._mesh, theta_dev, active_dev,
+                        data.x, data.y, data.mask,
+                    )
+                )
+            else:
+                u1_dev, u2_dev, theta64_dev = ppa._kmn_stats_x64_from32_impl(
+                    kernel, theta_dev, active_dev, data.x, data.y, data.mask
+                )
+
+        keys = list(pending.keys())
+        with instr.phase("sync_fetch"):
+            vals = jax.device_get(
+                [theta64_dev, u1_dev, u2_dev] + [pending[k] for k in keys]
+            )
+        theta64, u1, u2 = vals[0], vals[1], vals[2]
+        fetched = dict(zip(keys, vals[3:]))
+        for key, val in fetched.items():
+            arr = np.asarray(val)
+            instr.log_metric(
+                key, int(arr) if np.issubdtype(arr.dtype, np.integer) else float(arr)
+            )
+        instr.log_info("Optimal kernel: " + kernel.describe(theta64))
+
+        raw = self._build_predictor(instr, kernel, theta64, active64, u1, u2)
+        return raw, fetched

@@ -55,8 +55,31 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             data = self._group(x, y)
         instr.log_metric("num_experts", data.num_experts)
 
-        if self._optimizer == "device":
-            theta_opt, f_final = self._fit_device(instr, kernel, data)
+        # PPA runs over the latent modes as targets (GPClf.scala:62-65), and
+        # the active-set provider also sees the latents, not the 0/1 labels —
+        # the reference substitutes f for y before produceModel.  targets_fn
+        # defers flattening (a device sync on the device path) until a
+        # provider actually reads the targets.
+        from spark_gp_tpu.parallel.experts import num_experts_for, ungroup
+
+        def make_targets_fn(latent_y):
+            def targets_fn():
+                e_real = num_experts_for(x.shape[0], self._dataset_size_for_expert)
+                return ungroup(np.asarray(latent_y)[:e_real], x.shape[0])
+
+            return targets_fn
+
+        if self._resolved_optimizer() == "device":
+            # Fully async pipeline: on-device Laplace + L-BFGS, the latent
+            # modes stay on device as the PPA targets, and the host syncs
+            # exactly once inside _finalize_device_fit.
+            theta_dev, f_final, pending = self._fit_device(instr, kernel, data)
+            latent_y = f_final * data.mask
+            latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
+            raw, _ = self._finalize_device_fit(
+                instr, kernel, theta_dev, pending, x,
+                make_targets_fn(latent_y), latent_data,
+            )
         else:
             if self._mesh is not None:
                 objective = make_sharded_laplace_objective(
@@ -83,24 +106,21 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             theta_dev = jnp.asarray(theta_opt, dtype=data.x.dtype)
             _, _, f_final = objective(theta_dev, state["f"])
 
-        # PPA over the latent modes as targets (GPClf.scala:62-65).  The
-        # active-set provider also sees the latents, not the 0/1 labels —
-        # the reference substitutes f for y before produceModel.
-        latent_data = ExpertData(x=data.x, y=f_final * data.mask, mask=data.mask)
-        from spark_gp_tpu.parallel.experts import num_experts_for, ungroup
+            latent_y = f_final * data.mask
+            latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
+            raw = self._projected_process(
+                instr, kernel, theta_opt, x, make_targets_fn(latent_y)(),
+                latent_data,
+            )
 
-        e_real = num_experts_for(x.shape[0], self._dataset_size_for_expert)
-        f_flat = ungroup(np.asarray(f_final * data.mask)[:e_real], x.shape[0])
-        raw = self._projected_process(instr, kernel, theta_opt, x, f_flat, latent_data)
         instr.log_success()
         model = GaussianProcessClassificationModel(raw)
         model.instr = instr
         return model
 
     def _fit_device(self, instr: Instrumentation, kernel, data):
-        """One-dispatch on-device classifier optimization."""
-        import numpy as _np
-
+        """Dispatch the one-program on-device Laplace optimization without
+        blocking: returns device (theta, latent modes) plus pending scalars."""
         from spark_gp_tpu.models.laplace import (
             fit_gpc_device,
             fit_gpc_device_sharded,
@@ -126,12 +146,8 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                     kernel, float(self._tol), log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter,
                 )
-            theta_opt = _np.asarray(theta, dtype=_np.float64)
-        instr.log_metric("lbfgs_iters", int(n_iter))
-        instr.log_metric("lbfgs_nfev", int(n_fev))
-        instr.log_metric("final_nll", float(f))
-        instr.log_info("Optimal kernel: " + kernel.describe(theta_opt))
-        return theta_opt, f_final
+        pending = {"lbfgs_iters": n_iter, "lbfgs_nfev": n_fev, "final_nll": f}
+        return theta, f_final, pending
 
 
 class GaussianProcessClassificationModel:

@@ -56,8 +56,14 @@ class GaussianProcessRegression(GaussianProcessCommons):
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("expert_size", data.expert_size)
 
-        if self._optimizer == "device":
-            theta_opt = self._fit_device(instr, kernel, data)
+        if self._resolved_optimizer() == "device":
+            # Fully async pipeline: the on-device L-BFGS, the f64 PPA
+            # statistics and the scalar diagnostics drain in one host sync
+            # inside _finalize_device_fit.
+            theta_dev, pending = self._fit_device(instr, kernel, data)
+            raw, _ = self._finalize_device_fit(
+                instr, kernel, theta_dev, pending, x, lambda: y, data
+            )
         else:
             if self._mesh is not None:
                 vag = make_sharded_value_and_grad(kernel, data, self._mesh)
@@ -66,15 +72,16 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
             checkpointer = self._make_checkpointer(kernel)
             theta_opt = self._optimize_hypers(instr, kernel, vag, callback=checkpointer)
-
-        raw = self._projected_process(instr, kernel, theta_opt, x, y, data)
+            raw = self._projected_process(instr, kernel, theta_opt, x, y, data)
         instr.log_success()
         model = GaussianProcessRegressionModel(raw)
         model.instr = instr
         return model
 
-    def _fit_device(self, instr: Instrumentation, kernel, data) -> np.ndarray:
-        """One-dispatch on-device optimization (optimize/lbfgs_device.py)."""
+    def _fit_device(self, instr: Instrumentation, kernel, data):
+        """Dispatch the one-program on-device optimization
+        (optimize/lbfgs_device.py) WITHOUT blocking: returns the device theta
+        plus the pending diagnostic scalars for a single deferred fetch."""
         import jax.numpy as jnp
 
         from spark_gp_tpu.models.likelihood import (
@@ -103,12 +110,8 @@ class GaussianProcessRegression(GaussianProcessCommons):
                     kernel, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
                 )
-            theta = np.asarray(theta, dtype=np.float64)
-        instr.log_metric("lbfgs_iters", int(n_iter))
-        instr.log_metric("lbfgs_nfev", int(n_fev))
-        instr.log_metric("final_nll", float(f))
-        instr.log_info("Optimal kernel: " + kernel.describe(theta))
-        return theta
+        pending = {"lbfgs_iters": n_iter, "lbfgs_nfev": n_fev, "final_nll": f}
+        return theta, pending
 
     def _make_checkpointer(self, kernel):
         if self._checkpoint_dir is None:

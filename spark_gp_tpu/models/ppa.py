@@ -51,22 +51,71 @@ from spark_gp_tpu.parallel.experts import ExpertData
 from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 
 
-def _expert_stats(kernel: Kernel, theta, active, x, y, mask):
-    """One expert's (K_mn K_nm, K_mn y) contribution, padding masked out."""
-    kmn = kernel.cross(theta, active, x) * mask[None, :]
+def _flat_stats(kernel: Kernel, theta, active, xf, yf, maskf):
+    """(K_mn K_nm, K_mn y) over a flat ``[c, p]`` point chunk — one big
+    MXU matmul with the m axis as rows, instead of c/s tiny per-expert
+    matmuls (the expert structure is irrelevant to these sums)."""
+    kmn = kernel.cross(theta, active, xf) * maskf[None, :]  # [m, c]
     u1 = jax.lax.dot_general(
         kmn, kmn, (((1,), (1,)), ((), ())), precision=jax.lax.Precision.HIGHEST
     )
-    u2 = kmn @ (y * mask)
+    u2 = kmn @ (yf * maskf)
     return u1, u2
 
 
+# Cap on the [m, chunk] cross-kernel intermediate (elements).  64M f64
+# entries = 512 MB — comfortably inside a v5e's HBM next to the data.
+_STATS_CHUNK_ELEMS = 64 * 1024 * 1024
+
+
 def kmn_stats(kernel: Kernel, theta, active, data: ExpertData):
-    """Single-device accumulation of (U1 [m,m], u2 [m]) over experts."""
-    u1, u2 = jax.vmap(_expert_stats, in_axes=(None, None, None, 0, 0, 0))(
-        kernel, theta, active, data.x, data.y, data.mask
+    """Accumulation of (U1 [m,m], u2 [m]) over all experts.
+
+    Flattens the expert stack (the sums don't care about expert boundaries
+    — PGPH.scala:25-35 just adds per-expert pieces) and processes it in
+    memory-bounded chunks via ``lax.scan``, each chunk one MXU matmul.
+    """
+    e, s, p = data.x.shape
+    m = active.shape[0]
+    n_flat = e * s
+    xf = data.x.reshape(n_flat, p)
+    yf = data.y.reshape(n_flat)
+    maskf = data.mask.reshape(n_flat)
+
+    chunk = max(1, min(n_flat, _STATS_CHUNK_ELEMS // max(m, 1)))
+    n_chunks = -(-n_flat // chunk)
+    if n_chunks <= 1:
+        return _flat_stats(kernel, theta, active, xf, yf, maskf)
+
+    pad = n_chunks * chunk - n_flat
+    # Pad features with copies of the first point, not zeros — the mask
+    # already excludes padding from the sums, but a custom kernel may be
+    # non-finite at the zero point and NaN * 0 would poison U1 (same benign-
+    # padding convention as group_for_experts).
+    xf = jnp.concatenate([xf, jnp.broadcast_to(xf[:1], (pad, p))], axis=0)
+    yf = jnp.pad(yf, ((0, pad),))
+    maskf = jnp.pad(maskf, ((0, pad),))
+
+    def body(carry, args):
+        u1, u2 = carry
+        xc, yc, mc = args
+        du1, du2 = _flat_stats(kernel, theta, active, xc, yc, mc)
+        return (u1 + du1, u2 + du2), None
+
+    init = (
+        jnp.zeros((m, m), dtype=xf.dtype),
+        jnp.zeros((m,), dtype=xf.dtype),
     )
-    return jnp.sum(u1, axis=0), jnp.sum(u2, axis=0)
+    (u1, u2), _ = jax.lax.scan(
+        body,
+        init,
+        (
+            xf.reshape(n_chunks, chunk, p),
+            yf.reshape(n_chunks, chunk),
+            maskf.reshape(n_chunks, chunk),
+        ),
+    )
+    return u1, u2
 
 
 @partial(jax.jit, static_argnums=0)
@@ -100,6 +149,57 @@ def make_sharded_kmn_stats(kernel: Kernel, mesh):
     return lambda theta, active, data: _sharded_kmn_stats_impl(
         kernel, mesh, theta, active, data.x, data.y, data.mask
     )
+
+
+@partial(jax.jit, static_argnums=0)
+def _kmn_stats_x64_from32_impl(kernel: Kernel, theta32, active64, x32, y32, mask32):
+    """Fused f64 (U1, u2) statistics taking the *f32 device* optimum directly.
+
+    The upcasts happen inside the one program so the optimizer's device theta
+    chains into the PPA stage with zero host round-trips — on high-RTT
+    runtimes (tunneled TPU, multi-host pods) every intermediate
+    ``np.asarray`` costs a full sync.  Requires ``jax.enable_x64()`` at call
+    time.  Returns ``(u1, u2, theta64)`` so the caller can fetch everything
+    in a single ``device_get``.
+    """
+    theta64 = theta32.astype(jnp.float64)
+    data = ExpertData(
+        x=x32.astype(jnp.float64),
+        y=y32.astype(jnp.float64),
+        mask=mask32.astype(jnp.float64),
+    )
+    u1, u2 = kmn_stats(kernel, theta64, active64, data)
+    return u1, u2, theta64
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _sharded_kmn_stats_x64_from32_impl(
+    kernel: Kernel, mesh, theta32, active64, x32, y32, mask32
+):
+    """Sharded variant of :func:`_kmn_stats_x64_from32_impl`: experts sharded,
+    active set replicated, one psum over ICI (PGPH.scala:25-35)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS)),
+        out_specs=(P(), P(), P()),
+    )
+    def sharded(theta_, active_, x_, y_, mask_):
+        theta64 = theta_.astype(jnp.float64)
+        local = ExpertData(
+            x=x_.astype(jnp.float64),
+            y=y_.astype(jnp.float64),
+            mask=mask_.astype(jnp.float64),
+        )
+        u1, u2 = kmn_stats(kernel, theta64, active_, local)
+        return (
+            jax.lax.psum(u1, EXPERT_AXIS),
+            jax.lax.psum(u2, EXPERT_AXIS),
+            theta64,
+        )
+
+    return sharded(theta32, active64, x32, y32, mask32)
 
 
 def magic_solve(

@@ -1,0 +1,276 @@
+"""Batched SPD inverse + logdet as one Pallas TPU kernel.
+
+The BCM likelihood (likelihood.py) needs, for every expert's s x s Gram
+matrix: log|K|, alpha = K^-1 y, and — for the gradient — the full K^-1
+(dNLL/dK = 0.5*(K^-1 - alpha alpha^T), GaussianProcessRegression.scala:63-67).
+XLA's batched Cholesky lowering on TPU costs ~11us per 100x100 matrix (a
+sequential column loop that leaves the VPU idle), and the autodiff backward
+adds two batched triangular solves on top.  This kernel replaces the whole
+factor/solve/invert chain with ONE fused pass producing (K^-1, logdet).
+
+Algorithm: blocked right-looking Cholesky, factoring and inverting together.
+
+* the batch rides the sublane dimension — each grid instance holds
+  ``[T=8, 128, 128]`` matrices in VMEM and processes all 8 in lockstep;
+* the 128 columns go in 4 static blocks of 32: the 32x32 diagonal block is
+  factored scalar-by-scalar on the VPU (cheap: 1k elements/step), its
+  inverse accumulated simultaneously from the elementary-column factors
+  (E_j^-1 applications — VPU rank-1s, no transposes); panels and trailing
+  Schur updates are MXU matmuls, so the O(n^3) work rides the systolic
+  array;
+* W = L^-1 is assembled block-row by block-row (the standard blocked
+  triangular inversion), and K^-1 = W^T W is one final batched matmul.
+
+Stability is Cholesky-class: panels are scaled by L33^-1 whose norm grows
+like sqrt(cond K) — unlike a Gauss-Jordan sweep, whose explicit pivot-block
+inverses square the conditioning and NaN out on the cond ~ 1e6 matrices the
+hyperparameter search routinely visits (an earlier sweep-based version of
+this kernel failed exactly that way).  A genuinely non-PD input produces
+sqrt(p <= 0) = NaN, which propagates to the NLL exactly like a failed
+Cholesky in the fallback path.
+
+``spd_inv_logdet`` is the public entry: custom-VJP'd (the cotangent is two
+batched matmuls — no triangular solves anywhere), with an XLA Cholesky
+fallback for CPU, float64, or n > 128.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_T = 8  # matrices per grid instance (f32 sublane tile)
+_N = 128  # padded matrix size (lane width)
+_NB = 32  # diagonal block size
+_BLOCKS = _N // _NB
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _bmm(a, b, contract=(2, 1)):
+    """Unrolled batch matmul over the static T axis.
+
+    ``contract=(i, j)`` contracts dim i of ``a`` with dim j of ``b`` (both
+    counted with the batch dim present), so transposes never materialize:
+    ``(2,1)`` = a @ b, ``(2,2)`` = a @ b^T, ``(1,1)`` = a^T @ b.
+
+    HIGHEST precision: the default bf16 MXU path costs ~1e-3 relative error
+    on the inverse — fatal for L-BFGS line-search consistency; the 6-pass
+    f32 emulation keeps everything at true f32 accuracy.
+    """
+    return jnp.stack(
+        [
+            jax.lax.dot_general(
+                a[t],
+                b[t],
+                ((( contract[0] - 1,), (contract[1] - 1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_HI,
+            )
+            for t in range(a.shape[0])
+        ]
+    )
+
+
+def _row(mat, j, rows):
+    """Row j of ``[T,n,n]`` by masked sublane-reduction -> ``[T,1,n]``."""
+    return jnp.sum(jnp.where(rows == j, mat, 0.0), axis=1, keepdims=True)
+
+
+def _mini_chol_inv(p0):
+    """Scalar Cholesky of ``[T,32,32]`` SPD blocks, fused with inversion.
+
+    Returns ``(L, L^-1, logdet)``.  L^-1 is accumulated by applying each
+    elementary factor's inverse on the left: with E_j = I + (c_j - e_j)e_j^T
+    (c_j = j-th Cholesky column) we have L = E_0 ... E_31 and
+    E_j^-1 X = X + v_j X[j,:] with v_j = -(c_j - e_j)/l_j — a VPU rank-1
+    per step, reading row j by masked reduction (no transposes, no
+    triangular solves).
+    """
+    t = p0.shape[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (t, _NB, _NB), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, _NB, _NB), 2)
+    riota = jax.lax.broadcasted_iota(jnp.int32, (t, _NB, 1), 1)
+    eye = (rows == cols).astype(jnp.float32)
+
+    def step(j, carry):
+        schur, l_mat, li_mat, ld = carry
+        row = _row(schur, j, rows)  # [T,1,32]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (t, 1, _NB), 2)
+        piv = jnp.sum(jnp.where(lane == j, row, 0.0), axis=2, keepdims=True)
+        col = jnp.sum(
+            jnp.where(cols == j, schur, 0.0), axis=2, keepdims=True
+        )  # [T,32,1] — Schur complement stays symmetric: column j == row j
+        sqrt_p = jnp.sqrt(piv)
+        schur = schur - col * (row / piv)  # trailing rank-1 (stale top rows
+        #                                   are never read again)
+        col_l = jnp.where(riota >= j, col / sqrt_p, 0.0)
+        l_mat = jnp.where(cols == j, col_l, l_mat)
+        # Li <- E_j^-1 @ Li
+        v = jnp.where(riota > j, -col / piv, 0.0) + jnp.where(
+            riota == j, 1.0 / sqrt_p - 1.0, 0.0
+        )
+        li_mat = li_mat + v * _row(li_mat, j, rows)
+        return schur, l_mat, li_mat, ld + jnp.log(piv[:, 0, 0])
+
+    _, l_mat, li_mat, ld = jax.lax.fori_loop(
+        0,
+        _NB,
+        step,
+        (p0, jnp.zeros_like(p0), eye, jnp.zeros((t,), jnp.float32)),
+    )
+    return l_mat, li_mat, ld
+
+
+def _chol_inv_kernel(k_ref, kinv_ref, ld_ref, a_ref, w_ref):
+    a_ref[:] = k_ref[:]
+    w_ref[:] = jnp.zeros((_T, _N, _N), jnp.float32)
+    ld = jnp.zeros((_T,), jnp.float32)
+
+    for b in range(_BLOCKS):
+        j0 = b * _NB
+        hi = j0 + _NB
+        pivot = a_ref[:, j0:hi, j0:hi]
+        l33, l33_inv, ld_b = _mini_chol_inv(pivot)
+        ld = ld + ld_b
+        a_ref[:, j0:hi, j0:hi] = l33
+        w_ref[:, j0:hi, j0:hi] = l33_inv
+        if b + 1 < _BLOCKS:
+            c_panel = a_ref[:, hi:, j0:hi]  # [T, rest, 32]
+            l_panel = _bmm(c_panel, l33_inv, contract=(2, 2))  # C L33^-T
+            a_ref[:, hi:, j0:hi] = l_panel
+            a_ref[:, hi:, hi:] = a_ref[:, hi:, hi:] - _bmm(
+                l_panel, l_panel, contract=(2, 2)
+            )
+        # blocked triangular inversion, row b of W = L^-1:
+        # W[b,c] = -L33inv @ sum_{c <= k < b} L[b,k] W[k,c]
+        for c in range(b):
+            c0 = c * _NB
+            acc = None
+            for k in range(c, b):
+                k0 = k * _NB
+                term = _bmm(
+                    a_ref[:, j0:hi, k0 : k0 + _NB],
+                    w_ref[:, k0 : k0 + _NB, c0 : c0 + _NB],
+                )
+                acc = term if acc is None else acc + term
+            w_ref[:, j0:hi, c0 : c0 + _NB] = -_bmm(l33_inv, acc)
+
+    # K^-1 = L^-T L^-1 = W^T W
+    kinv_ref[:] = _bmm(w_ref[:], w_ref[:], contract=(1, 1))
+    ld_ref[:] = jnp.broadcast_to(ld[:, None], (_T, _N))
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _factor_batched(k, interpret: bool = False):
+    """``[B, 128, 128] f32 -> (K^-1 [B,128,128], logdet [B])`` — B a multiple
+    of 8."""
+    b = k.shape[0]
+    grid = (b // _T,)
+    kinv, ld = pl.pallas_call(
+        _chol_inv_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_T, _N, _N), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=[
+            pl.BlockSpec((_T, _N, _N), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_T, _N), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, _N, _N), jnp.float32),
+            jax.ShapeDtypeStruct((b, _N), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_T, _N, _N), jnp.float32),
+            pltpu.VMEM((_T, _N, _N), jnp.float32),
+        ],
+        interpret=interpret,
+    )(k)
+    return kinv, ld[:, 0]
+
+
+def _pad_to_kernel_shape(k):
+    """Embed ``[B, n, n]`` (n <= 128) into identity-padded ``[B8, 128, 128]``:
+    unit diagonal in the pad block contributes logdet 0 and an identity
+    inverse block, both sliced away on return."""
+    b, n = k.shape[0], k.shape[-1]
+    b_pad = (-b) % _T
+    n_pad = _N - n
+    k = jnp.pad(k, ((0, b_pad), (0, n_pad), (0, n_pad)))
+    if n_pad:
+        diag = jnp.concatenate(
+            [jnp.zeros((n,), k.dtype), jnp.ones((n_pad,), k.dtype)]
+        )
+        k = k + jnp.diag(diag)[None, :, :]
+    if b_pad:
+        # padded batch entries are all-zero matrices -> make them identity
+        pad_eye = jnp.eye(_N, dtype=k.dtype)
+        sel = (jnp.arange(b + b_pad) >= b)[:, None, None]
+        k = jnp.where(sel, pad_eye[None], k)
+    return k, b, n
+
+
+def _pallas_inv_logdet(k, interpret: bool = False):
+    k_pad, b, n = _pad_to_kernel_shape(k)
+    kinv, ld = _factor_batched(k_pad, interpret)
+    return kinv[:b, :n, :n], ld[:b]
+
+
+def _chol_inv_logdet(k):
+    """XLA fallback: one Cholesky, logdet from the diagonal, inverse by
+    triangular solves against I."""
+    chol_l = jnp.linalg.cholesky(k)
+    diag = jnp.diagonal(chol_l, axis1=-2, axis2=-1)
+    logdet = 2.0 * jnp.sum(jnp.log(diag), axis=-1)
+    eye = jnp.broadcast_to(jnp.eye(k.shape[-1], dtype=k.dtype), k.shape)
+    y = jax.scipy.linalg.solve_triangular(chol_l, eye, lower=True)
+    kinv = jax.scipy.linalg.solve_triangular(
+        chol_l, y, lower=True, trans=1
+    )
+    return kinv, logdet
+
+
+def _use_pallas(k) -> bool:
+    return (
+        jax.default_backend() == "tpu"
+        and k.dtype == jnp.float32
+        and k.ndim == 3
+        and k.shape[-1] <= _N
+    )
+
+
+@jax.custom_vjp
+def spd_inv_logdet(k):
+    """``[B, n, n] SPD -> (K^-1 [B,n,n], logdet [B])``.
+
+    One fused Pallas blocked-Cholesky pass on TPU f32 (n <= 128); Cholesky +
+    triangular solves elsewhere.  Non-PD inputs yield NaNs (never an
+    exception — surfaced like a failed Cholesky).
+    """
+    if _use_pallas(k):
+        return _pallas_inv_logdet(k)
+    return _chol_inv_logdet(k)
+
+
+def _spd_fwd(k):
+    kinv, logdet = spd_inv_logdet(k)
+    return (kinv, logdet), kinv
+
+
+def _spd_bwd(kinv, cotangents):
+    g_kinv, g_logdet = cotangents
+    # d logdet / dK = K^-1 (symmetric); d K^-1 / dK applied to a cotangent G
+    # is -K^-1 G K^-1.  Two batched MXU matmuls — no triangular solves.
+    kbar = -jnp.einsum(
+        "bij,bjk,bkl->bil", kinv, g_kinv, kinv, precision=_HI
+    )
+    kbar = kbar + g_logdet[:, None, None] * kinv
+    return (kbar,)
+
+
+spd_inv_logdet.defvjp(_spd_fwd, _spd_bwd)

@@ -87,23 +87,16 @@ def group_for_experts(
     e = num_experts_for(n, dataset_size_for_expert)
     s = math.ceil(n / e)
 
-    # Number of real points in each expert under `index % E` assignment:
-    # experts 0 .. (n % e - 1) get ceil(n/e), the rest floor(n/e) ... careful:
-    # point i -> expert i % e; expert j holds indices j, j+e, ..., count =
-    # ceil((n - j) / e).
-    counts = np.array([math.ceil((n - j) / e) for j in range(e)])
+    # Expert j, slot t holds point j + t*e (when < n) — one vectorized
+    # gather, no per-expert Python loop.  Padded slots gather the expert's
+    # first point (benign values, masked out of every reduction).
+    point = np.arange(e)[:, None] + np.arange(s)[None, :] * e  # [e, s]
+    valid = point < n
+    gather = np.where(valid, point, np.arange(e)[:, None])
 
-    xg = np.zeros((e, s, x.shape[1]), dtype=x.dtype)
-    yg = np.zeros((e, s), dtype=y.dtype)
-    mask = np.zeros((e, s), dtype=x.dtype)
-    for j in range(e):
-        idx = np.arange(j, n, e)
-        xg[j, : counts[j]] = x[idx]
-        yg[j, : counts[j]] = y[idx]
-        mask[j, : counts[j]] = 1.0
-        if counts[j] < s and counts[j] > 0:
-            # benign padding features: repeat the first real point
-            xg[j, counts[j] :] = x[idx[0]]
+    xg = x[gather]  # [e, s, p]
+    yg = np.where(valid, y[gather], 0.0).astype(y.dtype)
+    mask = valid.astype(x.dtype)
 
     if dtype is not None:
         xg = xg.astype(dtype)
@@ -118,8 +111,8 @@ def ungroup(values: np.ndarray, n_points: int) -> np.ndarray:
     dropped."""
     values = np.asarray(values)
     e, s = values.shape
+    point = np.arange(e)[:, None] + np.arange(s)[None, :] * e  # [e, s]
+    valid = point < n_points
     out = np.zeros(n_points, dtype=values.dtype)
-    for j in range(e):
-        idx = np.arange(j, n_points, e)
-        out[idx] = values[j, : len(idx)]
+    out[point[valid]] = values[valid]
     return out

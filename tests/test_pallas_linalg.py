@@ -1,0 +1,94 @@
+"""Tests for the batched Pallas SPD sweep (ops/pallas_linalg.py).
+
+The TPU kernel is exercised through the Pallas interpreter so CI stays
+CPU-only — the math (blocked symmetric sweep) is identical; only the Mosaic
+lowering differs.  The public ``spd_inv_logdet`` entry falls back to the
+Cholesky path on CPU, which the rest of the suite covers transitively via
+the likelihood oracle tests.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spark_gp_tpu.ops.pallas_linalg import (
+    _chol_inv_logdet,
+    _pallas_inv_logdet,
+    spd_inv_logdet,
+)
+
+
+def _spd_batch(b, n, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=(b, n, n)).astype(dtype)
+    return a @ a.transpose(0, 2, 1) + n * np.eye(n, dtype=dtype)
+
+
+@pytest.mark.parametrize("b,n", [(1, 4), (5, 60), (8, 128), (3, 100)])
+def test_sweep_matches_numpy(b, n):
+    k = _spd_batch(b, n)
+    kinv, ld = _pallas_inv_logdet(jnp.asarray(k), interpret=True)
+    kinv_ref = np.linalg.inv(k.astype(np.float64))
+    _, ld_ref = np.linalg.slogdet(k.astype(np.float64))
+    scale = np.max(np.abs(kinv_ref))
+    np.testing.assert_allclose(np.asarray(kinv), kinv_ref, atol=5e-5 * scale)
+    np.testing.assert_allclose(np.asarray(ld), ld_ref, rtol=1e-5, atol=1e-4)
+
+
+def test_sweep_batch_padding():
+    # batch not a multiple of the sublane tile: pad entries are identity
+    # matrices and must not leak into real outputs
+    k = _spd_batch(3, 100, seed=1)
+    kinv, ld = _pallas_inv_logdet(jnp.asarray(k), interpret=True)
+    assert kinv.shape == (3, 100, 100)
+    assert ld.shape == (3,)
+    _, ld_ref = np.linalg.slogdet(k.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(ld), ld_ref, rtol=1e-5, atol=1e-4)
+
+
+def test_fallback_matches_sweep():
+    k = _spd_batch(4, 32, seed=2)
+    kinv_f, ld_f = _chol_inv_logdet(jnp.asarray(k))
+    kinv_p, ld_p = _pallas_inv_logdet(jnp.asarray(k), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(kinv_f), np.asarray(kinv_p), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ld_f), np.asarray(ld_p), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_custom_vjp_matches_autodiff_cholesky():
+    """Gradient through spd_inv_logdet == autodiff through the plain
+    Cholesky formulation (the public entry uses the fallback on CPU, so
+    this validates the custom VJP formula itself)."""
+    k = _spd_batch(3, 20, seed=3, dtype=np.float64)
+    y = np.random.default_rng(4).normal(size=(3, 20))
+
+    def nll_via_entry(km):
+        kinv, ld = spd_inv_logdet(km)
+        alpha = jnp.einsum("bij,bj->bi", kinv, jnp.asarray(y))
+        return 0.5 * jnp.einsum("bi,bi->", jnp.asarray(y), alpha) + 0.5 * jnp.sum(ld)
+
+    def nll_via_chol(km):
+        chol = jnp.linalg.cholesky(km)
+        sol = jax.scipy.linalg.cho_solve((chol, True), y)
+        ld = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(chol, axis1=-2, axis2=-1)), axis=-1
+        )
+        return 0.5 * jnp.einsum("bi,bi->", jnp.asarray(y), sol) + 0.5 * jnp.sum(ld)
+
+    g_entry = jax.grad(nll_via_entry)(jnp.asarray(k))
+    g_chol = jax.grad(nll_via_chol)(jnp.asarray(k))
+    np.testing.assert_allclose(
+        np.asarray(g_entry), np.asarray(g_chol), rtol=1e-8, atol=1e-10
+    )
+
+
+def test_non_pd_yields_nan():
+    k = np.eye(8, dtype=np.float32)[None].repeat(2, 0)
+    k[1, 0, 0] = -1.0  # indefinite
+    kinv, ld = _pallas_inv_logdet(jnp.asarray(k), interpret=True)
+    assert np.isfinite(np.asarray(ld)[0])
+    assert not np.isfinite(np.asarray(ld)[1])
