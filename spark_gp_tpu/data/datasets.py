@@ -25,6 +25,17 @@ import numpy as np
 _DATA_DIR = os.path.join(os.path.dirname(__file__), "files")
 
 
+def _read_csv(path: str, skip_rows: int = 0) -> np.ndarray:
+    """Numeric CSV -> float64 [rows, cols]: the native parallel parser
+    (spark_gp_tpu.native, the counterpart of the reference's Spark CSV
+    ingestion runtime) when it builds, ``np.loadtxt`` otherwise."""
+    from spark_gp_tpu import native
+
+    if native.available():
+        return native.read_csv(path, skip_rows=skip_rows)
+    return np.loadtxt(path, delimiter=",", skiprows=skip_rows, ndmin=2)
+
+
 def make_synthetics(n: int = 2000, noise_var: float = 0.01, seed: int = 13):
     x = np.linspace(0.0, 1.0, n).reshape(n, 1)
     rng = np.random.default_rng(seed)
@@ -36,7 +47,7 @@ def load_airfoil(path: str | None = None):
     """Returns (x [1503, 5], y [1503]) — frequency, angle of attack, chord
     length, free-stream velocity, displacement thickness -> sound pressure."""
     path = path or os.path.join(_DATA_DIR, "airfoil.csv")
-    raw = np.loadtxt(path, delimiter=",")
+    raw = _read_csv(path)
     return raw[:, :5], raw[:, 5]
 
 
@@ -71,7 +82,7 @@ def load_mnist_binary(path: str | None = None, digits=(6, 8), seed: int = 0):
     generated so the pipeline and benchmarks remain runnable.
     """
     if path is not None:
-        raw = np.loadtxt(path, delimiter=",")
+        raw = _read_csv(path)
         labels = raw[:, 0]
         keep = np.isin(labels, digits)
         x = raw[keep, 1:]
@@ -134,7 +145,7 @@ def load_protein(path: str | None = None, n: int | None = None, seed: int = 7):
     ``n`` subsamples either source.
     """
     if path is not None:
-        raw = np.loadtxt(path, delimiter=",", skiprows=1)
+        raw = _read_csv(path, skip_rows=1)
         return _subsample(raw[:, 1:], raw[:, 0], n, seed)
     return _synthetic_regression(n or 45730, 9, seed)
 
@@ -148,6 +159,6 @@ def load_year_msd(path: str | None = None, n: int | None = None, seed: int = 11)
     subsamples either source.
     """
     if path is not None:
-        raw = np.loadtxt(path, delimiter=",")
+        raw = _read_csv(path)
         return _subsample(raw[:, 1:], raw[:, 0], n, seed)
     return _synthetic_regression(n or 515345, 90, seed)

@@ -1,0 +1,152 @@
+"""ctypes bindings for the native data-plane runtime (gpdata.cpp).
+
+Built on first use with g++ (the image ships no pybind11; ctypes over a C
+ABI keeps the binding dependency-free).  The shared object is cached next to
+the source keyed by a source hash, so rebuilds happen only when the C++
+changes.  Every entry point degrades gracefully: if the toolchain or the
+build is unavailable, ``available()`` is False and callers fall back to
+numpy — the framework never hard-requires the native path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "gpdata.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _so_path() -> str:
+    with open(_SRC, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    return os.path.join(_DIR, f"_gpdata_{digest}.so")
+
+
+def _build(so_path: str) -> None:
+    # Atomic build: compile to a temp name, rename into place.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            [
+                "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                _SRC, "-o", tmp,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, so_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            so = _so_path()
+            if not os.path.exists(so):
+                _build(so)
+            lib = ctypes.CDLL(so)
+            lib.gpdata_read_csv.restype = ctypes.c_int
+            lib.gpdata_read_csv.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.gpdata_free.restype = None
+            lib.gpdata_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+            lib.gpdata_zscore.restype = None
+            lib.gpdata_zscore.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int64,
+                ctypes.c_int64,
+            ]
+            lib.gpdata_num_threads.restype = ctypes.c_int
+            _lib = lib
+        except Exception:
+            _build_failed = True
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library built (or loaded from cache) successfully."""
+    return _load() is not None
+
+
+_ERRORS = {
+    -1: "cannot open file",
+    -2: "mmap failed",
+    -3: "no data rows",
+    -4: "allocation failed",
+    -5: "malformed field or ragged row",
+}
+
+
+def read_csv(path: str, skip_rows: int = 0) -> np.ndarray:
+    """Parallel CSV parse -> float64 ``[rows, cols]``.
+
+    Raises ``RuntimeError`` when the native library is unavailable — callers
+    that want transparent degradation should use
+    :func:`spark_gp_tpu.data.datasets._read_csv`, which falls back to
+    ``np.loadtxt``.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native gpdata library unavailable")
+    out = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.gpdata_read_csv(
+        os.fsencode(path), skip_rows, ctypes.byref(out),
+        ctypes.byref(rows), ctypes.byref(cols),
+    )
+    if rc == -1 and not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if rc != 0:
+        raise ValueError(
+            f"gpdata_read_csv({path!r}): {_ERRORS.get(rc, f'error {rc}')}"
+        )
+    try:
+        arr = np.ctypeslib.as_array(out, shape=(rows.value, cols.value)).copy()
+    finally:
+        lib.gpdata_free(out)
+    return arr
+
+
+def zscore(x: np.ndarray) -> np.ndarray:
+    """Column-standardize a float64 C-contiguous copy of ``x`` in native
+    code (zero-variance columns left unscaled, Scaling.scala:18)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native gpdata library unavailable")
+    x = np.ascontiguousarray(x, dtype=np.float64).copy()
+    if x.ndim != 2:
+        raise ValueError("zscore expects [rows, cols]")
+    lib.gpdata_zscore(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        x.shape[0],
+        x.shape[1],
+    )
+    return x

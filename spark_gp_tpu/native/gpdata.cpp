@@ -1,0 +1,268 @@
+// Native data-plane runtime: parallel CSV parsing + z-score scaling.
+//
+// The reference delegates its data plane to the Spark JVM runtime: CSV
+// ingestion via the DataFrame reader (regression/examples/Airfoil.scala:26-33,
+// classification/examples/MNIST.scala:20-26) and feature standardization as a
+// two-pass RDD reduce (commons/util/Scaling.scala:10-25).  This file is the
+// TPU framework's native equivalent of that runtime layer: the accelerator
+// never touches it, but host-side ingest throughput decides how fast a
+// 500k-row stress config (Year-Prediction-MSD) reaches the chip.
+//
+// Exposed as a plain C ABI consumed through ctypes (no pybind11 in the
+// image); built on first use by spark_gp_tpu/native/__init__.py.
+//
+//   gpdata_read_csv   mmap the file, split at newline boundaries into one
+//                     span per hardware thread, two passes (count rows /
+//                     parse in place) so the output is a single contiguous
+//                     row-major [rows, cols] float64 buffer with no
+//                     inter-thread synchronization on the hot path.
+//   gpdata_zscore     column-wise (x - mean) / std in parallel, zero-variance
+//                     columns clamped to std=1 (Scaling.scala:18 semantics).
+//   gpdata_free       release a buffer returned by gpdata_read_csv.
+
+#include <cerrno>
+#include <charconv>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Span {
+  const char* begin;
+  const char* end;
+  int64_t rows = 0;       // data rows in this span (pass 1)
+  int64_t row_base = 0;   // global index of this span's first row (prefix sum)
+};
+
+// A line is a data row iff it contains a non-whitespace character.
+inline bool is_data_line(const char* b, const char* e) {
+  for (const char* p = b; p < e; ++p) {
+    if (*p != ' ' && *p != '\t' && *p != '\r') return true;
+  }
+  return false;
+}
+
+int64_t count_rows(const char* b, const char* e) {
+  int64_t n = 0;
+  const char* line = b;
+  for (const char* p = b; p <= e; ++p) {
+    if (p == e || *p == '\n') {
+      if (is_data_line(line, p)) ++n;
+      line = p + 1;
+    }
+  }
+  return n;
+}
+
+// Parse one span's lines into out[row_base..)*cols.  Returns 0 on success,
+// -1 on a malformed field / wrong column count (first error wins).
+int parse_span(const Span& span, int64_t cols, double* out) {
+  const char* line = span.begin;
+  int64_t row = span.row_base;
+  for (const char* p = span.begin; p <= span.end; ++p) {
+    if (p == span.end || *p == '\n') {
+      if (is_data_line(line, p)) {
+        double* dst = out + row * cols;
+        const char* f = line;
+        for (int64_t c = 0; c < cols; ++c) {
+          while (f < p && (*f == ' ' || *f == '\t')) ++f;
+          // std::from_chars: locale-free and ~4x strtod throughput — CSV
+          // float decode dominates the whole ingest pass.
+          auto res = std::from_chars(f, p, dst[c]);
+          if (res.ec != std::errc() || res.ptr == f)
+            return -1;  // empty / non-numeric field
+          f = res.ptr;
+          while (f < p && (*f == ' ' || *f == '\t')) ++f;
+          if (c + 1 < cols) {
+            if (f >= p || (*f != ',' && *f != ';')) return -1;
+            ++f;
+          }
+        }
+        // allow trailing separator/whitespace only
+        while (f < p && (*f == ' ' || *f == '\t' || *f == '\r' || *f == ','))
+          ++f;
+        if (f < p) return -1;  // extra columns
+        ++row;
+      }
+      line = p + 1;
+    }
+  }
+  return 0;
+}
+
+int64_t detect_cols(const char* b, const char* e) {
+  const char* line = b;
+  for (const char* p = b; p <= e; ++p) {
+    if (p == e || *p == '\n') {
+      if (is_data_line(line, p)) {
+        int64_t cols = 1;
+        bool in_field = false;
+        for (const char* q = line; q < p; ++q) {
+          if (*q == ',' || *q == ';') ++cols;
+          (void)in_field;
+        }
+        return cols;
+      }
+      line = p + 1;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int gpdata_num_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n ? static_cast<int>(n) : 1;
+}
+
+void gpdata_free(double* buf) { std::free(buf); }
+
+// Returns 0 on success; negative error codes:
+//   -1 open/stat failed, -2 mmap failed, -3 empty/no data rows,
+//   -4 allocation failed, -5 parse error (malformed field or ragged row).
+int gpdata_read_csv(const char* path, int64_t skip_rows, double** out,
+                    int64_t* out_rows, int64_t* out_cols) {
+  *out = nullptr;
+  *out_rows = 0;
+  *out_cols = 0;
+
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    ::close(fd);
+    return st.st_size == 0 ? -3 : -1;
+  }
+  size_t size = static_cast<size_t>(st.st_size);
+  void* map = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (map == MAP_FAILED) return -2;
+  const char* data = static_cast<const char*>(map);
+  const char* end = data + size;
+
+  // Skip header rows (counting every line, data or not, like numpy skiprows).
+  const char* begin = data;
+  for (int64_t skipped = 0; skipped < skip_rows && begin < end; ++skipped) {
+    const char* nl = static_cast<const char*>(
+        memchr(begin, '\n', static_cast<size_t>(end - begin)));
+    begin = nl ? nl + 1 : end;
+  }
+
+  int64_t cols = detect_cols(begin, end);
+  if (cols <= 0) {
+    munmap(map, size);
+    return -3;
+  }
+
+  // Carve spans at newline boundaries, one per thread.
+  int nt = gpdata_num_threads();
+  int64_t bytes = end - begin;
+  if (bytes < (1 << 16)) nt = 1;  // parsing overhead beats threading
+  std::vector<Span> spans;
+  spans.reserve(nt);
+  const char* cursor = begin;
+  for (int t = 0; t < nt && cursor < end; ++t) {
+    const char* stop =
+        (t == nt - 1) ? end : begin + (bytes * (t + 1)) / nt;
+    if (stop < end) {
+      const char* nl = static_cast<const char*>(
+          memchr(stop, '\n', static_cast<size_t>(end - stop)));
+      stop = nl ? nl + 1 : end;
+    }
+    spans.push_back(Span{cursor, stop});
+    cursor = stop;
+  }
+
+  // Pass 1: count rows per span.
+  {
+    std::vector<std::thread> workers;
+    for (auto& s : spans)
+      workers.emplace_back([&s] { s.rows = count_rows(s.begin, s.end); });
+    for (auto& w : workers) w.join();
+  }
+  int64_t total = 0;
+  for (auto& s : spans) {
+    s.row_base = total;
+    total += s.rows;
+  }
+  if (total == 0) {
+    munmap(map, size);
+    return -3;
+  }
+
+  double* buf = static_cast<double*>(
+      std::malloc(static_cast<size_t>(total) * cols * sizeof(double)));
+  if (!buf) {
+    munmap(map, size);
+    return -4;
+  }
+
+  // Pass 2: parse in place, no synchronization (disjoint output ranges).
+  std::vector<int> status(spans.size(), 0);
+  {
+    std::vector<std::thread> workers;
+    for (size_t i = 0; i < spans.size(); ++i)
+      workers.emplace_back([&, i] { status[i] = parse_span(spans[i], cols, buf); });
+    for (auto& w : workers) w.join();
+  }
+  munmap(map, size);
+  for (int s : status) {
+    if (s != 0) {
+      std::free(buf);
+      return -5;
+    }
+  }
+
+  *out = buf;
+  *out_rows = total;
+  *out_cols = cols;
+  return 0;
+}
+
+// In-place column-wise standardization; std==0 columns clamped to 1
+// (commons/util/Scaling.scala:18).
+void gpdata_zscore(double* data, int64_t rows, int64_t cols) {
+  if (rows <= 0 || cols <= 0) return;
+  std::vector<double> mean(cols, 0.0), m2(cols, 0.0);
+  // Column statistics: single pass, compensated enough for feature scaling
+  // (two-pass mean/variance like Scaling.scala:13-16).
+  for (int64_t c = 0; c < cols; ++c) {
+    double s = 0.0;
+    for (int64_t r = 0; r < rows; ++r) s += data[r * cols + c];
+    mean[c] = s / rows;
+  }
+  for (int64_t c = 0; c < cols; ++c) {
+    double s = 0.0;
+    for (int64_t r = 0; r < rows; ++r) {
+      double d = data[r * cols + c] - mean[c];
+      s += d * d;
+    }
+    double var = s / rows;
+    m2[c] = var > 0.0 ? std::sqrt(var) : 1.0;
+  }
+  int nt = gpdata_num_threads();
+  std::vector<std::thread> workers;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = rows * t / nt, hi = rows * (t + 1) / nt;
+    workers.emplace_back([&, lo, hi] {
+      for (int64_t r = lo; r < hi; ++r)
+        for (int64_t c = 0; c < cols; ++c)
+          data[r * cols + c] = (data[r * cols + c] - mean[c]) / m2[c];
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,104 @@
+"""Tests for the native C++ data-plane runtime (spark_gp_tpu/native).
+
+The library is compiled on first use with g++; when the toolchain is
+missing the whole module degrades to numpy and these tests skip.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import native
+from spark_gp_tpu.data.datasets import _read_csv
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _write_csv(text: str) -> str:
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    with os.fdopen(fd, "w") as fh:
+        fh.write(text)
+    return path
+
+
+def test_read_csv_matches_numpy():
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(1000, 7))
+    path = _write_csv(
+        "\n".join(",".join(f"{v:.17g}" for v in row) for row in data) + "\n"
+    )
+    try:
+        parsed = native.read_csv(path)
+        np.testing.assert_array_equal(parsed, np.loadtxt(path, delimiter=","))
+        np.testing.assert_allclose(parsed, data, rtol=0, atol=0)
+    finally:
+        os.unlink(path)
+
+
+def test_read_csv_skiprows_blank_lines_no_trailing_newline():
+    path = _write_csv("header,line\n1,2\n\n3.5,-4e2\n  \n5,6")
+    try:
+        parsed = native.read_csv(path, skip_rows=1)
+        np.testing.assert_allclose(
+            parsed, [[1.0, 2.0], [3.5, -400.0], [5.0, 6.0]]
+        )
+    finally:
+        os.unlink(path)
+
+
+def test_read_csv_errors():
+    with pytest.raises(FileNotFoundError):
+        native.read_csv("/nonexistent/definitely_missing.csv")
+    path = _write_csv("1,2\n3,banana\n")
+    try:
+        with pytest.raises(ValueError, match="malformed"):
+            native.read_csv(path)
+    finally:
+        os.unlink(path)
+    ragged = _write_csv("1,2\n3,4,5\n")
+    try:
+        with pytest.raises(ValueError, match="malformed"):
+            native.read_csv(ragged)
+    finally:
+        os.unlink(ragged)
+
+
+def test_zscore_matches_numpy_semantics():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(500, 4)) * [1.0, 10.0, 0.1, 1.0] + [0, 5, -3, 0]
+    x[:, 3] = 2.0  # zero-variance column stays unscaled (Scaling.scala:18)
+    z = native.zscore(x)
+    mean = x.mean(axis=0)
+    std = x.std(axis=0)
+    std[std == 0] = 1.0
+    np.testing.assert_allclose(z, (x - mean) / std, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(z[:, 3], 0.0, atol=1e-12)
+
+
+def test_dataset_helper_uses_native_and_matches_fallback(monkeypatch):
+    rng = np.random.default_rng(2)
+    data = rng.normal(size=(50, 3))
+    path = _write_csv("\n".join(",".join(map(str, r)) for r in data) + "\n")
+    try:
+        fast = _read_csv(path)
+        monkeypatch.setattr(native, "available", lambda: False)
+        slow = _read_csv(path)
+        np.testing.assert_array_equal(fast, slow)
+    finally:
+        os.unlink(path)
+
+
+def test_large_parallel_parse():
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(20000, 12))  # > 64 KiB: exercises the threaded path
+    path = _write_csv("\n".join(",".join(f"{v:.17g}" for v in row) for row in data))
+    try:
+        parsed = native.read_csv(path)
+        assert parsed.shape == (20000, 12)
+        np.testing.assert_allclose(parsed, data)
+    finally:
+        os.unlink(path)
