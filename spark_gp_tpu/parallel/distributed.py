@@ -1,0 +1,169 @@
+"""Multi-host orchestration: the DCN-side counterpart of the in-chip mesh.
+
+The reference's distributed backend is Spark's driver<->executor RPC with
+``treeAggregate``/``broadcast`` (SURVEY.md §2.4).  On TPU the communication
+splits into two planes:
+
+* **ICI** (inter-chip interconnect) carries every algorithmic collective —
+  the (NLL, grad) psum of the BCM objective and the (U1, u2) psum of the
+  PPA statistics (likelihood.py / ppa.py shard_map programs).  Nothing in
+  this module touches ICI: XLA inserts those collectives from the sharding
+  annotations.
+* **DCN** (data-center network) only carries process coordination and
+  per-host data feeding — this module.  There is no point-to-point traffic
+  anywhere in the algorithm (SURVEY.md §2.4), so the DCN layer is exactly
+  three things: runtime initialization, a global mesh over every host's
+  chips, and assembling globally-sharded expert stacks from process-local
+  rows.
+
+Single-process environments (one chip, CPU tests, the 8-device simulated
+mesh) pass through unchanged: ``initialize()`` is a no-op,
+``global_expert_mesh()`` sees only local devices, and
+``distribute_global_experts`` degrades to :func:`mesh.shard_experts`.
+
+Typical multi-host launch (same program on every host, e.g. via the TPU VM
+runtime or mpirun over DCN):
+
+    from spark_gp_tpu.parallel import distributed as dist
+
+    dist.initialize()                       # env-driven coordinator discovery
+    mesh = dist.global_expert_mesh()        # 1-D mesh over ALL hosts' chips
+    data = dist.distribute_global_experts(  # per-host rows -> global [E,s,p]
+        x_local, y_local, expert_size, mesh
+    )
+    model = (GaussianProcessRegression()... .setMesh(mesh)).fit_distributed(...)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+from spark_gp_tpu.parallel.mesh import EXPERT_AXIS, expert_mesh, shard_experts
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the JAX distributed runtime (DCN coordination plane).
+
+    A no-op when the runtime is already initialized or when running
+    single-process with no coordinator configured — so library code can call
+    it unconditionally.  On managed TPU pods all three arguments come from
+    the environment and may be omitted (``jax.distributed.initialize()``
+    autodetects); on hand-rolled clusters pass them explicitly.
+    """
+    import jax
+
+    if jax.distributed.is_initialized():
+        return
+    if coordinator_address is None and num_processes is None:
+        import os
+
+        auto = (
+            "COORDINATOR_ADDRESS" in os.environ
+            or "JAX_COORDINATOR_ADDRESS" in os.environ
+            or os.environ.get("TPU_WORKER_HOSTNAMES")
+        )
+        if not auto:
+            return  # single-process: nothing to coordinate
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def num_processes() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def global_expert_mesh():
+    """1-D ``experts`` mesh over every chip of every host.
+
+    ``jax.devices()`` is global after :func:`initialize`; the expert axis
+    spans hosts so the psum collectives ride ICI within a slice and DCN only
+    between slices (XLA picks the hierarchical reduction)."""
+    return expert_mesh()
+
+
+def distribute_global_experts(
+    x_local: np.ndarray,
+    y_local: np.ndarray,
+    dataset_size_for_expert: int,
+    mesh=None,
+) -> ExpertData:
+    """Assemble a globally-sharded expert stack from process-local rows.
+
+    Each host contributes its own ``[n_local, p]`` rows (e.g. its shard of a
+    distributed file set — the counterpart of HDFS partitions feeding Spark
+    executors, GaussianProcessCommons.scala:20-24).  Rows are grouped into
+    experts host-locally (round-robin is an arbitrary-but-balanced
+    assignment — grouping locally just picks a different arbitrary balanced
+    assignment and saves the all-to-all resharding), then the per-host
+    ``[E_local, s, ...]`` stacks are stitched into one global array whose
+    expert axis is sharded across all hosts' devices.
+
+    Single-process: equivalent to ``shard_experts(group_for_experts(...))``.
+    """
+    import jax
+
+    if mesh is None:
+        mesh = global_expert_mesh()
+
+    if jax.process_count() == 1:
+        return shard_experts(
+            group_for_experts(x_local, y_local, dataset_size_for_expert), mesh
+        )
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    local = group_for_experts(x_local, y_local, dataset_size_for_expert)
+    # Every process must contribute the same expert count for a dense global
+    # axis: pad to the max across hosts (masked experts contribute nothing).
+    from jax.experimental import multihost_utils
+
+    dims = np.asarray([local.num_experts, local.expert_size], dtype=np.int64)
+    gathered = multihost_utils.process_allgather(dims, tiled=False)
+    e_max, s_max = (int(v) for v in np.max(gathered.reshape(-1, 2), axis=0))
+    if local.expert_size != s_max or local.num_experts != e_max:
+        local = _pad_stack(local, e_max, s_max)
+
+    def stitch(a):
+        spec = P(EXPERT_AXIS, *([None] * (a.ndim - 1)))
+        return multihost_utils.host_local_array_to_global_array(
+            np.asarray(a), mesh, spec
+        )
+
+    return ExpertData(
+        x=stitch(local.x), y=stitch(local.y), mask=stitch(local.mask)
+    )
+
+
+def _pad_stack(data: ExpertData, e_target: int, s_target: int) -> ExpertData:
+    """Pad an expert stack to [e_target, s_target, ...] with masked entries."""
+    x = np.asarray(data.x)
+    y = np.asarray(data.y)
+    mask = np.asarray(data.mask)
+    e, s = x.shape[0], x.shape[1]
+    if s_target > s:
+        # benign feature padding: repeat each expert's first point
+        x_pad = np.repeat(x[:, :1], s_target - s, axis=1)
+        x = np.concatenate([x, x_pad], axis=1)
+        y = np.pad(y, ((0, 0), (0, s_target - s)))
+        mask = np.pad(mask, ((0, 0), (0, s_target - s)))
+    if e_target > e:
+        x = np.concatenate(
+            [x, np.repeat(x[:1], e_target - e, axis=0)], axis=0
+        )
+        y = np.pad(y, ((0, e_target - e), (0, 0)))
+        mask = np.pad(mask, ((0, e_target - e), (0, 0)))
+    import jax.numpy as jnp
+
+    return ExpertData(x=jnp.asarray(x), y=jnp.asarray(y), mask=jnp.asarray(mask))
