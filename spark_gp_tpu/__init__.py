@@ -26,6 +26,10 @@ The TPU-first design differs deliberately from the reference's architecture:
   inverses, no ``eigSym`` positive-definiteness sweeps).
 """
 
+from spark_gp_tpu.utils.platform import honor_platform_env as _honor_platform_env
+
+_honor_platform_env()
+
 from spark_gp_tpu.kernels import (
     ARDRBFKernel,
     Const,

@@ -225,21 +225,28 @@ class GaussianProcessCommons(GaussianProcessParams):
         instr: Instrumentation,
         kernel: Kernel,
         theta_opt: np.ndarray,
-        x: np.ndarray,
-        y_targets: np.ndarray,
+        x: Optional[np.ndarray],
+        y_targets: Optional[np.ndarray],
         data: ExpertData,
+        active_override: Optional[np.ndarray] = None,
     ) -> ppa.ProjectedProcessRawPredictor:
         """Active set -> distributed (U1, u2) -> magic solve -> predictor
         (GaussianProcessCommons.scala:40-59)."""
         import jax.numpy as jnp
 
         with instr.phase("active_set"):
-            # The provider receives the noise-augmented model kernel, as the
-            # reference passes getKernel (GaussianProcessCommons.scala:43) —
-            # the greedy provider's Seeger scores divide by its whiteNoiseVar.
-            active = self._active_set_provider(
-                self._active_set_size, x, y_targets, kernel, theta_opt, self._seed,
-            )
+            if active_override is not None:
+                # pre-selected set (multi-host fit_distributed path)
+                active = active_override
+            else:
+                # The provider receives the noise-augmented model kernel, as
+                # the reference passes getKernel
+                # (GaussianProcessCommons.scala:43) — the greedy provider's
+                # Seeger scores divide by its whiteNoiseVar.
+                active = self._active_set_provider(
+                    self._active_set_size, x, y_targets, kernel, theta_opt,
+                    self._seed,
+                )
         active = np.asarray(active)
 
         # The (U1, u2) accumulation runs in float64 (XLA emulates f64 on TPU;
@@ -298,9 +305,10 @@ class GaussianProcessCommons(GaussianProcessParams):
         kernel: Kernel,
         theta_dev,
         pending: dict,
-        x: np.ndarray,
-        targets_fn: Callable[[], np.ndarray],
+        x: Optional[np.ndarray],
+        targets_fn: Optional[Callable[[], np.ndarray]],
         data: ExpertData,
+        active_override: Optional[np.ndarray] = None,
     ):
         """Device-pipelined PPA build: the optimizer's *device* theta chains
         straight into the f64 (U1, u2) statistics program, and everything —
@@ -323,7 +331,9 @@ class GaussianProcessCommons(GaussianProcessParams):
 
         provider = self._active_set_provider
         with instr.phase("active_set"):
-            if getattr(provider, "uses_fit_outputs", True):
+            if active_override is not None:
+                active = active_override
+            elif getattr(provider, "uses_fit_outputs", True):
                 # e.g. greedy Seeger scores read theta and the targets: a
                 # host sync is unavoidable for this provider family.
                 theta_host = np.asarray(theta_dev, dtype=np.float64)

@@ -56,13 +56,21 @@ class GaussianProcessRegression(GaussianProcessCommons):
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("expert_size", data.expert_size)
 
+        return self._fit_from_stack(instr, kernel, data, x, lambda: y, None)
+
+    def _fit_from_stack(
+        self, instr, kernel, data, x, targets_fn, active_override
+    ) -> "GaussianProcessRegressionModel":
+        """Shared optimize → active set → PPA tail of ``fit`` and
+        ``fit_distributed``."""
         if self._resolved_optimizer() == "device":
             # Fully async pipeline: the on-device L-BFGS, the f64 PPA
             # statistics and the scalar diagnostics drain in one host sync
             # inside _finalize_device_fit.
             theta_dev, pending = self._fit_device(instr, kernel, data)
             raw, _ = self._finalize_device_fit(
-                instr, kernel, theta_dev, pending, x, lambda: y, data
+                instr, kernel, theta_dev, pending, x, targets_fn, data,
+                active_override=active_override,
             )
         else:
             if self._mesh is not None:
@@ -72,11 +80,74 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
             checkpointer = self._make_checkpointer(kernel)
             theta_opt = self._optimize_hypers(instr, kernel, vag, callback=checkpointer)
-            raw = self._projected_process(instr, kernel, theta_opt, x, y, data)
+            raw = self._projected_process(
+                instr, kernel, theta_opt, x,
+                None if targets_fn is None else targets_fn(), data,
+                active_override=active_override,
+            )
         instr.log_success()
         model = GaussianProcessRegressionModel(raw)
         model.instr = instr
         return model
+
+    def fit_distributed(
+        self, data, active_set: Optional[np.ndarray] = None
+    ) -> "GaussianProcessRegressionModel":
+        """Multi-host fit from a pre-sharded expert stack.
+
+        ``data`` is the output of
+        :func:`spark_gp_tpu.parallel.distributed.distribute_global_experts`
+        — a globally-sharded ``ExpertData`` whose expert axis spans every
+        host's devices.  No process ever needs the full row set: the active
+        set is either supplied explicitly (replicated ``[m, p]``) or drawn
+        uniformly from the stack itself as a mesh collective
+        (:func:`...distributed.sample_active_from_stack`, the counterpart of
+        the reference's ``takeSample``, ActiveSetProvider.scala:48-56).
+
+        Single-process it is equivalent to ``fit`` with a pre-grouped stack.
+        """
+        from spark_gp_tpu.models.active_set import RandomActiveSetProvider
+        from spark_gp_tpu.parallel.distributed import sample_active_from_stack
+
+        instr = Instrumentation(name="GaussianProcessRegression")
+        mesh_prev = self._mesh
+        if self._mesh is None:
+            from jax.sharding import NamedSharding
+
+            sh = getattr(data.x, "sharding", None)
+            if not isinstance(sh, NamedSharding):
+                raise ValueError(
+                    "fit_distributed needs setMesh(...) or a NamedSharding-"
+                    "sharded expert stack"
+                )
+            self._mesh = sh.mesh
+
+        try:
+            kernel = self._get_kernel()
+            instr.log_metric("num_experts", int(data.x.shape[0]))
+            instr.log_metric("expert_size", int(data.x.shape[1]))
+
+            with instr.phase("active_set_select"):
+                if active_set is None:
+                    if self._active_set_provider is not RandomActiveSetProvider:
+                        import warnings
+
+                        warnings.warn(
+                            "fit_distributed selects the active set by "
+                            "uniform sampling from the sharded stack; the "
+                            "configured provider "
+                            f"({self._active_set_provider!r}) needs host-"
+                            "local rows and is not consulted — pass "
+                            "active_set=... explicitly to override.",
+                            stacklevel=2,
+                        )
+                    active_set = sample_active_from_stack(
+                        data, self._active_set_size, self._seed, self._mesh
+                    )
+            active64 = np.asarray(active_set, dtype=np.float64)
+            return self._fit_from_stack(instr, kernel, data, None, None, active64)
+        finally:
+            self._mesh = mesh_prev
 
     def _fit_device(self, instr: Instrumentation, kernel, data):
         """Dispatch the one-program on-device optimization

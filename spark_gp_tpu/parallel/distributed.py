@@ -56,26 +56,76 @@ def initialize(
     it unconditionally.  On managed TPU pods all three arguments come from
     the environment and may be omitted (``jax.distributed.initialize()``
     autodetects); on hand-rolled clusters pass them explicitly.
+
+    ``jax.distributed.initialize`` hard-fails once the XLA backend exists
+    (it must run before ``jax.devices()``/any computation).  If the backend
+    is already up, joining a coordination plane is impossible — this
+    function then degrades to single-process with a ``RuntimeWarning``
+    rather than crashing callers that invoke it defensively in
+    environments (e.g. a single-host TPU site) where coordinator env vars
+    happen to be set.
     """
     import jax
 
+    from spark_gp_tpu.utils.platform import backends_already_initialized
+
     if jax.distributed.is_initialized():
         return
-    if coordinator_address is None and num_processes is None:
+    auto = coordinator_address is None and num_processes is None
+    multi_host = False
+    if auto:
         import os
 
-        auto = (
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        detected = (
             "COORDINATOR_ADDRESS" in os.environ
             or "JAX_COORDINATOR_ADDRESS" in os.environ
-            or os.environ.get("TPU_WORKER_HOSTNAMES")
+            or hostnames
         )
-        if not auto:
+        # A genuinely multi-host pod must not silently degrade: each host
+        # training on 1/P of the data would be wrong results with no error.
+        multi_host = len([h for h in hostnames.split(",") if h.strip()]) > 1
+        if not detected:
             return  # single-process: nothing to coordinate
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    if backends_already_initialized():
+        late_msg = (
+            "distributed.initialize() called after the XLA backend was "
+            "initialized; multi-process coordination is unavailable (it must "
+            "run before jax.devices()/device_put/any computation)."
+        )
+        if not auto or multi_host:
+            # Explicit coordinator args or a detected multi-host pod:
+            # silently training 1/num_processes of the data per host would
+            # be a correctness bug — fail loudly.
+            raise RuntimeError(late_msg)
+        import warnings
+
+        warnings.warn(
+            late_msg + " Continuing single-process.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError) as exc:
+        # RuntimeError: the backend raced us up; ValueError: env vars present
+        # but incomplete (e.g. TPU_WORKER_HOSTNAMES with no coordinator
+        # address on a single-host TPU site).
+        if not auto or multi_host:
+            raise  # real cluster: surface the failure, don't train 1/P-wrong
+        import warnings
+
+        warnings.warn(
+            f"jax.distributed.initialize() failed during env-driven "
+            f"autodetection; continuing single-process: {exc}",
+            RuntimeWarning,
+            stacklevel=2,
+        )
 
 
 def num_processes() -> int:
@@ -132,6 +182,12 @@ def distribute_global_experts(
     dims = np.asarray([local.num_experts, local.expert_size], dtype=np.int64)
     gathered = multihost_utils.process_allgather(dims, tiled=False)
     e_max, s_max = (int(v) for v in np.max(gathered.reshape(-1, 2), axis=0))
+    # The stitched global expert axis (e_max * num_processes) must divide
+    # evenly over the mesh actually used for P(EXPERT_AXIS) sharding: round
+    # e_max up to a multiple of the mesh's per-process device count (NOT
+    # jax.local_device_count() — the mesh may span a device subset).
+    per_proc = max(1, mesh.devices.size // jax.process_count())
+    e_max = -(-e_max // per_proc) * per_proc
     if local.expert_size != s_max or local.num_experts != e_max:
         local = _pad_stack(local, e_max, s_max)
 
@@ -144,6 +200,39 @@ def distribute_global_experts(
     return ExpertData(
         x=stitch(local.x), y=stitch(local.y), mask=stitch(local.mask)
     )
+
+
+def sample_active_from_stack(
+    data: ExpertData, m: int, seed: int, mesh
+) -> np.ndarray:
+    """Uniform active-set selection straight off a (possibly multi-host)
+    sharded expert stack, returned replicated on every host.
+
+    The multi-host counterpart of RandomActiveSetProvider / the reference's
+    ``takeSample`` (ActiveSetProvider.scala:48-56): no host ever sees the
+    global rows.  The validity mask (tiny: N bits) is resharded to
+    replicated so every process draws the *same* m flat indices from the
+    shared seed, then the [m, p] row gather runs as one XLA program with a
+    replicated output — the cross-host traffic is the m selected rows, not
+    the dataset.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    mask = np.asarray(jax.jit(lambda a: a, out_shardings=rep)(data.mask))
+    valid = np.flatnonzero(mask.reshape(-1) > 0)
+    if m > valid.size:
+        raise ValueError(f"active set size {m} exceeds {valid.size} points")
+    rng = np.random.default_rng(seed)
+    sel = np.sort(rng.choice(valid, size=m, replace=False))
+
+    p = data.x.shape[-1]
+    gather = jax.jit(
+        lambda x, i: x.reshape(-1, p)[i], out_shardings=rep
+    )
+    return np.asarray(gather(data.x, jnp.asarray(sel)))
 
 
 def _pad_stack(data: ExpertData, e_target: int, s_target: int) -> ExpertData:

@@ -1,0 +1,46 @@
+"""Platform-selection hygiene for hooked JAX runtimes.
+
+Some TPU site hooks (e.g. the axon tunnel shim) rewrite the resolved
+``jax_platforms`` *config* at jax-import time — ``JAX_PLATFORMS=cpu`` in the
+environment still resolves to ``"axon,cpu"``, and the first backend lookup
+then blocks on an unreachable tunnel instead of running on CPU.  An explicit
+``jax.config.update`` wins over the hook; this module restores the documented
+env-var contract for every entry point (examples, bench, library import).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Re-assert the user's ``JAX_PLATFORMS`` env var over site hooks.
+
+    No-op when the env var is unset, already in effect, or when a backend is
+    already initialized (too late to change platforms safely).
+    """
+    plats = os.environ.get("JAX_PLATFORMS")
+    if not plats:
+        return
+    import jax
+
+    if jax.config.jax_platforms == plats:
+        return
+    if backends_already_initialized():
+        return
+    jax.config.update("jax_platforms", plats)
+
+
+def backends_already_initialized() -> bool:
+    """True once any XLA backend client exists in this process.
+
+    Single home for the private-API probe (``jax._src.xla_bridge``) so a
+    JAX-internals move only needs fixing in one place; falls back to False
+    (callers then rely on their own late-call error handling).
+    """
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:
+        return False

@@ -60,6 +60,45 @@ def test_distributed_fit_on_simulated_mesh():
     assert rmse < 0.2
 
 
+def test_sample_active_from_stack_replicated_valid_rows():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(130, 3))
+    y = rng.normal(size=130)
+    mesh = dist.global_expert_mesh()
+    data = dist.distribute_global_experts(x, y, 16, mesh)
+    active = dist.sample_active_from_stack(data, 20, seed=7, mesh=mesh)
+    assert active.shape == (20, 3)
+    # every selected row is a real data row (mask excluded padding)
+    rows = {tuple(np.round(r, 12)) for r in x}
+    for r in np.asarray(active):
+        assert tuple(np.round(r, 12)) in rows
+    # deterministic across "hosts": same seed -> same selection
+    again = dist.sample_active_from_stack(data, 20, seed=7, mesh=mesh)
+    np.testing.assert_array_equal(active, again)
+
+
+def test_fit_distributed_single_process():
+    """fit_distributed consumes a pre-sharded stack end-to-end."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(400, 3))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=400)
+    mesh = dist.global_expert_mesh()
+    data = dist.distribute_global_experts(x, y, 50, mesh)
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(60)
+        .setMaxIter(15)
+        .setMesh(mesh)
+        .fit_distributed(data)
+    )
+    pred = model.predict(x)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.2
+
+
 def test_pad_stack_algebra():
     rng = np.random.default_rng(2)
     data = group_for_experts(rng.normal(size=(60, 2)), rng.normal(size=60), 10)
