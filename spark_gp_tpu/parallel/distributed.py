@@ -82,9 +82,15 @@ def initialize(
             or "JAX_COORDINATOR_ADDRESS" in os.environ
             or hostnames
         )
-        # A genuinely multi-host pod must not silently degrade: each host
+        # A genuinely multi-host cluster must not silently degrade: each host
         # training on 1/P of the data would be wrong results with no error.
-        multi_host = len([h for h in hostnames.split(",") if h.strip()]) > 1
+        # An explicit coordinator address is deliberate cluster config (a
+        # single-host TPU site sets only TPU_WORKER_HOSTNAMES=localhost).
+        multi_host = (
+            "COORDINATOR_ADDRESS" in os.environ
+            or "JAX_COORDINATOR_ADDRESS" in os.environ
+            or len([h for h in hostnames.split(",") if h.strip()]) > 1
+        )
         if not detected:
             return  # single-process: nothing to coordinate
     if backends_already_initialized():
@@ -223,8 +229,9 @@ def sample_active_from_stack(
     rep = NamedSharding(mesh, P())
     mask = np.asarray(jax.jit(lambda a: a, out_shardings=rep)(data.mask))
     valid = np.flatnonzero(mask.reshape(-1) > 0)
-    if m > valid.size:
-        raise ValueError(f"active set size {m} exceeds {valid.size} points")
+    # clamp like RandomActiveSetProvider so fit_distributed keeps fit()'s
+    # single-process behavior for m > N
+    m = min(m, valid.size)
     rng = np.random.default_rng(seed)
     sel = np.sort(rng.choice(valid, size=m, replace=False))
 
