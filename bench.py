@@ -214,7 +214,9 @@ def supervise() -> int:
                 result["detail"]["fallback"] = f"default plan failed: {reason}"
             print(json.dumps(result))
             return 0
-        errors[name + "-worker"] = err
+        errors[name + "-worker"] = err or (
+            f"worker emitted JSON without 'value': {json.dumps(result)[:300]}"
+        )
     print(json.dumps({"metric": METRIC, "value": None, "unit": UNIT, "error": errors}))
     return 1
 

@@ -50,7 +50,7 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData):
     two batched matmuls instead of batched triangular solves
     (dNLL/dK = 0.5*(K^-1 - alpha alpha^T), GPR.scala:63-67).
 
-    Elsewhere (CPU tests, f64, s > 128) the classic formulation — one
+    Elsewhere (CPU tests, f64, s > 512) the classic formulation — one
     Cholesky, one vector solve, logdet from the diagonal — is cheaper than
     materializing inverses, so the two paths split here rather than inside
     ``spd_inv_logdet``.

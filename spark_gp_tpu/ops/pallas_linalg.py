@@ -11,15 +11,24 @@ factor/solve/invert chain with ONE fused pass producing (K^-1, logdet).
 Algorithm: blocked right-looking Cholesky, factoring and inverting together.
 
 * the batch rides the sublane dimension — each grid instance holds
-  ``[T=8, 128, 128]`` matrices in VMEM and processes all 8 in lockstep;
-* the 128 columns go in 4 static blocks of 32: the 32x32 diagonal block is
-  factored scalar-by-scalar on the VPU (cheap: 1k elements/step), its
-  inverse accumulated simultaneously from the elementary-column factors
-  (E_j^-1 applications — VPU rank-1s, no transposes); panels and trailing
-  Schur updates are MXU matmuls, so the O(n^3) work rides the systolic
-  array;
+  ``[T, n, n]`` matrices in VMEM and processes all T in lockstep; T adapts
+  to n so the working set stays within VMEM (T=8 at n<=128 down to T=1 at
+  n=512);
+* columns go in static diagonal blocks (32-wide for n<=128, 64-wide above,
+  plus an 8-multiple remainder block so s=100 pads to 104, not 128): each
+  diagonal block is factored scalar-by-scalar on the VPU (cheap: ~1k
+  elements/step), its inverse accumulated simultaneously from the
+  elementary-column factors (E_j^-1 applications — VPU rank-1s, no
+  transposes); panels and trailing Schur updates are MXU matmuls, so the
+  O(n^3) work rides the systolic array;
 * W = L^-1 is assembled block-row by block-row (the standard blocked
-  triangular inversion), and K^-1 = W^T W is one final batched matmul.
+  triangular inversion), and K^-1 = W^T W is one final batched matmul;
+* logdet comes out PER DIAGONAL BLOCK (lane j of the aux output = block
+  j's contribution), which makes small-expert packing a pure pre/post
+  transform: for s <= 64 several experts are embedded block-diagonally in
+  one 128-wide tile (2x64 or 4x32 — full lane utilization instead of
+  zero-padding a 100+-lane tile), and the wrapper group-sums each
+  sub-matrix's block logdets on the way out.
 
 Stability is Cholesky-class: panels are scaled by L33^-1 whose norm grows
 like sqrt(cond K) — unlike a Gauss-Jordan sweep, whose explicit pivot-block
@@ -27,11 +36,18 @@ inverses square the conditioning and NaN out on the cond ~ 1e6 matrices the
 hyperparameter search routinely visits (an earlier sweep-based version of
 this kernel failed exactly that way).  A genuinely non-PD input produces
 sqrt(p <= 0) = NaN, which propagates to the NLL exactly like a failed
-Cholesky in the fallback path.
+Cholesky in the fallback path.  For valid SPD inputs block-diagonal
+packing cannot cross-contaminate: the Schur complement and W stay exactly
+block-diagonal (off-diagonal panels are zero and every update of them is a
+product with a zero factor).  A NaN from a non-PD sub-matrix, however,
+spreads through 0*NaN panel products into the *inverses* (never the
+logdets, which are recorded per block) of its tile mates — harmless for
+the likelihood path, which sums the per-expert NLL and goes NaN on any
+non-PD expert regardless.
 
 ``spd_inv_logdet`` is the public entry: custom-VJP'd (the cotangent is two
 batched matmuls — no triangular solves anywhere), with an XLA Cholesky
-fallback for CPU, float64, or n > 128.
+fallback for CPU, float64, or n > 512.
 """
 
 from __future__ import annotations
@@ -43,12 +59,28 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_T = 8  # matrices per grid instance (f32 sublane tile)
-_N = 128  # padded matrix size (lane width)
-_NB = 32  # diagonal block size
-_BLOCKS = _N // _NB
+_LANE = 128  # TPU lane width; full-utilization tile width for packing
+_N_MAX = 512  # largest matrix the Pallas path handles (VMEM at T=1)
 
 _HI = jax.lax.Precision.HIGHEST
+
+
+def _blocks_for(n_pad: int) -> tuple:
+    """Static diagonal-block sizes: 32s (64s above 128) + 8-multiple tail."""
+    nb = 32 if n_pad <= 128 else 64
+    sizes = [nb] * (n_pad // nb)
+    if n_pad % nb:
+        sizes.append(n_pad % nb)
+    return tuple(sizes)
+
+
+def _tiles_for(n_pad: int) -> int:
+    """Matrices per grid instance: fill ~6 MB of VMEM across the 4 working
+    [T, n, n] buffers (in, out, 2 scratch), floor 1."""
+    t = 8
+    while t > 1 and t * n_pad * n_pad * 16 > 6_000_000:
+        t //= 2
+    return t
 
 
 def _bmm(a, b, contract=(2, 1)):
@@ -67,7 +99,7 @@ def _bmm(a, b, contract=(2, 1)):
             jax.lax.dot_general(
                 a[t],
                 b[t],
-                ((( contract[0] - 1,), (contract[1] - 1,)), ((), ())),
+                (((contract[0] - 1,), (contract[1] - 1,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=_HI,
             )
@@ -82,29 +114,29 @@ def _row(mat, j, rows):
 
 
 def _mini_chol_inv(p0):
-    """Scalar Cholesky of ``[T,32,32]`` SPD blocks, fused with inversion.
+    """Scalar Cholesky of ``[T,nb,nb]`` SPD blocks, fused with inversion.
 
     Returns ``(L, L^-1, logdet)``.  L^-1 is accumulated by applying each
     elementary factor's inverse on the left: with E_j = I + (c_j - e_j)e_j^T
-    (c_j = j-th Cholesky column) we have L = E_0 ... E_31 and
+    (c_j = j-th Cholesky column) we have L = E_0 ... E_{nb-1} and
     E_j^-1 X = X + v_j X[j,:] with v_j = -(c_j - e_j)/l_j — a VPU rank-1
     per step, reading row j by masked reduction (no transposes, no
     triangular solves).
     """
-    t = p0.shape[0]
-    rows = jax.lax.broadcasted_iota(jnp.int32, (t, _NB, _NB), 1)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (t, _NB, _NB), 2)
-    riota = jax.lax.broadcasted_iota(jnp.int32, (t, _NB, 1), 1)
+    t, nb = p0.shape[0], p0.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (t, nb, nb), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, nb, nb), 2)
+    riota = jax.lax.broadcasted_iota(jnp.int32, (t, nb, 1), 1)
     eye = (rows == cols).astype(jnp.float32)
 
     def step(j, carry):
         schur, l_mat, li_mat, ld = carry
-        row = _row(schur, j, rows)  # [T,1,32]
-        lane = jax.lax.broadcasted_iota(jnp.int32, (t, 1, _NB), 2)
+        row = _row(schur, j, rows)  # [T,1,nb]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (t, 1, nb), 2)
         piv = jnp.sum(jnp.where(lane == j, row, 0.0), axis=2, keepdims=True)
         col = jnp.sum(
             jnp.where(cols == j, schur, 0.0), axis=2, keepdims=True
-        )  # [T,32,1] — Schur complement stays symmetric: column j == row j
+        )  # [T,nb,1] — Schur complement stays symmetric: column j == row j
         sqrt_p = jnp.sqrt(piv)
         schur = schur - col * (row / piv)  # trailing rank-1 (stale top rows
         #                                   are never read again)
@@ -119,106 +151,177 @@ def _mini_chol_inv(p0):
 
     _, l_mat, li_mat, ld = jax.lax.fori_loop(
         0,
-        _NB,
+        nb,
         step,
         (p0, jnp.zeros_like(p0), eye, jnp.zeros((t,), jnp.float32)),
     )
     return l_mat, li_mat, ld
 
 
-def _chol_inv_kernel(k_ref, kinv_ref, ld_ref, a_ref, w_ref):
-    a_ref[:] = k_ref[:]
-    w_ref[:] = jnp.zeros((_T, _N, _N), jnp.float32)
-    ld = jnp.zeros((_T,), jnp.float32)
+def _make_kernel(t: int, n: int, sizes: tuple):
+    """Kernel closure for a [t, n, n] tile with the given diagonal blocks."""
+    offs = [0]
+    for s in sizes:
+        offs.append(offs[-1] + s)
 
-    for b in range(_BLOCKS):
-        j0 = b * _NB
-        hi = j0 + _NB
-        pivot = a_ref[:, j0:hi, j0:hi]
-        l33, l33_inv, ld_b = _mini_chol_inv(pivot)
-        ld = ld + ld_b
-        a_ref[:, j0:hi, j0:hi] = l33
-        w_ref[:, j0:hi, j0:hi] = l33_inv
-        if b + 1 < _BLOCKS:
-            c_panel = a_ref[:, hi:, j0:hi]  # [T, rest, 32]
-            l_panel = _bmm(c_panel, l33_inv, contract=(2, 2))  # C L33^-T
-            a_ref[:, hi:, j0:hi] = l_panel
-            a_ref[:, hi:, hi:] = a_ref[:, hi:, hi:] - _bmm(
-                l_panel, l_panel, contract=(2, 2)
-            )
-        # blocked triangular inversion, row b of W = L^-1:
-        # W[b,c] = -L33inv @ sum_{c <= k < b} L[b,k] W[k,c]
-        for c in range(b):
-            c0 = c * _NB
-            acc = None
-            for k in range(c, b):
-                k0 = k * _NB
-                term = _bmm(
-                    a_ref[:, j0:hi, k0 : k0 + _NB],
-                    w_ref[:, k0 : k0 + _NB, c0 : c0 + _NB],
+    def kernel(k_ref, kinv_ref, ld_ref, a_ref, w_ref):
+        a_ref[:] = k_ref[:]
+        w_ref[:] = jnp.zeros((t, n, n), jnp.float32)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (t, n), 1)
+        ld_acc = jnp.zeros((t, n), jnp.float32)
+
+        for b, nb in enumerate(sizes):
+            j0, hi = offs[b], offs[b + 1]
+            pivot = a_ref[:, j0:hi, j0:hi]
+            l33, l33_inv, ld_b = _mini_chol_inv(pivot)
+            # per-block logdet at lane b (packing wrapper group-sums these)
+            ld_acc = ld_acc + jnp.where(lane == b, ld_b[:, None], 0.0)
+            a_ref[:, j0:hi, j0:hi] = l33
+            w_ref[:, j0:hi, j0:hi] = l33_inv
+            if hi < n:
+                c_panel = a_ref[:, hi:, j0:hi]  # [T, rest, nb]
+                l_panel = _bmm(c_panel, l33_inv, contract=(2, 2))  # C L33^-T
+                a_ref[:, hi:, j0:hi] = l_panel
+                a_ref[:, hi:, hi:] = a_ref[:, hi:, hi:] - _bmm(
+                    l_panel, l_panel, contract=(2, 2)
                 )
-                acc = term if acc is None else acc + term
-            w_ref[:, j0:hi, c0 : c0 + _NB] = -_bmm(l33_inv, acc)
+            # blocked triangular inversion, row b of W = L^-1:
+            # W[b,c] = -L33inv @ sum_{c <= k < b} L[b,k] W[k,c]
+            for c in range(b):
+                c0, c1 = offs[c], offs[c + 1]
+                acc = None
+                for k in range(c, b):
+                    k0, k1 = offs[k], offs[k + 1]
+                    term = _bmm(
+                        a_ref[:, j0:hi, k0:k1], w_ref[:, k0:k1, c0:c1]
+                    )
+                    acc = term if acc is None else acc + term
+                w_ref[:, j0:hi, c0:c1] = -_bmm(l33_inv, acc)
 
-    # K^-1 = L^-T L^-1 = W^T W
-    kinv_ref[:] = _bmm(w_ref[:], w_ref[:], contract=(1, 1))
-    ld_ref[:] = jnp.broadcast_to(ld[:, None], (_T, _N))
+        # K^-1 = L^-T L^-1 = W^T W
+        kinv_ref[:] = _bmm(w_ref[:], w_ref[:], contract=(1, 1))
+        ld_ref[:] = ld_acc
+
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnums=1)
 def _factor_batched(k, interpret: bool = False):
-    """``[B, 128, 128] f32 -> (K^-1 [B,128,128], logdet [B])`` — B a multiple
-    of 8."""
-    b = k.shape[0]
-    grid = (b // _T,)
+    """``[B, n_pad, n_pad] f32 -> (K^-1 [B,n_pad,n_pad], block logdets
+    [B, n_pad])`` — n_pad a multiple of 8, B a multiple of the tile count."""
+    b, n = k.shape[0], k.shape[-1]
+    t = _tiles_for(n)
+    sizes = _blocks_for(n)
+    grid = (b // t,)
     kinv, ld = pl.pallas_call(
-        _chol_inv_kernel,
+        _make_kernel(t, n, sizes),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_T, _N, _N), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+            pl.BlockSpec((t, n, n), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
         ],
         out_specs=[
-            pl.BlockSpec((_T, _N, _N), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_T, _N), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, n, n), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, _N, _N), jnp.float32),
-            jax.ShapeDtypeStruct((b, _N), jnp.float32),
+            jax.ShapeDtypeStruct((b, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((b, n), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((_T, _N, _N), jnp.float32),
-            pltpu.VMEM((_T, _N, _N), jnp.float32),
+            pltpu.VMEM((t, n, n), jnp.float32),
+            pltpu.VMEM((t, n, n), jnp.float32),
         ],
         interpret=interpret,
     )(k)
-    return kinv, ld[:, 0]
+    return kinv, ld
 
 
-def _pad_to_kernel_shape(k):
-    """Embed ``[B, n, n]`` (n <= 128) into identity-padded ``[B8, 128, 128]``:
-    unit diagonal in the pad block contributes logdet 0 and an identity
-    inverse block, both sliced away on return."""
+def _identity_pad(k, n_pad: int):
+    """Embed ``[B, n, n]`` into ``[B, n_pad, n_pad]`` with a unit-diagonal
+    pad block: logdet contribution 0, identity inverse block, both sliced
+    away on return."""
+    n = k.shape[-1]
+    if n_pad == n:
+        return k
+    k = jnp.pad(k, ((0, 0), (0, n_pad - n), (0, n_pad - n)))
+    diag = jnp.concatenate(
+        [jnp.zeros((n,), k.dtype), jnp.ones((n_pad - n,), k.dtype)]
+    )
+    return k + jnp.diag(diag)[None, :, :]
+
+
+def _batch_pad(k, t: int):
+    """Pad the batch to a multiple of t with identity matrices."""
     b, n = k.shape[0], k.shape[-1]
-    b_pad = (-b) % _T
-    n_pad = _N - n
-    k = jnp.pad(k, ((0, b_pad), (0, n_pad), (0, n_pad)))
-    if n_pad:
-        diag = jnp.concatenate(
-            [jnp.zeros((n,), k.dtype), jnp.ones((n_pad,), k.dtype)]
-        )
-        k = k + jnp.diag(diag)[None, :, :]
-    if b_pad:
-        # padded batch entries are all-zero matrices -> make them identity
-        pad_eye = jnp.eye(_N, dtype=k.dtype)
-        sel = (jnp.arange(b + b_pad) >= b)[:, None, None]
-        k = jnp.where(sel, pad_eye[None], k)
-    return k, b, n
+    b_pad = (-b) % t
+    if not b_pad:
+        return k
+    k = jnp.pad(k, ((0, b_pad), (0, 0), (0, 0)))
+    pad_eye = jnp.eye(n, dtype=k.dtype)
+    sel = (jnp.arange(b + b_pad) >= b)[:, None, None]
+    return jnp.where(sel, pad_eye[None], k)
+
+
+def _pallas_inv_logdet_direct(k, interpret: bool):
+    """One matrix per tile slot: n padded to a multiple of 8."""
+    b, n = k.shape[0], k.shape[-1]
+    n_pad = -(-n // 8) * 8
+    k = _identity_pad(k, n_pad)
+    k = _batch_pad(k, _tiles_for(n_pad))
+    kinv, ld = _factor_batched(k, interpret)
+    return kinv[:b, :n, :n], jnp.sum(ld[:b, : len(_blocks_for(n_pad))], axis=-1)
+
+
+def _pallas_inv_logdet_packed(k, interpret: bool):
+    """Small experts (n <= 64): several matrices embedded block-diagonally
+    in one full-lane-width tile (4x32 or 2x64) — full MXU/VPU lane
+    utilization instead of padding a mostly-empty 100+-lane tile.
+
+    Correct because Cholesky/inverse of a block-diagonal matrix is the
+    block-diagonal of the per-block results, and the kernel emits logdet
+    per 32/64-wide diagonal block, so each sub-matrix's logdet is a static
+    group-sum (sub-matrix boundaries align with block boundaries).
+    """
+    import jax.scipy.linalg as jsp
+
+    b, n = k.shape[0], k.shape[-1]
+    sub = 32 if n <= 32 else 64
+    pack = _LANE // sub
+    k = _identity_pad(k, sub)
+    k = _batch_pad(k, pack)
+    bp = k.shape[0] // pack
+    k4 = k.reshape(bp, pack, sub, sub)
+    packed = jax.vmap(
+        lambda ms: jsp.block_diag(*[ms[i] for i in range(pack)])
+    )(k4)
+    packed = _batch_pad(packed, _tiles_for(_LANE))
+    kinv_p, ld_p = _factor_batched(packed, interpret)
+    kinv_p = kinv_p[:bp]
+    ld_p = ld_p[:bp]
+    # sub-matrix i occupies rows/cols [i*sub, (i+1)*sub) and diagonal
+    # blocks [i*bps, (i+1)*bps) with bps blocks of size 32 or 64 each
+    bps = len(_blocks_for(_LANE)) // pack
+    kinv = jnp.stack(
+        [
+            kinv_p[:, i * sub : (i + 1) * sub, i * sub : (i + 1) * sub]
+            for i in range(pack)
+        ],
+        axis=1,
+    ).reshape(bp * pack, sub, sub)
+    ld = jnp.stack(
+        [
+            jnp.sum(ld_p[:, i * bps : (i + 1) * bps], axis=-1)
+            for i in range(pack)
+        ],
+        axis=1,
+    ).reshape(bp * pack)
+    return kinv[:b, :n, :n], ld[:b]
 
 
 def _pallas_inv_logdet(k, interpret: bool = False):
-    k_pad, b, n = _pad_to_kernel_shape(k)
-    kinv, ld = _factor_batched(k_pad, interpret)
-    return kinv[:b, :n, :n], ld[:b]
+    if k.shape[-1] <= 64:
+        return _pallas_inv_logdet_packed(k, interpret)
+    return _pallas_inv_logdet_direct(k, interpret)
 
 
 def _chol_inv_logdet(k):
@@ -240,7 +343,7 @@ def _use_pallas(k) -> bool:
         jax.default_backend() == "tpu"
         and k.dtype == jnp.float32
         and k.ndim == 3
-        and k.shape[-1] <= _N
+        and k.shape[-1] <= _N_MAX
     )
 
 
@@ -248,8 +351,9 @@ def _use_pallas(k) -> bool:
 def spd_inv_logdet(k):
     """``[B, n, n] SPD -> (K^-1 [B,n,n], logdet [B])``.
 
-    One fused Pallas blocked-Cholesky pass on TPU f32 (n <= 128); Cholesky +
-    triangular solves elsewhere.  Non-PD inputs yield NaNs (never an
+    One fused Pallas blocked-Cholesky pass on TPU f32 (n <= 512, with
+    block-diagonal packing of 2-4 matrices per tile for n <= 64); Cholesky
+    + triangular solves elsewhere.  Non-PD inputs yield NaNs (never an
     exception — surfaced like a failed Cholesky).
     """
     if _use_pallas(k):
