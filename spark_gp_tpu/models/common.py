@@ -40,6 +40,7 @@ class GaussianProcessParams:
         self._seed: int = 0
         self._mesh = None
         self._checkpoint_dir: Optional[str] = None
+        self._checkpoint_interval: int = 10
         self._optimizer: str = "auto"
         self._hyper_space: str = "auto"
 
@@ -88,7 +89,24 @@ class GaussianProcessParams:
         return self
 
     def setCheckpointDir(self, path: Optional[str]):
+        """Persist optimizer state for kill-and-resume durability.
+
+        Host optimizer: theta is saved every L-BFGS iteration.  Device
+        optimizer: the fit runs in ``checkpointInterval``-iteration segments
+        and the FULL L-BFGS state (iterate, history, aux) is persisted
+        between segments; a matching checkpoint in this directory resumes
+        the fit mid-run automatically.
+        """
         self._checkpoint_dir = path
+        return self
+
+    def setCheckpointInterval(self, iters: int):
+        """Device-optimizer segment length: iterations between checkpoints
+        (default 10).  Smaller = finer resume granularity, one extra host
+        sync per segment."""
+        if int(iters) < 1:
+            raise ValueError("checkpoint interval must be >= 1")
+        self._checkpoint_interval = int(iters)
         return self
 
     def setOptimizer(self, value: str):
@@ -108,12 +126,6 @@ class GaussianProcessParams:
     def _resolved_optimizer(self) -> str:
         if self._optimizer != "auto":
             return self._optimizer
-        if self._checkpoint_dir is not None:
-            # L-BFGS state checkpointing hooks the host driver's per-step
-            # callback; the one-dispatch device loop has no step boundary to
-            # checkpoint at, so an explicit checkpoint dir keeps the host
-            # optimizer.
-            return "host"
         import jax
 
         return "device" if jax.default_backend() == "tpu" else "host"
@@ -157,6 +169,8 @@ class GaussianProcessParams:
     set_tol = setTol
     set_seed = setSeed
     set_mesh = setMesh
+    set_checkpoint_dir = setCheckpointDir
+    set_checkpoint_interval = setCheckpointInterval
     set_optimizer = setOptimizer
     set_hyper_space = setHyperSpace
 
@@ -191,6 +205,15 @@ class GaussianProcessCommons(GaussianProcessParams):
             data = shard_experts(data, self._mesh)
         return data
 
+    def _make_checkpointer(self, kernel):
+        if self._checkpoint_dir is None:
+            return None
+        from spark_gp_tpu.utils.checkpoint import LbfgsCheckpointer
+
+        return LbfgsCheckpointer(
+            self._checkpoint_dir, kernel, tag=type(self).__name__
+        )
+
     def _optimize_hypers(
         self,
         instr: Instrumentation,
@@ -202,6 +225,23 @@ class GaussianProcessCommons(GaussianProcessParams):
         (GaussianProcessCommons.scala:66-92)."""
         instr.log_info("Optimising the kernel hyperparameters")
         theta0 = kernel.init_theta()
+        if self._checkpoint_dir is not None:
+            # resume the host optimizer from the last persisted iterate
+            from spark_gp_tpu.utils.checkpoint import (
+                kernel_signature,
+                load_checkpoint,
+            )
+
+            ck = load_checkpoint(self._checkpoint_dir, tag=type(self).__name__)
+            if (
+                ck is not None
+                and np.asarray(ck[1]).shape == theta0.shape
+                and ck[2] == kernel_signature(kernel, theta0.shape[0])
+            ):
+                instr.log_info(
+                    f"Resuming from checkpoint (iteration {ck[0]})"
+                )
+                theta0 = np.asarray(ck[1])
         lower, upper = kernel.bounds()
         with instr.phase("optimize_hypers"):
             res = minimize_lbfgsb(

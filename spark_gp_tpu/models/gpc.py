@@ -99,7 +99,10 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                 state["f"] = f_new
                 return value, grad
 
-            theta_opt = self._optimize_hypers(instr, kernel, value_and_grad)
+            theta_opt = self._optimize_hypers(
+                instr, kernel, value_and_grad,
+                callback=self._make_checkpointer(kernel),
+            )
 
             # Final evaluation at theta*: settles f at the optimum
             # (GPClf.scala:60's foreach).
@@ -136,7 +139,21 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
         with instr.phase("optimize_hypers"):
-            if self._mesh is not None:
+            if self._checkpoint_dir is not None:
+                from spark_gp_tpu.models.laplace import (
+                    fit_gpc_device_checkpointed,
+                )
+                from spark_gp_tpu.utils.checkpoint import (
+                    DeviceOptimizerCheckpointer,
+                )
+
+                theta, f_final, f, n_iter, n_fev = fit_gpc_device_checkpointed(
+                    kernel, float(self._tol), self._mesh, log_space, theta0,
+                    lower, upper, data, self._max_iter,
+                    self._checkpoint_interval,
+                    DeviceOptimizerCheckpointer(self._checkpoint_dir, "gpc"),
+                )
+            elif self._mesh is not None:
                 theta, f_final, f, n_iter, n_fev = fit_gpc_device_sharded(
                     kernel, float(self._tol), self._mesh, log_space, theta0,
                     lower, upper, data.x, data.y, data.mask, max_iter,

@@ -171,7 +171,23 @@ class GaussianProcessRegression(GaussianProcessCommons):
         log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
         with instr.phase("optimize_hypers"):
-            if self._mesh is not None:
+            if self._checkpoint_dir is not None:
+                # segmented fit: one host sync per checkpointInterval
+                # iterations, full state persisted between segments, resumes
+                # from a matching prior checkpoint automatically
+                from spark_gp_tpu.models.likelihood import (
+                    fit_gpr_device_checkpointed,
+                )
+                from spark_gp_tpu.utils.checkpoint import (
+                    DeviceOptimizerCheckpointer,
+                )
+
+                theta, f, n_iter, n_fev = fit_gpr_device_checkpointed(
+                    kernel, self._mesh, log_space, theta0, lower, upper,
+                    data, self._max_iter, tol, self._checkpoint_interval,
+                    DeviceOptimizerCheckpointer(self._checkpoint_dir, "gpr"),
+                )
+            elif self._mesh is not None:
                 theta, f, n_iter, n_fev = fit_gpr_device_sharded(
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
@@ -183,13 +199,6 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 )
         pending = {"lbfgs_iters": n_iter, "lbfgs_nfev": n_fev, "final_nll": f}
         return theta, pending
-
-    def _make_checkpointer(self, kernel):
-        if self._checkpoint_dir is None:
-            return None
-        from spark_gp_tpu.utils.checkpoint import LbfgsCheckpointer
-
-        return LbfgsCheckpointer(self._checkpoint_dir, kernel)
 
 
 class GaussianProcessRegressionModel:

@@ -184,33 +184,38 @@ def make_laplace_objective(kernel: Kernel, data: ExpertData, tol):
     return obj
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
-def _sharded_laplace_impl(kernel: Kernel, tol, mesh, theta, x, y, mask, f0):
+def _make_sharded_logz(kernel: Kernel, tol, mesh):
+    """shard_map'd ``(theta, f, x, y, mask) -> (value, grad, f_new)`` core,
+    shared by the host-driven objective, the one-dispatch fit and the
+    segmented checkpointing loop."""
+
     @partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(
-            P(),
-            P(EXPERT_AXIS),
-            P(EXPERT_AXIS),
-            P(EXPERT_AXIS),
-            P(EXPERT_AXIS),
+            P(), P(EXPERT_AXIS),
+            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
         ),
         out_specs=(P(), P(), P(EXPERT_AXIS)),
     )
-    def sharded(theta_, x_, y_, mask_, f0_):
+    def core(theta, f_carry, x_, y_, mask_):
         local = ExpertData(x=x_, y=y_, mask=mask_)
-        value, grad, f = batched_neg_logz(kernel, tol, theta_, local, f0_)
+        value, grad, f_new = batched_neg_logz(kernel, tol, theta, local, f_carry)
         # The Laplace gradient is assembled manually (Alg 5.1), not by
         # differentiating w.r.t. the replicated theta, so unlike the GPR
         # path it DOES need its own psum.
         return (
             jax.lax.psum(value, EXPERT_AXIS),
             jax.lax.psum(grad, EXPERT_AXIS),
-            f,
+            f_new,
         )
 
-    return sharded(theta, x, y, mask, f0)
+    return core
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _sharded_laplace_impl(kernel: Kernel, tol, mesh, theta, x, y, mask, f0):
+    return _make_sharded_logz(kernel, tol, mesh)(theta, f0, x, y, mask)
 
 
 def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
@@ -257,6 +262,99 @@ def fit_gpc_device(
         vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
     )
     return from_u(theta), f_final, f, n_iter, n_fev
+
+
+# --- segmented device fit: checkpoint/resume (likelihood.py counterpart) --
+
+
+def _gpc_segment_vag(kernel: Kernel, tol, mesh, log_space, data: ExpertData):
+    from spark_gp_tpu.optimize.lbfgs_device import log_transform_vag
+
+    if mesh is None:
+
+        def base(theta, f_carry):
+            value, grad, f_new = batched_neg_logz(
+                kernel, tol, theta, data, f_carry
+            )
+            return value, grad, f_new
+
+    else:
+        core = _make_sharded_logz(kernel, tol, mesh)
+
+        def base(theta, f_carry):
+            return core(theta, f_carry, data.x, data.y, data.mask)
+
+    return log_transform_vag(base) if log_space else base
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def gpc_device_segment_init(
+    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask
+):
+    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
+
+    data = ExpertData(x=x, y=y, mask=mask)
+    vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data)
+    t0 = jnp.log(theta0) if log_space else theta0
+    return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def gpc_device_segment_run(
+    kernel: Kernel, tol, mesh, log_space, state, lower, upper, x, y, mask,
+    iter_limit,
+):
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_run_segment,
+        log_transform_bounds,
+    )
+
+    data = ExpertData(x=x, y=y, mask=mask)
+    vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data)
+    lo, hi = (
+        log_transform_bounds(lower, upper) if log_space else (lower, upper)
+    )
+    return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
+
+
+def fit_gpc_device_checkpointed(
+    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper,
+    data: ExpertData, max_iter: int, chunk: int, saver,
+):
+    """Segmented on-device classifier fit with state persistence — see
+    likelihood.fit_gpr_device_checkpointed.  The aux carry here is the
+    latent warm-start stack, so a resume continues from the settled modes,
+    not from zero latents.  Returns (theta, f_latents, nll, n_iter, n_fev).
+    """
+    from spark_gp_tpu.utils.checkpoint import data_fingerprint
+
+    meta = {
+        "kind": "gpc",
+        "log_space": bool(log_space),
+        "theta_dim": int(theta0.shape[0]),
+        "num_experts": int(data.x.shape[0]),
+        "expert_size": int(data.x.shape[1]),
+        "data_fingerprint": data_fingerprint(data.x, data.y, data.mask),
+    }
+    init = partial(gpc_device_segment_init, kernel, float(tol), mesh, log_space)
+    # shapes/dtypes only — skips a full Laplace Newton solve on resume
+    template = jax.eval_shape(
+        init, theta0, lower, upper, data.x, data.y, data.mask
+    )
+    state = saver.load(template, meta)
+    if state is None:
+        state = init(theta0, lower, upper, data.x, data.y, data.mask)
+    while not bool(state.done) and int(state.n_iter) < max_iter:
+        limit = jnp.asarray(
+            min(int(state.n_iter) + chunk, max_iter), jnp.int32
+        )
+        state = gpc_device_segment_run(
+            kernel, float(tol), mesh, log_space, state, lower, upper,
+            data.x, data.y, data.mask, limit,
+        )
+        saver.save(state, meta)
+    theta = jnp.exp(state.theta) if log_space else state.theta
+    return theta, state.aux, state.f, state.n_iter, state.n_fev
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))

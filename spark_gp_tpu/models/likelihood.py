@@ -93,8 +93,11 @@ def make_value_and_grad(kernel: Kernel, data: ExpertData):
     return vag
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _sharded_vag_impl(kernel: Kernel, mesh, theta, x, y, mask):
+def _make_sharded_vag(kernel: Kernel, mesh):
+    """shard_map'd ``(theta, x, y, mask) -> (nll, grad)`` core, reusable
+    inside larger jitted programs (the one-dispatch fits, the segmented
+    checkpointing loop)."""
+
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -112,7 +115,12 @@ def _sharded_vag_impl(kernel: Kernel, mesh, theta, x, y, mask):
         # the device count).
         return jax.lax.psum(value, EXPERT_AXIS), grad
 
-    return sharded(theta, x, y, mask)
+    return sharded
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _sharded_vag_impl(kernel: Kernel, mesh, theta, x, y, mask):
+    return _make_sharded_vag(kernel, mesh)(theta, x, y, mask)
 
 
 def make_sharded_value_and_grad(kernel: Kernel, data: ExpertData, mesh):
@@ -161,6 +169,114 @@ def fit_gpr_device(
         vag, theta0, lower, upper, jnp.zeros(()), max_iter=max_iter, tol=tol
     )
     return from_u(theta), f, n_iter, n_fev
+
+
+# --- segmented device fit: checkpoint/resume for long runs ----------------
+
+
+def _gpr_segment_vag(kernel: Kernel, mesh, log_space, data: ExpertData):
+    """The (possibly sharded, possibly log-space) objective used by the
+    segmented fit — identical math to the one-dispatch fits above."""
+    from spark_gp_tpu.optimize.lbfgs_device import log_transform_vag
+
+    if mesh is None:
+
+        def base(theta, aux):
+            value, grad = jax.value_and_grad(
+                lambda t: batched_nll(kernel, t, data)
+            )(theta)
+            return value, grad, aux
+
+    else:
+        core = _make_sharded_vag(kernel, mesh)
+
+        def base(theta, aux):
+            value, grad = core(theta, data.x, data.y, data.mask)
+            return value, grad, aux
+
+    return log_transform_vag(base) if log_space else base
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def gpr_device_segment_init(
+    kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask
+):
+    """One objective evaluation -> the optimizer's carried state (the
+    checkpoint unit)."""
+    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
+
+    data = ExpertData(x=x, y=y, mask=mask)
+    vag = _gpr_segment_vag(kernel, mesh, log_space, data)
+    t0 = jnp.log(theta0) if log_space else theta0
+    return lbfgs_init_state(vag, t0, jnp.zeros((), theta0.dtype))
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def gpr_device_segment_run(
+    kernel: Kernel, mesh, log_space, state, lower, upper, x, y, mask,
+    iter_limit, tol,
+):
+    """Advance the device L-BFGS to ``iter_limit`` total iterations (one
+    compiled program, reused for every segment — iter_limit is traced)."""
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_run_segment,
+        log_transform_bounds,
+    )
+
+    data = ExpertData(x=x, y=y, mask=mask)
+    vag = _gpr_segment_vag(kernel, mesh, log_space, data)
+    lo, hi = (
+        log_transform_bounds(lower, upper) if log_space else (lower, upper)
+    )
+    return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
+
+
+def fit_gpr_device_checkpointed(
+    kernel: Kernel, mesh, log_space, theta0, lower, upper, data: ExpertData,
+    max_iter: int, tol, chunk: int, saver,
+):
+    """On-device fit in K-iteration segments with state persistence.
+
+    The single-program fits above have no host boundary to checkpoint at;
+    this driver trades one host sync per ``chunk`` iterations for
+    kill-and-resume durability: each segment is one dispatch of the same
+    compiled program, and the full optimizer state (theta, gradient,
+    curvature history, aux) round-trips through ``saver`` between segments.
+    A valid prior checkpoint resumes the fit mid-run (same kernel/config,
+    enforced via the saver's meta).  Returns (theta, nll, n_iter, n_fev).
+    """
+    from spark_gp_tpu.utils.checkpoint import data_fingerprint
+
+    meta = {
+        "kind": "gpr",
+        "log_space": bool(log_space),
+        "theta_dim": int(theta0.shape[0]),
+        "num_experts": int(data.x.shape[0]),
+        "expert_size": int(data.x.shape[1]),
+        # same-shaped but different data must not resume a finished run's
+        # state (it would return the stale theta with zero iterations)
+        "data_fingerprint": data_fingerprint(data.x, data.y, data.mask),
+    }
+    init = partial(gpr_device_segment_init, kernel, mesh, log_space)
+    # shapes/dtypes only — no objective evaluation unless we really init
+    template = jax.eval_shape(
+        init, theta0, lower, upper, data.x, data.y, data.mask
+    )
+    state = saver.load(template, meta)
+    if state is None:
+        state = init(theta0, lower, upper, data.x, data.y, data.mask)
+    tol = jnp.asarray(tol, state.theta.dtype)
+    while not bool(state.done) and int(state.n_iter) < max_iter:
+        limit = jnp.asarray(
+            min(int(state.n_iter) + chunk, max_iter), jnp.int32
+        )
+        state = gpr_device_segment_run(
+            kernel, mesh, log_space, state, lower, upper,
+            data.x, data.y, data.mask, limit, tol,
+        )
+        saver.save(state, meta)
+    theta = jnp.exp(state.theta) if log_space else state.theta
+    return theta, state.f, state.n_iter, state.n_fev
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2))

@@ -36,6 +36,26 @@ import jax
 import jax.numpy as jnp
 
 
+def log_transform_vag(value_and_grad_aux):
+    """Chain-rule wrap of an objective for u = log(theta) coordinates."""
+
+    def vag_u(u, aux):
+        theta = jnp.exp(u)
+        value, grad, aux2 = value_and_grad_aux(theta, aux)
+        return value, grad * theta, aux2
+
+    return vag_u
+
+
+def log_transform_bounds(lower, upper):
+    """Box bounds mapped through log (0 lower -> -inf, inf upper -> inf)."""
+    lower_u = jnp.where(lower > 0, jnp.log(jnp.maximum(lower, 1e-300)), -jnp.inf)
+    upper_u = jnp.where(
+        jnp.isposinf(upper), jnp.inf, jnp.log(jnp.maximum(upper, 1e-300))
+    )
+    return lower_u, upper_u
+
+
 def log_reparam(value_and_grad_aux, theta0, lower, upper):
     """Map a box-constrained objective to log-domain coordinates u = log(theta).
 
@@ -43,18 +63,14 @@ def log_reparam(value_and_grad_aux, theta0, lower, upper):
     ``optimize.lbfgsb.minimize_lbfgsb(log_space=True)`` for why GP marginal
     likelihoods want this.  Caller guarantees theta0 > 0, lower >= 0.
     """
-
-    def vag_u(u, aux):
-        theta = jnp.exp(u)
-        value, grad, aux2 = value_and_grad_aux(theta, aux)
-        return value, grad * theta, aux2
-
-    u0 = jnp.log(theta0)
-    lower_u = jnp.where(lower > 0, jnp.log(jnp.maximum(lower, 1e-300)), -jnp.inf)
-    upper_u = jnp.where(
-        jnp.isposinf(upper), jnp.inf, jnp.log(jnp.maximum(upper, 1e-300))
+    lower_u, upper_u = log_transform_bounds(lower, upper)
+    return (
+        log_transform_vag(value_and_grad_aux),
+        jnp.log(theta0),
+        lower_u,
+        upper_u,
+        jnp.exp,
     )
-    return vag_u, u0, lower_u, upper_u, jnp.exp
 
 
 class _LbfgsState(NamedTuple):
@@ -112,6 +128,64 @@ def _two_loop_direction(grad, s_hist, y_hist, rho, count, head, m_hist):
     return -r
 
 
+def lbfgs_init_state(value_and_grad_aux, theta0, aux0, m_hist: int = 10):
+    """Evaluate the objective once and build the optimizer's carried state.
+
+    The state is a flat-array NamedTuple (plus the aux pytree), so it can be
+    pulled to host, persisted, and fed back into ``lbfgs_run_segment`` — the
+    checkpoint/resume unit for long fits (SURVEY.md §5: JAX has no lineage;
+    the reference leans on Spark recompute).
+    """
+    theta0 = jnp.asarray(theta0)
+    dtype = theta0.dtype
+    h = theta0.shape[0]
+    f0, g0, aux1 = value_and_grad_aux(theta0, aux0)
+    return _LbfgsState(
+        theta=theta0,
+        f=f0,
+        grad=g0,
+        aux=aux1,
+        s_hist=jnp.zeros((m_hist, h), dtype=dtype),
+        y_hist=jnp.zeros((m_hist, h), dtype=dtype),
+        rho=jnp.zeros((m_hist,), dtype=dtype),
+        hist_count=jnp.zeros((), jnp.int32),
+        hist_head=jnp.zeros((), jnp.int32),
+        n_iter=jnp.zeros((), jnp.int32),
+        n_fev=jnp.ones((), jnp.int32),
+        done=jnp.zeros((), jnp.bool_),
+    )
+
+
+def lbfgs_run_segment(
+    value_and_grad_aux,
+    state: _LbfgsState,
+    lower,
+    upper,
+    iter_limit,
+    tol: float = 1e-6,
+    m_hist: int = 10,
+    max_ls: int = 25,
+    armijo_c1: float = 1e-4,
+):
+    """Run L-BFGS iterations until convergence or ``n_iter >= iter_limit``.
+
+    ``iter_limit`` is an absolute iteration count (may be traced), so a host
+    loop can advance the same compiled program in K-iteration segments,
+    persisting the returned state between dispatches.
+    """
+    dtype = state.theta.dtype
+    lower = jnp.asarray(lower, dtype=dtype)
+    upper = jnp.asarray(upper, dtype=dtype)
+    body = _make_body(
+        value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1
+    )
+
+    def cond(s: _LbfgsState):
+        return jnp.logical_and(~s.done, s.n_iter < iter_limit)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
 def lbfgs_minimize_device(
     value_and_grad_aux,
     theta0,
@@ -131,11 +205,17 @@ def lbfgs_minimize_device(
     driver: projected-gradient inf-norm < tol, or relative objective change
     < tol between accepted iterates.
     """
-    theta0 = jnp.asarray(theta0)
-    dtype = theta0.dtype
-    lower = jnp.asarray(lower, dtype=dtype)
-    upper = jnp.asarray(upper, dtype=dtype)
-    h = theta0.shape[0]
+    state = lbfgs_init_state(value_and_grad_aux, theta0, aux0, m_hist)
+    final = lbfgs_run_segment(
+        value_and_grad_aux, state, lower, upper, max_iter, tol,
+        m_hist, max_ls, armijo_c1,
+    )
+    return final.theta, final.f, final.aux, final.n_iter, final.n_fev
+
+
+def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1):
+    """One L-BFGS iteration (direction, Wolfe line search, history update)."""
+    dtype = lower.dtype
 
     def proj(t):
         return jnp.clip(t, lower, upper)
@@ -143,27 +223,7 @@ def lbfgs_minimize_device(
     def proj_grad_norm(theta, grad):
         # norm of the projected gradient: zero at a KKT point of the box
         step = proj(theta - grad) - theta
-        return jnp.max(jnp.abs(step)) if h else jnp.zeros((), dtype)
-
-    f0, g0, aux1 = value_and_grad_aux(theta0, aux0)
-
-    init = _LbfgsState(
-        theta=theta0,
-        f=f0,
-        grad=g0,
-        aux=aux1,
-        s_hist=jnp.zeros((m_hist, h), dtype=dtype),
-        y_hist=jnp.zeros((m_hist, h), dtype=dtype),
-        rho=jnp.zeros((m_hist,), dtype=dtype),
-        hist_count=jnp.zeros((), jnp.int32),
-        hist_head=jnp.zeros((), jnp.int32),
-        n_iter=jnp.zeros((), jnp.int32),
-        n_fev=jnp.ones((), jnp.int32),
-        done=jnp.zeros((), jnp.bool_),
-    )
-
-    def cond(state: _LbfgsState):
-        return jnp.logical_and(~state.done, state.n_iter < max_iter)
+        return jnp.max(jnp.abs(step)) if step.size else jnp.zeros((), dtype)
 
     def body(state: _LbfgsState):
         direction = _two_loop_direction(
@@ -323,5 +383,4 @@ def lbfgs_minimize_device(
             done=converged | stalled,
         )
 
-    final = jax.lax.while_loop(cond, body, init)
-    return final.theta, final.f, final.aux, final.n_iter, final.n_fev
+    return body

@@ -16,12 +16,23 @@ import os
 import numpy as np
 
 
-class LbfgsCheckpointer:
-    """Callback for ``scipy.optimize.minimize``: saves theta every iteration."""
+def kernel_signature(kernel, theta_dim: int) -> str:
+    """Structural identity of a kernel config (values zeroed) — guards a
+    checkpoint against being resumed under a different kernel that happens
+    to share the hyperparameter count."""
+    return kernel.describe(np.zeros(theta_dim))
 
-    def __init__(self, directory: str, kernel) -> None:
+
+class LbfgsCheckpointer:
+    """Callback for ``scipy.optimize.minimize``: saves theta every iteration.
+
+    ``tag`` (the estimator class name) keys the file so GPR and GPC fits
+    sharing a directory cannot cross-contaminate.
+    """
+
+    def __init__(self, directory: str, kernel, tag: str = "gp") -> None:
         os.makedirs(directory, exist_ok=True)
-        self.path = os.path.join(directory, "lbfgs_state.json")
+        self.path = os.path.join(directory, f"lbfgs_state_{tag}.json")
         self.kernel = kernel
         self.iteration = 0
 
@@ -32,6 +43,7 @@ class LbfgsCheckpointer:
             "iteration": self.iteration,
             "theta": theta.tolist(),
             "kernel": self.kernel.describe(theta),
+            "kernel_sig": kernel_signature(self.kernel, theta.shape[0]),
         }
         tmp = self.path + ".tmp"
         with open(tmp, "w") as fh:
@@ -39,11 +51,101 @@ class LbfgsCheckpointer:
         os.replace(tmp, self.path)
 
 
-def load_checkpoint(directory: str):
-    """Returns ``(iteration, theta)`` or ``None`` if no checkpoint exists."""
-    path = os.path.join(directory, "lbfgs_state.json")
+def load_checkpoint(directory: str, tag: str = "gp"):
+    """Returns ``(iteration, theta, kernel_sig)`` or ``None`` if absent."""
+    path = os.path.join(directory, f"lbfgs_state_{tag}.json")
     if not os.path.exists(path):
         return None
     with open(path) as fh:
         payload = json.load(fh)
-    return payload["iteration"], np.asarray(payload["theta"], dtype=np.float64)
+    return (
+        payload["iteration"],
+        np.asarray(payload["theta"], dtype=np.float64),
+        payload.get("kernel_sig"),
+    )
+
+
+class DeviceOptimizerCheckpointer:
+    """Persists the FULL on-device L-BFGS state between K-iteration segments.
+
+    Unlike :class:`LbfgsCheckpointer` (theta-only, host optimizer), this
+    round-trips the entire ``_LbfgsState`` pytree — iterate, gradient,
+    curvature history, line-search counters and the aux carry (the
+    classifier's latent warm-start stack) — so a killed fit resumes exactly
+    where it stopped, not merely from the last theta.  Written atomically
+    (tmp + rename); a checkpoint from a different configuration (shape or
+    meta mismatch) is ignored with a warning rather than trusted.
+    """
+
+    def __init__(self, directory: str, tag: str = "gp") -> None:
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"{tag}_device_lbfgs.npz")
+
+    def save(self, state, meta: dict) -> None:
+        import jax
+
+        leaves = jax.tree.leaves(jax.device_get(state))
+        arrays = {f"leaf_{i}": np.asarray(v) for i, v in enumerate(leaves)}
+        arrays["meta_json"] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8
+        )
+        tmp = self.path + ".tmp.npz"
+        np.savez(tmp, **arrays)
+        os.replace(tmp, self.path)
+
+    def load(self, template_state, meta: dict):
+        """Rebuild a state pytree from disk, or ``None`` when absent/stale.
+
+        ``template_state`` (a freshly-initialized state of the current
+        configuration) supplies the pytree structure; the stored leaves must
+        match its shapes exactly.
+        """
+        import warnings
+
+        import jax
+
+        if not os.path.exists(self.path):
+            return None
+        with np.load(self.path) as npz:
+            stored_meta = json.loads(bytes(npz["meta_json"]))
+            template_leaves, treedef = jax.tree.flatten(template_state)
+            if stored_meta != meta:
+                warnings.warn(
+                    f"ignoring device checkpoint {self.path}: configuration "
+                    f"changed ({stored_meta} != {meta})",
+                    stacklevel=2,
+                )
+                return None
+            leaves = []
+            for i, tmpl in enumerate(template_leaves):
+                key = f"leaf_{i}"
+                if (
+                    key not in npz
+                    or npz[key].shape != tuple(tmpl.shape)
+                    or npz[key].dtype != tmpl.dtype
+                ):
+                    warnings.warn(
+                        f"ignoring device checkpoint {self.path}: state "
+                        f"layout changed",
+                        stacklevel=2,
+                    )
+                    return None
+                leaves.append(npz[key])
+        return jax.tree.unflatten(treedef, leaves)
+
+
+def data_fingerprint(*arrays) -> list:
+    """Cheap content fingerprint for checkpoint-staleness checks.
+
+    f64 sums are reduction-order-stable for the same array/program, so the
+    same data reproduces the same fingerprint across runs while different
+    data (even same-shaped) almost surely does not — preventing a finished
+    checkpoint from short-circuiting a fit on new data.
+    """
+    import jax.numpy as jnp
+
+    vals = []
+    for a in arrays:
+        a64 = jnp.asarray(a).astype(jnp.float64)
+        vals.extend([float(jnp.sum(a64)), float(jnp.sum(a64 * a64))])
+    return vals

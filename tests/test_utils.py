@@ -93,9 +93,10 @@ def test_checkpointer_roundtrip(tmp_path):
     ck = LbfgsCheckpointer(str(tmp_path), RBFKernel(1.0))
     ck(np.array([0.7]))
     ck(np.array([0.9]))
-    it, theta = load_checkpoint(str(tmp_path))
+    it, theta, sig = load_checkpoint(str(tmp_path))
     assert it == 2
     np.testing.assert_allclose(theta, [0.9])
+    assert sig == RBFKernel(1.0).describe(np.zeros(1))
 
 
 def test_checkpoint_resume_through_estimator(tmp_path):
@@ -111,13 +112,13 @@ def test_checkpoint_resume_through_estimator(tmp_path):
         .setCheckpointDir(str(tmp_path))
     )
     gp.fit(x, y)
-    state = load_checkpoint(str(tmp_path))
+    state = load_checkpoint(str(tmp_path), tag="GaussianProcessRegression")
     assert state is not None
     assert state[0] >= 1
     # The default hyper space is log-domain; the checkpoint must nonetheless
     # hold LINEAR-domain theta (inside the kernel's box bounds), so a resume
     # can seed theta0 from it directly.
-    _, theta = state
+    _, theta, _ = state
     assert np.all(theta >= 1e-6) and np.all(theta <= 10.0)
 
 
