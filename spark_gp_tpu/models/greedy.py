@@ -5,15 +5,30 @@ the active set one point at a time, scoring every candidate with the
 information-gain delta of *Fast Forward Selection to Speed Up Sparse Gaussian
 Process Regression*.
 
-Re-design vs the reference:
+Re-design vs the reference (and vs the round-1 version of this file):
 
 * the reference broadcasts ``inv(Kmm)`` and ``inv(sigma2 Kmm + Kmn Knm)`` and
-  loops per-candidate per-expert on executors (ASP.scala:84-136); here each
-  round is dense linear algebra over *all* candidates at once — the expert
-  partition is irrelevant to the math (experts partition the points), so the
-  scores are three batched quadratic forms on the MXU;
-* no explicit inverses: both quadratic forms go through Cholesky solves of
-  the two m x m systems (factor reuse, SURVEY.md §7 hard-part 7).
+  loops per-candidate per-expert on executors (ASP.scala:84-136), refactoring
+  both matrices from scratch every round — O(k^2 N) solves per round;
+* here NOTHING is refactored: appending a point only *extends* ``Kmm`` and
+  ``sigma2 Kmm + Kmn Knm`` by one row/column (existing entries never change),
+  so each round extends the two Cholesky factors by one row (a triangular
+  solve), and the candidate statistics update incrementally from the new
+  factor rows:
+
+      W = L_mm^-1 K_mn   (row append:  W_k = (c_new - w . W) / d)
+      p = sum_rows W^2   (p += W_k^2)
+      V = L_pd^-1 K_mn,  q = sum_rows V^2,  z = L_pd^-1 K_mn y,
+      mu = V^T z         (mu += V_k z_k)
+
+  — O(m N) MXU work per round instead of O(k^2 N), a ~m/3-fold total FLOP
+  reduction (three orders of magnitude at the reference's m=1000), and the
+  entire m-round loop is ONE jitted ``lax.fori_loop``: state stays
+  device-resident, zero host syncs until the final index fetch.
+
+Memory: three [m, N] buffers (K_mn rows, W, V) — ~280 MB at the Protein
+config (m=512, N=46k, f32), ~6 GB at m=1000, N=515k; chunk N if a config
+ever exceeds HBM.
 
 NaN candidate scores (li^2 <= 0 under float error) are excluded, matching the
 reference's NaN filter (ASP.scala:130-132).
@@ -21,11 +36,103 @@ reference's NaN filter (ASP.scala:130-132).
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from spark_gp_tpu.kernels.base import Kernel
-from spark_gp_tpu.ops.linalg import chol_solve
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _greedy_select(kernel: Kernel, m: int, theta, xj, yj, first_idx):
+    """Device-resident forward selection; returns the m chosen indices."""
+    n = xj.shape[0]
+    dtype = xj.dtype
+    sigma2 = jnp.asarray(kernel.white_noise_var(theta), dtype)
+    k_diag = kernel.diag(theta, xj)  # includes the +sigma2 noise diagonal
+    solve = partial(
+        jax.lax.linalg.triangular_solve,
+        left_side=True, lower=True, transpose_a=False,
+    )
+
+    def cross_row(idx):
+        # K(x_idx, .) against every candidate; the Eye/noise component of
+        # the model kernel contributes 0 off its own training set, matching
+        # the reference's crossKernel (kernel/Kernel.scala:151-161)
+        return kernel.cross(theta, xj[idx][None, :], xj)[0]
+
+    def append(k, idx, state):
+        (cross, w_buf, v_buf, l_mm, l_pd, z, p_vec, q_vec, mu_vec,
+         mask, chosen) = state
+        c_new = cross_row(idx)
+
+        # Kmm gains column [K(a_j, x_idx)]_j — already present in the stored
+        # cross rows; unfilled rows are zero, which the identity-padded
+        # factors forward-solve to zero (no masking needed).
+        kmm_col = cross[:, idx]
+        kmm_nn = k_diag[idx]
+        w = solve(l_mm, kmm_col[:, None])[:, 0]
+        d = jnp.sqrt(kmm_nn - w @ w)
+        l_mm = l_mm.at[k].set(w.at[k].set(d))
+        w_k = (c_new - w @ w_buf) / d
+        p_vec = p_vec + w_k * w_k
+
+        pd_col = sigma2 * kmm_col + cross @ c_new
+        pd_nn = sigma2 * kmm_nn + c_new @ c_new
+        v = solve(l_pd, pd_col[:, None])[:, 0]
+        e = jnp.sqrt(pd_nn - v @ v)
+        l_pd = l_pd.at[k].set(v.at[k].set(e))
+        v_k = (c_new - v @ v_buf) / e
+        q_vec = q_vec + v_k * v_k
+
+        z_k = (c_new @ yj - v @ z) / e
+        z = z.at[k].set(z_k)
+        mu_vec = mu_vec + v_k * z_k
+
+        return (
+            cross.at[k].set(c_new),
+            w_buf.at[k].set(w_k),
+            v_buf.at[k].set(v_k),
+            l_mm, l_pd, z, p_vec, q_vec, mu_vec,
+            mask.at[idx].set(True),
+            chosen.at[k].set(idx),
+        )
+
+    state = (
+        jnp.zeros((m, n), dtype),  # cross (K_mn rows)
+        jnp.zeros((m, n), dtype),  # W = L_mm^-1 K_mn
+        jnp.zeros((m, n), dtype),  # V = L_pd^-1 K_mn
+        jnp.eye(m, dtype=dtype),   # L_mm (unit diag on unfilled rows)
+        jnp.eye(m, dtype=dtype),   # L_pd
+        jnp.zeros((m,), dtype),    # z = L_pd^-1 K_mn y
+        jnp.zeros((n,), dtype),    # p
+        jnp.zeros((n,), dtype),    # q
+        jnp.zeros((n,), dtype),    # mu
+        jnp.zeros((n,), bool),     # chosen mask
+        jnp.zeros((m,), jnp.int32),
+    )
+    state = append(0, first_idx, state)
+
+    def body(k, state):
+        p_vec, q_vec, mu_vec, mask = state[6], state[7], state[8], state[9]
+        # Seeger information-gain delta (ASP.scala:106-128)
+        li2 = k_diag - p_vec
+        ratio2 = sigma2 / li2  # (sigma / li)^2
+        ksi = 1.0 / (ratio2 + 1.0 - q_vec)
+        kappa = ksi * (1.0 + 2.0 * ratio2)
+        delta = -0.5 * jnp.log(ratio2) - 0.5 * (
+            jnp.log(ksi)
+            + ksi * (1.0 - kappa) / sigma2 * (yj - mu_vec) ** 2
+            - kappa
+            + 2.0
+        )
+        delta = jnp.where(jnp.isnan(delta) | mask, -jnp.inf, delta)
+        return append(k, jnp.argmax(delta), state)
+
+    state = jax.lax.fori_loop(1, m, body, state)
+    return state[-1]
 
 
 def greedy_active_set(
@@ -45,48 +152,11 @@ def greedy_active_set(
     m = min(active_set_size, n)
     rng = np.random.default_rng(seed)
 
-    theta = jnp.asarray(np.asarray(theta_opt, dtype=np.float64))
     xj = jnp.asarray(x)
-    yj = jnp.asarray(y)
+    theta = jnp.asarray(np.asarray(theta_opt, dtype=np.float64), dtype=xj.dtype)
+    yj = jnp.asarray(y, dtype=xj.dtype)
 
-    sigma2 = float(np.asarray(kernel.white_noise_var(theta)))
-    sigma = np.sqrt(sigma2)
-    k_diag_all = kernel.diag(theta, xj)  # includes the +sigma2 noise diagonal
-
-    chosen = [int(rng.integers(n))]
-
-    while len(chosen) < m:
-        active = xj[jnp.asarray(chosen)]
-        kmm = kernel.gram(theta, active)  # [k, k], noise-augmented diagonal
-        cross = kernel.cross(theta, active, xj)  # [k, N]
-
-        kmn_knm = cross @ cross.T
-        kmn_y = cross @ yj
-        pd_mat = sigma2 * kmm + kmn_knm
-
-        l_mm = jnp.linalg.cholesky(kmm)
-        l_pd = jnp.linalg.cholesky(pd_mat)
-
-        kinv_cross = chol_solve(l_mm, cross)  # [k, N]
-        pdinv_cross = chol_solve(l_pd, cross)  # [k, N]
-        magic_vector = chol_solve(l_pd, kmn_y)
-
-        p_i = jnp.sum(cross * kinv_cross, axis=0)
-        q_i = jnp.sum(cross * pdinv_cross, axis=0)
-        mu_i = cross.T @ magic_vector
-
-        li2 = k_diag_all - p_i
-        li = jnp.sqrt(li2)
-        ratio2 = sigma2 / li2  # (sigma / li)^2
-        ksi = 1.0 / (ratio2 + 1.0 - q_i)
-        kappa = ksi * (1.0 + 2.0 * ratio2)
-        delta = -jnp.log(sigma / li) - 0.5 * (
-            jnp.log(ksi) + ksi * (1.0 - kappa) / sigma2 * (yj - mu_i) ** 2 - kappa + 2.0
-        )
-
-        delta = jnp.where(jnp.isnan(delta), -jnp.inf, delta)
-        # exclude already-chosen points (their li^2 ~ 0 usually NaNs anyway)
-        delta = delta.at[jnp.asarray(chosen)].set(-jnp.inf)
-        chosen.append(int(jnp.argmax(delta)))
-
+    chosen = _greedy_select(
+        kernel, m, theta, xj, yj, jnp.asarray(int(rng.integers(n)), jnp.int32)
+    )
     return x[np.asarray(chosen)]
