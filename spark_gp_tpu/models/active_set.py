@@ -7,14 +7,17 @@ hyperparameters, and returns the m active points ``[m, p]``.
 * :class:`RandomActiveSetProvider` — uniform sample without replacement
   (ASP.scala:48-56; the default, GaussianProcessParams.scala:33).
 * :class:`KMeansActiveSetProvider` — centroids of a jitted Lloyd iteration
-  (ASP.scala:26-43 delegates to Spark ML KMeans; here ``lax.scan`` over
-  Lloyd steps, distance matrices on the MXU, k-means++-style seeding by
-  random choice as Spark does by default maxIter 20).
+  (ASP.scala:26-43 delegates to Spark ML KMeans, whose default init is the
+  parallelized k-means++ variant; here true k-means++ D²-weighted seeding
+  as one jitted ``fori_loop``, then ``lax.scan`` over Lloyd steps with
+  distance matrices on the MXU; default maxIter 20 as the reference's).
 * :class:`GreedilyOptimizingActiveSetProvider` — Seeger et al. 2003 fast
   forward selection (ASP.scala:59-136), implemented in ``greedy.py``.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -81,22 +84,55 @@ class KMeansActiveSetProvider(ActiveSetProvider):
         x = np.asarray(x)
         n = x.shape[0]
         k = min(active_set_size, n)
-        rng = np.random.default_rng(seed)
-        init_idx = rng.choice(n, size=k, replace=False)
-        centroids = jnp.asarray(x[init_idx])
         xj = jnp.asarray(x)
 
+        key = jax.random.PRNGKey(seed)
+        centroids = _kmeanspp_init(key, xj, k)
         centroids = _lloyd(xj, centroids, self.max_iter)
         return np.asarray(centroids)
 
 
-def _lloyd(x, centroids, max_iter):
+@partial(jax.jit, static_argnums=2)
+def _kmeanspp_init(key, x, k):
+    """k-means++ D²-weighted seeding (Arthur & Vassilvitskii 2007), fully
+    jitted: the running min-squared-distance vector is the categorical
+    sampling weight for each next seed.  Duplicate points get weight 0 and
+    are never re-selected while any spread remains."""
+    n = x.shape[0]
+    key, sub = jax.random.split(key)
+    first = jax.random.randint(sub, (), 0, n)
+    centroids = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(x[first])
+    min_d2 = jnp.sum((x - x[first]) ** 2, axis=1)
+
+    def body(i, carry):
+        centroids, min_d2, key = carry
+        key, sub = jax.random.split(key)
+        # log-weights: zero-distance (already-chosen/duplicate) points get
+        # -inf; if every point coincides with a centroid, fall back uniform
+        weights = jnp.where(
+            jnp.any(min_d2 > 0), jnp.log(min_d2), jnp.zeros_like(min_d2)
+        )
+        idx = jax.random.categorical(sub, weights)
+        c = x[idx]
+        centroids = centroids.at[i].set(c)
+        min_d2 = jnp.minimum(min_d2, jnp.sum((x - c) ** 2, axis=1))
+        return centroids, min_d2, key
+
+    centroids, _, _ = jax.lax.fori_loop(1, k, body, (centroids, min_d2, key))
+    return centroids
+
+
+def _lloyd(x, centroids, max_iter, mask=None):
+    """``max_iter`` Lloyd steps; ``mask`` (optional [n]) excludes padded
+    points from assignments and centroid updates."""
     k = centroids.shape[0]
 
     def step(c, _):
         d = sq_dist(x, c)  # [n, k]
         assign = jnp.argmin(d, axis=1)
         onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)  # [n, k]
+        if mask is not None:
+            onehot = onehot * mask[:, None]
         counts = jnp.sum(onehot, axis=0)  # [k]
         sums = jax.lax.dot_general(
             onehot, x, (((0,), (0,)), ((), ())),

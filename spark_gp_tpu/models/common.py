@@ -43,6 +43,7 @@ class GaussianProcessParams:
         self._checkpoint_interval: int = 10
         self._optimizer: str = "auto"
         self._hyper_space: str = "auto"
+        self._profile_dir: Optional[str] = None
 
     # --- reference setter names (GaussianProcessParams.scala:32-53) -------
     def setKernel(self, value: Union[Kernel, Callable[[], Kernel]]):
@@ -86,6 +87,16 @@ class GaussianProcessParams:
     def setMesh(self, mesh):
         """Shard the expert axis over this ``jax.sharding.Mesh`` (1-D)."""
         self._mesh = mesh
+        return self
+
+    def setProfileDir(self, path: Optional[str]):
+        """Capture a ``jax.profiler`` trace of the fit into this directory
+        (viewable in TensorBoard/Perfetto).  ``None`` (default) disables
+        profiling.  The reference has no tracing at all (SURVEY.md §5 —
+        three Instrumentation log lines); a TPU framework without timeline
+        capture is undebuggable, so this is a first-class estimator flag.
+        """
+        self._profile_dir = path
         return self
 
     def setCheckpointDir(self, path: Optional[str]):
@@ -169,6 +180,7 @@ class GaussianProcessParams:
     set_tol = setTol
     set_seed = setSeed
     set_mesh = setMesh
+    set_profile_dir = setProfileDir
     set_checkpoint_dir = setCheckpointDir
     set_checkpoint_interval = setCheckpointInterval
     set_optimizer = setOptimizer
@@ -257,6 +269,13 @@ class GaussianProcessCommons(GaussianProcessParams):
         instr.log_metric("lbfgs_iters", res.nit)
         instr.log_metric("lbfgs_nfev", res.nfev)
         instr.log_metric("final_nll", res.fun)
+        instr.log_metric("lbfgs_stalled", 0.0 if res.success else 1.0)
+        if not res.success:
+            instr.log_warning(
+                "L-BFGS-B terminated abnormally (not converged): "
+                f"{res.message} — the returned hyperparameters are the best "
+                "iterate seen, not a certified optimum."
+            )
         instr.log_info("Optimal kernel: " + kernel.describe(res.theta))
         return res.theta
 
@@ -412,6 +431,16 @@ class GaussianProcessCommons(GaussianProcessParams):
             arr = np.asarray(val)
             instr.log_metric(
                 key, int(arr) if np.issubdtype(arr.dtype, np.integer) else float(arr)
+            )
+        if bool(np.asarray(fetched.get("lbfgs_stalled", False))):
+            # The device optimizer's line search exhausted without an
+            # acceptable step — the analogue of the host path's
+            # success=False.  The fit still produces a model from the best
+            # iterate, but a production run should treat this as suspect.
+            instr.log_warning(
+                "device L-BFGS stalled (line search exhausted before "
+                "convergence) — returned hyperparameters are the best "
+                "iterate seen, not a certified optimum."
             )
         instr.log_info("Optimal kernel: " + kernel.describe(theta64))
 

@@ -69,6 +69,23 @@ class GaussianProcessClassifier(GaussianProcessCommons):
 
             return targets_fn
 
+        from spark_gp_tpu.utils.instrumentation import maybe_profile
+
+        with maybe_profile(self._profile_dir):
+            raw = self._fit_from_stack(instr, kernel, data, x, make_targets_fn)
+        instr.log_success()
+        model = GaussianProcessClassificationModel(raw)
+        model.instr = instr
+        return model
+
+    def _fit_from_stack(
+        self, instr, kernel, data, x, make_targets_fn, active_override=None
+    ) -> ProjectedProcessRawPredictor:
+        """Shared optimize → settle latents → active set → PPA tail of
+        ``fit`` and ``fit_distributed``.  ``make_targets_fn(latent_y)`` must
+        return a zero-arg callable producing the provider's flat targets
+        (deferred: fetching latents is a device sync the random/kmeans
+        providers never need)."""
         if self._resolved_optimizer() == "device":
             # Fully async pipeline: on-device Laplace + L-BFGS, the latent
             # modes stay on device as the PPA targets, and the host syncs
@@ -78,7 +95,9 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
             raw, _ = self._finalize_device_fit(
                 instr, kernel, theta_dev, pending, x,
-                make_targets_fn(latent_y), latent_data,
+                None if make_targets_fn is None else make_targets_fn(latent_y),
+                latent_data,
+                active_override=active_override,
             )
         else:
             if self._mesh is not None:
@@ -112,14 +131,13 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             latent_y = f_final * data.mask
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
             raw = self._projected_process(
-                instr, kernel, theta_opt, x, make_targets_fn(latent_y)(),
+                instr, kernel, theta_opt, x,
+                None if make_targets_fn is None
+                else make_targets_fn(latent_y)(),
                 latent_data,
+                active_override=active_override,
             )
-
-        instr.log_success()
-        model = GaussianProcessClassificationModel(raw)
-        model.instr = instr
-        return model
+        return raw
 
     def _fit_device(self, instr: Instrumentation, kernel, data):
         """Dispatch the one-program on-device Laplace optimization without
@@ -147,23 +165,33 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                     DeviceOptimizerCheckpointer,
                 )
 
-                theta, f_final, f, n_iter, n_fev = fit_gpc_device_checkpointed(
-                    kernel, float(self._tol), self._mesh, log_space, theta0,
-                    lower, upper, data, self._max_iter,
-                    self._checkpoint_interval,
-                    DeviceOptimizerCheckpointer(self._checkpoint_dir, "gpc"),
+                theta, f_final, f, n_iter, n_fev, stalled = (
+                    fit_gpc_device_checkpointed(
+                        kernel, float(self._tol), self._mesh, log_space,
+                        theta0, lower, upper, data, self._max_iter,
+                        self._checkpoint_interval,
+                        DeviceOptimizerCheckpointer(self._checkpoint_dir, "gpc"),
+                    )
                 )
             elif self._mesh is not None:
-                theta, f_final, f, n_iter, n_fev = fit_gpc_device_sharded(
-                    kernel, float(self._tol), self._mesh, log_space, theta0,
-                    lower, upper, data.x, data.y, data.mask, max_iter,
+                theta, f_final, f, n_iter, n_fev, stalled = (
+                    fit_gpc_device_sharded(
+                        kernel, float(self._tol), self._mesh, log_space,
+                        theta0, lower, upper, data.x, data.y, data.mask,
+                        max_iter,
+                    )
                 )
             else:
-                theta, f_final, f, n_iter, n_fev = fit_gpc_device(
+                theta, f_final, f, n_iter, n_fev, stalled = fit_gpc_device(
                     kernel, float(self._tol), log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter,
                 )
-        pending = {"lbfgs_iters": n_iter, "lbfgs_nfev": n_fev, "final_nll": f}
+        pending = {
+            "lbfgs_iters": n_iter,
+            "lbfgs_nfev": n_fev,
+            "final_nll": f,
+            "lbfgs_stalled": stalled,
+        }
         return theta, f_final, pending
 
 

@@ -63,28 +63,33 @@ class GaussianProcessRegression(GaussianProcessCommons):
     ) -> "GaussianProcessRegressionModel":
         """Shared optimize → active set → PPA tail of ``fit`` and
         ``fit_distributed``."""
-        if self._resolved_optimizer() == "device":
-            # Fully async pipeline: the on-device L-BFGS, the f64 PPA
-            # statistics and the scalar diagnostics drain in one host sync
-            # inside _finalize_device_fit.
-            theta_dev, pending = self._fit_device(instr, kernel, data)
-            raw, _ = self._finalize_device_fit(
-                instr, kernel, theta_dev, pending, x, targets_fn, data,
-                active_override=active_override,
-            )
-        else:
-            if self._mesh is not None:
-                vag = make_sharded_value_and_grad(kernel, data, self._mesh)
-            else:
-                vag = make_value_and_grad(kernel, data)
+        from spark_gp_tpu.utils.instrumentation import maybe_profile
 
-            checkpointer = self._make_checkpointer(kernel)
-            theta_opt = self._optimize_hypers(instr, kernel, vag, callback=checkpointer)
-            raw = self._projected_process(
-                instr, kernel, theta_opt, x,
-                None if targets_fn is None else targets_fn(), data,
-                active_override=active_override,
-            )
+        with maybe_profile(self._profile_dir):
+            if self._resolved_optimizer() == "device":
+                # Fully async pipeline: the on-device L-BFGS, the f64 PPA
+                # statistics and the scalar diagnostics drain in one host
+                # sync inside _finalize_device_fit.
+                theta_dev, pending = self._fit_device(instr, kernel, data)
+                raw, _ = self._finalize_device_fit(
+                    instr, kernel, theta_dev, pending, x, targets_fn, data,
+                    active_override=active_override,
+                )
+            else:
+                if self._mesh is not None:
+                    vag = make_sharded_value_and_grad(kernel, data, self._mesh)
+                else:
+                    vag = make_value_and_grad(kernel, data)
+
+                checkpointer = self._make_checkpointer(kernel)
+                theta_opt = self._optimize_hypers(
+                    instr, kernel, vag, callback=checkpointer
+                )
+                raw = self._projected_process(
+                    instr, kernel, theta_opt, x,
+                    None if targets_fn is None else targets_fn(), data,
+                    active_override=active_override,
+                )
         instr.log_success()
         model = GaussianProcessRegressionModel(raw)
         model.instr = instr
@@ -182,22 +187,27 @@ class GaussianProcessRegression(GaussianProcessCommons):
                     DeviceOptimizerCheckpointer,
                 )
 
-                theta, f, n_iter, n_fev = fit_gpr_device_checkpointed(
+                theta, f, n_iter, n_fev, stalled = fit_gpr_device_checkpointed(
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data, self._max_iter, tol, self._checkpoint_interval,
                     DeviceOptimizerCheckpointer(self._checkpoint_dir, "gpr"),
                 )
             elif self._mesh is not None:
-                theta, f, n_iter, n_fev = fit_gpr_device_sharded(
+                theta, f, n_iter, n_fev, stalled = fit_gpr_device_sharded(
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
                 )
             else:
-                theta, f, n_iter, n_fev = fit_gpr_device(
+                theta, f, n_iter, n_fev, stalled = fit_gpr_device(
                     kernel, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
                 )
-        pending = {"lbfgs_iters": n_iter, "lbfgs_nfev": n_fev, "final_nll": f}
+        pending = {
+            "lbfgs_iters": n_iter,
+            "lbfgs_nfev": n_fev,
+            "final_nll": f,
+            "lbfgs_stalled": stalled,
+        }
         return theta, pending
 
 

@@ -240,7 +240,7 @@ def fit_gpc_device(
 ):
     """Single-chip on-device classifier fit; the latent warm-start stack is
     the optimizer's auxiliary carry.  Returns (theta, f_latents, nll, n_iter,
-    n_fev)."""
+    n_fev, stalled)."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
@@ -258,10 +258,10 @@ def fit_gpc_device(
         from_u = lambda t: t
 
     f0 = jnp.zeros_like(y)
-    theta, f, f_final, n_iter, n_fev = lbfgs_minimize_device(
+    theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
         vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
     )
-    return from_u(theta), f_final, f, n_iter, n_fev
+    return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
 
 # --- segmented device fit: checkpoint/resume (likelihood.py counterpart) --
@@ -324,7 +324,8 @@ def fit_gpc_device_checkpointed(
     """Segmented on-device classifier fit with state persistence — see
     likelihood.fit_gpr_device_checkpointed.  The aux carry here is the
     latent warm-start stack, so a resume continues from the settled modes,
-    not from zero latents.  Returns (theta, f_latents, nll, n_iter, n_fev).
+    not from zero latents.  Returns (theta, f_latents, nll, n_iter, n_fev,
+    stalled).
     """
     from spark_gp_tpu.utils.checkpoint import data_fingerprint
 
@@ -354,7 +355,7 @@ def fit_gpc_device_checkpointed(
         )
         saver.save(state, meta)
     theta = jnp.exp(state.theta) if log_space else state.theta
-    return theta, state.aux, state.f, state.n_iter, state.n_fev
+    return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
@@ -376,7 +377,7 @@ def fit_gpc_device_sharded(
             P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
             P(),
         ),
-        out_specs=(P(), P(EXPERT_AXIS), P(), P(), P()),
+        out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
     )
     def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_):
         local = ExpertData(x=x_, y=y_, mask=mask_)
@@ -395,9 +396,9 @@ def fit_gpc_device_sharded(
             vag, t0, lo, hi, from_u = vag, theta0_, lower_, upper_, (lambda t: t)
 
         f0 = jnp.zeros_like(y_)
-        theta, f, f_final, n_iter, n_fev = lbfgs_minimize_device(
+        theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
             vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
         )
-        return from_u(theta), f_final, f, n_iter, n_fev
+        return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
     return run(theta0, lower, upper, x, y, mask, max_iter)

@@ -148,7 +148,7 @@ def fit_gpr_device(
     kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter, tol
 ):
     """Single-chip on-device fit: objective + projected L-BFGS in one XLA
-    program.  Returns (theta_opt, final_nll, n_iter, n_fev)."""
+    program.  Returns (theta_opt, final_nll, n_iter, n_fev, stalled)."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
@@ -165,10 +165,10 @@ def fit_gpr_device(
     else:
         from_u = lambda t: t
 
-    theta, f, _, n_iter, n_fev = lbfgs_minimize_device(
+    theta, f, _, n_iter, n_fev, stalled = lbfgs_minimize_device(
         vag, theta0, lower, upper, jnp.zeros(()), max_iter=max_iter, tol=tol
     )
-    return from_u(theta), f, n_iter, n_fev
+    return from_u(theta), f, n_iter, n_fev, stalled
 
 
 # --- segmented device fit: checkpoint/resume for long runs ----------------
@@ -276,7 +276,7 @@ def fit_gpr_device_checkpointed(
         )
         saver.save(state, meta)
     theta = jnp.exp(state.theta) if log_space else state.theta
-    return theta, state.f, state.n_iter, state.n_fev
+    return theta, state.f, state.n_iter, state.n_fev, state.stalled
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
@@ -299,7 +299,7 @@ def fit_gpr_device_sharded(
             P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
             P(), P(),
         ),
-        out_specs=(P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
     )
     def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, tol_):
         local = ExpertData(x=x_, y=y_, mask=mask_)
@@ -318,9 +318,9 @@ def fit_gpr_device_sharded(
         else:
             vag, t0, lo, hi, from_u = vag, theta0_, lower_, upper_, (lambda t: t)
 
-        theta, f, _, n_iter, n_fev = lbfgs_minimize_device(
+        theta, f, _, n_iter, n_fev, stalled = lbfgs_minimize_device(
             vag, t0, lo, hi, jnp.zeros(()), max_iter=max_iter_, tol=tol_,
         )
-        return from_u(theta), f, n_iter, n_fev
+        return from_u(theta), f, n_iter, n_fev, stalled
 
     return run(theta0, lower, upper, x, y, mask, max_iter, tol)

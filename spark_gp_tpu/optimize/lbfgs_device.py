@@ -86,6 +86,7 @@ class _LbfgsState(NamedTuple):
     n_iter: jax.Array  # int32
     n_fev: jax.Array  # int32
     done: jax.Array  # bool
+    stalled: jax.Array  # bool: line search exhausted without an acceptable step
 
 
 def _two_loop_direction(grad, s_hist, y_hist, rho, count, head, m_hist):
@@ -153,6 +154,7 @@ def lbfgs_init_state(value_and_grad_aux, theta0, aux0, m_hist: int = 10):
         n_iter=jnp.zeros((), jnp.int32),
         n_fev=jnp.ones((), jnp.int32),
         done=jnp.zeros((), jnp.bool_),
+        stalled=jnp.zeros((), jnp.bool_),
     )
 
 
@@ -199,18 +201,26 @@ def lbfgs_minimize_device(
     armijo_c1: float = 1e-4,
 ):
     """Minimize on device.  ``value_and_grad_aux(theta, aux) -> (f, g, aux)``
-    must be jit-traceable.  Returns ``(theta, f, aux, n_iter, n_fev)``.
+    must be jit-traceable.  Returns ``(theta, f, aux, n_iter, n_fev,
+    stalled)``.
 
     Convergence mirrors the scipy/Breeze pair of tests used by the host
     driver: projected-gradient inf-norm < tol, or relative objective change
-    < tol between accepted iterates.
+    < tol between accepted iterates.  ``stalled`` is True when the loop ended
+    because the line search could not find an acceptable step (the analogue
+    of scipy's ``success=False`` / ``ABNORMAL_TERMINATION_IN_LNSRCH``) — the
+    returned iterate is the best seen, but it is NOT a certified optimum and
+    callers should surface the condition (common.py logs a warning).
     """
     state = lbfgs_init_state(value_and_grad_aux, theta0, aux0, m_hist)
     final = lbfgs_run_segment(
         value_and_grad_aux, state, lower, upper, max_iter, tol,
         m_hist, max_ls, armijo_c1,
     )
-    return final.theta, final.f, final.aux, final.n_iter, final.n_fev
+    return (
+        final.theta, final.f, final.aux, final.n_iter, final.n_fev,
+        final.stalled,
+    )
 
 
 def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1):
@@ -381,6 +391,7 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
             n_iter=state.n_iter + 1,
             n_fev=state.n_fev + ls.n_fev,
             done=converged | stalled,
+            stalled=stalled,
         )
 
     return body

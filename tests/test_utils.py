@@ -131,3 +131,27 @@ def test_kfold_partitions_everything():
         assert len(train) + len(test) == 103
         seen.extend(test)
     assert sorted(seen) == list(range(103))
+
+
+def test_profile_dir_produces_trace(tmp_path):
+    """setProfileDir wires maybe_profile around the fit: a jax.profiler trace
+    must land in the directory (SURVEY.md §5 tracing row)."""
+    import os
+
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+    from spark_gp_tpu.data import make_synthetics
+
+    x, y = make_synthetics(n=120)
+    trace_dir = str(tmp_path / "trace")
+    (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(0.5, 1e-6, 10))
+        .setActiveSetSize(20)
+        .setMaxIter(3)
+        .setProfileDir(trace_dir)
+        .fit(x, y)
+    )
+    produced = []
+    for root, _dirs, files in os.walk(trace_dir):
+        produced.extend(os.path.join(root, f) for f in files)
+    assert produced, "no profiler trace files written"
