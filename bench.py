@@ -104,6 +104,23 @@ def _preflight(env: dict, timeout_s: float, attempts: int):
 _PROXY_WORKERS = 8  # ≈ the 8-executor Spark topology of the north star
 
 
+def _proxy_init():
+    """Worker init, run once per spawned worker BEFORE the timed window:
+    pins BLAS to one thread (a Spark executor runs netlib-java LAPACK
+    single-threaded per task, so 8 single-threaded processes model 8
+    executors — and unpinned spawned workers each start a full
+    physical-core-count OpenBLAS, measuring oversubscription instead of
+    compute) and pays the numpy/scipy import cost up front."""
+    for var in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS"):
+        os.environ[var] = "1"
+    import numpy  # noqa: F401
+    import scipy.linalg  # noqa: F401
+
+
+def _proxy_noop(_):
+    return None
+
+
 def _proxy_expert_batch(args):
     """One worker's share of experts for one objective evaluation — the
     reference's executor hot loop: gram, Cholesky, inverse, hand gradient
@@ -140,13 +157,19 @@ def _cpu_proxy_eval_seconds(x, y, expert_size: int, sigma: float, sigma2: float)
     sampled = min(e, _PROXY_WORKERS * 16)
     shares = [list(range(w, sampled, _PROXY_WORKERS)) for w in range(_PROXY_WORKERS)]
     shares = [s for s in shares if s]
-    start = time.perf_counter()
-    with mp.Pool(processes=len(shares)) as pool:
+    # spawn, not fork: this runs after JAX initialized the TPU backend, and
+    # forking a process holding live libtpu/gRPC threads is a documented
+    # deadlock source (the exact hang class this file defends against)
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(processes=len(shares), initializer=_proxy_init) as pool:
+        # pay interpreter startup outside the timed window
+        pool.map(_proxy_noop, range(len(shares)))
+        start = time.perf_counter()
         pool.map(
             _proxy_expert_batch,
             [(x, y, share, e, sigma, sigma2) for share in shares],
         )
-    elapsed = time.perf_counter() - start
+        elapsed = time.perf_counter() - start
     return elapsed * (e / sampled)
 
 

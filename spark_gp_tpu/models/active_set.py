@@ -34,6 +34,14 @@ class ActiveSetProvider:
     the fitted hyperparameters / targets at all: providers that only look at
     ``x`` (random sampling, k-means) let the driver keep theta on device and
     defer every host sync to one final fetch.
+
+    ``from_stack`` is the sharded entry point used by ``fit_distributed``:
+    no host ever holds the full row set, so selection runs against the
+    globally-sharded ``ExpertData`` stack directly (the counterpart of the
+    reference providers consuming RDDs, ASP.scala:13-20).  All three built-in
+    providers implement it natively; third-party providers inherit a
+    uniform-sampling fallback (with a warning) so ``fit_distributed`` still
+    produces a model.
     """
 
     uses_fit_outputs = True
@@ -49,6 +57,27 @@ class ActiveSetProvider:
     ) -> np.ndarray:
         raise NotImplementedError
 
+    def from_stack(
+        self, active_set_size: int, data, kernel: Kernel, theta, seed: int,
+        mesh,
+    ) -> np.ndarray:
+        """Select ``[m, p]`` active points from a sharded expert stack.
+
+        ``data.y`` carries the provider's targets (labels for regression,
+        latent modes for the classifier).  ``theta`` may be a device array.
+        """
+        import warnings
+
+        from spark_gp_tpu.parallel.distributed import sample_active_from_stack
+
+        warnings.warn(
+            f"{type(self).__name__} has no sharded-stack implementation; "
+            "falling back to uniform sampling for fit_distributed.  "
+            "Implement from_stack(...) to override.",
+            stacklevel=2,
+        )
+        return sample_active_from_stack(data, active_set_size, seed, mesh)
+
 
 class _RandomActiveSetProvider(ActiveSetProvider):
     """Uniform sample of m training points (ASP.scala:48-56)."""
@@ -61,6 +90,11 @@ class _RandomActiveSetProvider(ActiveSetProvider):
         m = min(active_set_size, n)
         idx = rng.choice(n, size=m, replace=False)
         return np.asarray(x)[idx]
+
+    def from_stack(self, active_set_size, data, kernel, theta, seed, mesh):
+        from spark_gp_tpu.parallel.distributed import sample_active_from_stack
+
+        return sample_active_from_stack(data, active_set_size, seed, mesh)
 
 
 RandomActiveSetProvider = _RandomActiveSetProvider()
@@ -90,6 +124,29 @@ class KMeansActiveSetProvider(ActiveSetProvider):
         centroids = _kmeanspp_init(key, xj, k)
         centroids = _lloyd(xj, centroids, self.max_iter)
         return np.asarray(centroids)
+
+    def from_stack(self, active_set_size, data, kernel, theta, seed, mesh):
+        """Sharded Lloyd over the expert stack: centroids replicated, points
+        sharded, per-step communication = one psum of the [k, p] sums and
+        [k] counts over ICI (the counterpart of Spark ML KMeans's
+        treeAggregate, ASP.scala:36-41).
+
+        Seeding: k-means++ over a replicated uniform subsample (≤ max(4k,
+        4096) rows) — the same spirit as Spark's k-means|| oversampling
+        init, which also avoids n sequential global D² passes.
+        """
+        from spark_gp_tpu.parallel.distributed import sample_active_from_stack
+
+        n_sub = max(4 * active_set_size, 4096)
+        sub = sample_active_from_stack(data, n_sub, seed, mesh)
+        k = min(active_set_size, sub.shape[0])
+        centroids = _kmeanspp_init(
+            jax.random.PRNGKey(seed), jnp.asarray(sub, dtype=data.x.dtype), k
+        )
+        centroids = _lloyd_stack_jit(
+            mesh, self.max_iter, data.x, data.mask, centroids
+        )
+        return np.asarray(centroids, dtype=np.float64)
 
 
 @partial(jax.jit, static_argnums=2)
@@ -122,9 +179,11 @@ def _kmeanspp_init(key, x, k):
     return centroids
 
 
-def _lloyd(x, centroids, max_iter, mask=None):
-    """``max_iter`` Lloyd steps; ``mask`` (optional [n]) excludes padded
-    points from assignments and centroid updates."""
+def _lloyd(x, centroids, max_iter, mask=None, psum=None):
+    """``max_iter`` Lloyd steps.  ``mask`` (optional [n]) excludes padded
+    points from assignments and centroid updates; ``psum`` (optional)
+    all-reduces the per-shard counts/sums when the point axis is sharded
+    (the single shared step for both the host and shard_map paths)."""
     k = centroids.shape[0]
 
     def step(c, _):
@@ -138,13 +197,41 @@ def _lloyd(x, centroids, max_iter, mask=None):
             onehot, x, (((0,), (0,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
         )  # [k, p]
+        if psum is not None:
+            counts = psum(counts)
+            sums = psum(sums)
         new_c = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), c
         )
         return new_c, None
 
-    out, _ = jax.lax.scan(jax.jit(step), centroids, None, length=max_iter)
+    out, _ = jax.lax.scan(step, centroids, None, length=max_iter)
     return out
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _lloyd_stack_jit(mesh, max_iter, x, mask, centroids):
+    """Lloyd iterations over a sharded ``[E, s, p]`` stack (masked)."""
+    from jax.sharding import PartitionSpec as P
+
+    from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+
+    p = x.shape[-1]
+    k = centroids.shape[0]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS), P()),
+        out_specs=P(),
+    )
+    def run(x_, mask_, c0):
+        return _lloyd(
+            x_.reshape(-1, p), c0, max_iter, mask=mask_.reshape(-1),
+            psum=lambda v: jax.lax.psum(v, EXPERT_AXIS),
+        )
+
+    return run(x, mask, centroids)
 
 
 class GreedilyOptimizingActiveSetProvider(ActiveSetProvider):
@@ -154,3 +241,10 @@ class GreedilyOptimizingActiveSetProvider(ActiveSetProvider):
         from spark_gp_tpu.models.greedy import greedy_active_set
 
         return greedy_active_set(active_set_size, x, y, kernel, theta_opt, seed)
+
+    def from_stack(self, active_set_size, data, kernel, theta, seed, mesh):
+        from spark_gp_tpu.models.greedy import greedy_active_set_from_stack
+
+        return greedy_active_set_from_stack(
+            active_set_size, data, kernel, theta, seed, mesh
+        )

@@ -13,6 +13,7 @@ SURVEY.md §5).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, Optional, Union
 
 import numpy as np
@@ -206,6 +207,28 @@ def _default_kernel_factory() -> Kernel:
 class GaussianProcessCommons(GaussianProcessParams):
     """Shared training skeleton (GaussianProcessCommons.scala:15-115)."""
 
+    @contextlib.contextmanager
+    def _stack_mesh(self, data):
+        """Context manager resolving the mesh for a ``fit_distributed`` call:
+        uses ``setMesh(...)`` when given, else the stack's own NamedSharding;
+        restores the estimator's mesh on exit (the estimator stays reusable
+        for plain ``fit``)."""
+        mesh_prev = self._mesh
+        if self._mesh is None:
+            from jax.sharding import NamedSharding
+
+            sh = getattr(data.x, "sharding", None)
+            if not isinstance(sh, NamedSharding):
+                raise ValueError(
+                    "fit_distributed needs setMesh(...) or a "
+                    "NamedSharding-sharded expert stack"
+                )
+            self._mesh = sh.mesh
+        try:
+            yield
+        finally:
+            self._mesh = mesh_prev
+
     def _get_kernel(self) -> Kernel:
         """User kernel + sigma2 * I — the noise-augmented model kernel
         (GaussianProcessCommons.scala:18)."""
@@ -295,8 +318,17 @@ class GaussianProcessCommons(GaussianProcessParams):
 
         with instr.phase("active_set"):
             if active_override is not None:
-                # pre-selected set (multi-host fit_distributed path)
+                # explicitly-supplied set (fit_distributed(active_set=...))
                 active = active_override
+            elif x is None:
+                # distributed mode: no host holds the rows — the provider
+                # selects from the sharded stack itself (data.y carries the
+                # targets: labels for GPR, latent modes for GPC)
+                active = self._active_set_provider.from_stack(
+                    self._active_set_size, data, kernel,
+                    np.asarray(theta_opt, dtype=np.float64), self._seed,
+                    self._mesh,
+                )
             else:
                 # The provider receives the noise-augmented model kernel, as
                 # the reference passes getKernel
@@ -392,6 +424,13 @@ class GaussianProcessCommons(GaussianProcessParams):
         with instr.phase("active_set"):
             if active_override is not None:
                 active = active_override
+            elif x is None:
+                # distributed mode: sharded-stack selection; theta stays on
+                # device (from_stack casts it to the stack dtype itself)
+                active = provider.from_stack(
+                    self._active_set_size, data, kernel, theta_dev,
+                    self._seed, self._mesh,
+                )
             elif getattr(provider, "uses_fit_outputs", True):
                 # e.g. greedy Seeger scores read theta and the targets: a
                 # host sync is unavoidable for this provider family.

@@ -69,14 +69,52 @@ class GaussianProcessClassifier(GaussianProcessCommons):
 
             return targets_fn
 
-        from spark_gp_tpu.utils.instrumentation import maybe_profile
-
-        with maybe_profile(self._profile_dir):
-            raw = self._fit_from_stack(instr, kernel, data, x, make_targets_fn)
+        raw = self._fit_from_stack(instr, kernel, data, x, make_targets_fn)
         instr.log_success()
         model = GaussianProcessClassificationModel(raw)
         model.instr = instr
         return model
+
+    def fit_distributed(
+        self, data, active_set: Optional[np.ndarray] = None
+    ) -> "GaussianProcessClassificationModel":
+        """Multi-host classifier fit from a pre-sharded expert stack.
+
+        The classifier counterpart of
+        :meth:`GaussianProcessRegression.fit_distributed`, closing the
+        asymmetry the reference never had (its train skeleton is shared,
+        GaussianProcessCommons.scala:15-115 / GPClf.scala:48-66): ``data``
+        is a globally-sharded ``ExpertData`` of {0,1} labels
+        (:func:`...distributed.distribute_global_experts`); the sharded
+        Laplace + L-BFGS loop keeps the latent stacks device-resident, and
+        the active-set provider selects over the *latent* targets from the
+        sharded stack (``ActiveSetProvider.from_stack``) — GPClf.scala:62-65
+        substitutes f for y before produceModel, so providers must see f.
+        """
+        instr = Instrumentation(name="GaussianProcessClassifier")
+        with self._stack_mesh(data):
+            kernel = self._get_kernel()
+            instr.log_metric("num_experts", int(data.x.shape[0]))
+            instr.log_metric("expert_size", int(data.x.shape[1]))
+
+            # Label-domain check on the sharded stack (GPClf.scala:68-72):
+            # one jitted reduction, no host gather of the labels.
+            import jax
+
+            ym = data.y * data.mask
+            ok = bool(jax.jit(lambda v: jnp.all(v * (v - 1.0) == 0.0))(ym))
+            if not ok:
+                raise ValueError("Only 0 and 1 labels are supported.")
+
+            active64 = (
+                None if active_set is None
+                else np.asarray(active_set, dtype=np.float64)
+            )
+            raw = self._fit_from_stack(instr, kernel, data, None, None, active64)
+            instr.log_success()
+            model = GaussianProcessClassificationModel(raw)
+            model.instr = instr
+            return model
 
     def _fit_from_stack(
         self, instr, kernel, data, x, make_targets_fn, active_override=None
@@ -86,6 +124,16 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         return a zero-arg callable producing the provider's flat targets
         (deferred: fetching latents is a device sync the random/kmeans
         providers never need)."""
+        from spark_gp_tpu.utils.instrumentation import maybe_profile
+
+        with maybe_profile(self._profile_dir):
+            return self._fit_from_stack_profiled(
+                instr, kernel, data, x, make_targets_fn, active_override
+            )
+
+    def _fit_from_stack_profiled(
+        self, instr, kernel, data, x, make_targets_fn, active_override=None
+    ) -> ProjectedProcessRawPredictor:
         if self._resolved_optimizer() == "device":
             # Fully async pipeline: on-device Laplace + L-BFGS, the latent
             # modes stay on device as the PPA targets, and the host syncs

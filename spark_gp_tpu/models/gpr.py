@@ -104,55 +104,24 @@ class GaussianProcessRegression(GaussianProcessCommons):
         :func:`spark_gp_tpu.parallel.distributed.distribute_global_experts`
         — a globally-sharded ``ExpertData`` whose expert axis spans every
         host's devices.  No process ever needs the full row set: the active
-        set is either supplied explicitly (replicated ``[m, p]``) or drawn
-        uniformly from the stack itself as a mesh collective
-        (:func:`...distributed.sample_active_from_stack`, the counterpart of
-        the reference's ``takeSample``, ActiveSetProvider.scala:48-56).
+        set is either supplied explicitly (replicated ``[m, p]``) or selected
+        by the configured provider's sharded-stack entry point
+        (``ActiveSetProvider.from_stack`` — random sampling, sharded-Lloyd
+        k-means and sharded greedy Seeger selection all run as mesh
+        collectives).
 
         Single-process it is equivalent to ``fit`` with a pre-grouped stack.
         """
-        from spark_gp_tpu.models.active_set import RandomActiveSetProvider
-        from spark_gp_tpu.parallel.distributed import sample_active_from_stack
-
         instr = Instrumentation(name="GaussianProcessRegression")
-        mesh_prev = self._mesh
-        if self._mesh is None:
-            from jax.sharding import NamedSharding
-
-            sh = getattr(data.x, "sharding", None)
-            if not isinstance(sh, NamedSharding):
-                raise ValueError(
-                    "fit_distributed needs setMesh(...) or a NamedSharding-"
-                    "sharded expert stack"
-                )
-            self._mesh = sh.mesh
-
-        try:
+        with self._stack_mesh(data):
             kernel = self._get_kernel()
             instr.log_metric("num_experts", int(data.x.shape[0]))
             instr.log_metric("expert_size", int(data.x.shape[1]))
-
-            with instr.phase("active_set_select"):
-                if active_set is None:
-                    if self._active_set_provider is not RandomActiveSetProvider:
-                        import warnings
-
-                        warnings.warn(
-                            "fit_distributed selects the active set by "
-                            "uniform sampling from the sharded stack; the "
-                            "configured provider "
-                            f"({self._active_set_provider!r}) needs host-"
-                            "local rows and is not consulted — pass "
-                            "active_set=... explicitly to override.",
-                            stacklevel=2,
-                        )
-                    active_set = sample_active_from_stack(
-                        data, self._active_set_size, self._seed, self._mesh
-                    )
-            active64 = np.asarray(active_set, dtype=np.float64)
+            active64 = (
+                None if active_set is None
+                else np.asarray(active_set, dtype=np.float64)
+            )
             return self._fit_from_stack(instr, kernel, data, None, None, active64)
-        finally:
-            self._mesh = mesh_prev
 
     def _fit_device(self, instr: Instrumentation, kernel, data):
         """Dispatch the one-program on-device optimization

@@ -3,35 +3,46 @@
 Counterpart of GreedilyOptimizingActiveSetProvider (ASP.scala:59-136): grow
 the active set one point at a time, scoring every candidate with the
 information-gain delta of *Fast Forward Selection to Speed Up Sparse Gaussian
-Process Regression*.
+Process Regression* (ASP.scala:106-128):
 
-Re-design vs the reference (and vs the round-1 version of this file):
+    li^2  = K_ii - k_i^T Kmm^-1 k_i
+    q_i   = k_i^T (sigma2 Kmm + Kmn Knm)^-1 k_i
+    mu_i  = k_i^T magicVector
+    delta = -log(sigma/li) - (log ksi + ksi (1-kappa)/sigma2 (y_i-mu_i)^2
+                              - kappa + 2) / 2
+
+Re-design vs the reference (third iteration of this file):
 
 * the reference broadcasts ``inv(Kmm)`` and ``inv(sigma2 Kmm + Kmn Knm)`` and
   loops per-candidate per-expert on executors (ASP.scala:84-136), refactoring
   both matrices from scratch every round — O(k^2 N) solves per round;
 * here NOTHING is refactored: appending a point only *extends* ``Kmm`` and
-  ``sigma2 Kmm + Kmn Knm`` by one row/column (existing entries never change),
-  so each round extends the two Cholesky factors by one row (a triangular
-  solve), and the candidate statistics update incrementally from the new
-  factor rows:
+  ``sigma2 Kmm + Kmn Knm`` by one row/column, so each round extends the two
+  Cholesky factors by one row (a triangular solve).  The candidate statistics
+  p = rowsum(W^2), q = rowsum(V^2), mu = V^T z (with W = L_mm^-1 K_sel,
+  V = L_pd^-1 K_sel, z = L_pd^-1 Kmn y) update from the new factor rows, and
+  the new rows themselves need only the STORED cross rows K_sel [m, N]:
 
-      W = L_mm^-1 K_mn   (row append:  W_k = (c_new - w . W) / d)
-      p = sum_rows W^2   (p += W_k^2)
-      V = L_pd^-1 K_mn,  q = sum_rows V^2,  z = L_pd^-1 K_mn y,
-      mu = V^T z         (mu += V_k z_k)
+      w_row = (c_new - (L_mm^-T w)^T K_sel) / d
+      v_row = (c_new - (L_pd^-T v)^T K_sel) / e
 
-  — O(m N) MXU work per round instead of O(k^2 N), a ~m/3-fold total FLOP
-  reduction (three orders of magnitude at the reference's m=1000), and the
-  entire m-round loop is ONE jitted ``lax.fori_loop``: state stays
-  device-resident, zero host syncs until the final index fetch.
+  — the transpose-solve identity w^T (L^-1 K_sel) = (L^-T w)^T K_sel means
+  the W and V buffers of the previous design never need materializing: ONE
+  [m, N] buffer (the cross rows) instead of three, ~2 GB at the Year-MSD
+  config (m=1000, N=515k, f32) vs ~6 GB before.  O(mN) MXU work per round,
+  and the entire m-round loop is ONE jitted ``lax.fori_loop``.
 
-Memory: three [m, N] buffers (K_mn rows, W, V) — ~280 MB at the Protein
-config (m=512, N=46k, f32), ~6 GB at m=1000, N=515k; chunk N if a config
-ever exceeds HBM.
+* the candidate axis N shards over the device mesh: every buffer and
+  candidate statistic is [m, N/D] or [N/D] per device, the small factor
+  state (L_mm, L_pd, z) is replicated, and each round's cross-device
+  traffic is two scalar all-reduces (the argmax) plus four psums of [m]/
+  scalar statistics — the TPU counterpart of the reference's
+  broadcast-inverses + distributed-argmax round (ASP.scala:88-132).  The
+  same core runs unsharded when ``axis`` is None.
 
-NaN candidate scores (li^2 <= 0 under float error) are excluded, matching the
-reference's NaN filter (ASP.scala:130-132).
+NaN candidate scores (li^2 <= 0 under float error) are excluded, matching
+the reference's NaN filter (ASP.scala:130-132); padded stack slots and
+already-chosen points are masked out the same way.
 """
 
 from __future__ import annotations
@@ -41,82 +52,114 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+
+_INT_MAX = np.int32(np.iinfo(np.int32).max)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _greedy_select(kernel: Kernel, m: int, theta, xj, yj, first_idx):
-    """Device-resident forward selection; returns the m chosen indices."""
-    n = xj.shape[0]
-    dtype = xj.dtype
+def _greedy_core(kernel: Kernel, m: int, axis, theta, xf, yf, maskf, first_gidx):
+    """Device-resident forward selection over a (possibly sharded) candidate
+    axis; returns the m chosen points ``[m, p]`` (replicated under shard_map).
+
+    ``xf [nl, p]``, ``yf [nl]``, ``maskf [nl]`` are the local candidate
+    shard; ``first_gidx`` is the GLOBAL flat index of the seed point (the
+    reference seeds with one uniform sample, ASP.scala:70).  ``axis`` is the
+    shard_map axis name, or None when running unsharded.
+    """
+    nl = xf.shape[0]
+    dtype = xf.dtype
+
+    def psum(v):
+        return jax.lax.psum(v, axis) if axis is not None else v
+
+    def pmax(v):
+        return jax.lax.pmax(v, axis) if axis is not None else v
+
+    def pmin(v):
+        return jax.lax.pmin(v, axis) if axis is not None else v
+
+    base = (
+        jax.lax.axis_index(axis) * nl if axis is not None else jnp.int32(0)
+    )
+    gids = jnp.arange(nl, dtype=jnp.int32) + base
+
     sigma2 = jnp.asarray(kernel.white_noise_var(theta), dtype)
-    k_diag = kernel.diag(theta, xj)  # includes the +sigma2 noise diagonal
+    k_diag = kernel.diag(theta, xf)  # includes the +sigma2 noise diagonal
     solve = partial(
         jax.lax.linalg.triangular_solve,
         left_side=True, lower=True, transpose_a=False,
     )
+    solve_t = partial(
+        jax.lax.linalg.triangular_solve,
+        left_side=True, lower=True, transpose_a=True,
+    )
 
-    def cross_row(idx):
-        # K(x_idx, .) against every candidate; the Eye/noise component of
-        # the model kernel contributes 0 off its own training set, matching
-        # the reference's crossKernel (kernel/Kernel.scala:151-161)
-        return kernel.cross(theta, xj[idx][None, :], xj)[0]
+    def append(k, gidx, state):
+        (ksel, l_mm, l_pd, z, p_vec, q_vec, mu_vec, sel, chosen_x,
+         chosen_gidx) = state
+        onehot = (gids == gidx).astype(dtype)
+        x_sel = psum(onehot @ xf)  # [p] — the round's cross-device gather
+        # K(x_sel, .) against the local candidates; the Eye/noise component
+        # of the model kernel contributes 0 off its own training set
+        # (kernel/Kernel.scala:151-161).  Masked so padded slots never feed
+        # the factor statistics.
+        c_new = kernel.cross(theta, x_sel[None, :], xf)[0] * maskf
 
-    def append(k, idx, state):
-        (cross, w_buf, v_buf, l_mm, l_pd, z, p_vec, q_vec, mu_vec,
-         mask, chosen) = state
-        c_new = cross_row(idx)
-
-        # Kmm gains column [K(a_j, x_idx)]_j — already present in the stored
-        # cross rows; unfilled rows are zero, which the identity-padded
-        # factors forward-solve to zero (no masking needed).
-        kmm_col = cross[:, idx]
-        kmm_nn = k_diag[idx]
+        # Kmm gains column [K(a_j, x_sel)]_j — present in the stored cross
+        # rows; unfilled rows are zero, which the identity-padded factors
+        # forward-solve to zero (no masking needed).
+        kmm_col = psum(ksel @ onehot)
+        kmm_nn = psum(jnp.dot(k_diag * maskf, onehot))
         w = solve(l_mm, kmm_col[:, None])[:, 0]
         d = jnp.sqrt(kmm_nn - w @ w)
+        # row k of W = L_mm^-1 K_sel via the transpose-solve identity; uses
+        # the PRE-update factor (prefix rows only — w is zero past k)
+        a = solve_t(l_mm, w[:, None])[:, 0]
+        w_row = (c_new - a @ ksel) / d
         l_mm = l_mm.at[k].set(w.at[k].set(d))
-        w_k = (c_new - w @ w_buf) / d
-        p_vec = p_vec + w_k * w_k
+        p_vec = p_vec + w_row * w_row
 
-        pd_col = sigma2 * kmm_col + cross @ c_new
-        pd_nn = sigma2 * kmm_nn + c_new @ c_new
+        pd_col = sigma2 * kmm_col + psum(ksel @ c_new)
+        pd_nn = sigma2 * kmm_nn + psum(c_new @ c_new)
         v = solve(l_pd, pd_col[:, None])[:, 0]
         e = jnp.sqrt(pd_nn - v @ v)
+        b = solve_t(l_pd, v[:, None])[:, 0]
+        v_row = (c_new - b @ ksel) / e
         l_pd = l_pd.at[k].set(v.at[k].set(e))
-        v_k = (c_new - v @ v_buf) / e
-        q_vec = q_vec + v_k * v_k
+        q_vec = q_vec + v_row * v_row
 
-        z_k = (c_new @ yj - v @ z) / e
+        z_k = (psum(c_new @ yf) - v @ z) / e
         z = z.at[k].set(z_k)
-        mu_vec = mu_vec + v_k * z_k
+        mu_vec = mu_vec + v_row * z_k
 
         return (
-            cross.at[k].set(c_new),
-            w_buf.at[k].set(w_k),
-            v_buf.at[k].set(v_k),
+            ksel.at[k].set(c_new),
             l_mm, l_pd, z, p_vec, q_vec, mu_vec,
-            mask.at[idx].set(True),
-            chosen.at[k].set(idx),
+            sel | (onehot > 0),
+            chosen_x.at[k].set(x_sel),
+            chosen_gidx.at[k].set(jnp.asarray(gidx, jnp.int32)),
         )
 
+    p_dim = xf.shape[1]
     state = (
-        jnp.zeros((m, n), dtype),  # cross (K_mn rows)
-        jnp.zeros((m, n), dtype),  # W = L_mm^-1 K_mn
-        jnp.zeros((m, n), dtype),  # V = L_pd^-1 K_mn
-        jnp.eye(m, dtype=dtype),   # L_mm (unit diag on unfilled rows)
-        jnp.eye(m, dtype=dtype),   # L_pd
-        jnp.zeros((m,), dtype),    # z = L_pd^-1 K_mn y
-        jnp.zeros((n,), dtype),    # p
-        jnp.zeros((n,), dtype),    # q
-        jnp.zeros((n,), dtype),    # mu
-        jnp.zeros((n,), bool),     # chosen mask
-        jnp.zeros((m,), jnp.int32),
+        jnp.zeros((m, nl), dtype),  # ksel: cross rows of the chosen points
+        jnp.eye(m, dtype=dtype),    # L_mm (unit diag on unfilled rows)
+        jnp.eye(m, dtype=dtype),    # L_pd
+        jnp.zeros((m,), dtype),     # z = L_pd^-1 K_mn y
+        jnp.zeros((nl,), dtype),    # p
+        jnp.zeros((nl,), dtype),    # q
+        jnp.zeros((nl,), dtype),    # mu
+        jnp.zeros((nl,), bool),     # chosen mask (local)
+        jnp.zeros((m, p_dim), dtype),  # the selected points
+        jnp.zeros((m,), jnp.int32),    # their global flat indices
     )
-    state = append(0, first_idx, state)
+    state = append(0, jnp.asarray(first_gidx, jnp.int32), state)
 
     def body(k, state):
-        p_vec, q_vec, mu_vec, mask = state[6], state[7], state[8], state[9]
+        p_vec, q_vec, mu_vec, sel = state[4], state[5], state[6], state[7]
         # Seeger information-gain delta (ASP.scala:106-128)
         li2 = k_diag - p_vec
         ratio2 = sigma2 / li2  # (sigma / li)^2
@@ -124,15 +167,49 @@ def _greedy_select(kernel: Kernel, m: int, theta, xj, yj, first_idx):
         kappa = ksi * (1.0 + 2.0 * ratio2)
         delta = -0.5 * jnp.log(ratio2) - 0.5 * (
             jnp.log(ksi)
-            + ksi * (1.0 - kappa) / sigma2 * (yj - mu_vec) ** 2
+            + ksi * (1.0 - kappa) / sigma2 * (yf - mu_vec) ** 2
             - kappa
             + 2.0
         )
-        delta = jnp.where(jnp.isnan(delta) | mask, -jnp.inf, delta)
-        return append(k, jnp.argmax(delta), state)
+        delta = jnp.where(
+            jnp.isnan(delta) | sel | (maskf == 0), -jnp.inf, delta
+        )
+        # distributed NaN-filtered argmax (ASP.scala:130-132): max value
+        # across shards, lowest global index on ties
+        loc = jnp.argmax(delta).astype(jnp.int32)
+        lval = delta[loc]
+        gmax = pmax(lval)
+        gidx = pmin(jnp.where(lval == gmax, gids[loc], _INT_MAX))
+        return append(k, gidx, state)
 
     state = jax.lax.fori_loop(1, m, body, state)
-    return state[-1]
+    return state[-2], state[-1]  # (points [m, p], global indices [m])
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _greedy_select(kernel: Kernel, m: int, theta, xj, yj, maskj, first_idx):
+    return _greedy_core(kernel, m, None, theta, xj, yj, maskj, first_idx)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _greedy_select_sharded(kernel: Kernel, m: int, mesh, theta, x, y, mask, first_gidx):
+    p = x.shape[-1]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS), P(),
+        ),
+        out_specs=(P(), P()),
+    )
+    def run(theta_, x_, y_, mask_, first_):
+        return _greedy_core(
+            kernel, m, EXPERT_AXIS, theta_,
+            x_.reshape(-1, p), y_.reshape(-1), mask_.reshape(-1), first_,
+        )
+
+    return run(theta, x, y, mask, first_gidx)
 
 
 def greedy_active_set(
@@ -143,9 +220,9 @@ def greedy_active_set(
     theta_opt: np.ndarray,
     seed: int,
 ) -> np.ndarray:
-    """Select ``m`` active points greedily.  ``kernel`` must be the
-    noise-augmented model kernel (the reference passes ``getKernel``,
-    GaussianProcessCommons.scala:43)."""
+    """Select ``m`` active points greedily from host-resident rows.
+    ``kernel`` must be the noise-augmented model kernel (the reference passes
+    ``getKernel``, GaussianProcessCommons.scala:43)."""
     x = np.asarray(x)
     y = np.asarray(y)
     n = x.shape[0]
@@ -155,8 +232,45 @@ def greedy_active_set(
     xj = jnp.asarray(x)
     theta = jnp.asarray(np.asarray(theta_opt, dtype=np.float64), dtype=xj.dtype)
     yj = jnp.asarray(y, dtype=xj.dtype)
+    maskj = jnp.ones((n,), dtype=xj.dtype)
 
-    chosen = _greedy_select(
-        kernel, m, theta, xj, yj, jnp.asarray(int(rng.integers(n)), jnp.int32)
+    _, idx = _greedy_select(
+        kernel, m, theta, xj, yj, maskj,
+        jnp.asarray(int(rng.integers(n)), jnp.int32),
     )
-    return x[np.asarray(chosen)]
+    # return the exact host rows (the device points would be rounded to the
+    # device dtype, perturbing the f64 magic solve downstream)
+    return x[np.asarray(idx)]
+
+
+def greedy_active_set_from_stack(
+    active_set_size: int,
+    data,
+    kernel: Kernel,
+    theta,
+    seed: int,
+    mesh,
+) -> np.ndarray:
+    """Greedy selection straight off a (possibly multi-host) sharded expert
+    stack: candidate statistics stay sharded on the mesh for the whole
+    m-round loop; only the m selected rows ever replicate.
+
+    The targets are whatever the stack's ``y`` carries — labels for
+    regression, latent modes for the classifier (GPClf.scala:62-65
+    substitutes f for y before produceModel).
+    """
+    from spark_gp_tpu.parallel.distributed import replicated_valid_indices
+
+    # Host-side seed draw over the valid (unpadded) slots — the counterpart
+    # of the reference's 1-sample takeSample (ASP.scala:70).
+    valid = replicated_valid_indices(data, mesh)
+    m = min(active_set_size, valid.size)
+    rng = np.random.default_rng(seed)
+    first = int(rng.choice(valid))
+
+    theta_dev = jnp.asarray(theta, dtype=data.x.dtype)
+    chosen, _ = _greedy_select_sharded(
+        kernel, m, mesh, theta_dev, data.x, data.y, data.mask,
+        jnp.asarray(first, jnp.int32),
+    )
+    return np.asarray(chosen, dtype=np.float64)

@@ -208,6 +208,22 @@ def distribute_global_experts(
     )
 
 
+def replicated_valid_indices(data: ExpertData, mesh) -> np.ndarray:
+    """Global flat indices of the real (unpadded) rows of a sharded stack,
+    identical on every host.
+
+    The validity mask is tiny (N floats) so resharding it to replicated is
+    cheap; every process then sees the same index set and can make
+    deterministic seeded draws without any further coordination.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    mask = np.asarray(jax.jit(lambda a: a, out_shardings=rep)(data.mask))
+    return np.flatnonzero(mask.reshape(-1) > 0)
+
+
 def sample_active_from_stack(
     data: ExpertData, m: int, seed: int, mesh
 ) -> np.ndarray:
@@ -216,19 +232,17 @@ def sample_active_from_stack(
 
     The multi-host counterpart of RandomActiveSetProvider / the reference's
     ``takeSample`` (ActiveSetProvider.scala:48-56): no host ever sees the
-    global rows.  The validity mask (tiny: N bits) is resharded to
-    replicated so every process draws the *same* m flat indices from the
-    shared seed, then the [m, p] row gather runs as one XLA program with a
-    replicated output — the cross-host traffic is the m selected rows, not
-    the dataset.
+    global rows.  Every process draws the *same* m flat indices from the
+    shared seed (via :func:`replicated_valid_indices`), then the [m, p] row
+    gather runs as one XLA program with a replicated output — the cross-host
+    traffic is the m selected rows, not the dataset.
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     rep = NamedSharding(mesh, P())
-    mask = np.asarray(jax.jit(lambda a: a, out_shardings=rep)(data.mask))
-    valid = np.flatnonzero(mask.reshape(-1) > 0)
+    valid = replicated_valid_indices(data, mesh)
     # clamp like RandomActiveSetProvider so fit_distributed keeps fit()'s
     # single-process behavior for m > N
     m = min(m, valid.size)

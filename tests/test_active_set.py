@@ -105,3 +105,125 @@ def test_greedy_improves_over_random_on_fit(rng):
         r_random = fit_with(RandomActiveSetProvider, seed)
         assert r_greedy < r_random, (r_greedy, r_random)
         assert r_greedy < 0.05, r_greedy  # absolute: tail is actually covered
+
+
+def _dense_seeger_order(kernel, theta, x, y, m, first_idx):
+    """Test-only oracle: the reference's per-round recomputed Seeger scoring
+    (ASP.scala:84-136) — explicit inverses refactored from scratch each
+    round, no incremental state.  Returns the selected index sequence."""
+    import jax.numpy as jnp
+    import scipy.linalg
+
+    theta_j = jnp.asarray(theta)
+    sigma2 = float(kernel.white_noise_var(theta_j))
+    k_diag = np.asarray(kernel.diag(theta_j, jnp.asarray(x)))
+    chosen = [int(first_idx)]
+    for _ in range(1, m):
+        a = x[np.asarray(chosen)]
+        kmm = np.asarray(kernel.gram(theta_j, jnp.asarray(a)))  # noise diag in
+        kmn = np.asarray(kernel.cross(theta_j, jnp.asarray(a), jnp.asarray(x)))
+        kmm_inv = scipy.linalg.inv(kmm)  # ASP.scala:88, inv() verbatim
+        pd = sigma2 * kmm + kmn @ kmn.T
+        pd_inv = scipy.linalg.inv(pd)  # ASP.scala:100
+        magic = scipy.linalg.solve(pd, kmn @ y)  # ASP.scala:102
+        p_vec = np.einsum("kn,kl,ln->n", kmn, kmm_inv, kmn)  # ASP.scala:113
+        q_vec = np.einsum("kn,kl,ln->n", kmn, pd_inv, kmn)  # ASP.scala:114
+        mu = kmn.T @ magic  # ASP.scala:115
+        li2 = k_diag - p_vec
+        with np.errstate(invalid="ignore", divide="ignore"):
+            ratio2 = sigma2 / li2
+            ksi = 1.0 / (ratio2 + 1.0 - q_vec)
+            kappa = ksi * (1.0 + 2.0 * ratio2)
+            delta = -0.5 * np.log(ratio2) - 0.5 * (
+                np.log(ksi)
+                + ksi * (1.0 - kappa) / sigma2 * (y - mu) ** 2
+                - kappa
+                + 2.0
+            )
+        delta[np.isnan(delta)] = -np.inf  # ASP.scala:130 NaN filter
+        delta[np.asarray(chosen)] = -np.inf
+        chosen.append(int(np.argmax(delta)))
+    return chosen
+
+
+def test_greedy_matches_dense_seeger_oracle(rng):
+    """Order-exact parity: the incremental-Cholesky selection must pick the
+    SAME point sequence as the reference's dense recomputed scoring
+    (ASP.scala:106-128) in f64."""
+    import jax.numpy as jnp
+
+    from spark_gp_tpu.models.greedy import _greedy_select
+
+    x = rng.normal(size=(200, 3))
+    y = np.sin(x.sum(axis=1)) + 0.1 * rng.normal(size=200)
+    kernel = _kernel()
+    theta = kernel.init_theta()
+    m, first = 25, 17
+
+    oracle_idx = _dense_seeger_order(kernel, theta, x, y, m, first)
+    got_pts, got_idx = _greedy_select(
+        kernel, m, jnp.asarray(theta), jnp.asarray(x), jnp.asarray(y),
+        jnp.ones(200), jnp.asarray(first, dtype="int32"),
+    )
+    np.testing.assert_array_equal(np.asarray(got_idx), oracle_idx)
+    np.testing.assert_allclose(np.asarray(got_pts), x[oracle_idx], atol=1e-9)
+
+
+def test_greedy_sharded_matches_single_device(rng, eight_device_mesh):
+    """The shard_map'd selection (candidate axis over 8 devices, psum/pmax
+    collectives) must reproduce the unsharded core point-for-point,
+    including with a masked (padded) stack."""
+    import jax.numpy as jnp
+
+    from spark_gp_tpu.models.greedy import _greedy_select, _greedy_select_sharded
+    from spark_gp_tpu.parallel.experts import group_for_experts
+    from spark_gp_tpu.parallel.mesh import shard_experts
+
+    x = rng.normal(size=(210, 2))  # deliberately not divisible: padding
+    y = np.sin(x.sum(axis=1))
+    kernel = _kernel()
+    theta = jnp.asarray(kernel.init_theta())
+    data = shard_experts(group_for_experts(x, y, 16), eight_device_mesh)
+
+    # unsharded reference run over the same flattened (padded+masked) layout
+    xf = jnp.asarray(np.asarray(data.x).reshape(-1, 2))
+    yf = jnp.asarray(np.asarray(data.y).reshape(-1))
+    mf = jnp.asarray(np.asarray(data.mask).reshape(-1))
+    first = int(np.flatnonzero(np.asarray(mf) > 0)[5])
+
+    single, single_idx = _greedy_select(
+        kernel, 12, theta, xf, yf, mf, jnp.asarray(first, dtype="int32")
+    )
+    sharded, sharded_idx = _greedy_select_sharded(
+        kernel, 12, eight_device_mesh, theta, data.x, data.y, data.mask,
+        jnp.asarray(first, dtype="int32"),
+    )
+    single, sharded = np.asarray(single), np.asarray(sharded)
+    np.testing.assert_array_equal(np.asarray(sharded_idx), np.asarray(single_idx))
+    np.testing.assert_allclose(sharded, single, atol=1e-10)
+    # every selected point is a real (unpadded) data row
+    rows = {tuple(np.round(r, 12)) for r in x}
+    for r in sharded:
+        assert tuple(np.round(r, 12)) in rows
+
+
+def test_kmeans_from_stack_matches_clusters(rng, eight_device_mesh):
+    """Sharded-Lloyd k-means over a padded expert stack finds the same two
+    cluster centers as the host path."""
+    from spark_gp_tpu.parallel.experts import group_for_experts
+    from spark_gp_tpu.parallel.mesh import shard_experts
+
+    a = rng.normal(size=(60, 2)) * 0.2
+    b = rng.normal(size=(60, 2)) * 0.2 + np.array([5.0, 5.0])
+    x = np.concatenate([a, b])
+    y = np.concatenate([np.zeros(60), np.ones(60)])
+    data = shard_experts(group_for_experts(x, y, 16), eight_device_mesh)
+
+    k = _kernel()
+    active = KMeansActiveSetProvider(max_iter=20).from_stack(
+        2, data, k, k.init_theta(), 0, eight_device_mesh
+    )
+    assert active.shape == (2, 2)
+    centers = np.sort(np.asarray(active), axis=0)
+    np.testing.assert_allclose(centers[0], [0.0, 0.0], atol=0.5)
+    np.testing.assert_allclose(centers[1], [5.0, 5.0], atol=0.5)
