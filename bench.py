@@ -104,21 +104,22 @@ def _preflight(env: dict, timeout_s: float, attempts: int):
 _PROXY_WORKERS = 8  # ≈ the 8-executor Spark topology of the north star
 
 
-def _proxy_init():
+def _proxy_init(barrier):
     """Worker init, run once per spawned worker BEFORE the timed window:
     pins BLAS to one thread (a Spark executor runs netlib-java LAPACK
     single-threaded per task, so 8 single-threaded processes model 8
     executors — and unpinned spawned workers each start a full
     physical-core-count OpenBLAS, measuring oversubscription instead of
-    compute) and pays the numpy/scipy import cost up front."""
+    compute), pays the numpy/scipy import cost up front, and rendezvous at
+    the barrier so EVERY worker is fully initialized before any timed work
+    is dispatched (a noop warm-up map can't guarantee that: the first
+    worker online may drain all its chunks)."""
     for var in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS"):
         os.environ[var] = "1"
     import numpy  # noqa: F401
     import scipy.linalg  # noqa: F401
 
-
-def _proxy_noop(_):
-    return None
+    barrier.wait()
 
 
 def _proxy_expert_batch(args):
@@ -161,9 +162,11 @@ def _cpu_proxy_eval_seconds(x, y, expert_size: int, sigma: float, sigma2: float)
     # forking a process holding live libtpu/gRPC threads is a documented
     # deadlock source (the exact hang class this file defends against)
     ctx = mp.get_context("spawn")
-    with ctx.Pool(processes=len(shares), initializer=_proxy_init) as pool:
-        # pay interpreter startup outside the timed window
-        pool.map(_proxy_noop, range(len(shares)))
+    barrier = ctx.Barrier(len(shares) + 1)
+    with ctx.Pool(
+        processes=len(shares), initializer=_proxy_init, initargs=(barrier,)
+    ) as pool:
+        barrier.wait()  # all workers spawned + imported
         start = time.perf_counter()
         pool.map(
             _proxy_expert_batch,

@@ -56,7 +56,8 @@ def main():
 
     gp = (
         GaussianProcessRegression()
-        .setKernel(lambda: 1.0 * ARDRBFKernel(x.shape[1]) + WhiteNoiseKernel(0.1, 0.0, 1.0))
+        .setKernel(lambda: 1.0 * ARDRBFKernel(x.shape[1], x.shape[1] ** -0.5)
+        + WhiteNoiseKernel(0.1, 0.0, 1.0))
         .setDatasetSizeForExpert(args.expert)
         .setActiveSetSize(args.active)
         .setSigma2(1e-3)

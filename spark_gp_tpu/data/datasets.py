@@ -108,14 +108,29 @@ def make_benchmark_data(n: int, n_features: int = 3, seed: int = 13):
     return x, y
 
 
-def _synthetic_regression(n: int, p: int, seed: int, noise: float = 0.1):
+def _synthetic_regression(
+    n: int, p: int, seed: int, noise: float = 0.1,
+    effective_dim: int | None = None,
+):
     """Nonlinear multi-scale regression surface used as the stand-in for the
     UCI stress datasets when the real CSVs are unavailable (zero-egress
-    environment): y = sin(w1.x) + 0.5 cos(w2.x) + quadratic + noise."""
+    environment): y = sin(w1.x) + 0.5 cos(w2.x) + quadratic + noise.
+
+    ``effective_dim`` restricts the signal to the first k features (the
+    remaining p-k are pure distractors).  A full-rank random surface over
+    p ~ 90 dims is statistically unlearnable at any feasible sample size —
+    every direction is signal — whereas real wide tabular data (Year-MSD's
+    timbre features) concentrates relevance in a few directions; a
+    low-effective-dimension stand-in both mimics that and actually
+    exercises what ARD is for (pruning irrelevant dims).
+    """
     rng = np.random.default_rng(seed)
     x = rng.normal(size=(n, p))
-    w1 = rng.normal(size=p) / np.sqrt(p)
-    w2 = rng.normal(size=p) / np.sqrt(p)
+    k = p if effective_dim is None else min(effective_dim, p)
+    w1 = np.zeros(p)
+    w2 = np.zeros(p)
+    w1[:k] = rng.normal(size=k) / np.sqrt(k)
+    w2[:k] = rng.normal(size=k) / np.sqrt(k)
     y = (
         np.sin(x @ w1)
         + 0.5 * np.cos(3.0 * (x @ w2))
@@ -161,4 +176,4 @@ def load_year_msd(path: str | None = None, n: int | None = None, seed: int = 11)
     if path is not None:
         raw = _read_csv(path)
         return _subsample(raw[:, 1:], raw[:, 0], n, seed)
-    return _synthetic_regression(n or 515345, 90, seed)
+    return _synthetic_regression(n or 515345, 90, seed, effective_dim=8)

@@ -35,6 +35,11 @@ from spark_gp_tpu.parallel.experts import ExpertData
 from spark_gp_tpu.utils.instrumentation import Instrumentation
 
 
+def _labels_are_01(ym):
+    # module-level (single compilation across fits, jit caches by identity)
+    return jnp.all(ym * (ym - 1.0) == 0.0)
+
+
 class GaussianProcessClassifier(GaussianProcessCommons):
     """Binary GP classifier with the reference's fluent parameter API."""
 
@@ -98,12 +103,8 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             instr.log_metric("expert_size", int(data.x.shape[1]))
 
             # Label-domain check on the sharded stack (GPClf.scala:68-72):
-            # one jitted reduction, no host gather of the labels.
-            import jax
-
-            ym = data.y * data.mask
-            ok = bool(jax.jit(lambda v: jnp.all(v * (v - 1.0) == 0.0))(ym))
-            if not ok:
+            # one reduction on device, no host gather of the labels.
+            if not bool(_labels_are_01(data.y * data.mask)):
                 raise ValueError("Only 0 and 1 labels are supported.")
 
             active64 = (

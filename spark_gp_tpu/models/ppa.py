@@ -202,6 +202,14 @@ def _sharded_kmn_stats_x64_from32_impl(
     return sharded(theta32, active64, x32, y32, mask32)
 
 
+# Above this active-set size the O(m^3) magic solve moves off the host
+# single-thread numpy path onto the device (XLA f64): at m=1000 the host
+# solve is milliseconds, at m >= ~2k the device's parallel triangular
+# solves win by an order of magnitude (SURVEY.md §2.3 TP row — the m-axis
+# is the scaling direction the reference never had).
+_DEVICE_SOLVE_MIN_M = 2048
+
+
 def magic_solve(
     kernel: Kernel,
     theta,
@@ -210,9 +218,16 @@ def magic_solve(
     u2,
     solve_dtype=np.float64,
 ):
-    """Host f64 solve for (magicVector, magicMatrix) — PGPH.scala:49-60."""
+    """f64 solve for (magicVector, magicMatrix) — PGPH.scala:49-60.
+
+    Dispatches by m: host numpy below ``_DEVICE_SOLVE_MIN_M`` (cheap,
+    avoids device round-trips for the common m ~ 100..1000), the jitted
+    device solver above it (large-m path, parity-tested against the host).
+    """
     theta64 = np.asarray(theta, dtype=solve_dtype)
     active64 = np.asarray(active, dtype=solve_dtype)
+    if active64.shape[0] >= _DEVICE_SOLVE_MIN_M:
+        return magic_solve_device(kernel, theta64, active64, u1, u2)
     kmm, sn2 = _gram_f64_on_host(kernel, theta64, active64)
     u1 = np.asarray(u1, dtype=solve_dtype)
     u2 = np.asarray(u2, dtype=solve_dtype)
@@ -221,6 +236,76 @@ def magic_solve(
 
     magic_vector, magic_matrix = _solve_magic_np(pd_mat, kmm, u2, sn2)
     return magic_vector, magic_matrix
+
+
+@partial(jax.jit, static_argnums=0)
+def _magic_solve_device_impl(kernel: Kernel, theta, active, u1, u2, tau):
+    """One jitted f64 solve attempt with trace-relative jitter ``tau`` (a
+    traced scalar: every escalation reuses the same executable).  Returns
+    the solution plus a finiteness flag (Cholesky of an indefinite matrix
+    yields NaN, checked on host — can't raise under jit)."""
+    m = active.shape[0]
+    kmm = kernel.gram(theta, active)
+    sn2 = kernel.white_noise_var(theta)
+    eye = jnp.eye(m, dtype=u1.dtype)
+
+    def chol(mat, rel_jitter):
+        sym = 0.5 * (mat + mat.T)
+        return jnp.linalg.cholesky(
+            sym + (rel_jitter * jnp.trace(sym) / m) * eye
+        )
+
+    l_pd = chol(sn2 * kmm + u1, tau)
+    l_mm = chol(kmm, tau)
+
+    def chol_solve(l, b):
+        y = jax.lax.linalg.triangular_solve(
+            l, b, left_side=True, lower=True
+        )
+        return jax.lax.linalg.triangular_solve(
+            l, y, left_side=True, lower=True, transpose_a=True
+        )
+
+    magic_vector = chol_solve(l_pd, u2[:, None])[:, 0]
+    magic_matrix = sn2 * chol_solve(l_pd, eye) - chol_solve(l_mm, eye)
+    ok = jnp.all(jnp.isfinite(jnp.diagonal(l_pd))) & jnp.all(
+        jnp.isfinite(jnp.diagonal(l_mm))
+    )
+    return magic_vector, magic_matrix, ok
+
+
+def magic_solve_device(kernel: Kernel, theta64, active64, u1, u2):
+    """Device f64 magic solve for large active sets (m >~ 2k): Cholesky +
+    triangular solves as one XLA program, with the same escalating
+    trace-relative jitter semantics as the host path
+    (:func:`_psd_safe_cholesky`) driven from the host — each retry re-runs
+    the same compiled executable with a bigger traced jitter scalar.
+    """
+    with jax.enable_x64():
+        theta_d = jnp.asarray(theta64, dtype=jnp.float64)
+        active_d = jnp.asarray(active64, dtype=jnp.float64)
+        u1_d = jnp.asarray(u1, dtype=jnp.float64)
+        u2_d = jnp.asarray(u2, dtype=jnp.float64)
+        # tau=0 first; then the f32-noise-floor scale escalating x10, with
+        # the SAME cap as the host path's _psd_safe_cholesky (max relative
+        # jitter 1.2e-4) so the advice-bearing failure triggers identically
+        # on both dispatch branches
+        for k in range(5):
+            tau = 0.0 if k == 0 else 1.2e-7 * (10.0 ** (k - 1))
+            mv, mm, ok = _magic_solve_device_impl(
+                kernel, theta_d, active_d, u1_d, u2_d,
+                jnp.asarray(tau, jnp.float64),
+            )
+            if bool(ok):
+                if k > 0:
+                    import logging
+
+                    logging.getLogger("spark_gp_tpu").warning(
+                        "device magic solve required relative jitter %.3e "
+                        "for positive definiteness", tau,
+                    )
+                return np.asarray(mv), np.asarray(mm)
+    raise NotPositiveDefiniteException()
 
 
 def _gram_f64_on_host(kernel: Kernel, theta64, active64):
