@@ -164,10 +164,11 @@ def _stress_regression(loader, n, expert, active, max_iter) -> dict:
     start = time.perf_counter()
     model = gp.fit(x[tr], ys[tr])
     fit_seconds = time.perf_counter() - start
-    pred = model.predict(x[te]) * y_std + y_mean
+    pred_scaled = model.predict(x[te])
+    pred = pred_scaled * y_std + y_mean
     return {
         "rmse": float(rmse(y[te], pred)),
-        "rmse_scaled": float(rmse(ys[te], model.predict(x[te]))),
+        "rmse_scaled": float(rmse(ys[te], pred_scaled)),
         "n": int(x.shape[0]),
         "p": int(x.shape[1]),
         "expert": expert,
@@ -205,7 +206,8 @@ def part_weak_scaling() -> dict:
             + f" --xla_force_host_platform_device_count={d}"
         )
         env["QUALITY_SCALE_DEVICES"] = str(d)
-        out, err = _run_sub(["--scale-point"], 900, env)
+        timeout = float(os.environ.get("QUALITY_PART_TIMEOUT", 900))
+        out, err = _run_sub(["--scale-point"], timeout, env)
         results.append(out if out is not None else {"devices": d, "error": err})
     return {"points": results}
 

@@ -380,7 +380,7 @@ class GaussianProcessCommons(GaussianProcessParams):
         active64 = np.asarray(active, dtype=np.float64)
         with instr.phase("magic_solve"):
             magic_vector, magic_matrix = ppa.magic_solve(
-                kernel, theta, active64, u1, u2
+                kernel, theta, active64, u1, u2, mesh=self._mesh
             )
         return ppa.ProjectedProcessRawPredictor(
             kernel=kernel,

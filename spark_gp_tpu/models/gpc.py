@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,8 +36,12 @@ from spark_gp_tpu.parallel.experts import ExpertData
 from spark_gp_tpu.utils.instrumentation import Instrumentation
 
 
-def _labels_are_01(ym):
-    # module-level (single compilation across fits, jit caches by identity)
+@jax.jit
+def _labels_are_01(y, mask):
+    # module-level jit: single compilation across fits, and the reduction
+    # runs as a program (required for non-fully-addressable global arrays
+    # in multi-host runs — eager ops can't touch those)
+    ym = y * mask
     return jnp.all(ym * (ym - 1.0) == 0.0)
 
 
@@ -104,7 +109,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
 
             # Label-domain check on the sharded stack (GPClf.scala:68-72):
             # one reduction on device, no host gather of the labels.
-            if not bool(_labels_are_01(data.y * data.mask)):
+            if not bool(_labels_are_01(data.y, data.mask)):
                 raise ValueError("Only 0 and 1 labels are supported.")
 
             active64 = (

@@ -202,6 +202,13 @@ def _sharded_kmn_stats_x64_from32_impl(
     return sharded(theta32, active64, x32, y32, mask32)
 
 
+# One escalating-jitter policy for every magic-solve dispatch branch (host
+# numpy, replicated device, mesh-sharded): relative-to-(trace/m) diagonal
+# boosts, unjittered first, then the f32 noise-floor scale escalating x10.
+# A matrix that exhausts the schedule raises NotPositiveDefiniteException
+# with the reference's advice identically on all branches (PGPH.scala:9-11).
+_JITTER_SCHEDULE = (0.0, 1.2e-7, 1.2e-6, 1.2e-5, 1.2e-4)
+
 # Above this active-set size the O(m^3) magic solve moves off the host
 # single-thread numpy path onto the device (XLA f64): at m=1000 the host
 # solve is milliseconds, at m >= ~2k the device's parallel triangular
@@ -217,16 +224,22 @@ def magic_solve(
     u1,
     u2,
     solve_dtype=np.float64,
+    mesh=None,
 ):
     """f64 solve for (magicVector, magicMatrix) — PGPH.scala:49-60.
 
     Dispatches by m: host numpy below ``_DEVICE_SOLVE_MIN_M`` (cheap,
-    avoids device round-trips for the common m ~ 100..1000), the jitted
-    device solver above it (large-m path, parity-tested against the host).
+    avoids device round-trips for the common m ~ 100..1000); above it, the
+    jitted device solver — sharded over ``mesh`` when one with >1 devices
+    is supplied (the blocked distributed Cholesky of ops/dist_linalg.py,
+    scaling the O(m^3) with chips), replicated otherwise.  All three paths
+    are parity-tested against each other.
     """
     theta64 = np.asarray(theta, dtype=solve_dtype)
     active64 = np.asarray(active, dtype=solve_dtype)
     if active64.shape[0] >= _DEVICE_SOLVE_MIN_M:
+        if mesh is not None and mesh.devices.size > 1:
+            return sharded_magic_solve(kernel, theta64, active64, u1, u2, mesh)
         return magic_solve_device(kernel, theta64, active64, u1, u2)
     kmm, sn2 = _gram_f64_on_host(kernel, theta64, active64)
     u1 = np.asarray(u1, dtype=solve_dtype)
@@ -286,12 +299,7 @@ def magic_solve_device(kernel: Kernel, theta64, active64, u1, u2):
         active_d = jnp.asarray(active64, dtype=jnp.float64)
         u1_d = jnp.asarray(u1, dtype=jnp.float64)
         u2_d = jnp.asarray(u2, dtype=jnp.float64)
-        # tau=0 first; then the f32-noise-floor scale escalating x10, with
-        # the SAME cap as the host path's _psd_safe_cholesky (max relative
-        # jitter 1.2e-4) so the advice-bearing failure triggers identically
-        # on both dispatch branches
-        for k in range(5):
-            tau = 0.0 if k == 0 else 1.2e-7 * (10.0 ** (k - 1))
+        for k, tau in enumerate(_JITTER_SCHEDULE):
             mv, mm, ok = _magic_solve_device_impl(
                 kernel, theta_d, active_d, u1_d, u2_d,
                 jnp.asarray(tau, jnp.float64),
@@ -326,8 +334,8 @@ def _gram_f64_on_host(kernel: Kernel, theta64, active64):
     return kmm, sn2
 
 
-def _psd_safe_cholesky(mat, name, max_tries=4):
-    """Cholesky with escalating trace-relative jitter.
+def _psd_safe_cholesky(mat, name):
+    """Cholesky with the shared escalating trace-relative jitter schedule.
 
     The distributed U1 = sum K_mn K_nm accumulates on-device in float32; its
     smallest eigenvalues carry O(eps_f32 * lambda_max) noise which can push a
@@ -335,28 +343,24 @@ def _psd_safe_cholesky(mat, name, max_tries=4):
     proportional to trace/m (starting at f32 epsilon scale, escalating x10)
     perturbs the solution far less than the PPA approximation error itself.
     Raises NotPositiveDefiniteException (with the reference's "increase
-    sigma2" advice, PGPH.scala:9-11) only once jitter 1e4x the float32 noise
+    sigma2" advice, PGPH.scala:9-11) only once jitter 1e3x the float32 noise
     floor still fails — at that point the matrix is genuinely bad.
     """
     mat = 0.5 * (mat + mat.T)
-    try:
-        return np.linalg.cholesky(mat)
-    except np.linalg.LinAlgError:
-        pass
-    base = 1.2e-7 * np.trace(mat) / mat.shape[0] if mat.shape[0] else 1.0
-    for k in range(max_tries):
-        tau = base * (10.0**k)
+    scale = np.trace(mat) / mat.shape[0] if mat.shape[0] else 1.0
+    for tau in _JITTER_SCHEDULE:
         try:
-            chol = np.linalg.cholesky(mat + tau * np.eye(mat.shape[0]))
+            chol = np.linalg.cholesky(mat + (tau * scale) * np.eye(mat.shape[0]))
+        except np.linalg.LinAlgError:
+            continue
+        if tau:
             import logging
 
             logging.getLogger("spark_gp_tpu").warning(
                 "%s required jitter %.3e for positive definiteness "
-                "(float32 accumulation noise)", name, tau,
+                "(float32 accumulation noise)", name, tau * scale,
             )
-            return chol
-        except np.linalg.LinAlgError:
-            continue
+        return chol
     raise NotPositiveDefiniteException()
 
 
@@ -377,6 +381,70 @@ def _solve_magic_np(pd_mat, kmm, u2, sn2):
     kmm_inv = chol_solve_np(l_mm, eye)
     magic_matrix = sn2 * pd_inv - kmm_inv
     return magic_vector, magic_matrix
+
+
+def sharded_magic_solve(
+    kernel: Kernel, theta64, active64, u1, u2, mesh, block: int = 128
+):
+    """Mesh-sharded f64 magic solve: the m x m factorizations run as the
+    blocked distributed Cholesky of :mod:`spark_gp_tpu.ops.dist_linalg`
+    (rows sharded over the mesh, per-panel psum + all-gather over ICI), so
+    the O(m^3) PPA solve scales with device count — the SURVEY §2.3
+    tensor-parallel stretch row the reference has no counterpart for (its
+    solve is driver-local, PGPH.scala:54-59).
+
+    Same escalating-jitter semantics and failure advice as the host/device
+    paths; m is padded to mesh-size * block granularity with an identity
+    block (padded rows solve to zero / slice away exactly).
+    """
+    from spark_gp_tpu.ops import dist_linalg
+
+    with jax.enable_x64():
+        theta_d = jnp.asarray(theta64, dtype=jnp.float64)
+        kmm = np.asarray(kernel.gram(theta_d, jnp.asarray(active64)))
+        sn2 = float(np.asarray(kernel.white_noise_var(theta_d)))
+        m = active64.shape[0]
+        gran = mesh.devices.size * block
+        m_pad = -(-m // gran) * gran
+
+        pd = sn2 * kmm + np.asarray(u1, dtype=np.float64)
+        pd = 0.5 * (pd + pd.T)
+        kmm = 0.5 * (kmm + kmm.T)
+        u2_pad = np.zeros(m_pad)
+        u2_pad[:m] = np.asarray(u2, dtype=np.float64)
+        eye_scale_pd = np.trace(pd) / m
+        eye_scale_mm = np.trace(kmm) / m
+
+        for k, tau in enumerate(_JITTER_SCHEDULE):
+            pd_pad = dist_linalg.pad_spd(
+                pd + (tau * eye_scale_pd) * np.eye(m), m_pad
+            )
+            kmm_pad = dist_linalg.pad_spd(
+                kmm + (tau * eye_scale_mm) * np.eye(m), m_pad
+            )
+            l_pd = dist_linalg.sharded_cholesky(mesh, jnp.asarray(pd_pad), block)
+            l_mm = dist_linalg.sharded_cholesky(mesh, jnp.asarray(kmm_pad), block)
+            ok = bool(jnp.all(jnp.isfinite(l_pd))) and bool(
+                jnp.all(jnp.isfinite(l_mm))
+            )
+            if not ok:
+                continue
+            if k > 0:
+                import logging
+
+                logging.getLogger("spark_gp_tpu").warning(
+                    "sharded magic solve required relative jitter %.3e "
+                    "for positive definiteness", tau,
+                )
+            magic_vector = np.asarray(
+                dist_linalg.sharded_chol_solve(mesh, l_pd, u2_pad, block)
+            )[:m]
+            eye_pad = jnp.eye(m_pad, dtype=jnp.float64)
+            pd_inv = dist_linalg.sharded_chol_solve(mesh, l_pd, eye_pad, block)
+            kmm_inv = dist_linalg.sharded_chol_solve(mesh, l_mm, eye_pad, block)
+            magic_matrix = np.asarray(sn2 * pd_inv - kmm_inv)[:m, :m]
+            return magic_vector, magic_matrix
+    raise NotPositiveDefiniteException()
 
 
 @dataclass

@@ -1,0 +1,212 @@
+"""Distributed (mesh-sharded) dense Cholesky + triangular solves.
+
+The SURVEY §2.3 tensor-parallel row: the reference keeps every m x m solve
+on the driver (PGPH.scala:49-60), capping the active set at what one node
+factors comfortably.  Here the factorization itself shards over the device
+mesh, so the O(m^3) PPA solve scales with chips and the row-sharded matrix
+never needs to exist on one device.
+
+Algorithm — right-looking blocked Cholesky on a ROW-sharded matrix:
+
+    A is [m, m], rows sharded contiguously over the 1-D mesh (the same
+    layout `shard_experts` uses for the expert axis).  For each b-wide
+    panel k:
+
+      1. A_kk  <- psum of each device's owned slice of the diagonal block
+                  (replicated [b, b]; ownership-free: any panel/device
+                  overlap works)
+      2. L_kk  <- cholesky(A_kk) computed redundantly on every device
+                  (b x b — cheap, keeps it replicated without a broadcast)
+      3. X     <- A[:, k-panel] L_kk^-T locally on each row shard
+      4. write panel columns: L_kk rows at panel rows, X below, 0 above
+      5. L_col <- all_gather(X masked below panel)      [m, b]
+      6. trailing update A -= X L_col^T on columns past the panel
+
+    Per-panel communication: one [b, b] psum + one [m, b] all-gather —
+    O(m^2) total over the factorization, riding ICI.
+
+The blocked forward/backward substitutions follow the same panel walk with
+a replicated right-hand side ([m, r]); the O(m^2 r / D) outer-product work
+stays sharded, only [b, r] panel updates replicate.  Solving with r = m
+(for the PPA's magic matrix) keeps the replicated RHS as the only full-size
+array — which is unavoidable, the result itself is [m, m].
+
+Padding: callers pad m up to (mesh size * block) granularity with an
+identity diagonal block; padded rows factor to identity and zero RHS rows
+solve to zero, so results slice back exactly (see ppa.sharded_magic_solve).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+
+
+def _panel_selector(rows_g, r0, b, dtype):
+    """[b, m_loc] one-hot: sel[p, i] = 1 iff local row i is global row r0+p."""
+    return (rows_g[None, :] == (r0 + jnp.arange(b, dtype=rows_g.dtype))[:, None]).astype(dtype)
+
+
+def _chol_core(axis, b, a_loc):
+    """Row-sharded blocked Cholesky; returns the local L rows (strict upper
+    zeroed).  Runs inside shard_map."""
+    m_loc, m = a_loc.shape
+    dtype = a_loc.dtype
+    nb = m // b
+    base = jax.lax.axis_index(axis) * m_loc
+    rows_g = jnp.arange(m_loc, dtype=jnp.int32) + base
+    cols_g = jnp.arange(m, dtype=jnp.int32)
+
+    def panel(k, a_loc):
+        r0 = k * b
+        cols = jax.lax.dynamic_slice(a_loc, (0, r0), (m_loc, b))
+        sel = _panel_selector(rows_g, r0, b, dtype)
+        a_kk = jax.lax.psum(sel @ cols, axis)
+        l_kk = jnp.linalg.cholesky(a_kk)
+        # X = A[:, panel] L_kk^-T on every owned row
+        x = jax.lax.linalg.triangular_solve(
+            l_kk, cols, left_side=False, lower=True, transpose_a=True
+        )
+        in_panel = (rows_g >= r0) & (rows_g < r0 + b)
+        below = rows_g >= r0 + b
+        newcols = jnp.where(
+            below[:, None],
+            x,
+            jnp.where(in_panel[:, None], sel.T @ l_kk, jnp.zeros_like(x)),
+        )
+        a_loc = jax.lax.dynamic_update_slice(a_loc, newcols, (0, r0))
+
+        x_below = jnp.where(below[:, None], x, 0.0)
+        l_col = jax.lax.all_gather(x_below, axis, tiled=True)  # [m, b]
+        col_mask = (cols_g >= r0 + b).astype(dtype)
+        return a_loc - (x_below @ l_col.T) * col_mask[None, :]
+
+    a_loc = jax.lax.fori_loop(0, nb, panel, a_loc)
+    # zero the strict upper triangle (trailing updates leave junk there)
+    return jnp.where(cols_g[None, :] <= rows_g[:, None], a_loc, 0.0)
+
+
+def _solve_core(axis, b, l_loc, rhs):
+    """Solve A x = rhs given the row-sharded factor (A = L L^T): blocked
+    forward then backward substitution; rhs/x replicated [m, r]."""
+    m_loc, m = l_loc.shape
+    dtype = l_loc.dtype
+    nb = m // b
+    base = jax.lax.axis_index(axis) * m_loc
+    rows_g = jnp.arange(m_loc, dtype=jnp.int32) + base
+    cols_g = jnp.arange(m, dtype=jnp.int32)
+    r = rhs.shape[1]
+    # the replicated rhs becomes a loop carry whose body output is
+    # device-varying (all_gather results); cast so the types match
+    rhs = jax.lax.pcast(rhs, axis, to="varying")
+
+    def fwd(k, y):
+        r0 = k * b
+        cols = jax.lax.dynamic_slice(l_loc, (0, r0), (m_loc, b))
+        sel = _panel_selector(rows_g, r0, b, dtype)
+        l_kk = jax.lax.psum(sel @ cols, axis)
+        y_k = jax.lax.linalg.triangular_solve(
+            l_kk, jax.lax.dynamic_slice(y, (r0, 0), (b, r)),
+            left_side=True, lower=True,
+        )
+        below = (rows_g >= r0 + b).astype(dtype)
+        # local rows are globally contiguous: gather puts each shard's
+        # contribution at its global row positions directly
+        contrib = jax.lax.all_gather(
+            (cols * below[:, None]) @ y_k, axis, tiled=True
+        )  # [m, r]
+        y = jax.lax.dynamic_update_slice(y, y_k, (r0, 0))
+        return y - contrib * (cols_g >= r0 + b).astype(dtype)[:, None]
+
+    y = jax.lax.fori_loop(0, nb, fwd, rhs)
+
+    def bwd(kk, x):
+        r0 = (nb - 1 - kk) * b
+        sel = _panel_selector(rows_g, r0, b, dtype)
+        row_block = jax.lax.psum(sel @ l_loc, axis)  # [b, m] = L[panel, :]
+        l_kk = jax.lax.dynamic_slice(row_block, (0, r0), (b, b))
+        x_k = jax.lax.linalg.triangular_solve(
+            l_kk, jax.lax.dynamic_slice(x, (r0, 0), (b, r)),
+            left_side=True, lower=True, transpose_a=True,
+        )
+        x = jax.lax.dynamic_update_slice(x, x_k, (r0, 0))
+        above = (cols_g < r0).astype(dtype)[:, None]
+        return x - (row_block.T @ x_k) * above
+
+    return jax.lax.fori_loop(0, nb, bwd, y)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _sharded_cholesky_impl(mesh, b, a):
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P(EXPERT_AXIS), out_specs=P(EXPERT_AXIS),
+    )
+    def run(a_loc):
+        return _chol_core(EXPERT_AXIS, b, a_loc)
+
+    return run(a)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _sharded_solve_impl(mesh, b, l_sharded, rhs):
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(EXPERT_AXIS), P()), out_specs=P(EXPERT_AXIS),
+    )
+    def run(l_loc, rhs_):
+        x = _solve_core(EXPERT_AXIS, b, l_loc, rhs_)
+        # every device holds the identical full solution (device-varying
+        # only in type); returning each device's own row slice under a
+        # sharded out_spec reassembles it with zero communication
+        m_loc = l_loc.shape[0]
+        base = jax.lax.axis_index(EXPERT_AXIS) * m_loc
+        return jax.lax.dynamic_slice(
+            x, (base, jnp.zeros((), base.dtype)), (m_loc, x.shape[1])
+        )
+
+    return run(l_sharded, rhs)
+
+
+def sharded_cholesky(mesh, a, block: int = 128):
+    """Cholesky-factor a row-sharded SPD ``[m, m]`` array over the mesh.
+
+    ``m`` must be divisible by ``mesh size * block`` (pad with an identity
+    diagonal block otherwise).  Returns the row-sharded lower factor.
+    Indefiniteness surfaces as NaNs in the factor (check before trusting
+    solves — can't raise inside the program).
+    """
+    m = a.shape[0]
+    d = mesh.devices.size
+    if m % (d * block) != 0:
+        raise ValueError(
+            f"m={m} must be a multiple of devices*block={d * block}; "
+            "pad with an identity diagonal block"
+        )
+    a = jax.device_put(a, NamedSharding(mesh, P(EXPERT_AXIS)))
+    return _sharded_cholesky_impl(mesh, block, a)
+
+
+def sharded_chol_solve(mesh, l_sharded, rhs, block: int = 128):
+    """Solve ``A x = rhs`` from the row-sharded factor; ``rhs`` ``[m, r]``
+    (or ``[m]``) replicated; returns x of the same shape, row-sharded."""
+    vec = rhs.ndim == 1
+    rhs2 = rhs[:, None] if vec else rhs
+    rhs2 = jax.device_put(jnp.asarray(rhs2), NamedSharding(mesh, P()))
+    x = _sharded_solve_impl(mesh, block, l_sharded, rhs2)
+    return x[:, 0] if vec else x
+
+
+def pad_spd(a: np.ndarray, m_pad: int) -> np.ndarray:
+    """Embed SPD ``a`` in an ``[m_pad, m_pad]`` identity — padded rows factor
+    to e_i and zero-padded RHS rows solve to zero, so results slice back."""
+    m = a.shape[0]
+    out = np.eye(m_pad, dtype=a.dtype)
+    out[:m, :m] = a
+    return out

@@ -1,0 +1,68 @@
+"""Parity tests for the mesh-sharded blocked Cholesky (ops/dist_linalg.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_gp_tpu.ops import dist_linalg
+
+
+def _spd(rng, m):
+    b = rng.normal(size=(m, m)) / np.sqrt(m)
+    return b @ b.T * m * 0.1 + np.eye(m)
+
+
+def test_sharded_cholesky_matches_numpy(rng, eight_device_mesh):
+    m = 8 * 16 * 3  # 3 panels per device at block=16
+    a = _spd(rng, m)
+    l_sh = dist_linalg.sharded_cholesky(eight_device_mesh, jnp.asarray(a), block=16)
+    l_np = np.linalg.cholesky(a)
+    np.testing.assert_allclose(np.asarray(l_sh), l_np, rtol=1e-9, atol=1e-10)
+
+
+def test_sharded_solve_vector_and_matrix(rng, eight_device_mesh):
+    m = 8 * 16 * 2
+    a = _spd(rng, m)
+    l_sh = dist_linalg.sharded_cholesky(eight_device_mesh, jnp.asarray(a), block=16)
+
+    v = rng.normal(size=m)
+    x = np.asarray(dist_linalg.sharded_chol_solve(eight_device_mesh, l_sh, jnp.asarray(v), block=16))
+    np.testing.assert_allclose(a @ x, v, rtol=1e-8, atol=1e-9)
+
+    rhs = rng.normal(size=(m, 7))
+    xm = np.asarray(dist_linalg.sharded_chol_solve(eight_device_mesh, l_sh, jnp.asarray(rhs), block=16))
+    np.testing.assert_allclose(a @ xm, rhs, rtol=1e-8, atol=1e-9)
+
+
+def test_sharded_inverse_via_identity_rhs(rng, eight_device_mesh):
+    m = 8 * 16
+    a = _spd(rng, m)
+    l_sh = dist_linalg.sharded_cholesky(eight_device_mesh, jnp.asarray(a), block=16)
+    inv = np.asarray(
+        dist_linalg.sharded_chol_solve(
+            eight_device_mesh, l_sh, jnp.eye(m), block=16
+        )
+    )
+    np.testing.assert_allclose(inv, np.linalg.inv(a), rtol=1e-7, atol=1e-9)
+
+
+def test_pad_spd_roundtrip(rng, eight_device_mesh):
+    """Identity padding: factoring/solving the padded system reproduces the
+    unpadded solution exactly on the real block."""
+    m, m_pad = 100, 8 * 16
+    a = _spd(rng, m)
+    a_pad = dist_linalg.pad_spd(a, m_pad)
+    l_sh = dist_linalg.sharded_cholesky(eight_device_mesh, jnp.asarray(a_pad), block=16)
+    v = np.zeros(m_pad)
+    v[:m] = rng.normal(size=m)
+    x = np.asarray(dist_linalg.sharded_chol_solve(eight_device_mesh, l_sh, jnp.asarray(v), block=16))
+    np.testing.assert_allclose(a @ x[:m], v[:m], rtol=1e-8, atol=1e-9)
+    np.testing.assert_allclose(x[m:], 0.0, atol=1e-12)
+
+
+def test_block_granularity_rejected(rng, eight_device_mesh):
+    import pytest
+
+    with pytest.raises(ValueError, match="multiple"):
+        dist_linalg.sharded_cholesky(
+            eight_device_mesh, jnp.asarray(_spd(rng, 100)), block=16
+        )
