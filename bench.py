@@ -220,28 +220,36 @@ def worker() -> None:
 
     # Secondary metric: classifier throughput (the Laplace Newton inner loop
     # is the expensive novel path; VERDICT r2 flagged it as unmeasured on
-    # hardware).  Quarter-sized N keeps the bench's wall-clock budget.
+    # hardware).  Quarter-sized N keeps the bench's wall-clock budget; any
+    # failure here must not cost the already-measured primary metric, so
+    # the whole section is fenced (the supervisor's hardening contract:
+    # always one parseable JSON line).
     gpc_n = min(n, max(2000, n // 4))
-    from spark_gp_tpu import GaussianProcessClassifier
+    gpc_seconds = None
+    gpc_error = None
+    try:
+        from spark_gp_tpu import GaussianProcessClassifier
 
-    yc = (y[:gpc_n] > np.median(y[:gpc_n])).astype(np.float64)
+        yc = (y[:gpc_n] > np.median(y[:gpc_n])).astype(np.float64)
 
-    def make_gpc(iters: int):
-        return (
-            GaussianProcessClassifier()
-            .setKernel(lambda: RBFKernel(0.1))
-            .setDatasetSizeForExpert(expert_size)
-            .setActiveSetSize(expert_size)
-            .setSeed(13)
-            .setTol(1e-3)
-            .setMaxIter(iters)
-            .setOptimizer(os.environ.get("BENCH_OPTIMIZER", "device"))
-        )
+        def make_gpc(iters: int):
+            return (
+                GaussianProcessClassifier()
+                .setKernel(lambda: RBFKernel(0.1))
+                .setDatasetSizeForExpert(expert_size)
+                .setActiveSetSize(expert_size)
+                .setSeed(13)
+                .setTol(1e-3)
+                .setMaxIter(iters)
+                .setOptimizer(os.environ.get("BENCH_OPTIMIZER", "device"))
+            )
 
-    make_gpc(1).fit(x[:gpc_n], yc)  # warm-up (compile shared w/ measured fit)
-    gpc_start = time.perf_counter()
-    make_gpc(max_iter).fit(x[:gpc_n], yc)
-    gpc_seconds = time.perf_counter() - gpc_start
+        make_gpc(1).fit(x[:gpc_n], yc)  # warm-up (compile shared w/ fit)
+        gpc_start = time.perf_counter()
+        make_gpc(max_iter).fit(x[:gpc_n], yc)
+        gpc_seconds = time.perf_counter() - gpc_start
+    except Exception as exc:  # noqa: BLE001 — secondary metric only
+        gpc_error = f"{type(exc).__name__}: {exc}"[:200]
 
     # CPU f64 BLAS proxy of the reference's cost for the same work.
     proxy_eval_s = _cpu_proxy_eval_seconds(x, y, expert_size, sigma=0.1, sigma2=1e-3)
@@ -285,7 +293,10 @@ def worker() -> None:
             ),
             "gpc_n_points": gpc_n,
             "gpc_fit_seconds": gpc_seconds,
-            "gpc_train_points_per_sec": gpc_n / gpc_seconds,
+            "gpc_train_points_per_sec": (
+                None if gpc_seconds is None else gpc_n / gpc_seconds
+            ),
+            **({"gpc_error": gpc_error} if gpc_error else {}),
             "est_optimizer_tflops": total_flops / 1e12,
             "est_tflops_per_sec": est_tflops_per_sec,
             "est_mfu_vs_bf16_peak": (

@@ -31,10 +31,15 @@ from spark_gp_tpu.utils.platform import honor_platform_env as _honor_platform_en
 _honor_platform_env()
 
 from spark_gp_tpu.kernels import (
+    ARDMatern32Kernel,
+    ARDMatern52Kernel,
     ARDRBFKernel,
     Const,
     EyeKernel,
     Kernel,
+    Matern12Kernel,
+    Matern32Kernel,
+    Matern52Kernel,
     RBFKernel,
     Scalar,
     SumKernel,
@@ -62,6 +67,11 @@ __all__ = [
     "Kernel",
     "RBFKernel",
     "ARDRBFKernel",
+    "Matern12Kernel",
+    "Matern32Kernel",
+    "Matern52Kernel",
+    "ARDMatern32Kernel",
+    "ARDMatern52Kernel",
     "EyeKernel",
     "WhiteNoiseKernel",
     "SumKernel",

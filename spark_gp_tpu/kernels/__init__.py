@@ -16,6 +16,13 @@ from spark_gp_tpu.kernels.base import (
     TrainableScaleKernel,
     WhiteNoiseKernel,
 )
+from spark_gp_tpu.kernels.matern import (
+    ARDMatern32Kernel,
+    ARDMatern52Kernel,
+    Matern12Kernel,
+    Matern32Kernel,
+    Matern52Kernel,
+)
 from spark_gp_tpu.kernels.rbf import ARDRBFKernel, RBFKernel
 
 __all__ = [
@@ -30,4 +37,9 @@ __all__ = [
     "WhiteNoiseKernel",
     "RBFKernel",
     "ARDRBFKernel",
+    "Matern12Kernel",
+    "Matern32Kernel",
+    "Matern52Kernel",
+    "ARDMatern32Kernel",
+    "ARDMatern52Kernel",
 ]

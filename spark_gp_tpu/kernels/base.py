@@ -118,6 +118,67 @@ class StationaryKernel(Kernel):
         return jnp.ones(x.shape[0], dtype=x.dtype)
 
 
+class ScalarLengthscaleHypers(StationaryKernel):
+    """Shared hyperparameter plumbing for stationary kernels with one
+    trainable length-scale ``sigma`` bounded in ``[lower, upper]`` (the
+    RBF/Matérn isotropic families)."""
+
+    n_hypers = 1
+
+    def __init__(self, sigma: float = 1.0, lower: float = 1e-6,
+                 upper: float = math.inf):
+        self.sigma0 = float(sigma)
+        self.lower = float(lower)
+        self.upper = float(upper)
+
+    def _spec(self) -> tuple:
+        return (self.sigma0, self.lower, self.upper)
+
+    def init_theta(self):
+        return np.array([self.sigma0], dtype=np.float64)
+
+    def bounds(self):
+        return (
+            np.array([self.lower], dtype=np.float64),
+            np.array([self.upper], dtype=np.float64),
+        )
+
+
+class ARDHypers(StationaryKernel):
+    """Shared hyperparameter plumbing for ARD kernels: one trainable inverse
+    length-scale ``beta`` per feature dimension (beta multiplies, the
+    reference's ARDRBFKernel.scala:8-15 convention).  Construct with either
+    a dimension count (uniform ``beta`` init) or an explicit beta vector."""
+
+    def __init__(self, p_or_beta, beta: float = 1.0, lower=0.0,
+                 upper=math.inf):
+        if isinstance(p_or_beta, (int, np.integer)):
+            beta0 = np.full((int(p_or_beta),), float(beta), dtype=np.float64)
+        else:
+            beta0 = np.asarray(p_or_beta, dtype=np.float64)
+        self.beta0 = beta0
+        self.n_hypers = beta0.shape[0]
+        self.lower_b = np.broadcast_to(
+            np.asarray(lower, dtype=np.float64), beta0.shape
+        ).copy()
+        self.upper_b = np.broadcast_to(
+            np.asarray(upper, dtype=np.float64), beta0.shape
+        ).copy()
+
+    def _spec(self) -> tuple:
+        return (
+            tuple(self.beta0.tolist()),
+            tuple(self.lower_b.tolist()),
+            tuple(self.upper_b.tolist()),
+        )
+
+    def init_theta(self):
+        return self.beta0.copy()
+
+    def bounds(self):
+        return self.lower_b, self.upper_b
+
+
 class EyeKernel(Kernel):
     """Identity-matrix kernel: ``K = I`` on training points, 0 across sets,
     unit white-noise variance (kernel/Kernel.scala:142-163)."""

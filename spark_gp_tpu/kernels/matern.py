@@ -1,0 +1,123 @@
+"""Matérn kernel family (ν = 1/2, 3/2, 5/2), isotropic and ARD.
+
+Capability beyond the reference (akopich/spark-gp ships only RBF/ARD-RBF,
+kernel/RBFKernel.scala / ARDRBFKernel.scala): the Matérn family is the
+standard choice for physical processes whose sample paths are rougher than
+the RBF's C-infinity draws — ν = 1/2 gives the exponential (OU) kernel,
+3/2 and 5/2 once/twice-differentiable paths.
+
+With r = |x_i - x_j| and length-scale ``sigma`` (same parameter convention
+as :class:`~spark_gp_tpu.kernels.rbf.RBFKernel`):
+
+    nu = 1/2:  k = exp(-r / sigma)
+    nu = 3/2:  k = (1 + a) exp(-a),            a = sqrt(3) r / sigma
+    nu = 5/2:  k = (1 + a + a^2 / 3) exp(-a),  a = sqrt(5) r / sigma
+
+ARD variants follow the repo's ARD-RBF convention (beta multiplies:
+r^2 = |(x_i - x_j) * beta|^2, one trainable inverse length-scale per
+dimension, ARDRBFKernel.scala:8-15).
+
+Autodiff note: ARD puts hyperparameters inside the sqrt, whose derivative
+is 0/0 at coincident points; ``jnp.maximum(r2, eps)`` routes the gradient
+through the constant branch there (exactly the true zero derivative) while
+perturbing diagonal values by < 1e-12.  Gradients are FD-checked in
+tests/test_kernels.py like every other kernel.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_gp_tpu.kernels.base import ARDHypers, ScalarLengthscaleHypers
+from spark_gp_tpu.ops.distance import sq_dist, weighted_sq_dist
+
+_R2_FLOOR = 1e-24  # sqrt grad guard; sqrt(floor) = 1e-12 off the true diag
+
+
+def _matern_of_a(nu2: int, a):
+    """Matérn correlation as a function of the scaled distance a."""
+    if nu2 == 1:
+        return jnp.exp(-a)
+    if nu2 == 3:
+        return (1.0 + a) * jnp.exp(-a)
+    if nu2 == 5:
+        return (1.0 + a + a * a / 3.0) * jnp.exp(-a)
+    raise ValueError(f"unsupported 2*nu = {nu2}")
+
+
+def _safe_r(r2):
+    return jnp.sqrt(jnp.maximum(r2, _R2_FLOOR))
+
+
+class _MaternIso(ScalarLengthscaleHypers):
+    """One trainable length-scale ``sigma`` in ``[lower, upper]``.  The
+    subclass type distinguishes the ν variants for jit caching (Kernel
+    hashes on ``(type, _spec())``)."""
+
+    _nu2: int  # 2 * nu, set by subclasses
+
+    def _k(self, theta, sqd):
+        a = math.sqrt(self._nu2) * _safe_r(sqd) / theta[0]
+        return _matern_of_a(self._nu2, a)
+
+    def gram(self, theta, x):
+        return self._k(theta, sq_dist(x, x))
+
+    def cross(self, theta, x_test, x_train):
+        return self._k(theta, sq_dist(x_test, x_train))
+
+    def describe(self, theta) -> str:
+        return (
+            f"Matern{self._nu2}2Kernel("
+            f"sigma={float(np.asarray(theta)[0]):.1e})"
+        )
+
+
+class Matern12Kernel(_MaternIso):
+    """Exponential / Ornstein–Uhlenbeck kernel (Matérn ν = 1/2)."""
+
+    _nu2 = 1
+
+
+class Matern32Kernel(_MaternIso):
+    """Matérn ν = 3/2: once-differentiable sample paths."""
+
+    _nu2 = 3
+
+
+class Matern52Kernel(_MaternIso):
+    """Matérn ν = 5/2: twice-differentiable sample paths."""
+
+    _nu2 = 5
+
+
+class _MaternARD(ARDHypers):
+    """Per-dimension inverse length-scales, ARD-RBF convention
+    (``r^2 = |(x_i - x_j) * beta|^2``)."""
+
+    _nu2: int
+
+    def _k(self, theta, x_a, x_b):
+        a = math.sqrt(self._nu2) * _safe_r(weighted_sq_dist(x_a, x_b, theta))
+        return _matern_of_a(self._nu2, a)
+
+    def gram(self, theta, x):
+        return self._k(theta, x, x)
+
+    def cross(self, theta, x_test, x_train):
+        return self._k(theta, x_test, x_train)
+
+    def describe(self, theta) -> str:
+        vals = ", ".join(f"{v:.1e}" for v in np.asarray(theta))
+        return f"ARDMatern{self._nu2}2Kernel(beta=[{vals}])"
+
+
+class ARDMatern32Kernel(_MaternARD):
+    _nu2 = 3
+
+
+class ARDMatern52Kernel(_MaternARD):
+    _nu2 = 5

@@ -13,38 +13,17 @@ finite-difference test oracles.
 
 from __future__ import annotations
 
-import math
-
 import jax.numpy as jnp
 import numpy as np
 
-from spark_gp_tpu.kernels.base import StationaryKernel
+from spark_gp_tpu.kernels.base import ARDHypers, ScalarLengthscaleHypers
 from spark_gp_tpu.ops.distance import sq_dist, weighted_sq_dist
 
 
-class RBFKernel(StationaryKernel):
+class RBFKernel(ScalarLengthscaleHypers):
     """``k(x_i, x_j) = exp(-|x_i - x_j|^2 / (2 sigma^2))`` with one trainable
     length-scale ``sigma`` bounded in ``[lower, upper]``
     (RBFKernel.scala:14-54; default bounds :15-16)."""
-
-    n_hypers = 1
-
-    def __init__(self, sigma: float = 1.0, lower: float = 1e-6, upper: float = math.inf):
-        self.sigma0 = float(sigma)
-        self.lower = float(lower)
-        self.upper = float(upper)
-
-    def _spec(self) -> tuple:
-        return (self.sigma0, self.lower, self.upper)
-
-    def init_theta(self):
-        return np.array([self.sigma0], dtype=np.float64)
-
-    def bounds(self):
-        return (
-            np.array([self.lower], dtype=np.float64),
-            np.array([self.upper], dtype=np.float64),
-        )
 
     def _k(self, theta, sqd):
         sigma = theta[0]
@@ -60,7 +39,7 @@ class RBFKernel(StationaryKernel):
         return f"RBFKernel(sigma={float(np.asarray(theta)[0]):.1e})"
 
 
-class ARDRBFKernel(StationaryKernel):
+class ARDRBFKernel(ARDHypers):
     """Automatic Relevance Determination RBF:
     ``k(x_i, x_j) = exp(-|(x_i - x_j) * beta|^2)`` with one trainable inverse
     length-scale per feature dimension (ARDRBFKernel.scala:20-46).
@@ -68,33 +47,6 @@ class ARDRBFKernel(StationaryKernel):
     Note the reference's convention (no factor 1/2, beta multiplies rather
     than divides) is kept so hyperparameter values are directly comparable.
     """
-
-    def __init__(self, p_or_beta, beta: float = 1.0, lower=0.0, upper=math.inf):
-        if isinstance(p_or_beta, (int, np.integer)):
-            beta0 = np.full((int(p_or_beta),), float(beta), dtype=np.float64)
-        else:
-            beta0 = np.asarray(p_or_beta, dtype=np.float64)
-        self.beta0 = beta0
-        self.n_hypers = beta0.shape[0]
-        self.lower_b = np.broadcast_to(
-            np.asarray(lower, dtype=np.float64), beta0.shape
-        ).copy()
-        self.upper_b = np.broadcast_to(
-            np.asarray(upper, dtype=np.float64), beta0.shape
-        ).copy()
-
-    def _spec(self) -> tuple:
-        return (
-            tuple(self.beta0.tolist()),
-            tuple(self.lower_b.tolist()),
-            tuple(self.upper_b.tolist()),
-        )
-
-    def init_theta(self):
-        return self.beta0.copy()
-
-    def bounds(self):
-        return self.lower_b, self.upper_b
 
     def gram(self, theta, x):
         return jnp.exp(-weighted_sq_dist(x, x, theta))

@@ -350,7 +350,12 @@ def _psd_safe_cholesky(mat, name):
     scale = np.trace(mat) / mat.shape[0] if mat.shape[0] else 1.0
     for tau in _JITTER_SCHEDULE:
         try:
-            chol = np.linalg.cholesky(mat + (tau * scale) * np.eye(mat.shape[0]))
+            # tau=0 fast path: no O(m^2) identity add on the common
+            # first-try-succeeds route
+            jittered = mat if tau == 0.0 else (
+                mat + (tau * scale) * np.eye(mat.shape[0])
+            )
+            chol = np.linalg.cholesky(jittered)
         except np.linalg.LinAlgError:
             continue
         if tau:
@@ -415,19 +420,31 @@ def sharded_magic_solve(
         eye_scale_pd = np.trace(pd) / m
         eye_scale_mm = np.trace(kmm) / m
 
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(mesh, P())
+        # multi-host legality: reductions/reshards of row-sharded global
+        # arrays must run as programs with replicated outputs — eager
+        # jnp/np ops on non-fully-addressable arrays raise (same
+        # restriction as gpc._labels_are_01)
+        finite_ok = jax.jit(
+            lambda a, b: jnp.all(jnp.isfinite(a)) & jnp.all(jnp.isfinite(b)),
+            out_shardings=rep,
+        )
+        replicate = jax.jit(lambda a: a, out_shardings=rep)
+
         for k, tau in enumerate(_JITTER_SCHEDULE):
             pd_pad = dist_linalg.pad_spd(
-                pd + (tau * eye_scale_pd) * np.eye(m), m_pad
+                pd if tau == 0.0 else pd + (tau * eye_scale_pd) * np.eye(m),
+                m_pad,
             )
             kmm_pad = dist_linalg.pad_spd(
-                kmm + (tau * eye_scale_mm) * np.eye(m), m_pad
+                kmm if tau == 0.0 else kmm + (tau * eye_scale_mm) * np.eye(m),
+                m_pad,
             )
             l_pd = dist_linalg.sharded_cholesky(mesh, jnp.asarray(pd_pad), block)
             l_mm = dist_linalg.sharded_cholesky(mesh, jnp.asarray(kmm_pad), block)
-            ok = bool(jnp.all(jnp.isfinite(l_pd))) and bool(
-                jnp.all(jnp.isfinite(l_mm))
-            )
-            if not ok:
+            if not bool(finite_ok(l_pd, l_mm)):
                 continue
             if k > 0:
                 import logging
@@ -437,12 +454,16 @@ def sharded_magic_solve(
                     "for positive definiteness", tau,
                 )
             magic_vector = np.asarray(
-                dist_linalg.sharded_chol_solve(mesh, l_pd, u2_pad, block)
+                replicate(dist_linalg.sharded_chol_solve(mesh, l_pd, u2_pad, block))
             )[:m]
             eye_pad = jnp.eye(m_pad, dtype=jnp.float64)
             pd_inv = dist_linalg.sharded_chol_solve(mesh, l_pd, eye_pad, block)
             kmm_inv = dist_linalg.sharded_chol_solve(mesh, l_mm, eye_pad, block)
-            magic_matrix = np.asarray(sn2 * pd_inv - kmm_inv)[:m, :m]
+            magic_matrix = np.asarray(
+                replicate(
+                    jax.jit(lambda a, b: sn2 * a - b)(pd_inv, kmm_inv)
+                )
+            )[:m, :m]
             return magic_vector, magic_matrix
     raise NotPositiveDefiniteException()
 
