@@ -37,6 +37,7 @@ per-iteration expert math stays in device f32.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from functools import partial
 
@@ -209,6 +210,14 @@ def _sharded_kmn_stats_x64_from32_impl(
 # with the reference's advice identically on all branches (PGPH.scala:9-11).
 _JITTER_SCHEDULE = (0.0, 1.2e-7, 1.2e-6, 1.2e-5, 1.2e-4)
 
+
+def _jittered(mat: np.ndarray, tau: float, scale: float) -> np.ndarray:
+    """``mat + (tau * scale) I`` with a no-copy fast path at tau=0 (the
+    common first-try-succeeds route skips the O(m^2) identity add)."""
+    if tau == 0.0:
+        return mat
+    return mat + (tau * scale) * np.eye(mat.shape[0])
+
 # Above this active-set size the O(m^3) magic solve moves off the host
 # single-thread numpy path onto the device (XLA f64): at m=1000 the host
 # solve is milliseconds, at m >= ~2k the device's parallel triangular
@@ -350,12 +359,7 @@ def _psd_safe_cholesky(mat, name):
     scale = np.trace(mat) / mat.shape[0] if mat.shape[0] else 1.0
     for tau in _JITTER_SCHEDULE:
         try:
-            # tau=0 fast path: no O(m^2) identity add on the common
-            # first-try-succeeds route
-            jittered = mat if tau == 0.0 else (
-                mat + (tau * scale) * np.eye(mat.shape[0])
-            )
-            chol = np.linalg.cholesky(jittered)
+            chol = np.linalg.cholesky(_jittered(mat, tau, scale))
         except np.linalg.LinAlgError:
             continue
         if tau:
@@ -386,6 +390,28 @@ def _solve_magic_np(pd_mat, kmm, u2, sn2):
     kmm_inv = chol_solve_np(l_mm, eye)
     magic_matrix = sn2 * pd_inv - kmm_inv
     return magic_vector, magic_matrix
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_solve_helpers(mesh):
+    """Per-mesh jitted helper programs for the sharded magic solve, cached
+    so repeated solves don't re-trace/re-compile (jax.jit caches by wrapped
+    callable identity — fresh lambdas per call would defeat it).
+
+    All three run as programs with replicated outputs: multi-host legality
+    requires it — eager jnp/np ops on row-sharded global arrays that span
+    other hosts' devices raise (same restriction as gpc._labels_are_01).
+    """
+    from jax.sharding import NamedSharding
+
+    rep = NamedSharding(mesh, P())
+    finite_ok = jax.jit(
+        lambda a, b: jnp.all(jnp.isfinite(a)) & jnp.all(jnp.isfinite(b)),
+        out_shardings=rep,
+    )
+    replicate = jax.jit(lambda a: a, out_shardings=rep)
+    combine = jax.jit(lambda a, b, s: s * a - b, out_shardings=rep)
+    return finite_ok, replicate, combine
 
 
 def sharded_magic_solve(
@@ -420,27 +446,12 @@ def sharded_magic_solve(
         eye_scale_pd = np.trace(pd) / m
         eye_scale_mm = np.trace(kmm) / m
 
-        from jax.sharding import NamedSharding
-
-        rep = NamedSharding(mesh, P())
-        # multi-host legality: reductions/reshards of row-sharded global
-        # arrays must run as programs with replicated outputs — eager
-        # jnp/np ops on non-fully-addressable arrays raise (same
-        # restriction as gpc._labels_are_01)
-        finite_ok = jax.jit(
-            lambda a, b: jnp.all(jnp.isfinite(a)) & jnp.all(jnp.isfinite(b)),
-            out_shardings=rep,
-        )
-        replicate = jax.jit(lambda a: a, out_shardings=rep)
+        finite_ok, replicate, combine = _sharded_solve_helpers(mesh)
 
         for k, tau in enumerate(_JITTER_SCHEDULE):
-            pd_pad = dist_linalg.pad_spd(
-                pd if tau == 0.0 else pd + (tau * eye_scale_pd) * np.eye(m),
-                m_pad,
-            )
+            pd_pad = dist_linalg.pad_spd(_jittered(pd, tau, eye_scale_pd), m_pad)
             kmm_pad = dist_linalg.pad_spd(
-                kmm if tau == 0.0 else kmm + (tau * eye_scale_mm) * np.eye(m),
-                m_pad,
+                _jittered(kmm, tau, eye_scale_mm), m_pad
             )
             l_pd = dist_linalg.sharded_cholesky(mesh, jnp.asarray(pd_pad), block)
             l_mm = dist_linalg.sharded_cholesky(mesh, jnp.asarray(kmm_pad), block)
@@ -460,9 +471,7 @@ def sharded_magic_solve(
             pd_inv = dist_linalg.sharded_chol_solve(mesh, l_pd, eye_pad, block)
             kmm_inv = dist_linalg.sharded_chol_solve(mesh, l_mm, eye_pad, block)
             magic_matrix = np.asarray(
-                replicate(
-                    jax.jit(lambda a, b: sn2 * a - b)(pd_inv, kmm_inv)
-                )
+                combine(pd_inv, kmm_inv, jnp.asarray(sn2, jnp.float64))
             )[:m, :m]
             return magic_vector, magic_matrix
     raise NotPositiveDefiniteException()
@@ -488,16 +497,39 @@ class ProjectedProcessRawPredictor:
         """Returns a jittable ``x_test [t, p] -> (mean [t], var [t])``."""
         return partial(_predict_impl, self.kernel)
 
+    # cap on the [t, m] cross-kernel intermediate per dispatch: 32M entries
+    # (256 MB f64) — predictions on millions of rows stream through in
+    # fixed-size chunks instead of materializing one [t, m] matrix.
+    _PREDICT_CHUNK_ELEMS = 32 * 1024 * 1024
+
     def __call__(self, x_test):
-        dtype = jnp.result_type(jnp.asarray(x_test).dtype)
-        return _predict_jit(
+        x_test = jnp.asarray(x_test)
+        dtype = jnp.result_type(x_test.dtype)
+        args = (
             self.kernel,
             jnp.asarray(self.theta, dtype=dtype),
             jnp.asarray(self.active, dtype=dtype),
             jnp.asarray(self.magic_vector, dtype=dtype),
             jnp.asarray(self.magic_matrix, dtype=dtype),
-            jnp.asarray(x_test, dtype=dtype),
         )
+        t = x_test.shape[0]
+        m = max(1, self.active.shape[0])
+        chunk = max(1, self._PREDICT_CHUNK_ELEMS // m)
+        if t <= chunk:
+            return _predict_jit(*args, jnp.asarray(x_test, dtype=dtype))
+        # fixed chunk shape (last chunk padded) -> one compiled executable
+        means, vars_ = [], []
+        for start in range(0, t, chunk):
+            part = x_test[start : start + chunk]
+            pad = chunk - part.shape[0]
+            if pad:
+                part = jnp.concatenate(
+                    [part, jnp.broadcast_to(part[:1], (pad, part.shape[1]))]
+                )
+            mean, var = _predict_jit(*args, jnp.asarray(part, dtype=dtype))
+            means.append(mean[: chunk - pad] if pad else mean)
+            vars_.append(var[: chunk - pad] if pad else var)
+        return jnp.concatenate(means), jnp.concatenate(vars_)
 
 
 def _predict_impl(kernel, theta, active, magic_vector, magic_matrix, x_test):

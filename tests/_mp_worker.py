@@ -1,0 +1,93 @@
+"""Worker body for the REAL multi-process DCN test (test_multiprocess.py).
+
+Each process owns a disjoint shard of the rows (the HDFS-partition analogue,
+GaussianProcessCommons.scala:20-24), joins the coordination plane, stitches
+its rows into the globally-sharded expert stack, runs both estimators'
+``fit_distributed``, and prints one JSON line of results for the parent to
+cross-check across processes.
+
+Run (by the test): python tests/_mp_worker.py <pid> <nproc> <port>
+"""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    import numpy as np
+
+    from spark_gp_tpu.parallel import distributed as dist
+
+    dist.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert dist.num_processes() == nproc
+    mesh = dist.global_expert_mesh()
+
+    from spark_gp_tpu import (
+        GaussianProcessClassifier,
+        GaussianProcessRegression,
+        RBFKernel,
+    )
+
+    # Disjoint per-process rows; DELIBERATELY unequal counts so the
+    # cross-host expert-stack padding (_pad_stack) is exercised.
+    rng = np.random.default_rng(100 + pid)
+    n_local = 140 if pid == 0 else 104
+    x_local = rng.normal(size=(n_local, 2))
+    y_local = np.sin(x_local.sum(axis=1)) + 0.01 * rng.normal(size=n_local)
+
+    data = dist.distribute_global_experts(x_local, y_local, 16, mesh)
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(48)
+        .setMaxIter(15)
+        .setSeed(3)
+        .setMesh(mesh)
+        .fit_distributed(data)
+    )
+    probe = np.random.default_rng(999).normal(size=(32, 2))  # shared seed
+    pred = model.predict(probe)
+
+    yc_local = (x_local.sum(axis=1) > 0).astype(np.float64)
+    cdata = dist.distribute_global_experts(x_local, yc_local, 16, mesh)
+    cmodel = (
+        GaussianProcessClassifier()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(48)
+        .setMaxIter(10)
+        .setSeed(3)
+        .setMesh(mesh)
+        .fit_distributed(cdata)
+    )
+    cpred = cmodel.predict_proba(probe)[:, 1]
+
+    # training-fit quality on the local shard (loose: tiny maxiter)
+    rmse_local = float(
+        np.sqrt(np.mean((model.predict(x_local) - y_local) ** 2))
+    )
+    print(
+        "MPRESULT "
+        + json.dumps(
+            {
+                "pid": pid,
+                "n_global_devices": len(jax.devices()),
+                "pred": np.round(pred, 10).tolist(),
+                "cpred": np.round(cpred, 10).tolist(),
+                "rmse_local": rmse_local,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

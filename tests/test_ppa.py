@@ -214,3 +214,30 @@ def test_sharded_magic_solve_matches_host(rng, eight_device_mesh):
     )
     np.testing.assert_allclose(mv_sh, mv_host, rtol=1e-8, atol=1e-10)
     np.testing.assert_allclose(mm_sh, mm_host, rtol=1e-6, atol=1e-8)
+
+
+def test_chunked_prediction_matches_unchunked(rng):
+    """The streaming (chunked) predict path must produce byte-identical
+    results to a single-dispatch predict."""
+    m = 40
+    kernel = RBFKernel(1.0) + Const(1e-3) * EyeKernel()
+    raw = ProjectedProcessRawPredictor(
+        kernel=kernel,
+        theta=np.asarray(kernel.init_theta(), dtype=np.float64),
+        active=rng.normal(size=(m, 2)),
+        magic_vector=rng.normal(size=m),
+        magic_matrix=rng.normal(size=(m, m)),
+    )
+    x_test = rng.normal(size=(517, 2))  # not a multiple of any chunk size
+    mean_one, var_one = (np.asarray(a) for a in raw(x_test))
+    old = ProjectedProcessRawPredictor._PREDICT_CHUNK_ELEMS
+    try:
+        # force tiny chunks (100 elems / m=40 -> chunk of 2 rows)
+        ProjectedProcessRawPredictor._PREDICT_CHUNK_ELEMS = 100
+        mean_ch, var_ch = (np.asarray(a) for a in raw(x_test))
+    finally:
+        ProjectedProcessRawPredictor._PREDICT_CHUNK_ELEMS = old
+    # not byte-identical as a claim: different chunk shapes may compile to
+    # different tilings/reduction orders on accelerator backends
+    np.testing.assert_allclose(mean_ch, mean_one, rtol=1e-12, atol=1e-13)
+    np.testing.assert_allclose(var_ch, var_one, rtol=1e-12, atol=1e-13)
