@@ -46,9 +46,17 @@ def test_two_process_fit_distributed():
         for pid in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=560)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=560)
+            outs.append(out)
+    finally:
+        # a hung worker (e.g. a deadlocked collective) must not leak past
+        # the test holding the coordinator port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
 

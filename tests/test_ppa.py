@@ -217,8 +217,9 @@ def test_sharded_magic_solve_matches_host(rng, eight_device_mesh):
 
 
 def test_chunked_prediction_matches_unchunked(rng):
-    """The streaming (chunked) predict path must produce byte-identical
-    results to a single-dispatch predict."""
+    """The streaming (chunked) predict path must agree with a
+    single-dispatch predict to floating-point round-off (not byte-identical:
+    different chunk shapes may compile to different tilings)."""
     m = 40
     kernel = RBFKernel(1.0) + Const(1e-3) * EyeKernel()
     raw = ProjectedProcessRawPredictor(
