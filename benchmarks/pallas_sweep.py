@@ -32,7 +32,11 @@ def _bench(fn, k, iters: int = 20) -> float:
     return (time.perf_counter() - start) / iters
 
 
-def main() -> None:
+def sweep(sizes=(32, 64, 100, 128, 200, 256, 512), iters: int = 20) -> list:
+    """Time the fused Pallas kernel vs XLA's batched Cholesky chain at each
+    expert size; returns one dict per size (importable — bench.py embeds a
+    compressed sweep in its TPU runs so the artifact is captured on real
+    hardware automatically)."""
     import jax
     import jax.numpy as jnp
 
@@ -43,12 +47,10 @@ def main() -> None:
 
     backend = jax.default_backend()
     interpret = backend != "tpu"
-    if interpret:
-        print(json.dumps({"warning": f"backend={backend}: Pallas runs in "
-                          "interpret mode; timings are NOT meaningful"}))
 
     rng = np.random.default_rng(0)
-    for n in (32, 64, 100, 128, 200, 256, 512):
+    rows = []
+    for n in sizes:
         # batch sized to ~100k matrix elements of work per call
         b = max(8, min(1024, 4_000_000 // (n * n)))
         a = rng.normal(size=(b, n, n)).astype(np.float32)
@@ -56,17 +58,28 @@ def main() -> None:
 
         pallas_fn = jax.jit(lambda m: _pallas_inv_logdet(m, interpret))
         xla_fn = jax.jit(_chol_inv_logdet)
-        t_pallas = _bench(pallas_fn, k)
-        t_xla = _bench(xla_fn, k)
+        t_pallas = _bench(pallas_fn, k, iters)
+        t_xla = _bench(xla_fn, k, iters)
 
-        row = {
+        rows.append({
             "n": n,
             "batch": b,
             "pallas_us_per_matrix": round(t_pallas / b * 1e6, 2),
             "xla_us_per_matrix": round(t_xla / b * 1e6, 2),
             "speedup": round(t_xla / t_pallas, 2),
             "backend": backend,
-        }
+        })
+    return rows
+
+
+def main() -> None:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"warning": f"backend={jax.default_backend()}: "
+                          "Pallas runs in interpret mode; timings are NOT "
+                          "meaningful"}))
+    for row in sweep():
         print(json.dumps(row))
 
 

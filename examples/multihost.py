@@ -9,12 +9,14 @@ Two modes:
 
       python examples/multihost.py --procs 2
 
-* **Worker** (what each pod host runs in production): called with explicit
-  process coordinates.  On a real TPU pod, run this per host with your
-  launcher of choice (the TPU VM runtime populates the environment, so
-  ``dist.initialize()`` needs no arguments there):
+* **Worker** (what each pod host runs in production).  On a real TPU pod,
+  run this per host with your launcher of choice and OMIT ``--port``: the
+  TPU VM runtime populates the environment, so ``dist.initialize()`` is
+  called with no arguments and discovers the coordinator itself
+  (``--port`` wires a 127.0.0.1 coordinator and is only for the local
+  launcher mode above):
 
-      python examples/multihost.py --worker --pid 0 --procs 2 --port 29500
+      python examples/multihost.py --worker
 
 Each worker holds only its own shard of the rows — no process ever sees the
 full dataset; the expert stack, likelihood collectives, active-set draw and
@@ -105,7 +107,15 @@ def main() -> None:
         )
         for pid in range(args.procs)
     ]
-    rc = [p.wait() for p in procs]
+    try:
+        rc = [p.wait(timeout=600) for p in procs]
+    finally:
+        # one crashed worker leaves its peers deadlocked in a collective —
+        # kill survivors instead of hanging the launcher forever
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     if any(rc):
         raise SystemExit(f"worker failures: {rc}")
     print(f"OK: {args.procs}-process distributed fit")

@@ -192,3 +192,36 @@ def test_fit_distributed_with_kmeans_and_greedy_providers():
         pred = model.predict(x)
         rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
         assert rmse < 0.2, (type(provider).__name__, rmse)
+
+
+def test_gpc_fit_distributed_with_greedy_provider():
+    """The classifier's distributed provider path selects over the LATENT
+    targets from the sharded stack (GPClf.scala:62-65 substitutes f for y);
+    greedy must run natively — no fallback warning — and produce a working
+    model."""
+    import warnings
+
+    from spark_gp_tpu import (
+        GaussianProcessClassifier,
+        GreedilyOptimizingActiveSetProvider,
+    )
+    from spark_gp_tpu.utils.validation import accuracy
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(240, 2))
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    mesh = dist.global_expert_mesh()
+    data = dist.distribute_global_experts(x, y, 30, mesh)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        model = (
+            GaussianProcessClassifier()
+            .setDatasetSizeForExpert(30)
+            .setActiveSetSize(40)
+            .setMaxIter(15)
+            .setActiveSetProvider(GreedilyOptimizingActiveSetProvider())
+            .setMesh(mesh)
+            .fit_distributed(data)
+        )
+    assert accuracy(y, model.predict(x)) >= 0.9
