@@ -35,7 +35,9 @@ so this script is a supervisor/worker pair:
 Environment knobs: BENCH_N (default 100000; 20000 on CPU fallback),
 BENCH_EXPERT (100), BENCH_MAXITER (30), BENCH_OPTIMIZER (device),
 BENCH_PREFLIGHT_TIMEOUT (120 s), BENCH_PREFLIGHT_ATTEMPTS (3),
-BENCH_WORKER_TIMEOUT (2400 s).
+BENCH_WORKER_TIMEOUT (2400 s), BENCH_PALLAS_SWEEP (TPU only: "1" [default]
+appends the Pallas-vs-XLA expert-size sweep to the result detail; any
+other value disables it).
 """
 
 from __future__ import annotations

@@ -107,15 +107,22 @@ def main() -> None:
         )
         for pid in range(args.procs)
     ]
-    try:
-        rc = [p.wait(timeout=600) for p in procs]
-    finally:
-        # one crashed worker leaves its peers deadlocked in a collective —
-        # kill survivors instead of hanging the launcher forever
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+    # Poll loop: one crashed worker leaves its peers deadlocked in a
+    # collective, so kill the survivors as soon as any worker fails (and
+    # bound the whole demo at 600s) instead of hanging the launcher.
+    import time
+
+    deadline = time.monotonic() + 600
+    while any(p.poll() is None for p in procs):
+        failed = any(rc not in (None, 0) for rc in (p.poll() for p in procs))
+        if failed or time.monotonic() > deadline:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            break
+        time.sleep(0.2)
+    rc = [p.poll() for p in procs]
     if any(rc):
         raise SystemExit(f"worker failures: {rc}")
     print(f"OK: {args.procs}-process distributed fit")

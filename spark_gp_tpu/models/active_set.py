@@ -198,8 +198,9 @@ def _lloyd(x, centroids, max_iter, mask=None, psum=None):
             precision=jax.lax.Precision.HIGHEST,
         )  # [k, p]
         if psum is not None:
-            counts = psum(counts)
-            sums = psum(sums)
+            # one fused all-reduce per Lloyd step (latency over ICI)
+            fused = psum(jnp.concatenate([sums, counts[:, None]], axis=1))
+            sums, counts = fused[:, :-1], fused[:, -1]
         new_c = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), c
         )

@@ -101,18 +101,31 @@ def _greedy_core(kernel: Kernel, m: int, axis, theta, xf, yf, maskf, first_gidx)
         (ksel, l_mm, l_pd, z, p_vec, q_vec, mu_vec, sel, chosen_x,
          chosen_gidx) = state
         onehot = (gids == gidx).astype(dtype)
-        x_sel = psum(onehot @ xf)  # [p] — the round's cross-device gather
+        # Fused collective 1 — every onehot-derived statistic in ONE psum
+        # (the per-round loop is ICI-latency-bound at m ~ 1000; separate
+        # small all-reduces would dominate it): the selected point's row
+        # [p], the new Kmm column [m], and its diagonal entry [1].
+        fused_a = psum(
+            jnp.concatenate(
+                [onehot @ xf, ksel @ onehot, (k_diag * maskf) @ onehot[:, None]]
+            )
+        )
+        p_dim = xf.shape[1]
+        x_sel = fused_a[:p_dim]
+        kmm_col = fused_a[p_dim:p_dim + m]  # zeros past k: identity-padded
+        kmm_nn = fused_a[p_dim + m]         # factors forward-solve to zero
         # K(x_sel, .) against the local candidates; the Eye/noise component
         # of the model kernel contributes 0 off its own training set
         # (kernel/Kernel.scala:151-161).  Masked so padded slots never feed
         # the factor statistics.
         c_new = kernel.cross(theta, x_sel[None, :], xf)[0] * maskf
+        # Fused collective 2 — every c_new-derived statistic.
+        fused_b = psum(
+            jnp.concatenate(
+                [ksel @ c_new, (c_new @ c_new)[None], (c_new @ yf)[None]]
+            )
+        )
 
-        # Kmm gains column [K(a_j, x_sel)]_j — present in the stored cross
-        # rows; unfilled rows are zero, which the identity-padded factors
-        # forward-solve to zero (no masking needed).
-        kmm_col = psum(ksel @ onehot)
-        kmm_nn = psum(jnp.dot(k_diag * maskf, onehot))
         w = solve(l_mm, kmm_col[:, None])[:, 0]
         d = jnp.sqrt(kmm_nn - w @ w)
         # row k of W = L_mm^-1 K_sel via the transpose-solve identity; uses
@@ -122,8 +135,8 @@ def _greedy_core(kernel: Kernel, m: int, axis, theta, xf, yf, maskf, first_gidx)
         l_mm = l_mm.at[k].set(w.at[k].set(d))
         p_vec = p_vec + w_row * w_row
 
-        pd_col = sigma2 * kmm_col + psum(ksel @ c_new)
-        pd_nn = sigma2 * kmm_nn + psum(c_new @ c_new)
+        pd_col = sigma2 * kmm_col + fused_b[:m]
+        pd_nn = sigma2 * kmm_nn + fused_b[m]
         v = solve(l_pd, pd_col[:, None])[:, 0]
         e = jnp.sqrt(pd_nn - v @ v)
         b = solve_t(l_pd, v[:, None])[:, 0]
@@ -131,7 +144,7 @@ def _greedy_core(kernel: Kernel, m: int, axis, theta, xf, yf, maskf, first_gidx)
         l_pd = l_pd.at[k].set(v.at[k].set(e))
         q_vec = q_vec + v_row * v_row
 
-        z_k = (psum(c_new @ yf) - v @ z) / e
+        z_k = (fused_b[m + 1] - v @ z) / e
         z = z.at[k].set(z_k)
         mu_vec = mu_vec + v_row * z_k
 
