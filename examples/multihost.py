@@ -109,19 +109,24 @@ def main() -> None:
     ]
     # Poll loop: one crashed worker leaves its peers deadlocked in a
     # collective, so kill the survivors as soon as any worker fails (and
-    # bound the whole demo at 600s) instead of hanging the launcher.
+    # bound the whole demo at 600s); the finally also covers Ctrl-C or any
+    # launcher exception — workers must never outlive the launcher.
     import time
 
-    deadline = time.monotonic() + 600
-    while any(p.poll() is None for p in procs):
-        failed = any(rc not in (None, 0) for rc in (p.poll() for p in procs))
-        if failed or time.monotonic() > deadline:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-                    p.wait()
-            break
-        time.sleep(0.2)
+    try:
+        deadline = time.monotonic() + 600
+        while any(p.poll() is None for p in procs):
+            failed = any(
+                rc not in (None, 0) for rc in (p.poll() for p in procs)
+            )
+            if failed or time.monotonic() > deadline:
+                break
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     rc = [p.poll() for p in procs]
     if any(rc):
         raise SystemExit(f"worker failures: {rc}")
