@@ -19,6 +19,8 @@ Parts:
                  expensive path VERDICT r2 flagged as unmeasured)
   protein        46k-shape stand-in, subsampled: RMSE + wall-clock guard
   year_msd       515k-shape stand-in, subsampled: RMSE + wall-clock guard
+  greedy_scale   greedy Seeger selection at the Year-MSD shape (m=512),
+                 wall-clock + quality vs random at the same m
   weak_scaling   1/2/4/8 virtual CPU devices, fixed per-device load, the
                  sharded device-L-BFGS fit (records the curve's shape; on a
                  shared-core host this tracks compile/exec health, not true
@@ -37,8 +39,8 @@ import sys
 import time
 
 _ALL_PARTS = (
-    "airfoil", "gpc_mnist", "protein", "year_msd", "weak_scaling",
-    "pallas_sweep",
+    "airfoil", "gpc_mnist", "protein", "year_msd", "greedy_scale",
+    "weak_scaling", "pallas_sweep",
 )
 
 
@@ -130,15 +132,14 @@ def part_gpc_mnist() -> dict:
     }
 
 
-def _stress_regression(loader, n, expert, active, max_iter) -> dict:
-    _assert_platform()
+def _prep_regression(loader, n):
+    """Shared load/split/standardize prep for the regression parts.
+
+    Returns ``(x, ys, tr, te, y_mean, y_std)`` with features z-scored and
+    targets standardized using training-split statistics only."""
     import numpy as np
 
-    from spark_gp_tpu import (
-        ARDRBFKernel, GaussianProcessRegression, WhiteNoiseKernel,
-    )
     from spark_gp_tpu.ops.scaling import fit_scaler
-    from spark_gp_tpu.utils.validation import rmse
 
     x, y = loader(None, n=n)
     rng = np.random.default_rng(13)
@@ -149,13 +150,28 @@ def _stress_regression(loader, n, expert, active, max_iter) -> dict:
     x = (x - mean) / std
     y_mean, y_std = y[tr].mean(), y[tr].std()
     ys = (y - y_mean) / y_std
+    return x, ys, tr, te, y_mean, y_std
+
+
+def _ard_kernel_factory(p: int):
+    """The stress configs' kernel: dimension-aware ARD init + trained noise."""
+    from spark_gp_tpu import ARDRBFKernel, WhiteNoiseKernel
+
+    return lambda: (
+        1.0 * ARDRBFKernel(p, p ** -0.5) + WhiteNoiseKernel(0.1, 0.0, 1.0)
+    )
+
+
+def _stress_regression(loader, n, expert, active, max_iter) -> dict:
+    _assert_platform()
+    from spark_gp_tpu import GaussianProcessRegression
+    from spark_gp_tpu.utils.validation import rmse
+
+    x, ys, tr, te, y_mean, y_std = _prep_regression(loader, n)
 
     gp = (
         GaussianProcessRegression()
-        .setKernel(
-            lambda: 1.0 * ARDRBFKernel(x.shape[1], x.shape[1] ** -0.5)
-            + WhiteNoiseKernel(0.1, 0.0, 1.0)
-        )
+        .setKernel(_ard_kernel_factory(x.shape[1]))
         .setDatasetSizeForExpert(expert)
         .setActiveSetSize(active)
         .setMaxIter(max_iter)
@@ -165,9 +181,9 @@ def _stress_regression(loader, n, expert, active, max_iter) -> dict:
     model = gp.fit(x[tr], ys[tr])
     fit_seconds = time.perf_counter() - start
     pred_scaled = model.predict(x[te])
-    pred = pred_scaled * y_std + y_mean
+    y_te = ys[te] * y_std + y_mean
     return {
-        "rmse": float(rmse(y[te], pred)),
+        "rmse": float(rmse(y_te, pred_scaled * y_std + y_mean)),
         "rmse_scaled": float(rmse(ys[te], pred_scaled)),
         "n": int(x.shape[0]),
         "p": int(x.shape[1]),
@@ -175,7 +191,7 @@ def _stress_regression(loader, n, expert, active, max_iter) -> dict:
         "active": active,
         "max_iter": max_iter,
         "fit_seconds": fit_seconds,
-        "train_points_per_sec": cut / fit_seconds,
+        "train_points_per_sec": len(tr) / fit_seconds,
         "data": "synthetic stand-in (zero-egress env)",
     }
 
@@ -192,6 +208,56 @@ def part_year_msd() -> dict:
 
     n = int(os.environ.get("QUALITY_YEAR_N", 20000))
     return _stress_regression(load_year_msd, n, 100, 256, 15)
+
+
+def part_greedy_scale() -> dict:
+    """Greedy Seeger selection at the Year-MSD shape (90-d, subsampled N,
+    m = 512): wall-clock + fit quality vs random selection at the same m —
+    the provider the reference caps at toy sizes running at scale."""
+    _assert_platform()
+    from spark_gp_tpu import (
+        GaussianProcessRegression,
+        GreedilyOptimizingActiveSetProvider,
+        RandomActiveSetProvider,
+    )
+    from spark_gp_tpu.data import load_year_msd
+    from spark_gp_tpu.utils.validation import rmse
+
+    n = int(os.environ.get("QUALITY_GREEDY_N", 50000))
+    m = int(os.environ.get("QUALITY_GREEDY_M", 512))
+    x, ys, tr, te, _, _ = _prep_regression(load_year_msd, n)
+
+    def make_gp(provider, max_iter):
+        return (
+            GaussianProcessRegression()
+            .setKernel(_ard_kernel_factory(x.shape[1]))
+            .setDatasetSizeForExpert(100)
+            .setActiveSetSize(m)
+            .setActiveSetProvider(provider)
+            .setMaxIter(max_iter)
+            .setSeed(13)
+        )
+
+    # warm the jit cache OUTSIDE the timed window: the two timed fits share
+    # every executable except the provider's own, so whichever ran first
+    # would otherwise be charged the one-time compile cost
+    make_gp(RandomActiveSetProvider, 1).fit(x[tr], ys[tr])
+
+    out = {"n": int(x.shape[0]), "p": int(x.shape[1]), "m": m}
+    for name, provider in (
+        ("greedy", GreedilyOptimizingActiveSetProvider()),
+        ("random", RandomActiveSetProvider),
+    ):
+        gp = make_gp(provider, 12)
+        start = time.perf_counter()
+        model = gp.fit(x[tr], ys[tr])
+        seconds = time.perf_counter() - start
+        out[name] = {
+            "fit_seconds": seconds,
+            "active_set_seconds": model.instr.timings.get("active_set"),
+            "rmse_scaled": float(rmse(ys[te], model.predict(x[te]))),
+        }
+    return out
 
 
 def part_weak_scaling() -> dict:
