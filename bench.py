@@ -248,6 +248,7 @@ def worker() -> None:
     gpc_n = min(n, max(2000, n // 4))
     gpc_seconds = None
     predict_seconds = None
+    predict_error = None
     gpc_error = None
     try:
         # Prediction throughput (the reference's model.transform hot path):
@@ -257,6 +258,9 @@ def worker() -> None:
         pred_start = time.perf_counter()
         model.predict(x)
         predict_seconds = time.perf_counter() - pred_start
+    except Exception as exc:  # noqa: BLE001 — secondary metric only
+        predict_error = f"{type(exc).__name__}: {exc}"[:200]
+    try:
         from spark_gp_tpu import GaussianProcessClassifier
 
         yc = (y[:gpc_n] > np.median(y[:gpc_n])).astype(np.float64)
@@ -317,6 +321,7 @@ def worker() -> None:
             "predict_points_per_sec": (
                 None if predict_seconds is None else n / predict_seconds
             ),
+            **({"predict_error": predict_error} if predict_error else {}),
             "lbfgs_evals": nfev,
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,

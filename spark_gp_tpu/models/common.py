@@ -84,6 +84,17 @@ class GaussianProcessParams:
         self._seed = int(value)
         return self
 
+    def setAggregationDepth(self, value: int):
+        """API parity no-op: the reference declares this Spark ML param
+        (GaussianProcessParams.scala:9) but never forwards it to either
+        ``treeAggregate`` call (GPC.scala:73, PGPH.scala:25), and on TPU
+        the reduction topology is XLA's choice — psum over ICI picks the
+        ring/tree shape itself.  Accepted (and validated) so reference
+        call sites port without edits."""
+        if int(value) < 1:
+            raise ValueError("aggregation depth must be >= 1")
+        return self
+
     # --- TPU-native extensions -------------------------------------------
     def setMesh(self, mesh):
         """Shard the expert axis over this ``jax.sharding.Mesh`` (1-D)."""
@@ -180,6 +191,7 @@ class GaussianProcessParams:
     set_max_iter = setMaxIter
     set_tol = setTol
     set_seed = setSeed
+    set_aggregation_depth = setAggregationDepth
     set_mesh = setMesh
     set_profile_dir = setProfileDir
     set_checkpoint_dir = setCheckpointDir

@@ -63,3 +63,36 @@ def test_model_save_load_roundtrip(tmp_path):
 
     restored = GaussianProcessRegressionModel.load(path)
     np.testing.assert_allclose(restored.predict(x[:20]), model.predict(x[:20]), rtol=1e-12)
+
+
+def test_duplicate_rows_survive_via_jitter(rng):
+    """Exactly duplicated training rows make K_mm numerically singular; the
+    escalating-jitter PSD repair must keep the fit alive (the reference
+    would throw NotPositiveDefiniteException from its eigSym assert)."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    x = rng.normal(size=(100, 2))
+    x = np.concatenate([x, x[:40]])  # 40 exact duplicates
+    y = np.sin(x.sum(axis=1))
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(120)  # active set will include duplicate pairs
+        .setSigma2(1e-6)        # tiny noise: K_mm is genuinely near-singular
+        .setMaxIter(10)
+        .fit(x, y)
+    )
+    pred = model.predict(x)
+    assert np.all(np.isfinite(pred))
+    assert float(np.sqrt(np.mean((pred - y) ** 2))) < 0.2
+
+
+def test_aggregation_depth_accepted():
+    """Reference API parity: setAggregationDepth exists (the reference
+    declares but never forwards it; XLA owns the reduction shape here)."""
+    from spark_gp_tpu import GaussianProcessRegression
+
+    gp = GaussianProcessRegression().setAggregationDepth(2)
+    assert gp is not None
+    with pytest.raises(ValueError):
+        gp.setAggregationDepth(0)
