@@ -45,6 +45,7 @@ class GaussianProcessParams:
         self._optimizer: str = "auto"
         self._hyper_space: str = "auto"
         self._profile_dir: Optional[str] = None
+        self._predictive_variance: bool = True
 
     # --- reference setter names (GaussianProcessParams.scala:32-53) -------
     def setKernel(self, value: Union[Kernel, Callable[[], Kernel]]):
@@ -99,6 +100,16 @@ class GaussianProcessParams:
     def setMesh(self, mesh):
         """Shard the expert axis over this ``jax.sharding.Mesh`` (1-D)."""
         self._mesh = mesh
+        return self
+
+    def setPredictiveVariance(self, value: bool):
+        """``True`` (default, the reference's behavior): build the [m, m]
+        magic matrix so the model predicts variances.  ``False``: mean-only
+        model — skips the two O(m^3) inverse builds in the magic solve and
+        the [m, m] operator in the saved model, the dominant cost and
+        memory at large active sets (m ~ 10^4: ~800 MB f64 and most of the
+        solve time buys nothing if variances are never read)."""
+        self._predictive_variance = bool(value)
         return self
 
     def setProfileDir(self, path: Optional[str]):
@@ -193,6 +204,7 @@ class GaussianProcessParams:
     set_seed = setSeed
     set_aggregation_depth = setAggregationDepth
     set_mesh = setMesh
+    set_predictive_variance = setPredictiveVariance
     set_profile_dir = setProfileDir
     set_checkpoint_dir = setCheckpointDir
     set_checkpoint_interval = setCheckpointInterval
@@ -392,7 +404,8 @@ class GaussianProcessCommons(GaussianProcessParams):
         active64 = np.asarray(active, dtype=np.float64)
         with instr.phase("magic_solve"):
             magic_vector, magic_matrix = ppa.magic_solve(
-                kernel, theta, active64, u1, u2, mesh=self._mesh
+                kernel, theta, active64, u1, u2, mesh=self._mesh,
+                with_variance=self._predictive_variance,
             )
         return ppa.ProjectedProcessRawPredictor(
             kernel=kernel,

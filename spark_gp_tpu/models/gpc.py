@@ -275,6 +275,12 @@ class GaussianProcessClassificationModel:
         discards.
         """
         f, var = self.raw_predictor(np.asarray(x_test))
+        if averaged and var is None:
+            raise ValueError(
+                "model was fitted with setPredictiveVariance(False); "
+                "averaged probabilities need the latent variance — use "
+                "averaged=False or refit with variances enabled"
+            )
         if averaged:
             from spark_gp_tpu.ops.integrator import Integrator
 

@@ -194,6 +194,11 @@ class GaussianProcessRegressionModel:
 
     def predict_with_var(self, x_test: np.ndarray):
         mean, var = self.raw_predictor(np.asarray(x_test))
+        if var is None:
+            raise ValueError(
+                "model was fitted with setPredictiveVariance(False); "
+                "use predict(), or refit with variances enabled"
+            )
         return np.asarray(mean), np.asarray(var)
 
     def save(self, path: str) -> None:

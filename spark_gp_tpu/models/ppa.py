@@ -234,6 +234,7 @@ def magic_solve(
     u2,
     solve_dtype=np.float64,
     mesh=None,
+    with_variance: bool = True,
 ):
     """f64 solve for (magicVector, magicMatrix) — PGPH.scala:49-60.
 
@@ -243,25 +244,36 @@ def magic_solve(
     is supplied (the blocked distributed Cholesky of ops/dist_linalg.py,
     scaling the O(m^3) with chips), replicated otherwise.  All three paths
     are parity-tested against each other.
+
+    ``with_variance=False`` returns ``(magicVector, None)``: the two
+    inverse builds behind magicMatrix are the dominant O(m^3) cost and the
+    [m, m] result the dominant model memory — a mean-only model skips both
+    (setPredictiveVariance rationale).
     """
     theta64 = np.asarray(theta, dtype=solve_dtype)
     active64 = np.asarray(active, dtype=solve_dtype)
     if active64.shape[0] >= _DEVICE_SOLVE_MIN_M:
         if mesh is not None and mesh.devices.size > 1:
-            return sharded_magic_solve(kernel, theta64, active64, u1, u2, mesh)
-        return magic_solve_device(kernel, theta64, active64, u1, u2)
+            return sharded_magic_solve(
+                kernel, theta64, active64, u1, u2, mesh,
+                with_variance=with_variance,
+            )
+        return magic_solve_device(
+            kernel, theta64, active64, u1, u2, with_variance=with_variance
+        )
     kmm, sn2 = _gram_f64_on_host(kernel, theta64, active64)
     u1 = np.asarray(u1, dtype=solve_dtype)
     u2 = np.asarray(u2, dtype=solve_dtype)
 
     pd_mat = sn2 * kmm + u1
 
-    magic_vector, magic_matrix = _solve_magic_np(pd_mat, kmm, u2, sn2)
-    return magic_vector, magic_matrix
+    return _solve_magic_np(pd_mat, kmm, u2, sn2, with_variance=with_variance)
 
 
-@partial(jax.jit, static_argnums=0)
-def _magic_solve_device_impl(kernel: Kernel, theta, active, u1, u2, tau):
+@partial(jax.jit, static_argnums=(0, 6))
+def _magic_solve_device_impl(
+    kernel: Kernel, theta, active, u1, u2, tau, with_variance=True
+):
     """One jitted f64 solve attempt with trace-relative jitter ``tau`` (a
     traced scalar: every escalation reuses the same executable).  Returns
     the solution plus a finiteness flag (Cholesky of an indefinite matrix
@@ -278,7 +290,6 @@ def _magic_solve_device_impl(kernel: Kernel, theta, active, u1, u2, tau):
         )
 
     l_pd = chol(sn2 * kmm + u1, tau)
-    l_mm = chol(kmm, tau)
 
     def chol_solve(l, b):
         y = jax.lax.linalg.triangular_solve(
@@ -289,14 +300,18 @@ def _magic_solve_device_impl(kernel: Kernel, theta, active, u1, u2, tau):
         )
 
     magic_vector = chol_solve(l_pd, u2[:, None])[:, 0]
+    ok = jnp.all(jnp.isfinite(jnp.diagonal(l_pd)))
+    if not with_variance:
+        return magic_vector, jnp.zeros((0, 0), u1.dtype), ok
+    l_mm = chol(kmm, tau)
     magic_matrix = sn2 * chol_solve(l_pd, eye) - chol_solve(l_mm, eye)
-    ok = jnp.all(jnp.isfinite(jnp.diagonal(l_pd))) & jnp.all(
-        jnp.isfinite(jnp.diagonal(l_mm))
-    )
+    ok = ok & jnp.all(jnp.isfinite(jnp.diagonal(l_mm)))
     return magic_vector, magic_matrix, ok
 
 
-def magic_solve_device(kernel: Kernel, theta64, active64, u1, u2):
+def magic_solve_device(
+    kernel: Kernel, theta64, active64, u1, u2, with_variance: bool = True
+):
     """Device f64 magic solve for large active sets (m >~ 2k): Cholesky +
     triangular solves as one XLA program, with the same escalating
     trace-relative jitter semantics as the host path
@@ -311,7 +326,7 @@ def magic_solve_device(kernel: Kernel, theta64, active64, u1, u2):
         for k, tau in enumerate(_JITTER_SCHEDULE):
             mv, mm, ok = _magic_solve_device_impl(
                 kernel, theta_d, active_d, u1_d, u2_d,
-                jnp.asarray(tau, jnp.float64),
+                jnp.asarray(tau, jnp.float64), with_variance,
             )
             if bool(ok):
                 if k > 0:
@@ -321,7 +336,9 @@ def magic_solve_device(kernel: Kernel, theta64, active64, u1, u2):
                         "device magic solve required relative jitter %.3e "
                         "for positive definiteness", tau,
                     )
-                return np.asarray(mv), np.asarray(mm)
+                return np.asarray(mv), (
+                    np.asarray(mm) if with_variance else None
+                )
     raise NotPositiveDefiniteException()
 
 
@@ -373,10 +390,9 @@ def _psd_safe_cholesky(mat, name):
     raise NotPositiveDefiniteException()
 
 
-def _solve_magic_np(pd_mat, kmm, u2, sn2):
+def _solve_magic_np(pd_mat, kmm, u2, sn2, with_variance: bool = True):
     """numpy f64 Cholesky solves; raises NotPositiveDefiniteException."""
     l_pd = _psd_safe_cholesky(pd_mat, "sigma2*K_mm + Kmn*Knm")
-    l_mm = _psd_safe_cholesky(kmm, "K_mm")
 
     def chol_solve_np(l, b):
         from scipy.linalg import solve_triangular
@@ -385,6 +401,9 @@ def _solve_magic_np(pd_mat, kmm, u2, sn2):
         return solve_triangular(l, y, lower=True, trans=1)
 
     magic_vector = chol_solve_np(l_pd, u2)
+    if not with_variance:
+        return magic_vector, None
+    l_mm = _psd_safe_cholesky(kmm, "K_mm")
     eye = np.eye(pd_mat.shape[0])
     pd_inv = chol_solve_np(l_pd, eye)
     kmm_inv = chol_solve_np(l_mm, eye)
@@ -415,7 +434,8 @@ def _sharded_solve_helpers(mesh):
 
 
 def sharded_magic_solve(
-    kernel: Kernel, theta64, active64, u1, u2, mesh, block: int = 128
+    kernel: Kernel, theta64, active64, u1, u2, mesh, block: int = 128,
+    with_variance: bool = True,
 ):
     """Mesh-sharded f64 magic solve: the m x m factorizations run as the
     blocked distributed Cholesky of :mod:`spark_gp_tpu.ops.dist_linalg`
@@ -450,11 +470,19 @@ def sharded_magic_solve(
 
         for k, tau in enumerate(_JITTER_SCHEDULE):
             pd_pad = dist_linalg.pad_spd(_jittered(pd, tau, eye_scale_pd), m_pad)
-            kmm_pad = dist_linalg.pad_spd(
-                _jittered(kmm, tau, eye_scale_mm), m_pad
-            )
             l_pd = dist_linalg.sharded_cholesky(mesh, jnp.asarray(pd_pad), block)
-            l_mm = dist_linalg.sharded_cholesky(mesh, jnp.asarray(kmm_pad), block)
+            if with_variance:
+                kmm_pad = dist_linalg.pad_spd(
+                    _jittered(kmm, tau, eye_scale_mm), m_pad
+                )
+                l_mm = dist_linalg.sharded_cholesky(
+                    mesh, jnp.asarray(kmm_pad), block
+                )
+            else:
+                # mean-only: K_mm is never factored (the whole point at
+                # large m), and the retry gate must not depend on it —
+                # matching the host/device branches' semantics
+                l_mm = l_pd
             if not bool(finite_ok(l_pd, l_mm)):
                 continue
             if k > 0:
@@ -467,6 +495,8 @@ def sharded_magic_solve(
             magic_vector = np.asarray(
                 replicate(dist_linalg.sharded_chol_solve(mesh, l_pd, u2_pad, block))
             )[:m]
+            if not with_variance:
+                return magic_vector, None
             eye_pad = jnp.eye(m_pad, dtype=jnp.float64)
             pd_inv = dist_linalg.sharded_chol_solve(mesh, l_pd, eye_pad, block)
             kmm_inv = dist_linalg.sharded_chol_solve(mesh, l_mm, eye_pad, block)
@@ -491,10 +521,15 @@ class ProjectedProcessRawPredictor:
     theta: np.ndarray
     active: np.ndarray
     magic_vector: np.ndarray
-    magic_matrix: np.ndarray
+    magic_matrix: np.ndarray  # None for mean-only models (setPredictiveVariance(False))
 
     def predict_fn(self):
         """Returns a jittable ``x_test [t, p] -> (mean [t], var [t])``."""
+        if self.magic_matrix is None:
+            raise ValueError(
+                "model was fitted with setPredictiveVariance(False); "
+                "no variance operator is available"
+            )
         return partial(_predict_impl, self.kernel)
 
     # cap on the [t, m] cross-kernel intermediate per dispatch: 32M entries
@@ -503,20 +538,23 @@ class ProjectedProcessRawPredictor:
     _PREDICT_CHUNK_ELEMS = 32 * 1024 * 1024
 
     def __call__(self, x_test):
+        """``(mean [t], var [t])`` — ``var`` is None for mean-only models."""
         x_test = jnp.asarray(x_test)
         dtype = jnp.result_type(x_test.dtype)
+        mean_only = self.magic_matrix is None
         args = (
             self.kernel,
             jnp.asarray(self.theta, dtype=dtype),
             jnp.asarray(self.active, dtype=dtype),
             jnp.asarray(self.magic_vector, dtype=dtype),
-            jnp.asarray(self.magic_matrix, dtype=dtype),
-        )
+        ) + (() if mean_only else (jnp.asarray(self.magic_matrix, dtype=dtype),))
+        predict = _predict_mean_jit if mean_only else _predict_jit
         t = x_test.shape[0]
         m = max(1, self.active.shape[0])
         chunk = max(1, self._PREDICT_CHUNK_ELEMS // m)
         if t <= chunk:
-            return _predict_jit(*args, jnp.asarray(x_test, dtype=dtype))
+            out = predict(*args, jnp.asarray(x_test, dtype=dtype))
+            return (out, None) if mean_only else out
         # fixed chunk shape (last chunk padded) -> one compiled executable
         means, vars_ = [], []
         for start in range(0, t, chunk):
@@ -526,10 +564,15 @@ class ProjectedProcessRawPredictor:
                 part = jnp.concatenate(
                     [part, jnp.broadcast_to(part[:1], (pad, part.shape[1]))]
                 )
-            mean, var = _predict_jit(*args, jnp.asarray(part, dtype=dtype))
+            out = predict(*args, jnp.asarray(part, dtype=dtype))
+            mean, var = (out, None) if mean_only else out
             means.append(mean[: chunk - pad] if pad else mean)
-            vars_.append(var[: chunk - pad] if pad else var)
-        return jnp.concatenate(means), jnp.concatenate(vars_)
+            if var is not None:
+                vars_.append(var[: chunk - pad] if pad else var)
+        return (
+            jnp.concatenate(means),
+            jnp.concatenate(vars_) if vars_ else None,
+        )
 
 
 def _predict_impl(kernel, theta, active, magic_vector, magic_matrix, x_test):
@@ -544,3 +587,11 @@ def _predict_impl(kernel, theta, active, magic_vector, magic_matrix, x_test):
 
 
 _predict_jit = jax.jit(_predict_impl, static_argnums=0)
+
+
+def _predict_mean_impl(kernel, theta, active, magic_vector, x_test):
+    """Mean-only prediction: ``cross . magicVector`` (no [m, m] operator)."""
+    return kernel.cross(theta, x_test, active) @ magic_vector
+
+
+_predict_mean_jit = jax.jit(_predict_mean_impl, static_argnums=0)

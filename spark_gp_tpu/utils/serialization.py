@@ -30,7 +30,11 @@ def save_model(path: str, model, kind: str) -> None:
         theta=raw.theta,
         active=raw.active,
         magic_vector=raw.magic_vector,
-        magic_matrix=raw.magic_matrix,
+        # mean-only models (setPredictiveVariance(False)) have no [m, m]
+        # operator; an empty sentinel round-trips to None
+        magic_matrix=(
+            np.zeros((0, 0)) if raw.magic_matrix is None else raw.magic_matrix
+        ),
         kernel_pickle=np.frombuffer(
             pickle.dumps(raw.kernel), dtype=np.uint8
         ),
@@ -44,12 +48,13 @@ def load_model(path: str):
     with np.load(_normalize(path), allow_pickle=False) as data:
         kind = str(data["kind"])
         kernel = pickle.loads(data["kernel_pickle"].tobytes())
+        magic_matrix = data["magic_matrix"]
         raw = ProjectedProcessRawPredictor(
             kernel=kernel,
             theta=data["theta"],
             active=data["active"],
             magic_vector=data["magic_vector"],
-            magic_matrix=data["magic_matrix"],
+            magic_matrix=None if magic_matrix.size == 0 else magic_matrix,
         )
     if kind == "classification":
         return GaussianProcessClassificationModel(raw)

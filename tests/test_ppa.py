@@ -242,3 +242,67 @@ def test_chunked_prediction_matches_unchunked(rng):
     # different tilings/reduction orders on accelerator backends
     np.testing.assert_allclose(mean_ch, mean_one, rtol=1e-12, atol=1e-13)
     np.testing.assert_allclose(var_ch, var_one, rtol=1e-12, atol=1e-13)
+
+
+def test_mean_only_model(rng, tmp_path):
+    """setPredictiveVariance(False): mean identical to the full model, no
+    [m, m] operator built, informative errors on variance access, and
+    save/load round-trips the mean-only form (all three magic-solve
+    branches honor with_variance — host checked here, device/sharded via
+    their parity tests plus the dispatch flag)."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+    from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
+
+    x = rng.normal(size=(300, 2))
+    y = np.sin(x.sum(axis=1))
+
+    def gp(variance):
+        return (
+            GaussianProcessRegression()
+            .setKernel(lambda: RBFKernel(1.0))
+            .setActiveSetSize(60)
+            .setMaxIter(10)
+            .setSeed(5)
+            .setPredictiveVariance(variance)
+        )
+
+    full = gp(True).fit(x, y)
+    mean_only = gp(False).fit(x, y)
+    assert mean_only.raw_predictor.magic_matrix is None
+    np.testing.assert_allclose(
+        mean_only.predict(x), full.predict(x), rtol=1e-10, atol=1e-12
+    )
+    with pytest.raises(ValueError, match="setPredictiveVariance"):
+        mean_only.predict_with_var(x)
+
+    path = str(tmp_path / "mean_only.npz")
+    mean_only.save(path)
+    loaded = GaussianProcessRegressionModel.load(path)
+    assert loaded.raw_predictor.magic_matrix is None
+    np.testing.assert_allclose(loaded.predict(x), mean_only.predict(x))
+
+
+def test_mean_only_device_and_sharded_solvers(rng, eight_device_mesh):
+    """with_variance=False on the device and mesh-sharded branches returns
+    the same magic vector as the full solve, and None for the matrix."""
+    m = 300
+    kernel = RBFKernel(1.5) + Const(1e-3) * EyeKernel()
+    theta = np.asarray(kernel.init_theta(), dtype=np.float64)
+    active = rng.normal(size=(m, 3))
+    b = rng.normal(size=(m, m)) / np.sqrt(m)
+    u1 = b @ b.T * m * 0.01
+    u2 = rng.normal(size=m)
+
+    mv_full, _ = ppa.magic_solve(kernel, theta, active, u1, u2)
+    mv_dev, mm_dev = ppa.magic_solve_device(
+        kernel, theta, active, u1, u2, with_variance=False
+    )
+    assert mm_dev is None
+    np.testing.assert_allclose(mv_dev, mv_full, rtol=1e-9, atol=1e-11)
+
+    mv_sh, mm_sh = ppa.sharded_magic_solve(
+        kernel, theta, active, u1, u2, eight_device_mesh, block=16,
+        with_variance=False,
+    )
+    assert mm_sh is None
+    np.testing.assert_allclose(mv_sh, mv_full, rtol=1e-8, atol=1e-10)
