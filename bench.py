@@ -35,9 +35,9 @@ so this script is a supervisor/worker pair:
 Environment knobs: BENCH_N (default 100000; 20000 on CPU fallback),
 BENCH_EXPERT (100), BENCH_MAXITER (30), BENCH_OPTIMIZER (device),
 BENCH_PREFLIGHT_TIMEOUT (120 s), BENCH_PREFLIGHT_ATTEMPTS (3),
-BENCH_WORKER_TIMEOUT (2400 s), BENCH_PALLAS_SWEEP (TPU only: "1" [default]
-appends the Pallas-vs-XLA expert-size sweep to the result detail; any
-other value disables it).
+BENCH_WORKER_TIMEOUT (2400 s), BENCH_PALLAS_SWEEP / BENCH_AIRFOIL (TPU
+only: "1" [default] appends the Pallas-vs-XLA expert-size sweep / the
+airfoil 10-fold parity bar to the result detail; any other value disables).
 """
 
 from __future__ import annotations
@@ -350,22 +350,38 @@ def worker() -> None:
     # this line from the killed worker's captured output
     print(json.dumps(result), flush=True)
 
-    # On real hardware, piggyback the Pallas-vs-XLA expert-size sweep so the
-    # driver's bench run records it without a separate TPU session; re-emit
-    # the enriched result as the (last-line-wins) final JSON.
-    if platform == "tpu" and os.environ.get("BENCH_PALLAS_SWEEP", "1") == "1":
+    # On real hardware, piggyback extra artifacts the driver's bench run
+    # can capture without a separate TPU session (each fenced; the result
+    # is re-emitted after each so the last complete line always carries
+    # the most data): the Pallas-vs-XLA expert-size sweep, and the airfoil
+    # 10-fold parity bar on the f32 device path (the reference's < 2.1
+    # assert, Airfoil.scala:24 — quality.py records it on CPU; this is the
+    # on-chip number).
+    def _fenced_extra(env_var: str, key: str, fn) -> None:
+        if platform != "tpu" or os.environ.get(env_var, "1") != "1":
+            return
         try:
-            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-            from benchmarks.pallas_sweep import sweep as _pallas_sweep
-
-            result["detail"]["pallas_sweep"] = _pallas_sweep(
-                sizes=(32, 64, 100, 128, 256, 512), iters=10
-            )
+            result["detail"][key] = fn()
         except Exception as exc:  # noqa: BLE001 — secondary artifact only
-            result["detail"]["pallas_sweep"] = [
-                {"error": f"{type(exc).__name__}: {exc}"[:200]}
-            ]
+            result["detail"][key] = {
+                "error": f"{type(exc).__name__}: {exc}"[:200]
+            }
         print(json.dumps(result), flush=True)
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    def _run_pallas_sweep():
+        from benchmarks.pallas_sweep import sweep as _pallas_sweep
+
+        return _pallas_sweep(sizes=(32, 64, 100, 128, 256, 512), iters=10)
+
+    def _run_airfoil():
+        from quality import part_airfoil
+
+        return part_airfoil()
+
+    _fenced_extra("BENCH_PALLAS_SWEEP", "pallas_sweep", _run_pallas_sweep)
+    _fenced_extra("BENCH_AIRFOIL", "airfoil_10fold", _run_airfoil)
 
 
 def supervise() -> int:

@@ -189,8 +189,9 @@ class GaussianProcessRegressionModel:
         self.instr: Optional[Instrumentation] = None
 
     def predict(self, x_test: np.ndarray) -> np.ndarray:
-        mean, _ = self.raw_predictor(np.asarray(x_test))
-        return np.asarray(mean)
+        # mean-only path even on full models: the variance would be
+        # computed (O(t m^2)) just to be discarded
+        return np.asarray(self.raw_predictor.predict_mean(np.asarray(x_test)))
 
     def predict_with_var(self, x_test: np.ndarray):
         mean, var = self.raw_predictor(np.asarray(x_test))

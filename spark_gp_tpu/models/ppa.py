@@ -40,6 +40,7 @@ from __future__ import annotations
 import functools
 from dataclasses import dataclass
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -521,7 +522,8 @@ class ProjectedProcessRawPredictor:
     theta: np.ndarray
     active: np.ndarray
     magic_vector: np.ndarray
-    magic_matrix: np.ndarray  # None for mean-only models (setPredictiveVariance(False))
+    # None for mean-only models (setPredictiveVariance(False))
+    magic_matrix: Optional[np.ndarray]
 
     def predict_fn(self):
         """Returns a jittable ``x_test [t, p] -> (mean [t], var [t])``."""
@@ -537,11 +539,19 @@ class ProjectedProcessRawPredictor:
     # fixed-size chunks instead of materializing one [t, m] matrix.
     _PREDICT_CHUNK_ELEMS = 32 * 1024 * 1024
 
+    def predict_mean(self, x_test):
+        """Mean-only prediction ``[t]`` — skips the O(t m^2) variance
+        einsum entirely; works on full and mean-only models alike (the
+        cheap path for every caller that discards the variance)."""
+        return self._run(x_test, mean_only=True)[0]
+
     def __call__(self, x_test):
         """``(mean [t], var [t])`` — ``var`` is None for mean-only models."""
+        return self._run(x_test, mean_only=self.magic_matrix is None)
+
+    def _run(self, x_test, mean_only: bool):
         x_test = jnp.asarray(x_test)
         dtype = jnp.result_type(x_test.dtype)
-        mean_only = self.magic_matrix is None
         args = (
             self.kernel,
             jnp.asarray(self.theta, dtype=dtype),
