@@ -14,6 +14,13 @@ Prints one JSON line per size:
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+# runnable as ``python benchmarks/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import json
 import time
 

@@ -7,6 +7,13 @@ UCI airfoil self-noise (1503 rows, 5 features), z-scored; kernel
 Run: python examples/airfoil.py [--folds 10]
 """
 
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 
 import numpy as np

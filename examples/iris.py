@@ -6,6 +6,13 @@ active 30; prints 10-fold CV accuracy.
 Run: python examples/iris.py [--folds 10]
 """
 
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 
 import numpy as np
@@ -15,15 +22,21 @@ from spark_gp_tpu.data import load_iris
 from spark_gp_tpu.utils.validation import OneVsRest, accuracy, kfold_indices
 
 
+def make_gpc():
+    """The reference's iris configuration (Iris.scala:26): expert 20, active 30.
+
+    Single definition shared with quality.py's recorded artifact so the
+    measured model can never drift from the documented example.
+    """
+    return GaussianProcessClassifier().setDatasetSizeForExpert(20).setActiveSetSize(30)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--folds", type=int, default=10)
     args = parser.parse_args()
 
     x, y = load_iris()
-
-    def make_gpc():
-        return GaussianProcessClassifier().setDatasetSizeForExpert(20).setActiveSetSize(30)
 
     scores = []
     for train_idx, test_idx in kfold_indices(x.shape[0], args.folds, seed=13):

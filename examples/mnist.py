@@ -12,6 +12,13 @@ two-class problem of the same shape keeps the pipeline runnable.
 Run: python examples/mnist.py [--csv path] [--expert 100] [--active 100]
 """
 
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 
 import numpy as np

@@ -23,6 +23,13 @@ full dataset; the expert stack, likelihood collectives, active-set draw and
 PPA statistics all run as mesh programs.
 """
 
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 import os
 import subprocess

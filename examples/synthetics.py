@@ -7,6 +7,13 @@ sigma2 1e-3; asserts 10-fold CV RMSE < 0.11.
 Run: python examples/synthetics.py [--folds 10]
 """
 
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 
 from spark_gp_tpu import (

@@ -9,6 +9,13 @@ Run: python examples/year_msd.py [--csv path] [--n N] [--expert 100]
      [--active 1000] [--maxiter 30] [--devices K]
 """
 
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -105,18 +105,11 @@ def part_iris() -> dict:
     without asserting, Iris.scala:35; recorded here so regressions in the
     OvR/Laplace path are visible)."""
     _assert_platform()
-    from spark_gp_tpu import GaussianProcessClassifier
+    from examples.iris import make_gpc  # single source of the Iris.scala:26 config
     from spark_gp_tpu.data import load_iris
     from spark_gp_tpu.utils.validation import OneVsRest, accuracy, cross_validate
 
     x, y = load_iris()
-
-    def make_gpc():
-        return (
-            GaussianProcessClassifier()
-            .setDatasetSizeForExpert(20)
-            .setActiveSetSize(30)
-        )
 
     start = time.perf_counter()
     score = cross_validate(

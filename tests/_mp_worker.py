@@ -10,7 +10,13 @@ Run (by the test): python tests/_mp_worker.py <pid> <nproc> <port>
 """
 
 import json
+import os
 import sys
+
+# launched as ``python tests/_mp_worker.py`` — sys.path[0] is tests/, so the
+# package root must be added explicitly (the parent's pytest path setup does
+# not cross the process boundary)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
