@@ -98,6 +98,7 @@ def test_custom_vjp_matches_autodiff_cholesky():
     )
 
 
+@pytest.mark.tpu
 @pytest.mark.skipif(
     jax.default_backend() != "tpu", reason="needs real TPU (Mosaic lowering)"
 )
