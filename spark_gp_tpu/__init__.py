@@ -35,11 +35,15 @@ from spark_gp_tpu.kernels import (
     ARDMatern52Kernel,
     ARDRBFKernel,
     Const,
+    DotProductKernel,
     EyeKernel,
     Kernel,
     Matern12Kernel,
     Matern32Kernel,
     Matern52Kernel,
+    PeriodicKernel,
+    PolynomialKernel,
+    RationalQuadraticKernel,
     RBFKernel,
     Scalar,
     SumKernel,
@@ -72,6 +76,10 @@ __all__ = [
     "Matern52Kernel",
     "ARDMatern32Kernel",
     "ARDMatern52Kernel",
+    "RationalQuadraticKernel",
+    "PeriodicKernel",
+    "DotProductKernel",
+    "PolynomialKernel",
     "EyeKernel",
     "WhiteNoiseKernel",
     "SumKernel",

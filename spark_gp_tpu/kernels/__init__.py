@@ -16,6 +16,12 @@ from spark_gp_tpu.kernels.base import (
     TrainableScaleKernel,
     WhiteNoiseKernel,
 )
+from spark_gp_tpu.kernels.families import (
+    DotProductKernel,
+    PeriodicKernel,
+    PolynomialKernel,
+    RationalQuadraticKernel,
+)
 from spark_gp_tpu.kernels.matern import (
     ARDMatern32Kernel,
     ARDMatern52Kernel,
@@ -42,4 +48,8 @@ __all__ = [
     "Matern52Kernel",
     "ARDMatern32Kernel",
     "ARDMatern52Kernel",
+    "RationalQuadraticKernel",
+    "PeriodicKernel",
+    "DotProductKernel",
+    "PolynomialKernel",
 ]

@@ -57,10 +57,10 @@ def _flat_stats(kernel: Kernel, theta, active, xf, yf, maskf):
     """(K_mn K_nm, K_mn y) over a flat ``[c, p]`` point chunk — one big
     MXU matmul with the m axis as rows, instead of c/s tiny per-expert
     matmuls (the expert structure is irrelevant to these sums)."""
+    from spark_gp_tpu.ops.distance import mxu_inner
+
     kmn = kernel.cross(theta, active, xf) * maskf[None, :]  # [m, c]
-    u1 = jax.lax.dot_general(
-        kmn, kmn, (((1,), (1,)), ((), ())), precision=jax.lax.Precision.HIGHEST
-    )
+    u1 = mxu_inner(kmn, kmn)
     u2 = kmn @ (yf * maskf)
     return u1, u2
 

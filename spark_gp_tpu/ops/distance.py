@@ -16,6 +16,18 @@ import jax
 import jax.numpy as jnp
 
 
+def mxu_inner(x1: jax.Array, x2: jax.Array) -> jax.Array:
+    """``[n1, p], [n2, p] -> [n1, n2]`` pairwise inner products as one MXU
+    matmul at HIGHEST precision — the single home of the "contract feature
+    dim, full-f32 accumulation" convention every kernel rides."""
+    return jax.lax.dot_general(
+        x1,
+        x2,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
 def sq_dist(x1: jax.Array, x2: jax.Array) -> jax.Array:
     """``[n1, p], [n2, p] -> [n1, n2]`` matrix of squared Euclidean distances.
 
@@ -23,16 +35,9 @@ def sq_dist(x1: jax.Array, x2: jax.Array) -> jax.Array:
     floating point, and a negative squared distance would poison ``exp``-based
     kernels' gradients.
     """
-    # Promote to at least f32: the MXU path for the inner products.
     n1 = jnp.sum(x1 * x1, axis=-1)[:, None]
     n2 = jnp.sum(x2 * x2, axis=-1)[None, :]
-    inner = jax.lax.dot_general(
-        x1,
-        x2,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    return jnp.maximum(n1 + n2 - 2.0 * inner, 0.0)
+    return jnp.maximum(n1 + n2 - 2.0 * mxu_inner(x1, x2), 0.0)
 
 
 def weighted_sq_dist(x1: jax.Array, x2: jax.Array, w: jax.Array) -> jax.Array:
