@@ -1,9 +1,12 @@
 """Iris example — classification/examples/Iris.scala:10-36.
 
-3-class iris via one-vs-rest over the binary GP classifier; expert 20,
-active 30; prints 10-fold CV accuracy.
+3-class iris via one-vs-rest over the binary GP classifier (the
+reference's exact setup); expert 20, active 30; prints 10-fold CV
+accuracy.  ``--native`` switches to the native multiclass softmax-Laplace
+estimator instead — one coupled model per fold rather than 3 binary fits
+(capability beyond the reference).
 
-Run: python examples/iris.py [--folds 10]
+Run: python examples/iris.py [--folds 10] [--native]
 """
 
 import os as _os
@@ -31,17 +34,35 @@ def make_gpc():
     return GaussianProcessClassifier().setDatasetSizeForExpert(20).setActiveSetSize(30)
 
 
+def make_native_gpc():
+    """Native multiclass variant at the same expert/active configuration."""
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+
+    return (
+        GaussianProcessMulticlassClassifier()
+        .setDatasetSizeForExpert(20)
+        .setActiveSetSize(30)
+    )
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--folds", type=int, default=10)
+    parser.add_argument(
+        "--native", action="store_true",
+        help="native multiclass softmax-Laplace instead of one-vs-rest",
+    )
     args = parser.parse_args()
 
     x, y = load_iris()
 
     scores = []
     for train_idx, test_idx in kfold_indices(x.shape[0], args.folds, seed=13):
-        ovr = OneVsRest(make_gpc).fit(x[train_idx], y[train_idx])
-        scores.append(accuracy(y[test_idx], ovr.predict(x[test_idx])))
+        if args.native:
+            clf = make_native_gpc().fit(x[train_idx], y[train_idx])
+        else:
+            clf = OneVsRest(make_gpc).fit(x[train_idx], y[train_idx])
+        scores.append(accuracy(y[test_idx], clf.predict(x[test_idx])))
     print("Accuracy: " + str(float(np.mean(scores))))
 
 

@@ -57,6 +57,10 @@ from spark_gp_tpu.models.gpc import (
     GaussianProcessClassifier,
     GaussianProcessClassificationModel,
 )
+from spark_gp_tpu.models.gpc_mc import (
+    GaussianProcessMulticlassClassifier,
+    GaussianProcessMulticlassModel,
+)
 from spark_gp_tpu.models.active_set import (
     ActiveSetProvider,
     GreedilyOptimizingActiveSetProvider,
@@ -89,6 +93,8 @@ __all__ = [
     "GaussianProcessRegressionModel",
     "GaussianProcessClassifier",
     "GaussianProcessClassificationModel",
+    "GaussianProcessMulticlassClassifier",
+    "GaussianProcessMulticlassModel",
     "ActiveSetProvider",
     "RandomActiveSetProvider",
     "KMeansActiveSetProvider",

@@ -54,14 +54,17 @@ from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 
 
 def _flat_stats(kernel: Kernel, theta, active, xf, yf, maskf):
-    """(K_mn K_nm, K_mn y) over a flat ``[c, p]`` point chunk — one big
+    """(K_mn K_nm, K_mn Y) over a flat ``[c, p]`` point chunk — one big
     MXU matmul with the m axis as rows, instead of c/s tiny per-expert
-    matmuls (the expert structure is irrelevant to these sums)."""
+    matmuls (the expert structure is irrelevant to these sums).  ``yf`` is
+    ``[c]`` (single target) or ``[c, C]`` (multi-target: the multiclass
+    latent heads share U1 and differ only in the right-hand sides)."""
     from spark_gp_tpu.ops.distance import mxu_inner
 
     kmn = kernel.cross(theta, active, xf) * maskf[None, :]  # [m, c]
     u1 = mxu_inner(kmn, kmn)
-    u2 = kmn @ (yf * maskf)
+    ym = yf * (maskf if yf.ndim == 1 else maskf[:, None])
+    u2 = kmn @ ym
     return u1, u2
 
 
@@ -78,12 +81,20 @@ def kmn_stats(kernel: Kernel, theta, active, data: ExpertData):
     memory-bounded chunks via ``lax.scan``, each chunk one MXU matmul.
     """
     e, s, p = data.x.shape
-    m = active.shape[0]
-    n_flat = e * s
-    xf = data.x.reshape(n_flat, p)
-    yf = data.y.reshape(n_flat)
-    maskf = data.mask.reshape(n_flat)
+    u1, u2 = _kmn_stats_flat(
+        kernel, theta, active,
+        data.x.reshape(e * s, p),
+        data.y.reshape(e * s),
+        data.mask.reshape(e * s),
+    )
+    return u1, u2
 
+
+def _kmn_stats_flat(kernel: Kernel, theta, active, xf, yf, maskf):
+    """Chunked (U1, U2) accumulation over flat points; ``yf`` is ``[n]``
+    or ``[n, C]`` (see ``_flat_stats``)."""
+    n_flat, p = xf.shape
+    m = active.shape[0]
     chunk = max(1, min(n_flat, _STATS_CHUNK_ELEMS // max(m, 1)))
     n_chunks = -(-n_flat // chunk)
     if n_chunks <= 1:
@@ -95,25 +106,24 @@ def kmn_stats(kernel: Kernel, theta, active, data: ExpertData):
     # non-finite at the zero point and NaN * 0 would poison U1 (same benign-
     # padding convention as group_for_experts).
     xf = jnp.concatenate([xf, jnp.broadcast_to(xf[:1], (pad, p))], axis=0)
-    yf = jnp.pad(yf, ((0, pad),))
+    yf = jnp.pad(yf, ((0, pad),) + ((0, 0),) * (yf.ndim - 1))
     maskf = jnp.pad(maskf, ((0, pad),))
 
     def body(carry, args):
         u1, u2 = carry
-        xc, yc, mc = args
-        du1, du2 = _flat_stats(kernel, theta, active, xc, yc, mc)
+        du1, du2 = _flat_stats(kernel, theta, active, *args)
         return (u1 + du1, u2 + du2), None
 
     init = (
         jnp.zeros((m, m), dtype=xf.dtype),
-        jnp.zeros((m,), dtype=xf.dtype),
+        jnp.zeros((m,) + yf.shape[1:], dtype=xf.dtype),
     )
     (u1, u2), _ = jax.lax.scan(
         body,
         init,
         (
-            xf.reshape(n_chunks, chunk, p),
-            yf.reshape(n_chunks, chunk),
+            xf.reshape((n_chunks, chunk, p)),
+            yf.reshape((n_chunks, chunk) + yf.shape[1:]),
             maskf.reshape(n_chunks, chunk),
         ),
     )
@@ -122,11 +132,27 @@ def kmn_stats(kernel: Kernel, theta, active, data: ExpertData):
 
 @partial(jax.jit, static_argnums=0)
 def kmn_stats_jit(kernel: Kernel, theta, active, x, y, mask):
-    return kmn_stats(kernel, theta, active, ExpertData(x=x, y=y, mask=mask))
+    """Jitted (U1, U2) over an expert stack.  Rank-generic in the targets:
+    ``y [E, s]`` gives the reference's single-target u2 ``[m]``;
+    ``y [E, s, C]`` gives one shared U1 and per-column U2 ``[m, C]`` (the
+    multiclass PPA build — the C latent stacks share the kernel and active
+    set, so everything but the right-hand sides is common)."""
+    e, s, p = x.shape
+    return _kmn_stats_flat(
+        kernel, theta, active,
+        x.reshape(e * s, p),
+        y.reshape((e * s,) + y.shape[2:]),
+        mask.reshape(e * s),
+    )
 
 
 @partial(jax.jit, static_argnums=(0, 1))
 def _sharded_kmn_stats_impl(kernel: Kernel, mesh, theta, active, x, y, mask):
+    """Sharded (U1, U2): experts sharded, active set replicated, one psum
+    over ICI (PGPH.scala:25-35).  Rank-generic in ``y`` exactly like
+    :func:`kmn_stats_jit` (``[E, s]`` -> u2 ``[m]``; ``[E, s, C]`` ->
+    U2 ``[m, C]``)."""
+
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -134,8 +160,13 @@ def _sharded_kmn_stats_impl(kernel: Kernel, mesh, theta, active, x, y, mask):
         out_specs=(P(), P()),
     )
     def sharded(theta_, active_, x_, y_, mask_):
-        local = ExpertData(x=x_, y=y_, mask=mask_)
-        u1, u2 = kmn_stats(kernel, theta_, active_, local)
+        e, s, p = x_.shape
+        u1, u2 = _kmn_stats_flat(
+            kernel, theta_, active_,
+            x_.reshape(e * s, p),
+            y_.reshape((e * s,) + y_.shape[2:]),
+            mask_.reshape(e * s),
+        )
         return (
             jax.lax.psum(u1, EXPERT_AXIS),
             jax.lax.psum(u2, EXPERT_AXIS),
@@ -151,6 +182,12 @@ def make_sharded_kmn_stats(kernel: Kernel, mesh):
     return lambda theta, active, data: _sharded_kmn_stats_impl(
         kernel, mesh, theta, active, data.x, data.y, data.mask
     )
+
+
+def kmn_stats_sharded(kernel: Kernel, mesh, theta, active, x, y, mask):
+    """Public raw-array entry to the sharded (U1, U2) accumulation — the
+    mesh counterpart of :func:`kmn_stats_jit`, same rank-generic targets."""
+    return _sharded_kmn_stats_impl(kernel, mesh, theta, active, x, y, mask)
 
 
 @partial(jax.jit, static_argnums=0)
@@ -300,7 +337,9 @@ def _magic_solve_device_impl(
             l, y, left_side=True, lower=True, transpose_a=True
         )
 
-    magic_vector = chol_solve(l_pd, u2[:, None])[:, 0]
+    # single-target u2 [m] or multi-target U2 [m, C] (ndim is trace-static)
+    mv = chol_solve(l_pd, u2 if u2.ndim == 2 else u2[:, None])
+    magic_vector = mv if u2.ndim == 2 else mv[:, 0]
     ok = jnp.all(jnp.isfinite(jnp.diagonal(l_pd)))
     if not with_variance:
         return magic_vector, jnp.zeros((0, 0), u1.dtype), ok
@@ -462,8 +501,9 @@ def sharded_magic_solve(
         pd = sn2 * kmm + np.asarray(u1, dtype=np.float64)
         pd = 0.5 * (pd + pd.T)
         kmm = 0.5 * (kmm + kmm.T)
-        u2_pad = np.zeros(m_pad)
-        u2_pad[:m] = np.asarray(u2, dtype=np.float64)
+        u2_arr = np.asarray(u2, dtype=np.float64)
+        u2_pad = np.zeros((m_pad,) + u2_arr.shape[1:])
+        u2_pad[:m] = u2_arr
         eye_scale_pd = np.trace(pd) / m
         eye_scale_mm = np.trace(kmm) / m
 

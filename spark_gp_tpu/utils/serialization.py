@@ -43,6 +43,7 @@ def save_model(path: str, model, kind: str) -> None:
 
 def load_model(path: str):
     from spark_gp_tpu.models.gpc import GaussianProcessClassificationModel
+    from spark_gp_tpu.models.gpc_mc import GaussianProcessMulticlassModel
     from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
 
     with np.load(_normalize(path), allow_pickle=False) as data:
@@ -58,4 +59,6 @@ def load_model(path: str):
         )
     if kind == "classification":
         return GaussianProcessClassificationModel(raw)
+    if kind == "multiclass":
+        return GaussianProcessMulticlassModel(raw)
     return GaussianProcessRegressionModel(raw)

@@ -1,0 +1,330 @@
+"""Native multiclass (softmax Laplace) classifier tests.
+
+Oracle strategy (the repo's standard): the batched Algorithm-3.3
+implementation is checked against a brute-force dense f64 implementation
+of the SAME mathematics on the full ``[n*C]`` system — generic Newton with
+``numpy.linalg.solve``, log Z with ``slogdet`` — plus central finite
+differences for the hyperparameter gradient (which exercises the
+one-differentiable-Newton-step implicit-gradient trick end to end).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.special import logsumexp, softmax
+
+from spark_gp_tpu.kernels.base import Const, EyeKernel
+from spark_gp_tpu.kernels.rbf import RBFKernel
+from spark_gp_tpu.models.laplace_mc import (
+    _gram_stack,
+    batched_neg_logz_mc,
+    laplace_mc_mode,
+)
+
+
+def _problem(rng, n=14, n_classes=3, p=2):
+    x = rng.normal(size=(n, p))
+    y = rng.integers(0, n_classes, size=n)
+    return x, y, np.eye(n_classes)[y]
+
+
+def _oracle_mode_and_logz(kmat, y1h, iters=200):
+    """Dense full-system softmax Laplace in f64: generic Newton on the
+    stacked [n*C] system, log Z via slogdet — no Algorithm 3.3 structure
+    shared with the implementation under test."""
+    n, n_classes = y1h.shape
+    kb = np.kron(np.eye(n_classes), kmat)  # class-major blocks
+    big_f = np.zeros(n * n_classes)
+    for _ in range(iters):
+        f = big_f.reshape(n_classes, n).T
+        pi = softmax(f, axis=1)
+        d_mat = np.diag(pi.T.reshape(-1))
+        stack = np.vstack([np.diag(pi[:, c]) for c in range(n_classes)])
+        w_mat = d_mat - stack @ stack.T
+        grad = (y1h - pi).T.reshape(-1)
+        b = w_mat @ big_f + grad
+        a = np.linalg.solve(np.eye(n * n_classes) + w_mat @ kb, b)
+        f_new = kb @ a
+        done = np.max(np.abs(f_new - big_f)) < 1e-12
+        big_f = f_new
+        if done:
+            break
+    f = big_f.reshape(n_classes, n).T
+    pi = softmax(f, axis=1)
+    d_mat = np.diag(pi.T.reshape(-1))
+    stack = np.vstack([np.diag(pi[:, c]) for c in range(n_classes)])
+    w_mat = d_mat - stack @ stack.T
+    a = np.linalg.solve(kb, big_f)
+    psi = -0.5 * a @ big_f + np.sum(
+        np.sum(y1h * f, axis=1) - logsumexp(f, axis=1)
+    )
+    _, logdet = np.linalg.slogdet(np.eye(n * n_classes) + kb @ w_mat)
+    return f, psi - 0.5 * logdet
+
+
+@pytest.fixture
+def mc_fixture(rng):
+    x, y, y1h = _problem(rng)
+    kernel = RBFKernel(0.8) + Const(1e-3) * EyeKernel()
+    theta = jnp.asarray(np.array([0.8]))
+    kmat = _gram_stack(
+        kernel, theta, jnp.asarray(x[None]), jnp.ones((1, x.shape[0]))
+    )
+    return kernel, theta, x, y1h, kmat
+
+
+def test_mode_matches_dense_oracle(mc_fixture):
+    kernel, theta, x, y1h, kmat = mc_fixture
+    n = x.shape[0]
+    f_hat, _ = laplace_mc_mode(
+        kmat, jnp.asarray(y1h[None]), jnp.ones((1, n)),
+        jnp.zeros((1, n, y1h.shape[1])), 1e-10,
+    )
+    f_oracle, _ = _oracle_mode_and_logz(np.asarray(kmat[0]), y1h)
+    np.testing.assert_allclose(np.asarray(f_hat[0]), f_oracle, atol=1e-10)
+
+
+def test_logz_matches_dense_oracle(mc_fixture):
+    kernel, theta, x, y1h, kmat = mc_fixture
+    n = x.shape[0]
+    value, _, _ = batched_neg_logz_mc(
+        kernel, 1e-10, theta, jnp.asarray(x[None]), jnp.asarray(y1h[None]),
+        jnp.ones((1, n)), jnp.zeros((1, n, y1h.shape[1])),
+    )
+    _, logz_oracle = _oracle_mode_and_logz(np.asarray(kmat[0]), y1h)
+    np.testing.assert_allclose(-float(value), logz_oracle, rtol=1e-12)
+
+
+def test_gradient_matches_finite_difference(rng):
+    """The one-differentiable-Newton-step implicit gradient vs central FD
+    — the end-to-end check that the stop_gradient mode + single step
+    reproduces the full dlogZ/dtheta (incl. the determinant's implicit
+    f-dependence, the binary path's s2/s3 analogue)."""
+    x, y, y1h = _problem(rng, n=12)
+    kernel = RBFKernel(0.7) + Const(1e-2) * EyeKernel()
+    n = x.shape[0]
+
+    def nll(theta_val):
+        value, grad, _ = batched_neg_logz_mc(
+            kernel, 1e-12, jnp.asarray(np.array([theta_val])),
+            jnp.asarray(x[None]), jnp.asarray(y1h[None]), jnp.ones((1, n)),
+            jnp.zeros((1, n, y1h.shape[1])),
+        )
+        return float(value), float(grad[0])
+
+    _, grad = nll(0.7)
+    h = 1e-6
+    fd = (nll(0.7 + h)[0] - nll(0.7 - h)[0]) / (2 * h)
+    np.testing.assert_allclose(grad, fd, rtol=1e-6)
+
+
+def test_padding_is_inert(rng):
+    """An expert stack padded with masked rows must produce the same nll,
+    gradient and (real-row) modes as the unpadded stack."""
+    x, y, y1h = _problem(rng, n=10)
+    kernel = RBFKernel(0.8) + Const(1e-3) * EyeKernel()
+    theta = jnp.asarray(np.array([0.8]))
+    n, n_classes = y1h.shape
+
+    v0, g0, f0 = batched_neg_logz_mc(
+        kernel, 1e-10, theta, jnp.asarray(x[None]), jnp.asarray(y1h[None]),
+        jnp.ones((1, n)), jnp.zeros((1, n, n_classes)),
+    )
+    pad = 3
+    xp = np.concatenate([x, np.broadcast_to(x[:1], (pad, x.shape[1]))])
+    y1hp = np.concatenate([y1h, np.zeros((pad, n_classes))])
+    maskp = np.concatenate([np.ones(n), np.zeros(pad)])
+    v1, g1, f1 = batched_neg_logz_mc(
+        kernel, 1e-10, theta, jnp.asarray(xp[None]), jnp.asarray(y1hp[None]),
+        jnp.asarray(maskp[None]), jnp.zeros((1, n + pad, n_classes)),
+    )
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(f1[0, :n]), np.asarray(f0[0]), atol=1e-10
+    )
+
+
+def _blobs(rng, n_per=60, n_classes=3):
+    centers = np.array([[0.0, 0.0], [3.0, 0.0], [0.0, 3.0]])[:n_classes]
+    x = np.concatenate(
+        [rng.normal(size=(n_per, 2)) * 0.6 + c for c in centers]
+    )
+    y = np.repeat(np.arange(n_classes), n_per)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+@pytest.mark.parametrize("optimizer", ["host", "device"])
+def test_estimator_end_to_end_blobs(rng, optimizer):
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+
+    x, y = _blobs(rng)
+    model = (
+        GaussianProcessMulticlassClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(45)
+        .setActiveSetSize(40)
+        .setMaxIter(20)
+        .setOptimizer(optimizer)
+        .fit(x, y)
+    )
+    pred = model.predict(x)
+    acc = float(np.mean(pred == y))
+    assert acc > 0.95, acc
+    proba = model.predict_proba(x)
+    assert proba.shape == (x.shape[0], 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    # averaged probabilities use the shared latent variance
+    proba_avg = model.predict_proba(x[:10], averaged=True, mc_samples=64)
+    np.testing.assert_allclose(proba_avg.sum(axis=1), 1.0, rtol=1e-6)
+    assert model.num_classes == 3
+
+
+def test_estimator_sharded_objective(rng, eight_device_mesh):
+    """Host optimizer over the shard_map'd multiclass objective on the
+    8-device mesh: same quality as single-device."""
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+
+    x, y = _blobs(rng)
+    model = (
+        GaussianProcessMulticlassClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(24)
+        .setActiveSetSize(40)
+        .setMaxIter(15)
+        .setOptimizer("host")
+        .setMesh(eight_device_mesh)
+        .fit(x, y)
+    )
+    acc = float(np.mean(model.predict(x) == y))
+    assert acc > 0.95, acc
+
+
+def test_save_load_roundtrip(rng, tmp_path):
+    from spark_gp_tpu import (
+        GaussianProcessMulticlassClassifier,
+        GaussianProcessMulticlassModel,
+    )
+
+    x, y = _blobs(rng, n_per=40)
+    model = (
+        GaussianProcessMulticlassClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(40)
+        .setActiveSetSize(30)
+        .setMaxIter(10)
+        .fit(x, y)
+    )
+    path = str(tmp_path / "mc_model")
+    model.save(path)
+    loaded = GaussianProcessMulticlassModel.load(path)
+    np.testing.assert_allclose(
+        loaded.predict_raw(x[:20]), model.predict_raw(x[:20]), rtol=1e-12
+    )
+
+
+def test_label_validation():
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+
+    x = np.zeros((10, 2))
+    with pytest.raises(ValueError, match="integers"):
+        GaussianProcessMulticlassClassifier().fit(x, np.full(10, 0.5))
+    with pytest.raises(ValueError, match="integers"):
+        GaussianProcessMulticlassClassifier().fit(x, np.full(10, -1))
+    with pytest.raises(ValueError, match="at least 2"):
+        GaussianProcessMulticlassClassifier().fit(x, np.zeros(10))
+
+
+def test_iris_beats_bar(rng):
+    """Iris through the NATIVE multiclass path (the reference needs
+    OneVsRest + 3 fits for this, Iris.scala:26-27): 5-fold CV accuracy
+    above 0.9 with one model per fold."""
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+    from spark_gp_tpu.data import load_iris
+    from spark_gp_tpu.utils.validation import accuracy, kfold_indices
+
+    x, y = load_iris()
+    scores = []
+    for train_idx, test_idx in kfold_indices(x.shape[0], 5, seed=13):
+        model = (
+            GaussianProcessMulticlassClassifier()
+            .setDatasetSizeForExpert(20)
+            .setActiveSetSize(30)
+            .setMaxIter(20)
+            .fit(x[train_idx], y[train_idx])
+        )
+        scores.append(accuracy(y[test_idx], model.predict(x[test_idx])))
+    assert float(np.mean(scores)) > 0.9, scores
+
+
+def test_greedy_provider_multiclass(rng):
+    """The uses_fit_outputs provider branch: greedy Seeger selection over
+    the max-class latent margin (heuristic scalarization, documented in
+    _projected_process_multi)."""
+    from spark_gp_tpu import (
+        GaussianProcessMulticlassClassifier,
+        GreedilyOptimizingActiveSetProvider,
+    )
+
+    x, y = _blobs(rng, n_per=40)
+    model = (
+        GaussianProcessMulticlassClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(40)
+        .setActiveSetSize(24)
+        .setMaxIter(10)
+        .setActiveSetProvider(GreedilyOptimizingActiveSetProvider())
+        .fit(x, y)
+    )
+    acc = float(np.mean(model.predict(x) == y))
+    assert acc > 0.9, acc
+
+
+def test_device_sharded_fit(rng, eight_device_mesh):
+    """fit_gpc_mc_device_sharded: the whole multiclass optimizer inside one
+    shard_map over the 8-device mesh."""
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+
+    x, y = _blobs(rng)
+    model = (
+        GaussianProcessMulticlassClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(24)
+        .setActiveSetSize(40)
+        .setMaxIter(15)
+        .setOptimizer("device")
+        .setMesh(eight_device_mesh)
+        .fit(x, y)
+    )
+    acc = float(np.mean(model.predict(x) == y))
+    assert acc > 0.95, acc
+
+
+def test_device_checkpointed_fit_and_resume(rng, tmp_path):
+    """Segmented device fit persists L-BFGS state; an identical refit
+    resumes from the finished checkpoint without re-optimizing."""
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+
+    x, y = _blobs(rng, n_per=40)
+
+    def make():
+        return (
+            GaussianProcessMulticlassClassifier()
+            .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+            .setDatasetSizeForExpert(40)
+            .setActiveSetSize(30)
+            .setMaxIter(12)
+            .setOptimizer("device")
+            .setCheckpointDir(str(tmp_path))
+            .setCheckpointInterval(4)
+        )
+
+    m1 = make().fit(x, y)
+    files = list(tmp_path.iterdir())
+    assert files, "no checkpoint was written"
+    m2 = make().fit(x, y)  # resumes the finished state
+    np.testing.assert_allclose(
+        m2.predict_raw(x[:20]), m1.predict_raw(x[:20]), rtol=1e-5, atol=1e-8
+    )
