@@ -26,6 +26,7 @@ process over the per-class latent targets.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -41,6 +42,42 @@ from spark_gp_tpu.models.laplace_mc import (
 )
 from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
 from spark_gp_tpu.utils.instrumentation import Instrumentation
+
+
+@jax.jit
+def _max_label(y, mask):
+    # module-level jit: runs as a program with a replicated scalar output
+    # (multi-host global arrays reject eager reductions — gpc._labels_are_01
+    # rationale)
+    return jnp.max(y * mask)
+
+
+@jax.jit
+def _labels_valid(y, mask, n_classes):
+    ym = y * mask
+    return (
+        jnp.all(jnp.floor(ym) == ym)
+        & jnp.all(ym >= 0.0)
+        & jnp.all(ym < n_classes)
+    )
+
+
+@partial(jax.jit, static_argnums=2)
+def _one_hot_masked(y, mask, n_classes):
+    """One-hot targets on the (possibly sharded) expert stack; padded rows
+    all-zero.  A program, so the output inherits the stack's sharding."""
+    return (
+        jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=mask.dtype)
+        * mask[..., None]
+    )
+
+
+@jax.jit
+def _margin_targets(latents, mask):
+    """Scalar per-point targets for stack-based active-set providers: the
+    strongest class latent (a heuristic — the reference defines provider
+    scoring only for scalar targets)."""
+    return jnp.max(latents, axis=-1) * mask
 
 
 class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
@@ -71,15 +108,53 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("num_classes", n_classes)
 
-        # One-hot targets on the expert stack; padded rows are all-zero.
-        y1h = (
-            jax.nn.one_hot(
-                jnp.asarray(data.y).astype(jnp.int32), n_classes,
-                dtype=data.x.dtype,
-            )
-            * data.mask[..., None]
-        )
+        y1h = _one_hot_masked(data.y, data.mask, n_classes)
 
+        return self._fit_from_stack(instr, kernel, data, y1h, x)
+
+    def fit_distributed(
+        self,
+        data,
+        n_classes: Optional[int] = None,
+        active_set: Optional[np.ndarray] = None,
+    ) -> "GaussianProcessMulticlassModel":
+        """Multi-host multiclass fit from a pre-sharded expert stack.
+
+        The multiclass counterpart of
+        :meth:`GaussianProcessClassifier.fit_distributed`: ``data`` is a
+        globally-sharded ``ExpertData`` of integer labels ``0 .. C-1``
+        (:func:`...distributed.distribute_global_experts`); the sharded
+        softmax-Laplace + L-BFGS loop keeps the ``[E, s, C]`` latent
+        stacks device-resident, and the active-set provider selects from
+        the sharded stack over the max-class latent margin.  ``n_classes``
+        may be passed explicitly (required when this process's shard might
+        not contain every class); by default it is computed with one
+        device reduction over the global labels.
+        """
+        instr = Instrumentation(name="GaussianProcessMulticlassClassifier")
+        with self._stack_mesh(data):
+            kernel = self._get_kernel()
+            instr.log_metric("num_experts", int(data.x.shape[0]))
+            instr.log_metric("expert_size", int(data.x.shape[1]))
+
+            if n_classes is None:
+                n_classes = int(np.asarray(_max_label(data.y, data.mask))) + 1
+            if n_classes < 2:
+                raise ValueError("need at least 2 classes")
+            if not bool(_labels_valid(data.y, data.mask, float(n_classes))):
+                raise ValueError("labels must be integers 0 .. C-1")
+            instr.log_metric("num_classes", n_classes)
+            y1h = _one_hot_masked(data.y, data.mask, n_classes)
+            return self._fit_from_stack(
+                instr, kernel, data, y1h, None, active_override=active_set
+            )
+
+    def _fit_from_stack(
+        self, instr, kernel, data, y1h, x, active_override=None
+    ) -> "GaussianProcessMulticlassModel":
+        """Shared optimize → settle latents → PPA tail of ``fit`` and
+        ``fit_distributed`` (the gpc.py:_fit_from_stack pattern; ``x is
+        None`` means distributed mode)."""
         from spark_gp_tpu.utils.instrumentation import maybe_profile
 
         with maybe_profile(self._profile_dir):
@@ -90,7 +165,8 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
 
             latents = f_final * data.mask[..., None]  # [E, s, C]
             raw = self._projected_process_multi(
-                instr, kernel, theta_opt, x, data, latents
+                instr, kernel, theta_opt, x, data, latents,
+                active_override=active_override,
             )
         instr.log_success()
         model = GaussianProcessMulticlassModel(raw)
@@ -187,19 +263,40 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         return theta_host, f_final
 
     def _projected_process_multi(
-        self, instr, kernel, theta_opt, x, data, latents
+        self, instr, kernel, theta_opt, x, data, latents,
+        active_override: Optional[np.ndarray] = None,
     ) -> ProjectedProcessRawPredictor:
         """Active set → shared (U1, per-class U2) → multi-RHS magic solve
         (the multiclass tail of GaussianProcessCommons._projected_process;
         the per-class latent stacks substitute for y, GPClf.scala:62-65).
         Providers that score targets (greedy Seeger) see the strongest
         latent (max over classes) — a heuristic, since the reference
-        defines greedy selection only for scalar targets."""
-        from spark_gp_tpu.parallel.experts import num_experts_for, ungroup
+        defines greedy selection only for scalar targets.  ``x is None``
+        means distributed mode: no host holds the rows, so the provider
+        selects from the sharded stack (``from_stack``) over the margin
+        targets."""
+        from spark_gp_tpu.parallel.experts import (
+            ExpertData,
+            num_experts_for,
+            ungroup,
+        )
 
         with instr.phase("active_set"):
             provider = self._active_set_provider
-            if getattr(provider, "uses_fit_outputs", True):
+            if active_override is not None:
+                active = np.asarray(active_override, dtype=np.float64)
+            elif x is None:
+                sdata = ExpertData(
+                    x=data.x,
+                    y=_margin_targets(latents, data.mask),
+                    mask=data.mask,
+                )
+                active = provider.from_stack(
+                    self._active_set_size, sdata, kernel,
+                    np.asarray(theta_opt, dtype=np.float64), self._seed,
+                    self._mesh,
+                )
+            elif getattr(provider, "uses_fit_outputs", True):
                 e_real = num_experts_for(x.shape[0], self._dataset_size_for_expert)
                 margin = np.asarray(jnp.max(latents, axis=-1))[:e_real]
                 targets = ungroup(margin, x.shape[0])

@@ -328,3 +328,36 @@ def test_device_checkpointed_fit_and_resume(rng, tmp_path):
     np.testing.assert_allclose(
         m2.predict_raw(x[:20]), m1.predict_raw(x[:20]), rtol=1e-5, atol=1e-8
     )
+
+
+def test_fit_distributed_multiclass(rng, eight_device_mesh):
+    """Pre-sharded global stack entry: quality parity with plain fit, the
+    n_classes device inference, and the label-domain check on the stack."""
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+    from spark_gp_tpu.parallel import distributed as dist
+
+    x, y = _blobs(rng)
+    gdata = dist.distribute_global_experts(
+        x, y.astype(np.float64), 24, eight_device_mesh
+    )
+
+    def make():
+        return (
+            GaussianProcessMulticlassClassifier()
+            .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+            .setDatasetSizeForExpert(24)
+            .setActiveSetSize(40)
+            .setMaxIter(15)
+            .setMesh(eight_device_mesh)
+        )
+
+    model = make().fit_distributed(gdata)
+    acc = float(np.mean(model.predict(x) == y))
+    assert acc > 0.95, acc
+    assert model.num_classes == 3
+
+    bad = dist.distribute_global_experts(
+        x, y.astype(np.float64) + 0.5, 24, eight_device_mesh
+    )
+    with pytest.raises(ValueError, match="integers"):
+        make().fit_distributed(bad)
