@@ -202,6 +202,33 @@ class GaussianProcessRegressionModel:
             )
         return np.asarray(mean), np.asarray(var)
 
+    def predict_with_cov(self, x_test: np.ndarray):
+        """``(mean [t], cov [t, t])`` — joint predictive covariance between
+        the test points (the reference exposes only the per-point variance,
+        GaussianProcessCommons.scala:124).  ``diag(cov)`` agrees with
+        ``predict_with_var`` to float rounding (the two paths evaluate the
+        diagonal kernel term via ``self_diag`` vs ``diag(gram)``)."""
+        mean, cov = self.raw_predictor.predict_with_cov(np.asarray(x_test))
+        return np.asarray(mean), np.asarray(cov)
+
+    def sample_posterior(
+        self, x_test: np.ndarray, n_samples: int = 1, seed: int = 0
+    ) -> np.ndarray:
+        """``[n_samples, t]`` coherent draws from the joint posterior over
+        the test points (mean + L eps with L the jitter-repaired Cholesky
+        of the predictive covariance) — the Thompson-sampling primitive a
+        per-point variance cannot provide."""
+        from spark_gp_tpu.models.ppa import _psd_safe_cholesky
+
+        mean, cov = self.predict_with_cov(x_test)
+        chol = _psd_safe_cholesky(
+            np.asarray(cov, dtype=np.float64), "predictive covariance"
+        )
+        eps = np.random.default_rng(seed).standard_normal(
+            (n_samples, mean.shape[0])
+        )
+        return mean[None, :] + eps @ chol.T
+
     def save(self, path: str) -> None:
         from spark_gp_tpu.utils.serialization import save_model
 

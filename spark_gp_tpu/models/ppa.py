@@ -548,6 +548,15 @@ def sharded_magic_solve(
     raise NotPositiveDefiniteException()
 
 
+def _as_float(x_test):
+    """Integer test inputs must not drag theta/active/magic operators to an
+    integer dtype (a lengthscale of 1.2 would silently truncate to 1)."""
+    x_test = jnp.asarray(x_test)
+    if not jnp.issubdtype(x_test.dtype, jnp.floating):
+        x_test = x_test.astype(jnp.promote_types(x_test.dtype, jnp.float32))
+    return x_test
+
+
 @dataclass
 class ProjectedProcessRawPredictor:
     """Serializable (mean, variance) predictor against the m-point model —
@@ -585,13 +594,33 @@ class ProjectedProcessRawPredictor:
         cheap path for every caller that discards the variance)."""
         return self._run(x_test, mean_only=True)[0]
 
+    def predict_with_cov(self, x_test):
+        """``(mean [t], cov [t, t])`` — the full joint predictive
+        covariance (see :func:`_predict_cov_impl`).  Unchunked: the result
+        itself is O(t^2)."""
+        if self.magic_matrix is None:
+            raise ValueError(
+                "model was fitted with setPredictiveVariance(False); "
+                "no covariance operator is available"
+            )
+        x_test = _as_float(x_test)
+        dtype = x_test.dtype
+        return _predict_cov_jit(
+            self.kernel,
+            jnp.asarray(self.theta, dtype=dtype),
+            jnp.asarray(self.active, dtype=dtype),
+            jnp.asarray(self.magic_vector, dtype=dtype),
+            jnp.asarray(self.magic_matrix, dtype=dtype),
+            x_test,
+        )
+
     def __call__(self, x_test):
         """``(mean [t], var [t])`` — ``var`` is None for mean-only models."""
         return self._run(x_test, mean_only=self.magic_matrix is None)
 
     def _run(self, x_test, mean_only: bool):
-        x_test = jnp.asarray(x_test)
-        dtype = jnp.result_type(x_test.dtype)
+        x_test = _as_float(x_test)
+        dtype = x_test.dtype
         args = (
             self.kernel,
             jnp.asarray(self.theta, dtype=dtype),
@@ -634,6 +663,27 @@ def _predict_impl(kernel, theta, active, magic_vector, magic_matrix, x_test):
         "tm,mk,tk->t", cross, magic_matrix, cross
     )
     return mean, var
+
+
+def _predict_cov_impl(kernel, theta, active, magic_vector, magic_matrix, x_test):
+    """Full joint predictive covariance between test points:
+    ``Cov = K_tt + Cross . magicMatrix . Cross^T`` — the off-diagonal
+    extension of the per-point variance formula (same magic matrix, R&W
+    eq. 8.27; its diagonal equals ``var`` exactly since the Eye component
+    of the noise-augmented kernel contributes only on the diagonal).
+    Capability beyond the reference, which never exposes joint structure
+    (GaussianProcessCommons.scala:124 computes scalars per row); needed
+    for coherent posterior sampling / Thompson-style acquisition.
+    O(t^2) memory by nature — intended for modest t."""
+    cross = kernel.cross(theta, x_test, active)  # [t, m]
+    mean = cross @ magic_vector
+    cov = kernel.gram(theta, x_test) + cross @ magic_matrix @ cross.T
+    # exact symmetry (float rounding in the two matmuls breaks it at the
+    # ~1e-14 level, which a downstream Cholesky would amplify)
+    return mean, 0.5 * (cov + cov.T)
+
+
+_predict_cov_jit = jax.jit(_predict_cov_impl, static_argnums=0)
 
 
 _predict_jit = jax.jit(_predict_impl, static_argnums=0)

@@ -137,7 +137,9 @@ def minimize_lbfgsb(
     return OptimizeResult(
         theta=np.asarray(res.x, dtype=np.float64),
         fun=float(res.fun),
-        nit=int(res.nit),
+        # scipy omits nit when L-BFGS-B exits before its first iteration
+        # (e.g. all bounds pinned lower == upper)
+        nit=int(getattr(res, "nit", 0)),
         nfev=int(res.nfev),
         success=bool(res.success),
         message=str(res.message),

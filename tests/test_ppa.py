@@ -306,3 +306,115 @@ def test_mean_only_device_and_sharded_solvers(rng, eight_device_mesh):
     )
     assert mm_sh is None
     np.testing.assert_allclose(mv_sh, mv_full, rtol=1e-8, atol=1e-10)
+
+
+# --- joint predictive covariance + posterior sampling ---------------------
+
+
+def test_predict_with_cov_diag_equals_var(rng):
+    """diag(cov) == var exactly (the Eye noise component is diagonal)."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    x = rng.normal(size=(300, 2))
+    y = np.sin(x.sum(axis=1))
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setActiveSetSize(60)
+        .setMaxIter(15)
+        .fit(x, y)
+    )
+    t = x[:40]
+    mean_v, var = model.predict_with_var(t)
+    mean_c, cov = model.predict_with_cov(t)
+    np.testing.assert_allclose(mean_c, mean_v, rtol=1e-12)
+    np.testing.assert_allclose(np.diag(cov), var, rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(cov, cov.T, rtol=1e-9, atol=1e-12)
+
+
+def test_predict_cov_matches_dense_ppa_oracle(rng):
+    """Joint covariance against an independent dense f64 recomputation of
+    the full PPA chain (PGPH.scala:49-60 conventions + the R&W eq. 8.27
+    operator applied off-diagonally): same active set, same statistics,
+    numpy-only algebra.  Validates the cross/gram conventions and the
+    solve wiring, independent of the PPA approximation quality."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    n, m, sigma2, ls = 60, 20, 1e-2, 1.2
+    x = rng.normal(size=(n, 2))
+    y = np.sin(x.sum(axis=1))
+
+    model = (
+        GaussianProcessRegression()
+        # pinned hyperparameters: the oracle must use the same kernel
+        .setKernel(lambda: RBFKernel(ls, ls, ls))
+        .setSigma2(sigma2)
+        .setDatasetSizeForExpert(30)
+        .setActiveSetSize(m)
+        .setMaxIter(1)
+        .fit(x, y)
+    )
+    a = np.asarray(model.raw_predictor.active)
+    t = rng.normal(size=(12, 2))
+    mean, cov = model.predict_with_cov(t)
+
+    def k(p, q):
+        d2 = ((p[:, None, :] - q[None, :, :]) ** 2).sum(-1)
+        return np.exp(-d2 / (2 * ls**2))
+
+    kmm = k(a, a) + sigma2 * np.eye(m)  # noise-augmented K_mm
+    kan = k(a, x)  # cross kernel has no Eye component
+    pd = sigma2 * kmm + kan @ kan.T
+    mv = np.linalg.solve(pd, kan @ y)
+    mm = sigma2 * np.linalg.inv(pd) - np.linalg.inv(kmm)
+    kta = k(t, a)
+    mean_oracle = kta @ mv
+    cov_oracle = k(t, t) + sigma2 * np.eye(len(t)) + kta @ mm @ kta.T
+    np.testing.assert_allclose(mean, mean_oracle, rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(cov, cov_oracle, rtol=1e-6, atol=1e-9)
+
+
+def test_sample_posterior_statistics(rng):
+    """Seeded determinism; empirical mean/covariance of many draws match
+    the analytic posterior (loose MC tolerances)."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    x = rng.normal(size=(200, 1))
+    y = np.sin(x[:, 0])
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setActiveSetSize(50)
+        .setMaxIter(15)
+        .fit(x, y)
+    )
+    t = np.linspace(-1.5, 1.5, 10)[:, None]
+    s1 = model.sample_posterior(t, n_samples=4, seed=7)
+    s2 = model.sample_posterior(t, n_samples=4, seed=7)
+    np.testing.assert_allclose(s1, s2, rtol=1e-15)
+    assert s1.shape == (4, 10)
+
+    mean, cov = model.predict_with_cov(t)
+    draws = model.sample_posterior(t, n_samples=20000, seed=1)
+    np.testing.assert_allclose(
+        draws.mean(axis=0), mean, atol=4 * np.sqrt(np.diag(cov).max() / 20000) + 1e-3
+    )
+    emp_cov = np.cov(draws.T)
+    np.testing.assert_allclose(emp_cov, cov, atol=0.05 * max(1.0, np.abs(cov).max()))
+
+
+def test_mean_only_model_rejects_cov(rng):
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    x = rng.normal(size=(120, 2))
+    y = np.sin(x.sum(axis=1))
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setActiveSetSize(40)
+        .setMaxIter(5)
+        .setPredictiveVariance(False)
+        .fit(x, y)
+    )
+    with pytest.raises(ValueError, match="covariance"):
+        model.predict_with_cov(x[:5])
