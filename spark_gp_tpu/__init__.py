@@ -42,6 +42,7 @@ from spark_gp_tpu.kernels import (
     Matern32Kernel,
     Matern52Kernel,
     PeriodicKernel,
+    ProductKernel,
     PolynomialKernel,
     RationalQuadraticKernel,
     RBFKernel,
@@ -87,6 +88,7 @@ __all__ = [
     "EyeKernel",
     "WhiteNoiseKernel",
     "SumKernel",
+    "ProductKernel",
     "Scalar",
     "Const",
     "GaussianProcessRegression",

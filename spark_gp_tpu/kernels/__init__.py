@@ -10,6 +10,7 @@ from spark_gp_tpu.kernels.base import (
     ConstScaleKernel,
     EyeKernel,
     Kernel,
+    ProductKernel,
     Scalar,
     StationaryKernel,
     SumKernel,
@@ -36,6 +37,7 @@ __all__ = [
     "StationaryKernel",
     "EyeKernel",
     "SumKernel",
+    "ProductKernel",
     "TrainableScaleKernel",
     "ConstScaleKernel",
     "Scalar",

@@ -105,6 +105,8 @@ class Kernel:
     def __mul__(self, other):
         if isinstance(other, (int, float)):
             return Scalar(float(other)) * self
+        if isinstance(other, Kernel):
+            return ProductKernel(self, other)
         return NotImplemented
 
 
@@ -206,9 +208,10 @@ class EyeKernel(Kernel):
         return "I"
 
 
-class SumKernel(Kernel):
-    """``k1 + k2`` with concatenated hyperparameter vectors
-    (SumOfKernels.scala:15-65).  Children share no hyperparameters."""
+class _PairKernel(Kernel):
+    """Shared composite plumbing for binary kernel combinations: children's
+    hyperparameter vectors concatenate (``k1`` first), bounds likewise, and
+    the (type, child-specs) pair is the jit-static identity."""
 
     def __init__(self, k1: Kernel, k2: Kernel) -> None:
         self.k1 = k1
@@ -228,6 +231,63 @@ class SumKernel(Kernel):
         lo1, hi1 = self.k1.bounds()
         lo2, hi2 = self.k2.bounds()
         return np.concatenate([lo1, lo2]), np.concatenate([hi1, hi2])
+
+
+class ProductKernel(_PairKernel):
+    """``k1 * k2`` — elementwise (Schur) product of two kernels, PSD by the
+    Schur product theorem.  Capability beyond the reference (its algebra
+    stops at Sum + scalar scaling, kernel/package.scala:3-9); the canonical
+    use is quasi-periodic structure, ``RBFKernel(..) * PeriodicKernel(..)``.
+
+    ``white_noise_var`` is 0, and factors carrying white noise are rejected
+    at construction: the delta-ridge part of a product involves cross terms
+    between one factor's continuous part at zero distance and the other's
+    ridge, which the flat-scalar accounting cannot represent — add noise at
+    the top level (``k1 * k2 + WhiteNoiseKernel(...)``) instead.
+    """
+
+    def __init__(self, k1: Kernel, k2: Kernel) -> None:
+        super().__init__(k1, k2)
+        for factor in (k1, k2):
+            wn = float(
+                np.asarray(
+                    factor.white_noise_var(jnp.asarray(factor.init_theta()))
+                )
+            )
+            if wn != 0.0:
+                raise ValueError(
+                    "kernel products cannot contain white-noise factors "
+                    "(the product's delta ridge is not representable as a "
+                    "flat white_noise_var); add the noise at the top "
+                    "level: k1 * k2 + WhiteNoiseKernel(...)"
+                )
+
+    def gram(self, theta, x):
+        t1, t2 = self._split(theta)
+        return self.k1.gram(t1, x) * self.k2.gram(t2, x)
+
+    def cross(self, theta, x_test, x_train):
+        t1, t2 = self._split(theta)
+        return self.k1.cross(t1, x_test, x_train) * self.k2.cross(
+            t2, x_test, x_train
+        )
+
+    def diag(self, theta, x):
+        t1, t2 = self._split(theta)
+        return self.k1.diag(t1, x) * self.k2.diag(t2, x)
+
+    def self_diag(self, theta, x):
+        t1, t2 = self._split(theta)
+        return self.k1.self_diag(t1, x) * self.k2.self_diag(t2, x)
+
+    def describe(self, theta) -> str:
+        t1, t2 = self._split(np.asarray(theta))
+        return f"({self.k1.describe(t1)}) * ({self.k2.describe(t2)})"
+
+
+class SumKernel(_PairKernel):
+    """``k1 + k2`` with concatenated hyperparameter vectors
+    (SumOfKernels.scala:15-65).  Children share no hyperparameters."""
 
     def gram(self, theta, x):
         t1, t2 = self._split(theta)
