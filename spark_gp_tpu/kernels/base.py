@@ -208,6 +208,62 @@ class EyeKernel(Kernel):
         return "I"
 
 
+class ThetaOverrideKernel(Kernel):
+    """The same kernel spec started from a different hyperparameter point.
+
+    Delegates every computation to the wrapped spec and overrides only
+    ``init_theta`` — the mechanism behind multi-start hyperparameter
+    optimization (``setNumRestarts``): restart r wraps the user's kernel
+    with a perturbed starting point, so every fit path (host, device,
+    sharded) works unchanged.
+
+    The starting point is deliberately EXCLUDED from the jit-static
+    identity (``_spec``): no traced computation reads ``init_theta`` —
+    theta is always threaded as a dynamic argument — so wrappers around
+    the same inner kernel share one compiled executable per program
+    instead of recompiling every restart.  Consequence: two wrappers with
+    different starting points compare equal; the override only matters on
+    the host, where it is read directly.
+    """
+
+    def __init__(self, inner: Kernel, theta0) -> None:
+        self.inner = inner
+        self.theta0_ = tuple(float(v) for v in np.asarray(theta0).ravel())
+        if len(self.theta0_) != inner.n_hypers:
+            raise ValueError(
+                f"theta0 has {len(self.theta0_)} entries; kernel has "
+                f"{inner.n_hypers} hyperparameters"
+            )
+        self.n_hypers = inner.n_hypers
+
+    def _spec(self) -> tuple:
+        return (self.inner,)
+
+    def init_theta(self):
+        return np.array(self.theta0_, dtype=np.float64)
+
+    def bounds(self):
+        return self.inner.bounds()
+
+    def gram(self, theta, x):
+        return self.inner.gram(theta, x)
+
+    def cross(self, theta, x_test, x_train):
+        return self.inner.cross(theta, x_test, x_train)
+
+    def diag(self, theta, x):
+        return self.inner.diag(theta, x)
+
+    def self_diag(self, theta, x):
+        return self.inner.self_diag(theta, x)
+
+    def white_noise_var(self, theta):
+        return self.inner.white_noise_var(theta)
+
+    def describe(self, theta) -> str:
+        return self.inner.describe(theta)
+
+
 class _PairKernel(Kernel):
     """Shared composite plumbing for binary kernel combinations: children's
     hyperparameter vectors concatenate (``k1`` first), bounds likewise, and

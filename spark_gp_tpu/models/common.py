@@ -46,6 +46,8 @@ class GaussianProcessParams:
         self._hyper_space: str = "auto"
         self._profile_dir: Optional[str] = None
         self._predictive_variance: bool = True
+        self._num_restarts: int = 1
+        self._restart_scale: float = 0.5
 
     # --- reference setter names (GaussianProcessParams.scala:32-53) -------
     def setKernel(self, value: Union[Kernel, Callable[[], Kernel]]):
@@ -110,6 +112,23 @@ class GaussianProcessParams:
         memory at large active sets (m ~ 10^4: ~800 MB f64 and most of the
         solve time buys nothing if variances are never read)."""
         self._predictive_variance = bool(value)
+        return self
+
+    def setNumRestarts(self, value: int, scale: float = 0.5):
+        """Multi-start hyperparameter optimization (capability beyond the
+        reference, which runs L-BFGS-B from the kernel's initial values
+        once, GaussianProcessCommons.scala:84-86).  GP marginal likelihoods
+        are multimodal; ``value`` > 1 runs the fit from the user's starting
+        point plus ``value - 1`` seeded perturbations of it (log-normal
+        when the log hyper-space applies, else relative-scale normal,
+        clipped to the box bounds) and keeps the model with the lowest
+        final NLL.  ``scale`` controls the perturbation width.  Not
+        combinable with ``setCheckpointDir`` (the restarts would overwrite
+        one state file)."""
+        if int(value) < 1:
+            raise ValueError("number of restarts must be >= 1")
+        self._num_restarts = int(value)
+        self._restart_scale = float(scale)
         return self
 
     def setProfileDir(self, path: Optional[str]):
@@ -210,6 +229,7 @@ class GaussianProcessParams:
     set_checkpoint_interval = setCheckpointInterval
     set_optimizer = setOptimizer
     set_hyper_space = setHyperSpace
+    set_num_restarts = setNumRestarts
 
     def get_params(self) -> dict:
         return {
@@ -257,6 +277,80 @@ class GaussianProcessCommons(GaussianProcessParams):
         """User kernel + sigma2 * I — the noise-augmented model kernel
         (GaussianProcessCommons.scala:18)."""
         return self._kernel_factory() + Const(self._sigma2) * EyeKernel()
+
+    def _fit_with_restarts(self, outer_instr: Instrumentation, fit_once):
+        """Multi-start driver (setNumRestarts): ``fit_once(kernel, instr)``
+        must return a fitted model carrying
+        ``model.instr.metrics['final_nll']``.  Restart 0 is the user's
+        starting point on ``outer_instr`` (which already carries the
+        grouping metrics/timings); each further restart wraps the kernel
+        with a seeded perturbed ``init_theta`` on a fresh instr seeded from
+        the outer one — the fit paths themselves are untouched.  Returns
+        the lowest-NLL model, its instr annotated with every restart's NLL.
+        """
+        from spark_gp_tpu.kernels.base import ThetaOverrideKernel
+
+        kernel = self._get_kernel()
+        if self._num_restarts <= 1:
+            return fit_once(kernel, outer_instr)
+        if self._checkpoint_dir is not None:
+            raise ValueError(
+                "setNumRestarts(>1) is not combinable with "
+                "setCheckpointDir (restarts would overwrite one state file)"
+            )
+        theta0 = kernel.init_theta()
+        lower, upper = kernel.bounds()
+        use_log = self._use_log_space(kernel)  # matches the fit's space
+        rng = np.random.default_rng(self._seed ^ 0x5EED5)
+        # Snapshot the pre-fit state BEFORE any restart runs: later restarts
+        # must inherit the grouping metrics/timings only, not restart 0's
+        # fit results (phase() accumulates, so copying afterwards would
+        # double-count optimize/PPA timings on a non-0 winner).
+        base_metrics = dict(outer_instr.metrics)
+        base_timings = dict(outer_instr.timings)
+        # Perturbation scale per coordinate: relative to |theta0| where
+        # nonzero, else to the (finite) bound span — a zero-initialized
+        # hyperparameter in linear space would otherwise stay exactly zero
+        # in every restart.
+        span = np.where(
+            np.isfinite(upper - lower) & (upper > lower), upper - lower, 1.0
+        )
+        lin_scale = np.where(np.abs(theta0) > 0.0, np.abs(theta0), span)
+        best_model, best_nll, best_r = None, np.inf, -1
+        nlls = []
+        for r in range(self._num_restarts):
+            if r == 0:
+                # restart 0 keeps the user's starting point but is wrapped
+                # too: all restarts then share ONE jit-static kernel
+                # identity (ThetaOverrideKernel excludes theta0 from its
+                # spec), so every fit program compiles exactly once
+                t_r, instr_r = theta0, outer_instr
+            else:
+                eps = self._restart_scale * rng.standard_normal(theta0.shape)
+                if use_log:
+                    t_r = np.exp(np.log(theta0) + eps)
+                else:
+                    t_r = theta0 + eps * lin_scale
+                t_r = np.clip(t_r, lower, upper)
+                instr_r = Instrumentation(name=outer_instr.name)
+                instr_r.metrics.update(base_metrics)
+                instr_r.timings.update(base_timings)
+            kernel_r = ThetaOverrideKernel(kernel, t_r)
+            model = fit_once(kernel_r, instr_r)
+            nll = float(model.instr.metrics.get("final_nll", np.inf))
+            nlls.append(nll if np.isfinite(nll) else np.inf)
+            if nlls[-1] < best_nll:
+                best_model, best_nll, best_r = model, nlls[-1], r
+        if best_model is None:
+            raise RuntimeError(
+                "every restart produced a non-finite final NLL — the model "
+                "configuration is numerically unusable at these settings"
+            )
+        for r, nll in enumerate(nlls):
+            best_model.instr.log_metric(f"restart_{r}_nll", nll)
+        best_model.instr.log_metric("num_restarts", self._num_restarts)
+        best_model.instr.log_metric("best_restart", best_r)
+        return best_model
 
     def _group(self, x: np.ndarray, y: np.ndarray) -> ExpertData:
         data = group_for_experts(x, y, self._dataset_size_for_expert)

@@ -60,7 +60,6 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             # GPClf.scala:68-72
             raise ValueError("Only 0 and 1 labels are supported.")
 
-        kernel = self._get_kernel()
         with instr.phase("group_experts"):
             data = self._group(x, y)
         instr.log_metric("num_experts", data.num_experts)
@@ -79,11 +78,14 @@ class GaussianProcessClassifier(GaussianProcessCommons):
 
             return targets_fn
 
-        raw = self._fit_from_stack(instr, kernel, data, x, make_targets_fn)
-        instr.log_success()
-        model = GaussianProcessClassificationModel(raw)
-        model.instr = instr
-        return model
+        def fit_once(kernel, instr_r):
+            raw = self._fit_from_stack(instr_r, kernel, data, x, make_targets_fn)
+            instr_r.log_success()
+            model = GaussianProcessClassificationModel(raw)
+            model.instr = instr_r
+            return model
+
+        return self._fit_with_restarts(instr, fit_once)
 
     def fit_distributed(
         self, data, active_set: Optional[np.ndarray] = None
@@ -103,7 +105,6 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         """
         instr = Instrumentation(name="GaussianProcessClassifier")
         with self._stack_mesh(data):
-            kernel = self._get_kernel()
             instr.log_metric("num_experts", int(data.x.shape[0]))
             instr.log_metric("expert_size", int(data.x.shape[1]))
 
@@ -116,11 +117,17 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                 None if active_set is None
                 else np.asarray(active_set, dtype=np.float64)
             )
-            raw = self._fit_from_stack(instr, kernel, data, None, None, active64)
-            instr.log_success()
-            model = GaussianProcessClassificationModel(raw)
-            model.instr = instr
-            return model
+
+            def fit_once(kernel, instr_r):
+                raw = self._fit_from_stack(
+                    instr_r, kernel, data, None, None, active64
+                )
+                instr_r.log_success()
+                model = GaussianProcessClassificationModel(raw)
+                model.instr = instr_r
+                return model
+
+            return self._fit_with_restarts(instr, fit_once)
 
     def _fit_from_stack(
         self, instr, kernel, data, x, make_targets_fn, active_override=None

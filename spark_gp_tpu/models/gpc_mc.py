@@ -102,7 +102,6 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         if n_classes < 2:
             raise ValueError("need at least 2 classes")
 
-        kernel = self._get_kernel()
         with instr.phase("group_experts"):
             data = self._group(x, y_int.astype(np.float64))
         instr.log_metric("num_experts", data.num_experts)
@@ -110,7 +109,10 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
 
         y1h = _one_hot_masked(data.y, data.mask, n_classes)
 
-        return self._fit_from_stack(instr, kernel, data, y1h, x)
+        def fit_once(kernel, instr_r):
+            return self._fit_from_stack(instr_r, kernel, data, y1h, x)
+
+        return self._fit_with_restarts(instr, fit_once)
 
     def fit_distributed(
         self,
@@ -133,7 +135,6 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         """
         instr = Instrumentation(name="GaussianProcessMulticlassClassifier")
         with self._stack_mesh(data):
-            kernel = self._get_kernel()
             instr.log_metric("num_experts", int(data.x.shape[0]))
             instr.log_metric("expert_size", int(data.x.shape[1]))
 
@@ -145,9 +146,14 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                 raise ValueError("labels must be integers 0 .. C-1")
             instr.log_metric("num_classes", n_classes)
             y1h = _one_hot_masked(data.y, data.mask, n_classes)
-            return self._fit_from_stack(
-                instr, kernel, data, y1h, None, active_override=active_set
-            )
+
+            def fit_once(kernel, instr_r):
+                return self._fit_from_stack(
+                    instr_r, kernel, data, y1h, None,
+                    active_override=active_set,
+                )
+
+            return self._fit_with_restarts(instr, fit_once)
 
     def _fit_from_stack(
         self, instr, kernel, data, y1h, x, active_override=None

@@ -50,13 +50,15 @@ class GaussianProcessRegression(GaussianProcessCommons):
         if y.shape != (x.shape[0],):
             raise ValueError(f"y must be [N], got shape {y.shape}")
 
-        kernel = self._get_kernel()
         with instr.phase("group_experts"):
             data = self._group(x, y)
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("expert_size", data.expert_size)
 
-        return self._fit_from_stack(instr, kernel, data, x, lambda: y, None)
+        def fit_once(kernel, instr_r):
+            return self._fit_from_stack(instr_r, kernel, data, x, lambda: y, None)
+
+        return self._fit_with_restarts(instr, fit_once)
 
     def _fit_from_stack(
         self, instr, kernel, data, x, targets_fn, active_override
@@ -114,14 +116,19 @@ class GaussianProcessRegression(GaussianProcessCommons):
         """
         instr = Instrumentation(name="GaussianProcessRegression")
         with self._stack_mesh(data):
-            kernel = self._get_kernel()
             instr.log_metric("num_experts", int(data.x.shape[0]))
             instr.log_metric("expert_size", int(data.x.shape[1]))
             active64 = (
                 None if active_set is None
                 else np.asarray(active_set, dtype=np.float64)
             )
-            return self._fit_from_stack(instr, kernel, data, None, None, active64)
+
+            def fit_once(kernel, instr_r):
+                return self._fit_from_stack(
+                    instr_r, kernel, data, None, None, active64
+                )
+
+            return self._fit_with_restarts(instr, fit_once)
 
     def _fit_device(self, instr: Instrumentation, kernel, data):
         """Dispatch the one-program on-device optimization

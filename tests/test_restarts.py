@@ -1,0 +1,131 @@
+"""Multi-start hyperparameter optimization (setNumRestarts)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel, WhiteNoiseKernel
+from spark_gp_tpu.kernels.base import ThetaOverrideKernel
+
+
+def test_theta_override_kernel_delegates(rng):
+    inner = 1.0 * RBFKernel(0.5, 1e-6, 10.0) + WhiteNoiseKernel(0.1, 0, 1)
+    t_new = np.array([2.0, 1.5, 0.3])
+    k = ThetaOverrideKernel(inner, t_new)
+    np.testing.assert_allclose(k.init_theta(), t_new)
+    lo, hi = k.bounds()
+    lo_i, hi_i = inner.bounds()
+    np.testing.assert_allclose(lo, lo_i)
+    np.testing.assert_allclose(hi, hi_i)
+    x = jnp.asarray(rng.normal(size=(6, 2)))
+    theta = jnp.asarray(t_new)
+    np.testing.assert_allclose(
+        np.asarray(k.gram(theta, x)), np.asarray(inner.gram(theta, x)),
+        rtol=1e-15,
+    )
+    assert float(k.white_noise_var(theta)) == float(
+        inner.white_noise_var(theta)
+    )
+    assert hash(k) != hash(inner)
+    assert hash(k) == hash(ThetaOverrideKernel(inner, t_new))
+    with pytest.raises(ValueError, match="entries"):
+        ThetaOverrideKernel(inner, np.array([1.0]))
+
+
+def _problem(rng, n=300):
+    x = rng.normal(size=(n, 2))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=n)
+    return x, y
+
+
+def _make_gp(restarts=1):
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-3, 10.0))
+        .setActiveSetSize(50)
+        .setMaxIter(15)
+        .setSeed(7)
+    )
+    if restarts > 1:
+        gp = gp.setNumRestarts(restarts)
+    return gp
+
+
+def test_best_of_restarts_never_worse_than_single(rng):
+    """Restart 0 IS the single fit (same seed, deterministic), so the
+    best-of-R final NLL can only be <= the single fit's."""
+    x, y = _problem(rng)
+    single = _make_gp().fit(x, y)
+    multi = _make_gp(3).fit(x, y)
+    nll_single = float(single.instr.metrics["final_nll"])
+    nll_multi = float(multi.instr.metrics["final_nll"])
+    assert nll_multi <= nll_single + 1e-9
+    assert multi.instr.metrics["num_restarts"] == 3
+    assert 0 <= multi.instr.metrics["best_restart"] < 3
+    # the winner is a working model
+    from spark_gp_tpu.utils.validation import rmse
+
+    assert rmse(y, multi.predict(x)) < 0.2
+
+
+def test_restarts_reject_checkpointing(tmp_path):
+    gp = _make_gp(2).setCheckpointDir(str(tmp_path))
+    with pytest.raises(ValueError, match="not combinable"):
+        gp.fit(np.zeros((10, 2)), np.zeros(10))
+
+
+def test_restarts_validation():
+    with pytest.raises(ValueError, match=">= 1"):
+        GaussianProcessRegression().setNumRestarts(0)
+
+
+@pytest.mark.parametrize("make", ["binary", "multiclass"])
+def test_restarts_on_classifiers(rng, make):
+    from spark_gp_tpu import (
+        GaussianProcessClassifier,
+        GaussianProcessMulticlassClassifier,
+    )
+
+    x = rng.normal(size=(120, 2))
+    if make == "binary":
+        y = (x.sum(axis=1) > 0).astype(np.float64)
+        est = GaussianProcessClassifier()
+    else:
+        y = np.digitize(x.sum(axis=1), [-0.5, 0.5]).astype(np.float64)
+        est = GaussianProcessMulticlassClassifier()
+    model = (
+        est.setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(60)
+        .setActiveSetSize(30)
+        .setMaxIter(10)
+        .setNumRestarts(2)
+        .fit(x, y)
+    )
+    assert model.instr.metrics["num_restarts"] == 2
+    acc = float(np.mean(model.predict(x) == y))
+    assert acc > 0.8, acc
+
+
+def test_theta_override_shares_jit_identity():
+    """Different starting points share one jit-static identity (restarts
+    must not recompile every fit/predict program)."""
+    inner = 1.0 * RBFKernel(0.5, 1e-6, 10.0)
+    a = ThetaOverrideKernel(inner, np.array([1.0, 0.5]))
+    b = ThetaOverrideKernel(inner, np.array([2.0, 3.0]))
+    assert hash(a) == hash(b) and a == b
+    np.testing.assert_allclose(a.init_theta(), [1.0, 0.5])
+    np.testing.assert_allclose(b.init_theta(), [2.0, 3.0])
+
+
+def test_restarts_in_fit_distributed(rng, eight_device_mesh):
+    from spark_gp_tpu.parallel import distributed as dist
+
+    x, y = _problem(rng, n=240)
+    gdata = dist.distribute_global_experts(x, y, 30, eight_device_mesh)
+    model = (
+        _make_gp(2)
+        .setMesh(eight_device_mesh)
+        .fit_distributed(gdata)
+    )
+    assert model.instr.metrics["num_restarts"] == 2
+    assert "restart_1_nll" in model.instr.metrics
