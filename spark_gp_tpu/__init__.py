@@ -62,6 +62,10 @@ from spark_gp_tpu.models.gpc_mc import (
     GaussianProcessMulticlassClassifier,
     GaussianProcessMulticlassModel,
 )
+from spark_gp_tpu.models.gp_poisson import (
+    GaussianProcessPoissonModel,
+    GaussianProcessPoissonRegression,
+)
 from spark_gp_tpu.models.active_set import (
     ActiveSetProvider,
     GreedilyOptimizingActiveSetProvider,
@@ -97,6 +101,8 @@ __all__ = [
     "GaussianProcessClassificationModel",
     "GaussianProcessMulticlassClassifier",
     "GaussianProcessMulticlassModel",
+    "GaussianProcessPoissonRegression",
+    "GaussianProcessPoissonModel",
     "ActiveSetProvider",
     "RandomActiveSetProvider",
     "KMeansActiveSetProvider",

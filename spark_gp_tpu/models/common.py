@@ -122,9 +122,13 @@ class GaussianProcessParams:
         point plus ``value - 1`` seeded perturbations of it (log-normal
         when the log hyper-space applies, else relative-scale normal,
         clipped to the box bounds) and keeps the model with the lowest
-        final NLL.  ``scale`` controls the perturbation width.  Not
-        combinable with ``setCheckpointDir`` (the restarts would overwrite
-        one state file)."""
+        final NLL.  ``scale`` controls the perturbation width.  Each
+        restart is a COMPLETE fit — including the PPA model build — so at
+        very large active sets (m >~ 10^4, where the two O(m^3) inverse
+        builds dominate) pair restarts with
+        ``setPredictiveVariance(False)`` or a moderate m.  Not combinable
+        with ``setCheckpointDir`` (the restarts would overwrite one state
+        file)."""
         if int(value) < 1:
             raise ValueError("number of restarts must be >= 1")
         self._num_restarts = int(value)
@@ -420,6 +424,45 @@ class GaussianProcessCommons(GaussianProcessParams):
         instr.log_info("Optimal kernel: " + kernel.describe(res.theta))
         return res.theta
 
+    def _optimize_latent_host(self, instr, kernel, objective, f0):
+        """Host-driven L-BFGS-B over a latent-carrying jitted objective
+        ``(theta, f0) -> (value, grad, f_new)``: the latent warm start is
+        carried across evaluations (the explicit-state version of the
+        reference's in-place RDD mutation, GPClf.scala:53-60) and settled
+        with one final evaluation at theta* (GPClf.scala:60's foreach).
+        Shared by every Laplace-family estimator; returns
+        ``(theta_opt, f_final)``."""
+        state = {"f": f0}
+
+        def value_and_grad(theta):
+            value, grad, f_new = objective(theta, state["f"])
+            state["f"] = f_new
+            return value, grad
+
+        theta_opt = self._optimize_hypers(
+            instr, kernel, value_and_grad,
+            callback=self._make_checkpointer(kernel),
+        )
+        _, _, f_final = objective(theta_opt, state["f"])
+        return theta_opt, f_final
+
+    def _log_device_optimizer_result(
+        self, instr, kernel, theta_host, nll, n_iter, n_fev, stalled
+    ):
+        """Uniform diagnostics for a completed on-device fit — one home for
+        the metric names and the stall warning every estimator reports."""
+        instr.log_metric("lbfgs_iters", int(n_iter))
+        instr.log_metric("lbfgs_nfev", int(n_fev))
+        instr.log_metric("final_nll", float(nll))
+        instr.log_metric("lbfgs_stalled", float(bool(stalled)))
+        if bool(stalled):
+            instr.log_warning(
+                "device L-BFGS stalled (line search exhausted before "
+                "convergence) — returned hyperparameters are the best "
+                "iterate seen, not a certified optimum."
+            )
+        instr.log_info("Optimal kernel: " + kernel.describe(theta_host))
+
     def _projected_process(
         self,
         instr: Instrumentation,
@@ -431,10 +474,18 @@ class GaussianProcessCommons(GaussianProcessParams):
         active_override: Optional[np.ndarray] = None,
     ) -> ppa.ProjectedProcessRawPredictor:
         """Active set -> distributed (U1, u2) -> magic solve -> predictor
-        (GaussianProcessCommons.scala:40-59)."""
+        (GaussianProcessCommons.scala:40-59).
+
+        ``y_targets`` may be a value or a zero-arg callable; a callable is
+        resolved ONLY when the provider actually reads targets
+        (``uses_fit_outputs``) — for the classifiers the targets are the
+        device-resident latent stacks, and fetching them is a host sync the
+        random/kmeans providers never need.
+        """
         import jax.numpy as jnp
 
         with instr.phase("active_set"):
+            provider = self._active_set_provider
             if active_override is not None:
                 # explicitly-supplied set (fit_distributed(active_set=...))
                 active = active_override
@@ -442,19 +493,24 @@ class GaussianProcessCommons(GaussianProcessParams):
                 # distributed mode: no host holds the rows — the provider
                 # selects from the sharded stack itself (data.y carries the
                 # targets: labels for GPR, latent modes for GPC)
-                active = self._active_set_provider.from_stack(
+                active = provider.from_stack(
                     self._active_set_size, data, kernel,
                     np.asarray(theta_opt, dtype=np.float64), self._seed,
                     self._mesh,
                 )
-            else:
+            elif getattr(provider, "uses_fit_outputs", True):
                 # The provider receives the noise-augmented model kernel, as
                 # the reference passes getKernel
                 # (GaussianProcessCommons.scala:43) — the greedy provider's
                 # Seeger scores divide by its whiteNoiseVar.
-                active = self._active_set_provider(
-                    self._active_set_size, x, y_targets, kernel, theta_opt,
+                targets = y_targets() if callable(y_targets) else y_targets
+                active = provider(
+                    self._active_set_size, x, targets, kernel, theta_opt,
                     self._seed,
+                )
+            else:
+                active = provider(
+                    self._active_set_size, x, None, kernel, None, self._seed
                 )
         active = np.asarray(active)
 

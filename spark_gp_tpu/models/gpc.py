@@ -168,33 +168,17 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             else:
                 objective = make_laplace_objective(kernel, data, self._tol)
 
-            # Latent warm start carried across L-BFGS evaluations — the
-            # explicit functional version of the reference's in-place RDD
-            # mutation (GPClf.scala:53-60).
-            state = {"f": jnp.zeros_like(data.y)}
-
-            def value_and_grad(theta):
-                theta_dev = jnp.asarray(theta, dtype=data.x.dtype)
-                value, grad, f_new = objective(theta_dev, state["f"])
-                state["f"] = f_new
-                return value, grad
-
-            theta_opt = self._optimize_hypers(
-                instr, kernel, value_and_grad,
-                callback=self._make_checkpointer(kernel),
+            theta_opt, f_final = self._optimize_latent_host(
+                instr, kernel, objective, jnp.zeros_like(data.y)
             )
-
-            # Final evaluation at theta*: settles f at the optimum
-            # (GPClf.scala:60's foreach).
-            theta_dev = jnp.asarray(theta_opt, dtype=data.x.dtype)
-            _, _, f_final = objective(theta_dev, state["f"])
 
             latent_y = f_final * data.mask
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
             raw = self._projected_process(
                 instr, kernel, theta_opt, x,
+                # a callable: resolved only if the provider reads targets
                 None if make_targets_fn is None
-                else make_targets_fn(latent_y)(),
+                else make_targets_fn(latent_y),
                 latent_data,
                 active_override=active_override,
             )

@@ -181,8 +181,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
 
     def _fit_host(self, instr, kernel, data, y1h):
         """Host-driven L-BFGS-B over the jitted (possibly sharded)
-        multiclass objective; latent warm start carried across evaluations
-        (the explicit-state version of GPClf.scala:53-60)."""
+        multiclass objective (shared driver: _optimize_latent_host)."""
         if self._mesh is not None:
             objective = make_sharded_mc_objective(
                 kernel, data.x, y1h, data.mask, self._tol, self._mesh
@@ -191,22 +190,9 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
             objective = make_mc_objective(
                 kernel, data.x, y1h, data.mask, self._tol
             )
-        state = {"f": jnp.zeros_like(y1h)}
-
-        def value_and_grad(theta):
-            theta_dev = jnp.asarray(theta, dtype=data.x.dtype)
-            value, grad, f_new = objective(theta_dev, state["f"])
-            state["f"] = f_new
-            return value, grad
-
-        theta_opt = self._optimize_hypers(
-            instr, kernel, value_and_grad,
-            callback=self._make_checkpointer(kernel),
+        return self._optimize_latent_host(
+            instr, kernel, objective, jnp.zeros_like(y1h)
         )
-        # settle the latents at theta* (GPClf.scala:60's final foreach)
-        theta_dev = jnp.asarray(theta_opt, dtype=data.x.dtype)
-        _, _, f_final = objective(theta_dev, state["f"])
-        return theta_opt, f_final
 
     def _fit_device(self, instr, kernel, data, y1h):
         """On-device fit: one-dispatch single-chip / mesh-sharded, or the
@@ -255,17 +241,9 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                     jnp.asarray(self._max_iter, dtype=jnp.int32),
                 )
         theta_host = np.asarray(theta, dtype=np.float64)
-        instr.log_metric("lbfgs_iters", int(n_iter))
-        instr.log_metric("lbfgs_nfev", int(n_fev))
-        instr.log_metric("final_nll", float(nll))
-        instr.log_metric("lbfgs_stalled", float(bool(stalled)))
-        if bool(stalled):
-            instr.log_warning(
-                "device L-BFGS stalled (line search exhausted before "
-                "convergence) — returned hyperparameters are the best "
-                "iterate seen, not a certified optimum."
-            )
-        instr.log_info("Optimal kernel: " + kernel.describe(theta_host))
+        self._log_device_optimizer_result(
+            instr, kernel, theta_host, nll, n_iter, n_fev, stalled
+        )
         return theta_host, f_final
 
     def _projected_process_multi(

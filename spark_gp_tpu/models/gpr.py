@@ -88,8 +88,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                     instr, kernel, vag, callback=checkpointer
                 )
                 raw = self._projected_process(
-                    instr, kernel, theta_opt, x,
-                    None if targets_fn is None else targets_fn(), data,
+                    instr, kernel, theta_opt, x, targets_fn, data,
                     active_override=active_override,
                 )
         instr.log_success()

@@ -44,6 +44,7 @@ def save_model(path: str, model, kind: str) -> None:
 def load_model(path: str):
     from spark_gp_tpu.models.gpc import GaussianProcessClassificationModel
     from spark_gp_tpu.models.gpc_mc import GaussianProcessMulticlassModel
+    from spark_gp_tpu.models.gp_poisson import GaussianProcessPoissonModel
     from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
 
     with np.load(_normalize(path), allow_pickle=False) as data:
@@ -61,4 +62,6 @@ def load_model(path: str):
         return GaussianProcessClassificationModel(raw)
     if kind == "multiclass":
         return GaussianProcessMulticlassModel(raw)
+    if kind == "poisson":
+        return GaussianProcessPoissonModel(raw)
     return GaussianProcessRegressionModel(raw)
