@@ -91,6 +91,24 @@ class PoissonLikelihood(Likelihood):
         return y - ef, ef
 
 
+class BernoulliLikelihood(Likelihood):
+    """{0,1} labels with the sigmoid link — the reference classifier's
+    likelihood (GPClf.scala:92-97), expressed as one ``log_lik`` for the
+    generic core.  Exists primarily as a cross-validation oracle: the
+    generic autodiff path and the hand-assembled Algorithm-5.1 path of
+    :mod:`spark_gp_tpu.models.laplace` must produce identical objectives
+    and gradients (tests/test_poisson.py), each certifying the other.
+    """
+
+    def log_lik(self, f, y):
+        # log sigmoid((2y - 1) f): the stable joint form for y in {0, 1}
+        return jax.nn.log_sigmoid((2.0 * y - 1.0) * f)
+
+    def grad_hess(self, f, y):
+        pi = jax.nn.sigmoid(f)
+        return y - pi, pi * (1.0 - pi)
+
+
 class _GenNewtonState(NamedTuple):
     f: jax.Array  # [E, s]
     old_obj: jax.Array  # [E]
