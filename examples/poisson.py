@@ -1,0 +1,50 @@
+"""Poisson (count) GP regression example — model family beyond the
+reference (akopich/spark-gp ships Gaussian regression and binary
+classification only).
+
+Seeded synthetic counts with rate = exp(1 + sin 2x); fits the log-rate GP
+via the generic-likelihood Laplace core and asserts the posterior-expected
+rate recovers the truth to 10% mean relative error.
+
+Run: python examples/poisson.py [--n 2000]
+"""
+
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+from spark_gp_tpu import GaussianProcessPoissonRegression, RBFKernel
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=2000)
+    args = parser.parse_args()
+
+    rng = np.random.default_rng(42)
+    x = np.linspace(0, 4, args.n)[:, None]
+    rate = np.exp(1.0 + np.sin(2 * x[:, 0]))
+    y = rng.poisson(rate).astype(np.float64)
+
+    model = (
+        GaussianProcessPoissonRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-2, 10.0))
+        .setActiveSetSize(100)
+        .setMaxIter(25)
+        .fit(x, y)
+    )
+    rel = float(np.mean(np.abs(model.predict_rate(x) - rate) / rate))
+    print("Mean relative rate error: " + str(rel))
+    assert rel < 0.1, rel
+    print("OK (< 0.1)")
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,35 @@ class BernoulliLikelihood(Likelihood):
         return y - pi, pi * (1.0 - pi)
 
 
+class BinomialLikelihood(Likelihood):
+    """``y`` successes out of ``trials`` attempts, logit link:
+    ``y | f ~ Binomial(trials, sigmoid(f))``.
+
+    ``log p = y f - trials * log(1 + exp(f))`` (the ``log C(trials, y)``
+    term is constant in ``f`` and dropped).  ``W = trials * pi (1 - pi)``:
+    log-concave.  ``trials`` is a spec constant (aggregated binary data
+    with a common group size); per-point trial counts would need a
+    two-channel target and are out of scope.
+    """
+
+    def __init__(self, trials: int) -> None:
+        trials = int(trials)
+        if trials < 1:
+            raise ValueError("trials must be a positive integer")
+        self.trials = trials
+
+    def _spec(self) -> tuple:
+        return (self.trials,)
+
+    def log_lik(self, f, y):
+        # -trials * log(1 + e^f) = trials * log_sigmoid(-f), the stable form
+        return y * f + self.trials * jax.nn.log_sigmoid(-f)
+
+    def grad_hess(self, f, y):
+        pi = jax.nn.sigmoid(f)
+        return y - self.trials * pi, self.trials * pi * (1.0 - pi)
+
+
 class _GenNewtonState(NamedTuple):
     f: jax.Array  # [E, s]
     old_obj: jax.Array  # [E]
