@@ -424,6 +424,24 @@ class GaussianProcessCommons(GaussianProcessParams):
         instr.log_info("Optimal kernel: " + kernel.describe(res.theta))
         return res.theta
 
+    def _run_fit_distributed(self, name: str, data, active_set, prepare):
+        """Shared shell of every estimator's ``fit_distributed``: resolve
+        the mesh from the stack, log the stack shape, normalize an explicit
+        active set to f64, then run ``prepare(instr, active64) ->
+        fit_once(kernel, instr_r)`` through the multi-start driver.
+        Estimator-specific validation/target preparation lives in
+        ``prepare`` (label-domain checks, one-hot construction, ...)."""
+        instr = Instrumentation(name=name)
+        with self._stack_mesh(data):
+            instr.log_metric("num_experts", int(data.x.shape[0]))
+            instr.log_metric("expert_size", int(data.x.shape[1]))
+            active64 = (
+                None if active_set is None
+                else np.asarray(active_set, dtype=np.float64)
+            )
+            fit_once = prepare(instr, active64)
+            return self._fit_with_restarts(instr, fit_once)
+
     def _optimize_latent_host(self, instr, kernel, objective, f0):
         """Host-driven L-BFGS-B over a latent-carrying jitted objective
         ``(theta, f0) -> (value, grad, f_new)``: the latent warm start is

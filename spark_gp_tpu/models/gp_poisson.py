@@ -23,6 +23,8 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
+import jax
+
 from spark_gp_tpu.models.common import GaussianProcessCommons
 from spark_gp_tpu.models.laplace_generic import (
     PoissonLikelihood,
@@ -32,6 +34,14 @@ from spark_gp_tpu.models.laplace_generic import (
 )
 from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
 from spark_gp_tpu.utils.instrumentation import Instrumentation
+
+
+@jax.jit
+def _counts_valid(y, mask):
+    # module-level jit: one device reduction with a replicated scalar
+    # output (multi-host global arrays reject eager reductions)
+    ym = y * mask
+    return jnp.all(ym >= 0.0) & jnp.all(jnp.floor(ym) == ym)
 
 
 class GaussianProcessPoissonRegression(GaussianProcessCommons):
@@ -61,7 +71,36 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
 
         return self._fit_with_restarts(instr, fit_once)
 
-    def _fit_from_stack(self, instr, kernel, data, x) -> "GaussianProcessPoissonModel":
+    def fit_distributed(
+        self, data, active_set: Optional[np.ndarray] = None
+    ) -> "GaussianProcessPoissonModel":
+        """Multi-host count-regression fit from a pre-sharded expert stack
+        (the same entry point every other estimator has): ``data`` is a
+        globally-sharded ``ExpertData`` of counts
+        (:func:`...distributed.distribute_global_experts`); the sharded
+        generic-Laplace objective keeps the latent stacks device-resident,
+        and the provider selects over the latent log-rates from the stack.
+        """
+        def prepare(instr, active64):
+            if not bool(_counts_valid(data.y, data.mask)):
+                raise ValueError(
+                    "targets must be non-negative integer counts"
+                )
+
+            def fit_once(kernel, instr_r):
+                return self._fit_from_stack(
+                    instr_r, kernel, data, None, active_override=active64
+                )
+
+            return fit_once
+
+        return self._run_fit_distributed(
+            "GaussianProcessPoissonRegression", data, active_set, prepare
+        )
+
+    def _fit_from_stack(
+        self, instr, kernel, data, x, active_override=None
+    ) -> "GaussianProcessPoissonModel":
         from spark_gp_tpu.parallel.experts import (
             ExpertData,
             num_experts_for,
@@ -76,18 +115,26 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                 theta_opt, f_final = self._fit_host(instr, kernel, data)
 
             latent_y = f_final * data.mask
+            # latent log-rates substitute for y in the PPA build AND as the
+            # stack providers' targets (the GPClf.scala:62-65 substitution)
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
 
-            def targets_fn():
-                e_real = num_experts_for(
-                    x.shape[0], self._dataset_size_for_expert
-                )
-                return ungroup(np.asarray(latent_y)[:e_real], x.shape[0])
+            if x is None:
+                # distributed: provider selects from the sharded stack
+                targets_fn = None
+            else:
+
+                def targets_fn():
+                    e_real = num_experts_for(
+                        x.shape[0], self._dataset_size_for_expert
+                    )
+                    return ungroup(np.asarray(latent_y)[:e_real], x.shape[0])
 
             # targets stay a callable: materializing the latent stack is a
             # device sync the random/kmeans providers never need
             raw = self._projected_process(
-                instr, kernel, theta_opt, x, targets_fn, latent_data
+                instr, kernel, theta_opt, x, targets_fn, latent_data,
+                active_override=active_override,
             )
         instr.log_success()
         model = GaussianProcessPoissonModel(raw)

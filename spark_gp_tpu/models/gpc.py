@@ -103,20 +103,11 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         sharded stack (``ActiveSetProvider.from_stack``) — GPClf.scala:62-65
         substitutes f for y before produceModel, so providers must see f.
         """
-        instr = Instrumentation(name="GaussianProcessClassifier")
-        with self._stack_mesh(data):
-            instr.log_metric("num_experts", int(data.x.shape[0]))
-            instr.log_metric("expert_size", int(data.x.shape[1]))
-
+        def prepare(instr, active64):
             # Label-domain check on the sharded stack (GPClf.scala:68-72):
             # one reduction on device, no host gather of the labels.
             if not bool(_labels_are_01(data.y, data.mask)):
                 raise ValueError("Only 0 and 1 labels are supported.")
-
-            active64 = (
-                None if active_set is None
-                else np.asarray(active_set, dtype=np.float64)
-            )
 
             def fit_once(kernel, instr_r):
                 raw = self._fit_from_stack(
@@ -127,7 +118,11 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                 model.instr = instr_r
                 return model
 
-            return self._fit_with_restarts(instr, fit_once)
+            return fit_once
+
+        return self._run_fit_distributed(
+            "GaussianProcessClassifier", data, active_set, prepare
+        )
 
     def _fit_from_stack(
         self, instr, kernel, data, x, make_targets_fn, active_override=None

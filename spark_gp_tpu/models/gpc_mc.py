@@ -133,27 +133,28 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         not contain every class); by default it is computed with one
         device reduction over the global labels.
         """
-        instr = Instrumentation(name="GaussianProcessMulticlassClassifier")
-        with self._stack_mesh(data):
-            instr.log_metric("num_experts", int(data.x.shape[0]))
-            instr.log_metric("expert_size", int(data.x.shape[1]))
-
-            if n_classes is None:
-                n_classes = int(np.asarray(_max_label(data.y, data.mask))) + 1
-            if n_classes < 2:
+        def prepare(instr, active64):
+            n_cls = n_classes
+            if n_cls is None:
+                n_cls = int(np.asarray(_max_label(data.y, data.mask))) + 1
+            if n_cls < 2:
                 raise ValueError("need at least 2 classes")
-            if not bool(_labels_valid(data.y, data.mask, float(n_classes))):
+            if not bool(_labels_valid(data.y, data.mask, float(n_cls))):
                 raise ValueError("labels must be integers 0 .. C-1")
-            instr.log_metric("num_classes", n_classes)
-            y1h = _one_hot_masked(data.y, data.mask, n_classes)
+            instr.log_metric("num_classes", n_cls)
+            y1h = _one_hot_masked(data.y, data.mask, n_cls)
 
             def fit_once(kernel, instr_r):
                 return self._fit_from_stack(
                     instr_r, kernel, data, y1h, None,
-                    active_override=active_set,
+                    active_override=active64,
                 )
 
-            return self._fit_with_restarts(instr, fit_once)
+            return fit_once
+
+        return self._run_fit_distributed(
+            "GaussianProcessMulticlassClassifier", data, active_set, prepare
+        )
 
     def _fit_from_stack(
         self, instr, kernel, data, y1h, x, active_override=None

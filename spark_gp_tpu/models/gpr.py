@@ -113,21 +113,17 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
         Single-process it is equivalent to ``fit`` with a pre-grouped stack.
         """
-        instr = Instrumentation(name="GaussianProcessRegression")
-        with self._stack_mesh(data):
-            instr.log_metric("num_experts", int(data.x.shape[0]))
-            instr.log_metric("expert_size", int(data.x.shape[1]))
-            active64 = (
-                None if active_set is None
-                else np.asarray(active_set, dtype=np.float64)
-            )
-
+        def prepare(instr, active64):
             def fit_once(kernel, instr_r):
                 return self._fit_from_stack(
                     instr_r, kernel, data, None, None, active64
                 )
 
-            return self._fit_with_restarts(instr, fit_once)
+            return fit_once
+
+        return self._run_fit_distributed(
+            "GaussianProcessRegression", data, active_set, prepare
+        )
 
     def _fit_device(self, instr: Instrumentation, kernel, data):
         """Dispatch the one-program on-device optimization

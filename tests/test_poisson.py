@@ -284,3 +284,29 @@ def test_binomial_likelihood(rng):
     )
     p_hat = 1.0 / (1.0 + np.exp(-np.asarray(f_hat[0])))
     assert np.mean(np.abs(p_hat - p_true)) < 0.05
+
+
+def test_fit_distributed_poisson(rng, eight_device_mesh):
+    from spark_gp_tpu import GaussianProcessPoissonRegression
+    from spark_gp_tpu.parallel import distributed as dist
+
+    x, y, rate = _count_problem(rng)
+    gdata = dist.distribute_global_experts(x, y, 50, eight_device_mesh)
+
+    def make():
+        return (
+            GaussianProcessPoissonRegression()
+            .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-2, 10.0))
+            .setDatasetSizeForExpert(50)
+            .setActiveSetSize(60)
+            .setMaxIter(15)
+            .setMesh(eight_device_mesh)
+        )
+
+    model = make().fit_distributed(gdata)
+    rel = np.mean(np.abs(model.predict_rate(x) - rate) / rate)
+    assert rel < 0.25, rel
+
+    bad = dist.distribute_global_experts(x, y + 0.5, 50, eight_device_mesh)
+    with pytest.raises(ValueError, match="counts"):
+        make().fit_distributed(bad)
