@@ -29,7 +29,6 @@ loop is never differentiated through).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax

@@ -10,7 +10,6 @@ RegressionEvaluator / MulticlassClassificationEvaluator metrics.
 from __future__ import annotations
 
 import copy
-from typing import Sequence
 
 import numpy as np
 

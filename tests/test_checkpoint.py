@@ -8,13 +8,11 @@ not checkpoint at all, and load_checkpoint had no consumer).
 """
 
 import numpy as np
-import jax.numpy as jnp
 import pytest
 
 from spark_gp_tpu import GaussianProcessRegression, GaussianProcessClassifier, RBFKernel
 from spark_gp_tpu.utils.checkpoint import (
     DeviceOptimizerCheckpointer,
-    LbfgsCheckpointer,
     load_checkpoint,
 )
 

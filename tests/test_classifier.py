@@ -2,7 +2,6 @@
 Iris.scala) with asserted thresholds the reference only prints."""
 
 import numpy as np
-import pytest
 
 from spark_gp_tpu import GaussianProcessClassifier
 from spark_gp_tpu.data import load_iris

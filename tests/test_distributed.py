@@ -10,7 +10,7 @@ import numpy as np
 import jax
 
 from spark_gp_tpu.parallel import distributed as dist
-from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+from spark_gp_tpu.parallel.experts import group_for_experts
 from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 
 

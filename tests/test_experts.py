@@ -2,7 +2,6 @@
 (GaussianProcessCommons.scala:26-31)."""
 
 import numpy as np
-import pytest
 
 from spark_gp_tpu.parallel.experts import group_for_experts, num_experts_for
 

@@ -6,14 +6,13 @@ of independent per-chunk exact NLLs; padding must not change values; autodiff
 gradients must match finite differences of the oracle.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from spark_gp_tpu.kernels import Const, EyeKernel, RBFKernel, WhiteNoiseKernel
 from spark_gp_tpu.models.likelihood import batched_nll, make_value_and_grad
-from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+from spark_gp_tpu.parallel.experts import group_for_experts
 
 
 def _exact_nll(kernel, theta, x, y):

@@ -2,7 +2,6 @@
 entirely (its Laplace loop is untested, SURVEY.md §4).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -13,7 +12,6 @@ from spark_gp_tpu.models.laplace import (
     laplace_mode,
     make_laplace_objective,
 )
-from spark_gp_tpu.ops.linalg import masked_kernel_matrix
 from spark_gp_tpu.parallel.experts import group_for_experts
 
 

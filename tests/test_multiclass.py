@@ -8,7 +8,6 @@ differences for the hyperparameter gradient (which exercises the
 one-differentiable-Newton-step implicit-gradient trick end to end).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

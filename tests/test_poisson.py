@@ -6,7 +6,6 @@ the hyperparameter gradient, plus a check that the autodiff-derived
 grad/Hessian of the Likelihood base equals the Poisson closed forms.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

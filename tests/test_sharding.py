@@ -4,7 +4,6 @@
 order.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
