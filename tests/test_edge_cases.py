@@ -1,0 +1,97 @@
+"""Degenerate-input regression guards: the shapes a switching user hits
+first (tiny N, N below the expert/active sizes, constant or duplicate
+data, single-class labels, empty test sets) must produce finite models,
+not crashes."""
+
+import numpy as np
+
+from spark_gp_tpu import (
+    GaussianProcessClassifier,
+    GaussianProcessMulticlassClassifier,
+    GaussianProcessPoissonRegression,
+    GaussianProcessRegression,
+)
+
+
+def _finite(a):
+    assert np.all(np.isfinite(np.asarray(a)))
+
+
+def test_gpr_tiny_n_below_expert_and_active_sizes(rng):
+    x = rng.normal(size=(5, 2))
+    y = np.sin(x.sum(1))
+    model = GaussianProcessRegression().setMaxIter(5).fit(x, y)
+    _finite(model.predict(x))
+    mean, var = model.predict_with_var(x)
+    _finite(mean)
+    _finite(var)
+
+
+def test_gpr_single_point():
+    model = GaussianProcessRegression().setMaxIter(3).fit(
+        np.zeros((1, 2)), np.array([1.0])
+    )
+    _finite(model.predict(np.zeros((1, 2))))
+
+
+def test_gpr_constant_targets(rng):
+    x = rng.normal(size=(50, 2))
+    model = GaussianProcessRegression().setMaxIter(5).fit(x, np.full(50, 3.0))
+    pred = model.predict(x)
+    _finite(pred)
+    np.testing.assert_allclose(pred, 3.0, atol=0.2)
+
+
+def test_gpr_all_duplicate_rows():
+    x = np.tile(np.array([[0.3, -1.2]]), (30, 1))
+    model = GaussianProcessRegression().setMaxIter(3).fit(x, np.ones(30))
+    _finite(model.predict(x))
+
+
+def test_gpr_active_set_larger_than_n(rng):
+    x = rng.normal(size=(50, 2))
+    y = np.sin(x.sum(1))
+    model = (
+        GaussianProcessRegression().setActiveSetSize(500).setMaxIter(3).fit(x, y)
+    )
+    _finite(model.predict(x))
+
+
+def test_gpr_empty_test_set(rng):
+    x = rng.normal(size=(40, 2))
+    model = GaussianProcessRegression().setMaxIter(3).fit(x, np.sin(x.sum(1)))
+    assert model.predict(np.zeros((0, 2))).shape == (0,)
+
+
+def test_gpc_single_class_present(rng):
+    x = rng.normal(size=(50, 2))
+    model = GaussianProcessClassifier().setMaxIter(3).fit(x, np.zeros(50))
+    pred = model.predict(x)
+    _finite(pred)
+    proba = model.predict_proba(x)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_multiclass_label_gap(rng):
+    """Labels {0, 2} with class 1 absent: C = 3 is inferred from the max
+    label; the empty class simply never wins."""
+    x = rng.normal(size=(60, 2))
+    y = np.where(x.sum(1) > 0, 2.0, 0.0)
+    model = (
+        GaussianProcessMulticlassClassifier()
+        .setDatasetSizeForExpert(30)
+        .setActiveSetSize(20)
+        .setMaxIter(3)
+        .fit(x, y)
+    )
+    assert model.num_classes == 3
+    pred = model.predict(x)
+    assert set(np.unique(pred)) <= {0.0, 1.0, 2.0}
+
+
+def test_poisson_all_zero_counts(rng):
+    x = rng.normal(size=(50, 2))
+    model = GaussianProcessPoissonRegression().setMaxIter(3).fit(x, np.zeros(50))
+    rate = model.predict_rate(x)
+    _finite(rate)
+    assert np.all(rate >= 0)
