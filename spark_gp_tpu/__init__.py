@@ -74,7 +74,7 @@ from spark_gp_tpu.models.active_set import (
 )
 from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "Kernel",
