@@ -302,24 +302,13 @@ class GaussianProcessCommons(GaussianProcessParams):
                 "setNumRestarts(>1) is not combinable with "
                 "setCheckpointDir (restarts would overwrite one state file)"
             )
-        theta0 = kernel.init_theta()
-        lower, upper = kernel.bounds()
-        use_log = self._use_log_space(kernel)  # matches the fit's space
-        rng = np.random.default_rng(self._seed ^ 0x5EED5)
+        theta_batch = self._restart_theta_batch(kernel)
         # Snapshot the pre-fit state BEFORE any restart runs: later restarts
         # must inherit the grouping metrics/timings only, not restart 0's
         # fit results (phase() accumulates, so copying afterwards would
         # double-count optimize/PPA timings on a non-0 winner).
         base_metrics = dict(outer_instr.metrics)
         base_timings = dict(outer_instr.timings)
-        # Perturbation scale per coordinate: relative to |theta0| where
-        # nonzero, else to the (finite) bound span — a zero-initialized
-        # hyperparameter in linear space would otherwise stay exactly zero
-        # in every restart.
-        span = np.where(
-            np.isfinite(upper - lower) & (upper > lower), upper - lower, 1.0
-        )
-        lin_scale = np.where(np.abs(theta0) > 0.0, np.abs(theta0), span)
         best_model, best_nll, best_r = None, np.inf, -1
         nlls = []
         for r in range(self._num_restarts):
@@ -328,18 +317,12 @@ class GaussianProcessCommons(GaussianProcessParams):
                 # too: all restarts then share ONE jit-static kernel
                 # identity (ThetaOverrideKernel excludes theta0 from its
                 # spec), so every fit program compiles exactly once
-                t_r, instr_r = theta0, outer_instr
+                instr_r = outer_instr
             else:
-                eps = self._restart_scale * rng.standard_normal(theta0.shape)
-                if use_log:
-                    t_r = np.exp(np.log(theta0) + eps)
-                else:
-                    t_r = theta0 + eps * lin_scale
-                t_r = np.clip(t_r, lower, upper)
                 instr_r = Instrumentation(name=outer_instr.name)
                 instr_r.metrics.update(base_metrics)
                 instr_r.timings.update(base_timings)
-            kernel_r = ThetaOverrideKernel(kernel, t_r)
+            kernel_r = ThetaOverrideKernel(kernel, theta_batch[r])
             model = fit_once(kernel_r, instr_r)
             nll = float(model.instr.metrics.get("final_nll", np.inf))
             nlls.append(nll if np.isfinite(nll) else np.inf)
@@ -423,6 +406,32 @@ class GaussianProcessCommons(GaussianProcessParams):
             )
         instr.log_info("Optimal kernel: " + kernel.describe(res.theta))
         return res.theta
+
+    def _restart_theta_batch(self, kernel) -> np.ndarray:
+        """``[R, h]`` multi-start starting points: row 0 is the user's
+        ``init_theta``, rows 1+ seeded perturbations (log-normal in log
+        hyper-space; else additive with a per-coordinate scale relative to
+        ``|theta0|`` where nonzero and the finite bound span otherwise, so
+        zero-initialized coordinates are explored too), clipped to the box.
+        One definition shared by the sequential driver and the batched
+        on-device multi-start so both explore identical points."""
+        theta0 = kernel.init_theta()
+        lower, upper = kernel.bounds()
+        use_log = self._use_log_space(kernel)
+        rng = np.random.default_rng(self._seed ^ 0x5EED5)
+        span = np.where(
+            np.isfinite(upper - lower) & (upper > lower), upper - lower, 1.0
+        )
+        lin_scale = np.where(np.abs(theta0) > 0.0, np.abs(theta0), span)
+        rows = [theta0]
+        for _ in range(1, self._num_restarts):
+            eps = self._restart_scale * rng.standard_normal(theta0.shape)
+            if use_log:
+                t_r = np.exp(np.log(theta0) + eps)
+            else:
+                t_r = theta0 + eps * lin_scale
+            rows.append(np.clip(t_r, lower, upper))
+        return np.stack(rows)
 
     def _run_fit_distributed(self, name: str, data, active_set, prepare):
         """Shared shell of every estimator's ``fit_distributed``: resolve
@@ -661,6 +670,8 @@ class GaussianProcessCommons(GaussianProcessParams):
         fetched = dict(zip(keys, vals[3:]))
         for key, val in fetched.items():
             arr = np.asarray(val)
+            if arr.ndim != 0:
+                continue  # non-scalar diagnostics (e.g. per-restart NLLs)
             instr.log_metric(
                 key, int(arr) if np.issubdtype(arr.dtype, np.integer) else float(arr)
             )

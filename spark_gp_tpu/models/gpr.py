@@ -55,10 +55,87 @@ class GaussianProcessRegression(GaussianProcessCommons):
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("expert_size", data.expert_size)
 
+        if (
+            self._num_restarts > 1
+            and self._resolved_optimizer() == "device"
+            and self._mesh is None
+            and self._checkpoint_dir is None
+        ):
+            # ALL restarts as one vmapped device program; the PPA model is
+            # built once, for the winner (vs the sequential driver's
+            # full-fit-per-restart)
+            return self._fit_device_multistart(instr, data, x, y)
+
         def fit_once(kernel, instr_r):
             return self._fit_from_stack(instr_r, kernel, data, x, lambda: y, None)
 
         return self._fit_with_restarts(instr, fit_once)
+
+    def _fit_device_multistart(
+        self, instr, data, x, y
+    ) -> "GaussianProcessRegressionModel":
+        """Batched on-device multi-start (single chip): R starting points
+        run in one vmapped L-BFGS dispatch
+        (likelihood.fit_gpr_device_multistart); identical exploration to the
+        sequential driver (same ``_restart_theta_batch``)."""
+        import jax.numpy as jnp
+
+        from spark_gp_tpu.models.likelihood import fit_gpr_device_multistart
+        from spark_gp_tpu.utils.instrumentation import maybe_profile
+
+        with maybe_profile(self._profile_dir):
+            kernel = self._get_kernel()
+            dtype = data.x.dtype
+            theta_batch = jnp.asarray(
+                self._restart_theta_batch(kernel), dtype=dtype
+            )
+            lower, upper = kernel.bounds()
+            log_space = self._use_log_space(kernel)
+            instr.log_info(
+                "Optimising the kernel hyperparameters "
+                f"(on-device, {self._num_restarts} batched restarts)"
+            )
+            with instr.phase("optimize_hypers"):
+                theta, f, n_iter, n_fev, stalled, f_all, best = (
+                    fit_gpr_device_multistart(
+                        kernel, log_space, theta_batch,
+                        jnp.asarray(lower, dtype=dtype),
+                        jnp.asarray(upper, dtype=dtype),
+                        data.x, data.y, data.mask,
+                        jnp.asarray(self._max_iter, dtype=jnp.int32),
+                        jnp.asarray(self._tol, dtype=dtype),
+                    )
+                )
+            # the per-restart vector and the device-chosen winner index ride
+            # the existing single deferred fetch (no extra host sync here);
+            # non-scalar entries are returned un-logged
+            pending = {
+                "lbfgs_iters": n_iter,
+                "lbfgs_nfev": n_fev,
+                "final_nll": f,
+                "lbfgs_stalled": stalled,
+                "best_restart": best,
+                "restart_nlls": f_all,
+            }
+            raw, fetched = self._finalize_device_fit(
+                instr, kernel, theta, pending, x, lambda: y, data
+            )
+            nlls = np.asarray(fetched["restart_nlls"], dtype=np.float64)
+            if not np.any(np.isfinite(nlls)):
+                # mirror the sequential driver's failure contract
+                # (common.py _fit_with_restarts)
+                raise RuntimeError(
+                    "every restart produced a non-finite final NLL — the "
+                    "model configuration is numerically unusable at these "
+                    "settings"
+                )
+            for r, nll in enumerate(nlls):
+                instr.log_metric(f"restart_{r}_nll", float(nll))
+            instr.log_metric("num_restarts", self._num_restarts)
+        instr.log_success()
+        model = GaussianProcessRegressionModel(raw)
+        model.instr = instr
+        return model
 
     def _fit_from_stack(
         self, instr, kernel, data, x, targets_fn, active_override

@@ -171,6 +171,45 @@ def fit_gpr_device(
     return from_u(theta), f, n_iter, n_fev, stalled
 
 
+@partial(jax.jit, static_argnums=(0, 1))
+def fit_gpr_device_multistart(
+    kernel: Kernel, log_space, theta0_batch, lower, upper, x, y, mask,
+    max_iter, tol,
+):
+    """Multi-start single-chip fit: the R restarts run as ONE vmapped
+    on-device L-BFGS program (optimize/lbfgs_device.py multistart docs) and
+    only the winning iterate is returned — the PPA model is then built
+    once, for the winner.  Returns ``(theta_best, f_best, n_iter, n_fev,
+    stalled, f_all [R], best)``."""
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_minimize_device_multistart,
+        log_transform_bounds,
+        log_transform_vag,
+    )
+
+    data = ExpertData(x=x, y=y, mask=mask)
+
+    def vag(theta, aux):
+        value, grad = jax.value_and_grad(lambda t: batched_nll(kernel, t, data))(theta)
+        return value, grad, aux
+
+    if log_space:
+        vag = log_transform_vag(vag)
+        theta0_batch = jnp.log(theta0_batch)
+        lower, upper = log_transform_bounds(lower, upper)
+        from_u = jnp.exp
+    else:
+        from_u = lambda t: t
+
+    theta, f, _, n_iter, n_fev, stalled, f_all, best = (
+        lbfgs_minimize_device_multistart(
+            vag, theta0_batch, lower, upper, jnp.zeros(()),
+            max_iter=max_iter, tol=tol,
+        )
+    )
+    return from_u(theta), f, n_iter, n_fev, stalled, f_all, best
+
+
 # --- segmented device fit: checkpoint/resume for long runs ----------------
 
 

@@ -394,3 +394,52 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
         )
 
     return body
+
+
+def lbfgs_minimize_device_multistart(
+    value_and_grad_aux,
+    theta0_batch,
+    lower,
+    upper,
+    aux0,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    m_hist: int = 10,
+):
+    """ALL restarts of a multi-start minimization as ONE batched device
+    program: ``vmap`` over the starting points runs the R optimizers in
+    lockstep (a lane that stalls freezes — its line search keeps rejecting
+    from the same state; a lane that converges early can only keep
+    improving, since every accepted step requires Armijo decrease), so a
+    multi-start fit costs one dispatch and the per-lane compute batches
+    onto the MXU instead of R sequential programs.
+
+    ``theta0_batch`` is ``[R, h]``; ``aux0`` is shared (broadcast).
+    Returns ``(theta_best, f_best, aux_best, n_iter_best, n_fev_best,
+    stalled_best, f_all [R], best)`` — ``f_all`` is every lane's final
+    objective, ``best`` the winning lane's index (the SINGLE home of the
+    winner selection — callers must not re-rank), and the iter/fev
+    diagnostics are the winner's (matching the sequential driver's
+    winner-only reporting semantics).
+    """
+
+    def run_one(t0):
+        return lbfgs_minimize_device(
+            value_and_grad_aux, t0, lower, upper, aux0,
+            max_iter=max_iter, tol=tol, m_hist=m_hist,
+        )
+
+    thetas, fs, auxs, iters, fevs, stalls = jax.vmap(run_one)(theta0_batch)
+    # non-finite lanes (diverged restarts) must never win
+    fs_ranked = jnp.where(jnp.isfinite(fs), fs, jnp.inf)
+    best = jnp.argmin(fs_ranked)
+    return (
+        thetas[best],
+        fs[best],
+        jax.tree.map(lambda a: a[best], auxs),
+        iters[best],
+        fevs[best],
+        stalls[best],
+        fs,
+        best,
+    )

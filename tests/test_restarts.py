@@ -129,3 +129,30 @@ def test_restarts_in_fit_distributed(rng, eight_device_mesh):
     )
     assert model.instr.metrics["num_restarts"] == 2
     assert "restart_1_nll" in model.instr.metrics
+
+
+def test_batched_device_multistart(rng):
+    """GPR + device optimizer + restarts takes the batched one-dispatch
+    path: per-restart NLLs are recorded, the winner selection is internally
+    consistent (final_nll equals the best lane's NLL, best_restart points
+    at it), and the model is sound."""
+    x, y = _problem(rng)
+    batched = (
+        GaussianProcessRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-3, 10.0))
+        .setActiveSetSize(50)
+        .setMaxIter(15)
+        .setSeed(7)
+        .setNumRestarts(3)
+        .setOptimizer("device")
+        .fit(x, y)
+    )
+    m = batched.instr.metrics
+    assert m["num_restarts"] == 3
+    nlls = np.array([m[f"restart_{r}_nll"] for r in range(3)])
+    best = int(m["best_restart"])
+    np.testing.assert_allclose(m["final_nll"], nlls[best], rtol=1e-6)
+    assert nlls[best] == nlls.min()
+    from spark_gp_tpu.utils.validation import rmse
+
+    assert rmse(y, batched.predict(x)) < 0.2
