@@ -433,6 +433,32 @@ class GaussianProcessCommons(GaussianProcessParams):
             rows.append(np.clip(t_r, lower, upper))
         return np.stack(rows)
 
+    def _report_multistart_nlls(self, instr, fetched):
+        """Per-restart reporting shared by the batched device multi-start
+        paths: raises the sequential driver's error when every lane's NLL
+        is non-finite, else logs each restart's NLL and the restart count
+        (``best_restart`` is a scalar pending entry logged by the fetch)."""
+        nlls = np.asarray(fetched["restart_nlls"], dtype=np.float64)
+        if not np.any(np.isfinite(nlls)):
+            raise RuntimeError(
+                "every restart produced a non-finite final NLL — the model "
+                "configuration is numerically unusable at these settings"
+            )
+        for r, nll in enumerate(nlls):
+            instr.log_metric(f"restart_{r}_nll", float(nll))
+        instr.log_metric("num_restarts", self._num_restarts)
+
+    def _use_batched_multistart(self) -> bool:
+        """The batched one-dispatch multi-start applies on the plain
+        single-chip device path only (the sequential driver covers mesh /
+        checkpoint / host combinations)."""
+        return (
+            self._num_restarts > 1
+            and self._resolved_optimizer() == "device"
+            and self._mesh is None
+            and self._checkpoint_dir is None
+        )
+
     def _run_fit_distributed(self, name: str, data, active_set, prepare):
         """Shared shell of every estimator's ``fit_distributed``: resolve
         the mesh from the stack, log the stack shape, normalize an explicit

@@ -78,6 +78,9 @@ class GaussianProcessClassifier(GaussianProcessCommons):
 
             return targets_fn
 
+        if self._use_batched_multistart():
+            return self._fit_device_multistart(instr, data, x, make_targets_fn)
+
         def fit_once(kernel, instr_r):
             raw = self._fit_from_stack(instr_r, kernel, data, x, make_targets_fn)
             instr_r.log_success()
@@ -86,6 +89,58 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             return model
 
         return self._fit_with_restarts(instr, fit_once)
+
+    def _fit_device_multistart(
+        self, instr, data, x, make_targets_fn
+    ) -> "GaussianProcessClassificationModel":
+        """Batched on-device multi-start (single chip): R starting points
+        run in one vmapped Laplace + L-BFGS dispatch
+        (laplace.fit_gpc_device_multistart); the winner's latent modes feed
+        one PPA build."""
+        from spark_gp_tpu.models.laplace import fit_gpc_device_multistart
+        from spark_gp_tpu.utils.instrumentation import maybe_profile
+
+        with maybe_profile(self._profile_dir):
+            kernel = self._get_kernel()
+            dtype = data.x.dtype
+            theta_batch = jnp.asarray(
+                self._restart_theta_batch(kernel), dtype=dtype
+            )
+            lower, upper = kernel.bounds()
+            log_space = self._use_log_space(kernel)
+            instr.log_info(
+                "Optimising the kernel hyperparameters "
+                f"(on-device, {self._num_restarts} batched restarts)"
+            )
+            with instr.phase("optimize_hypers"):
+                theta, f_final, nll, n_iter, n_fev, stalled, f_all, best = (
+                    fit_gpc_device_multistart(
+                        kernel, float(self._tol), log_space, theta_batch,
+                        jnp.asarray(lower, dtype=dtype),
+                        jnp.asarray(upper, dtype=dtype),
+                        data.x, data.y, data.mask,
+                        jnp.asarray(self._max_iter, dtype=jnp.int32),
+                    )
+                )
+            latent_y = f_final * data.mask
+            latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
+            pending = {
+                "lbfgs_iters": n_iter,
+                "lbfgs_nfev": n_fev,
+                "final_nll": nll,
+                "lbfgs_stalled": stalled,
+                "best_restart": best,
+                "restart_nlls": f_all,
+            }
+            raw, fetched = self._finalize_device_fit(
+                instr, kernel, theta, pending, x,
+                make_targets_fn(latent_y), latent_data,
+            )
+            self._report_multistart_nlls(instr, fetched)
+        instr.log_success()
+        model = GaussianProcessClassificationModel(raw)
+        model.instr = instr
+        return model
 
     def fit_distributed(
         self, data, active_set: Optional[np.ndarray] = None

@@ -55,12 +55,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("expert_size", data.expert_size)
 
-        if (
-            self._num_restarts > 1
-            and self._resolved_optimizer() == "device"
-            and self._mesh is None
-            and self._checkpoint_dir is None
-        ):
+        if self._use_batched_multistart():
             # ALL restarts as one vmapped device program; the PPA model is
             # built once, for the winner (vs the sequential driver's
             # full-fit-per-restart)
@@ -120,18 +115,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
             raw, fetched = self._finalize_device_fit(
                 instr, kernel, theta, pending, x, lambda: y, data
             )
-            nlls = np.asarray(fetched["restart_nlls"], dtype=np.float64)
-            if not np.any(np.isfinite(nlls)):
-                # mirror the sequential driver's failure contract
-                # (common.py _fit_with_restarts)
-                raise RuntimeError(
-                    "every restart produced a non-finite final NLL — the "
-                    "model configuration is numerically unusable at these "
-                    "settings"
-                )
-            for r, nll in enumerate(nlls):
-                instr.log_metric(f"restart_{r}_nll", float(nll))
-            instr.log_metric("num_restarts", self._num_restarts)
+            self._report_multistart_nlls(instr, fetched)
         instr.log_success()
         model = GaussianProcessRegressionModel(raw)
         model.instr = instr

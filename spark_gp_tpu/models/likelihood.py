@@ -183,8 +183,7 @@ def fit_gpr_device_multistart(
     stalled, f_all [R], best)``."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device_multistart,
-        log_transform_bounds,
-        log_transform_vag,
+        log_reparam,
     )
 
     data = ExpertData(x=x, y=y, mask=mask)
@@ -194,10 +193,11 @@ def fit_gpr_device_multistart(
         return value, grad, aux
 
     if log_space:
-        vag = log_transform_vag(vag)
-        theta0_batch = jnp.log(theta0_batch)
-        lower, upper = log_transform_bounds(lower, upper)
-        from_u = jnp.exp
+        # log_reparam's transforms are elementwise, so the [R, h] batch of
+        # starting points maps through unchanged
+        vag, theta0_batch, lower, upper, from_u = log_reparam(
+            vag, theta0_batch, lower, upper
+        )
     else:
         from_u = lambda t: t
 

@@ -156,3 +156,27 @@ def test_batched_device_multistart(rng):
     from spark_gp_tpu.utils.validation import rmse
 
     assert rmse(y, batched.predict(x)) < 0.2
+
+
+def test_batched_device_multistart_classifier(rng):
+    from spark_gp_tpu import GaussianProcessClassifier
+
+    x = rng.normal(size=(150, 2))
+    y = (x.sum(axis=1) > 0).astype(np.float64)
+    model = (
+        GaussianProcessClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(75)
+        .setActiveSetSize(40)
+        .setMaxIter(10)
+        .setSeed(7)
+        .setNumRestarts(3)
+        .setOptimizer("device")
+        .fit(x, y)
+    )
+    m = model.instr.metrics
+    assert m["num_restarts"] == 3
+    nlls = np.array([m[f"restart_{r}_nll"] for r in range(3)])
+    np.testing.assert_allclose(m["final_nll"], nlls[int(m["best_restart"])], rtol=1e-6)
+    acc = float(np.mean(model.predict(x) == y))
+    assert acc > 0.9, acc
