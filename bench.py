@@ -34,7 +34,7 @@ so this script is a supervisor/worker pair:
 
 Environment knobs: BENCH_N (default 300000 on accelerators; 20000 on CPU),
 BENCH_EXPERT (100), BENCH_MAXITER (30), BENCH_OPTIMIZER (device),
-BENCH_PREFLIGHT_TIMEOUT (120 s), BENCH_PREFLIGHT_ATTEMPTS (3),
+BENCH_PREFLIGHT_TIMEOUT (150 s), BENCH_PREFLIGHT_ATTEMPTS (4),
 BENCH_WORKER_TIMEOUT (2400 s), BENCH_PALLAS_SWEEP / BENCH_AIRFOIL (TPU
 only: "1" [default] appends the Pallas-vs-XLA expert-size sweep / the
 airfoil 10-fold parity bar to the result detail; any other value disables).
@@ -415,8 +415,8 @@ def worker() -> None:
 
 def supervise() -> int:
     """Preflight → worker under watchdog → CPU fallback → one JSON line."""
-    pf_timeout = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", 120))
-    pf_attempts = int(os.environ.get("BENCH_PREFLIGHT_ATTEMPTS", 3))
+    pf_timeout = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", 150))
+    pf_attempts = int(os.environ.get("BENCH_PREFLIGHT_ATTEMPTS", 4))
     worker_timeout = float(os.environ.get("BENCH_WORKER_TIMEOUT", 2400))
     me = os.path.abspath(__file__)
 

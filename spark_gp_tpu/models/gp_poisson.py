@@ -66,10 +66,77 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
             data = self._group(x, y_f)
         instr.log_metric("num_experts", data.num_experts)
 
+        if self._use_batched_multistart():
+            return self._fit_device_multistart(instr, data, x)
+
         def fit_once(kernel, instr_r):
             return self._fit_from_stack(instr_r, kernel, data, x)
 
         return self._fit_with_restarts(instr, fit_once)
+
+    def _fit_device_multistart(
+        self, instr, data, x
+    ) -> "GaussianProcessPoissonModel":
+        """Batched on-device multi-start: R starting points in one vmapped
+        generic-Laplace + L-BFGS dispatch; one PPA build for the winner."""
+        from spark_gp_tpu.models.laplace_generic import (
+            fit_generic_device_multistart,
+        )
+        from spark_gp_tpu.parallel.experts import (
+            ExpertData,
+            num_experts_for,
+            ungroup,
+        )
+        from spark_gp_tpu.utils.instrumentation import maybe_profile
+
+        with maybe_profile(self._profile_dir):
+            kernel = self._get_kernel()
+            dtype = data.x.dtype
+            theta_batch = jnp.asarray(
+                self._restart_theta_batch(kernel), dtype=dtype
+            )
+            lower, upper = kernel.bounds()
+            log_space = self._use_log_space(kernel)
+            instr.log_info(
+                "Optimising the kernel hyperparameters "
+                f"(on-device, {self._num_restarts} batched restarts)"
+            )
+            with instr.phase("optimize_hypers"):
+                theta, f_final, nll, n_iter, n_fev, stalled, f_all, best = (
+                    fit_generic_device_multistart(
+                        self._likelihood, kernel, float(self._tol), log_space,
+                        theta_batch,
+                        jnp.asarray(lower, dtype=dtype),
+                        jnp.asarray(upper, dtype=dtype),
+                        data.x, data.y, data.mask,
+                        jnp.asarray(self._max_iter, dtype=jnp.int32),
+                    )
+                )
+            theta_host = np.asarray(theta, dtype=np.float64)
+            self._log_device_optimizer_result(
+                instr, kernel, theta_host, nll, n_iter, n_fev, stalled
+            )
+            instr.log_metric("best_restart", int(best))
+            self._report_multistart_nlls(
+                instr, {"restart_nlls": np.asarray(f_all)}
+            )
+
+            latent_y = f_final * data.mask
+            latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
+
+            def targets_fn():
+                e_real = num_experts_for(
+                    x.shape[0], self._dataset_size_for_expert
+                )
+                return ungroup(np.asarray(latent_y)[:e_real], x.shape[0])
+
+            raw = self._projected_process(
+                instr, kernel, theta_host, x, targets_fn, latent_data
+            )
+        instr.log_success()
+        model = GaussianProcessPoissonModel(raw)
+        model.instr = instr
+        return model
 
     def fit_distributed(
         self, data, active_set: Optional[np.ndarray] = None

@@ -109,10 +109,60 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
 
         y1h = _one_hot_masked(data.y, data.mask, n_classes)
 
+        if self._use_batched_multistart():
+            return self._fit_device_multistart(instr, data, y1h, x)
+
         def fit_once(kernel, instr_r):
             return self._fit_from_stack(instr_r, kernel, data, y1h, x)
 
         return self._fit_with_restarts(instr, fit_once)
+
+    def _fit_device_multistart(
+        self, instr, data, y1h, x
+    ) -> "GaussianProcessMulticlassModel":
+        """Batched on-device multi-start: R starting points in one vmapped
+        softmax-Laplace + L-BFGS dispatch; one PPA build for the winner."""
+        from spark_gp_tpu.models.laplace_mc import fit_gpc_mc_device_multistart
+        from spark_gp_tpu.utils.instrumentation import maybe_profile
+
+        with maybe_profile(self._profile_dir):
+            kernel = self._get_kernel()
+            dtype = data.x.dtype
+            theta_batch = jnp.asarray(
+                self._restart_theta_batch(kernel), dtype=dtype
+            )
+            lower, upper = kernel.bounds()
+            log_space = self._use_log_space(kernel)
+            instr.log_info(
+                "Optimising the kernel hyperparameters "
+                f"(on-device, {self._num_restarts} batched restarts)"
+            )
+            with instr.phase("optimize_hypers"):
+                theta, f_final, nll, n_iter, n_fev, stalled, f_all, best = (
+                    fit_gpc_mc_device_multistart(
+                        kernel, float(self._tol), log_space, theta_batch,
+                        jnp.asarray(lower, dtype=dtype),
+                        jnp.asarray(upper, dtype=dtype),
+                        data.x, y1h, data.mask,
+                        jnp.asarray(self._max_iter, dtype=jnp.int32),
+                    )
+                )
+            theta_host = np.asarray(theta, dtype=np.float64)
+            self._log_device_optimizer_result(
+                instr, kernel, theta_host, nll, n_iter, n_fev, stalled
+            )
+            instr.log_metric("best_restart", int(best))
+            self._report_multistart_nlls(
+                instr, {"restart_nlls": np.asarray(f_all)}
+            )
+            latents = f_final * data.mask[..., None]
+            raw = self._projected_process_multi(
+                instr, kernel, theta_host, x, data, latents
+            )
+        instr.log_success()
+        model = GaussianProcessMulticlassModel(raw)
+        model.instr = instr
+        return model
 
     def fit_distributed(
         self,

@@ -513,10 +513,7 @@ def fit_gpc_device_multistart(
     latent warm-start stacks ride per-lane ([R, E, s] total).  Returns
     ``(theta_best, f_latents_best, nll_best, n_iter, n_fev, stalled,
     f_all [R], best)``."""
-    from spark_gp_tpu.optimize.lbfgs_device import (
-        lbfgs_minimize_device_multistart,
-        log_reparam,
-    )
+    from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
     data = ExpertData(x=x, y=y, mask=mask)
 
@@ -524,19 +521,10 @@ def fit_gpc_device_multistart(
         value, grad, f_new = batched_neg_logz(kernel, tol, theta, data, f_carry)
         return value, grad, f_new
 
-    if log_space:
-        # log_reparam's transforms are elementwise, so the [R, h] batch of
-        # starting points maps through unchanged
-        vag, theta0_batch, lower, upper, from_u = log_reparam(
-            vag, theta0_batch, lower, upper
-        )
-    else:
-        from_u = lambda t: t
-
-    theta, f, f_final, n_iter, n_fev, stalled, f_all, best = (
-        lbfgs_minimize_device_multistart(
-            vag, theta0_batch, lower, upper, jnp.zeros_like(y),
-            max_iter=max_iter, tol=tol,
+    theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
+        multistart_minimize(
+            vag, log_space, theta0_batch, lower, upper, jnp.zeros_like(y),
+            max_iter, tol,
         )
     )
-    return from_u(theta), f_final, f, n_iter, n_fev, stalled, f_all, best
+    return theta, f_final, f, n_iter, n_fev, stalled, f_all, best

@@ -356,3 +356,28 @@ def fit_generic_device(
         vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
     )
     return from_u(theta), f_final, f, n_iter, n_fev, stalled
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def fit_generic_device_multistart(
+    lik: Likelihood, kernel: Kernel, tol, log_space,
+    theta0_batch, lower, upper, x, y, mask, max_iter,
+):
+    """Multi-start single-chip fit for any likelihood: R restarts as ONE
+    vmapped device program.  Returns ``(theta_best, f_latents_best,
+    nll_best, n_iter, n_fev, stalled, f_all [R], best)``."""
+    from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
+
+    def vag(theta, f_carry):
+        value, grad, f_new = batched_neg_logz_generic(
+            lik, kernel, tol, theta, x, y, mask, f_carry
+        )
+        return value, grad, f_new
+
+    theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
+        multistart_minimize(
+            vag, log_space, theta0_batch, lower, upper, jnp.zeros_like(y),
+            max_iter, tol,
+        )
+    )
+    return theta, f_final, f, n_iter, n_fev, stalled, f_all, best

@@ -472,3 +472,29 @@ def fit_gpc_mc_device_checkpointed(
         saver.save(state, meta)
     theta = jnp.exp(state.theta) if log_space else state.theta
     return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def fit_gpc_mc_device_multistart(
+    kernel: Kernel, tol, log_space, theta0_batch, lower, upper, x, y1h, mask,
+    max_iter,
+):
+    """Multi-start single-chip multiclass fit: R restarts as ONE vmapped
+    device program; the ``[E, s, C]`` latent stacks ride per lane.  Returns
+    ``(theta_best, f_latents_best, nll_best, n_iter, n_fev, stalled,
+    f_all [R], best)``."""
+    from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
+
+    def vag(theta, f_carry):
+        value, grad, f_new = batched_neg_logz_mc(
+            kernel, tol, theta, x, y1h, mask, f_carry
+        )
+        return value, grad, f_new
+
+    theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
+        multistart_minimize(
+            vag, log_space, theta0_batch, lower, upper, jnp.zeros_like(y1h),
+            max_iter, tol,
+        )
+    )
+    return theta, f_final, f, n_iter, n_fev, stalled, f_all, best

@@ -181,10 +181,7 @@ def fit_gpr_device_multistart(
     only the winning iterate is returned — the PPA model is then built
     once, for the winner.  Returns ``(theta_best, f_best, n_iter, n_fev,
     stalled, f_all [R], best)``."""
-    from spark_gp_tpu.optimize.lbfgs_device import (
-        lbfgs_minimize_device_multistart,
-        log_reparam,
-    )
+    from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
     data = ExpertData(x=x, y=y, mask=mask)
 
@@ -192,22 +189,11 @@ def fit_gpr_device_multistart(
         value, grad = jax.value_and_grad(lambda t: batched_nll(kernel, t, data))(theta)
         return value, grad, aux
 
-    if log_space:
-        # log_reparam's transforms are elementwise, so the [R, h] batch of
-        # starting points maps through unchanged
-        vag, theta0_batch, lower, upper, from_u = log_reparam(
-            vag, theta0_batch, lower, upper
-        )
-    else:
-        from_u = lambda t: t
-
-    theta, f, _, n_iter, n_fev, stalled, f_all, best = (
-        lbfgs_minimize_device_multistart(
-            vag, theta0_batch, lower, upper, jnp.zeros(()),
-            max_iter=max_iter, tol=tol,
-        )
+    theta, _, f, n_iter, n_fev, stalled, f_all, best = multistart_minimize(
+        vag, log_space, theta0_batch, lower, upper, jnp.zeros(()),
+        max_iter, tol,
     )
-    return from_u(theta), f, n_iter, n_fev, stalled, f_all, best
+    return theta, f, n_iter, n_fev, stalled, f_all, best
 
 
 # --- segmented device fit: checkpoint/resume for long runs ----------------

@@ -443,3 +443,28 @@ def lbfgs_minimize_device_multistart(
         fs,
         best,
     )
+
+
+def multistart_minimize(
+    value_and_grad_aux, log_space, theta0_batch, lower, upper, aux0,
+    max_iter, tol,
+):
+    """Shared plumbing of every model family's batched multi-start fit:
+    optional log-space reparameterization (elementwise, so the [R, h]
+    starting batch maps through unchanged) around
+    :func:`lbfgs_minimize_device_multistart`.  Returns
+    ``(theta_best, aux_best, nll_best, n_iter, n_fev, stalled,
+    f_all [R], best)`` in the original (non-log) coordinates."""
+    if log_space:
+        value_and_grad_aux, theta0_batch, lower, upper, from_u = log_reparam(
+            value_and_grad_aux, theta0_batch, lower, upper
+        )
+    else:
+        from_u = lambda t: t
+    theta, f, aux, n_iter, n_fev, stalled, f_all, best = (
+        lbfgs_minimize_device_multistart(
+            value_and_grad_aux, theta0_batch, lower, upper, aux0,
+            max_iter=max_iter, tol=tol,
+        )
+    )
+    return from_u(theta), aux, f, n_iter, n_fev, stalled, f_all, best

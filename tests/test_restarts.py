@@ -180,3 +180,44 @@ def test_batched_device_multistart_classifier(rng):
     np.testing.assert_allclose(m["final_nll"], nlls[int(m["best_restart"])], rtol=1e-6)
     acc = float(np.mean(model.predict(x) == y))
     assert acc > 0.9, acc
+
+
+@pytest.mark.parametrize("family", ["multiclass", "poisson"])
+def test_batched_device_multistart_mc_and_poisson(rng, family):
+    if family == "multiclass":
+        from spark_gp_tpu import GaussianProcessMulticlassClassifier as Est
+
+        x = rng.normal(size=(120, 2))
+        y = np.digitize(x.sum(axis=1), [-0.5, 0.5]).astype(np.float64)
+    else:
+        from spark_gp_tpu import GaussianProcessPoissonRegression as Est
+
+        x = np.linspace(0, 4, 120)[:, None]
+        y = rng.poisson(np.exp(1 + np.sin(2 * x[:, 0]))).astype(np.float64)
+    model = (
+        Est()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(60)
+        .setActiveSetSize(30)
+        .setMaxIter(8)
+        .setSeed(7)
+        .setNumRestarts(3)
+        .setOptimizer("device")
+        .fit(x, y)
+    )
+    m = model.instr.metrics
+    assert m["num_restarts"] == 3
+    nlls = np.array([m[f"restart_{r}_nll"] for r in range(3)])
+    np.testing.assert_allclose(
+        m["final_nll"], nlls[int(m["best_restart"])], rtol=1e-6
+    )
+    # the winner's PPA tail must produce a sound model, not just metrics
+    if family == "multiclass":
+        acc = float(np.mean(model.predict(x) == y))
+        assert acc > 0.85, acc
+    else:
+        rate = model.predict_rate(x)
+        assert np.all(np.isfinite(rate)) and np.all(rate >= 0)
+        rel = float(np.mean(np.abs(rate - np.exp(1 + np.sin(2 * x[:, 0])))
+                    / np.exp(1 + np.sin(2 * x[:, 0]))))
+        assert rel < 0.4, rel
