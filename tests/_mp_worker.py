@@ -2,9 +2,9 @@
 
 Each process owns a disjoint shard of the rows (the HDFS-partition analogue,
 GaussianProcessCommons.scala:20-24), joins the coordination plane, stitches
-its rows into the globally-sharded expert stack, runs both estimators'
-``fit_distributed``, and prints one JSON line of results for the parent to
-cross-check across processes.
+its rows into the globally-sharded expert stack, runs the regression,
+binary-classifier and multiclass ``fit_distributed`` paths, and prints one
+JSON line of results for the parent to cross-check across processes.
 
 Run (by the test): python tests/_mp_worker.py <pid> <nproc> <port>
 """
@@ -76,6 +76,22 @@ def main() -> None:
     )
     cpred = cmodel.predict_proba(probe)[:, 1]
 
+    # native multiclass over the same shards (3 quantile-ish buckets)
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+
+    ym_local = np.digitize(x_local.sum(axis=1), [-0.5, 0.5]).astype(np.float64)
+    mdata = dist.distribute_global_experts(x_local, ym_local, 16, mesh)
+    mmodel = (
+        GaussianProcessMulticlassClassifier()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(48)
+        .setMaxIter(8)
+        .setSeed(3)
+        .setMesh(mesh)
+        .fit_distributed(mdata)
+    )
+    mpred = mmodel.predict_raw(probe)
+
     # training-fit quality on the local shard (loose: tiny maxiter)
     rmse_local = float(
         np.sqrt(np.mean((model.predict(x_local) - y_local) ** 2))
@@ -88,6 +104,7 @@ def main() -> None:
                 "n_global_devices": len(jax.devices()),
                 "pred": np.round(pred, 10).tolist(),
                 "cpred": np.round(cpred, 10).tolist(),
+                "mpred": np.round(np.asarray(mpred), 10).tolist(),
                 "rmse_local": rmse_local,
             }
         ),

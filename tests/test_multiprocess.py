@@ -71,8 +71,9 @@ def test_two_process_fit_distributed():
     r0, r1 = results[0], results[1]
     assert r0["n_global_devices"] == 2
     # the fitted model is replicated: both processes must predict the SAME
-    # values on the shared probe set (regression and classifier)
+    # values on the shared probe set (regression, binary classifier and multiclass)
     np.testing.assert_allclose(r0["pred"], r1["pred"], rtol=0, atol=1e-8)
     np.testing.assert_allclose(r0["cpred"], r1["cpred"], rtol=0, atol=1e-8)
+    np.testing.assert_allclose(r0["mpred"], r1["mpred"], rtol=0, atol=1e-8)
     # and the joint fit actually learned the shared function
     assert r0["rmse_local"] < 0.2, r0["rmse_local"]
