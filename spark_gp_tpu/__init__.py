@@ -32,6 +32,7 @@ _honor_platform_env()
 
 from spark_gp_tpu.kernels import (
     ARDMatern32Kernel,
+    ARDRationalQuadraticKernel,
     ARDMatern52Kernel,
     ARDRBFKernel,
     Const,
@@ -86,6 +87,7 @@ __all__ = [
     "ARDMatern32Kernel",
     "ARDMatern52Kernel",
     "RationalQuadraticKernel",
+    "ARDRationalQuadraticKernel",
     "PeriodicKernel",
     "DotProductKernel",
     "PolynomialKernel",

@@ -18,6 +18,7 @@ from spark_gp_tpu.kernels.base import (
     WhiteNoiseKernel,
 )
 from spark_gp_tpu.kernels.families import (
+    ARDRationalQuadraticKernel,
     DotProductKernel,
     PeriodicKernel,
     PolynomialKernel,
@@ -51,6 +52,7 @@ __all__ = [
     "ARDMatern32Kernel",
     "ARDMatern52Kernel",
     "RationalQuadraticKernel",
+    "ARDRationalQuadraticKernel",
     "PeriodicKernel",
     "DotProductKernel",
     "PolynomialKernel",

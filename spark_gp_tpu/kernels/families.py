@@ -32,8 +32,8 @@ import math
 import jax.numpy as jnp
 import numpy as np
 
-from spark_gp_tpu.kernels.base import Kernel, StationaryKernel
-from spark_gp_tpu.ops.distance import mxu_inner, sq_dist
+from spark_gp_tpu.kernels.base import ARDHypers, Kernel, StationaryKernel
+from spark_gp_tpu.ops.distance import mxu_inner, sq_dist, weighted_sq_dist
 
 
 def _pair(value, default: float) -> tuple:
@@ -103,6 +103,59 @@ class RationalQuadraticKernel(_TwoHyperStationary):
         return (
             f"RationalQuadraticKernel(sigma={float(t[0]):.1e}, "
             f"alpha={float(t[1]):.1e})"
+        )
+
+
+class ARDRationalQuadraticKernel(ARDHypers):
+    """ARD rational quadratic: ``k = (1 + |(x - x') * beta|^2 /
+    alpha)^(-alpha)`` — one trainable inverse length-scale per feature
+    dimension (the ARD-RBF beta-multiplies, no-1/2 convention,
+    ARDRBFKernel.scala:8-15: ``alpha -> inf`` recovers ``ARDRBFKernel``
+    with the SAME betas) plus the trainable mixture-shape ``alpha``
+    APPENDED to the hyperparameter vector: ``theta = [beta_1..beta_p,
+    alpha]``.  Beta bounds follow :class:`ARDHypers` (per-dimension,
+    default ``[0, inf)`` so features can be pruned); ``alpha`` has its own
+    box."""
+
+    def __init__(self, p_or_beta, beta: float = 1.0, alpha: float = 1.0,
+                 lower=0.0, upper=math.inf,
+                 alpha_lower: float = 1e-6, alpha_upper: float = math.inf):
+        super().__init__(p_or_beta, beta, lower, upper)
+        self.alpha0 = float(alpha)
+        self.alpha_bounds = (float(alpha_lower), float(alpha_upper))
+        self.n_hypers = self.beta0.shape[0] + 1
+
+    def _spec(self) -> tuple:
+        return super()._spec() + (self.alpha0, self.alpha_bounds)
+
+    def init_theta(self):
+        return np.concatenate([self.beta0, [self.alpha0]])
+
+    def bounds(self):
+        return (
+            np.concatenate([self.lower_b, [self.alpha_bounds[0]]]),
+            np.concatenate([self.upper_b, [self.alpha_bounds[1]]]),
+        )
+
+    def _k(self, theta, x_a, x_b):
+        beta, alpha = theta[:-1], theta[-1]
+        base = 1.0 + weighted_sq_dist(x_a, x_b, beta) / alpha
+        # exp/log form for alpha-gradient stability (see
+        # RationalQuadraticKernel._k)
+        return jnp.exp(-alpha * jnp.log(base))
+
+    def gram(self, theta, x):
+        return self._k(theta, x, x)
+
+    def cross(self, theta, x_test, x_train):
+        return self._k(theta, x_test, x_train)
+
+    def describe(self, theta) -> str:
+        t = np.asarray(theta)
+        vals = ", ".join(f"{v:.1e}" for v in t[:-1])
+        return (
+            f"ARDRationalQuadraticKernel(beta=[{vals}], "
+            f"alpha={float(t[-1]):.1e})"
         )
 
 

@@ -559,3 +559,50 @@ def test_product_kernel_rejects_noise_factors():
         (RBFKernel(1.0) + WhiteNoiseKernel(0.1, 0, 1)) * RBFKernel(0.5)
     with pytest.raises(ValueError, match="white-noise"):
         RBFKernel(1.0) * EyeKernel()
+
+
+def test_ard_rational_quadratic(rng):
+    """Closed-form values, theta layout (beta..., alpha appended), FD
+    gradients, and the alpha -> inf RBF-ARD limit."""
+    from spark_gp_tpu import ARDRationalQuadraticKernel
+
+    beta = np.array([0.4, 1.2, 0.8])
+    alpha = 1.6
+    k = ARDRationalQuadraticKernel(beta, alpha=alpha)
+    assert k.n_hypers == 4
+    np.testing.assert_allclose(k.init_theta(), [0.4, 1.2, 0.8, 1.6])
+    lo, hi = k.bounds()
+    np.testing.assert_allclose(lo, [0.0, 0.0, 0.0, 1e-6])  # beta prunable
+    x = rng.normal(size=(7, 3))
+    theta = jnp.asarray(k.init_theta())
+    gram = np.asarray(k.gram(theta, jnp.asarray(x)))
+    d2 = (((x[:, None, :] - x[None, :, :]) * beta) ** 2).sum(-1)
+    np.testing.assert_allclose(
+        gram, (1.0 + d2 / alpha) ** (-alpha), rtol=1e-6
+    )
+    np.testing.assert_allclose(np.diag(gram), 1.0, rtol=1e-12)
+
+    # FD gradients through every hyperparameter incl. the appended alpha
+    w = jnp.asarray(rng.normal(size=(7, 7)))
+
+    def functional(t):
+        return float(jnp.sum(w * k.gram(jnp.asarray(t), jnp.asarray(x))))
+
+    auto = np.asarray(
+        jax.grad(lambda t: jnp.sum(w * k.gram(t, jnp.asarray(x))))(theta)
+    )
+    fd = _fd_grad(functional, k.init_theta())
+    np.testing.assert_allclose(auto, fd, rtol=2e-4, atol=1e-7)
+
+    # alpha -> inf recovers ARD-RBF with the SAME betas (the no-1/2
+    # reference convention, ARDRBFKernel.scala:43-46)
+    from spark_gp_tpu import ARDRBFKernel
+
+    k_inf = ARDRationalQuadraticKernel(beta, alpha=1e6)
+    gram_inf = np.asarray(
+        k_inf.gram(jnp.asarray(k_inf.init_theta()), jnp.asarray(x))
+    )
+    gram_rbf = np.asarray(
+        ARDRBFKernel(beta).gram(jnp.asarray(beta), jnp.asarray(x))
+    )
+    np.testing.assert_allclose(gram_inf, gram_rbf, rtol=1e-4)
