@@ -598,7 +598,8 @@ def test_ard_rational_quadratic(rng):
     # reference convention, ARDRBFKernel.scala:43-46)
     from spark_gp_tpu import ARDRBFKernel
 
-    k_inf = ARDRationalQuadraticKernel(beta, alpha=1e6)
+    # convergence error is O(d^4 / alpha): 1e7 puts it well under the rtol
+    k_inf = ARDRationalQuadraticKernel(beta, alpha=1e7)
     gram_inf = np.asarray(
         k_inf.gram(jnp.asarray(k_inf.init_theta()), jnp.asarray(x))
     )
