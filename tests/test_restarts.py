@@ -221,3 +221,24 @@ def test_batched_device_multistart_mc_and_poisson(rng, family):
         rel = float(np.mean(np.abs(rate - np.exp(1 + np.sin(2 * x[:, 0])))
                     / np.exp(1 + np.sin(2 * x[:, 0]))))
         assert rel < 0.4, rel
+
+
+def test_restart_winner_model_roundtrips(rng, tmp_path):
+    """A multi-start winner's model may carry a ThetaOverrideKernel inside
+    its predictor; save/load must round-trip it (pickle of the wrapper +
+    composite spec) with identical predictions."""
+    from spark_gp_tpu import GaussianProcessRegressionModel
+
+    x, y = _problem(rng, n=200)
+    model = _make_gp(3).fit(x, y)
+    path = str(tmp_path / "winner")
+    model.save(path)
+    loaded = GaussianProcessRegressionModel.load(path)
+    np.testing.assert_allclose(
+        loaded.predict(x[:30]), model.predict(x[:30]), rtol=1e-12
+    )
+    # the loaded kernel still describes itself (the instrumentation path)
+    desc = loaded.raw_predictor.kernel.describe(
+        loaded.raw_predictor.theta
+    )
+    assert isinstance(desc, str) and len(desc) > 0
