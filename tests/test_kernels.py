@@ -607,3 +607,51 @@ def test_ard_rational_quadratic(rng):
         ARDRBFKernel(beta).gram(jnp.asarray(beta), jnp.asarray(x))
     )
     np.testing.assert_allclose(gram_inf, gram_rbf, rtol=1e-4)
+
+
+def test_every_family_describes_itself(rng):
+    """kernel.describe(theta) — the 'Optimal kernel:' instrumentation line
+    (GPC.scala:89's toString analogue) — must produce a non-empty string
+    for every family and composite at its init theta."""
+    from spark_gp_tpu import (
+        ARDMatern32Kernel,
+        ARDRationalQuadraticKernel,
+        ARDRBFKernel,
+        Const,
+        DotProductKernel,
+        EyeKernel,
+        Matern12Kernel,
+        Matern32Kernel,
+        Matern52Kernel,
+        PeriodicKernel,
+        PolynomialKernel,
+        RationalQuadraticKernel,
+        RBFKernel,
+        WhiteNoiseKernel,
+    )
+    from spark_gp_tpu.kernels.base import ThetaOverrideKernel
+
+    kernels = [
+        RBFKernel(0.5),
+        ARDRBFKernel(3, 0.7),
+        Matern12Kernel(1.0),
+        Matern32Kernel(1.0),
+        Matern52Kernel(1.0),
+        ARDMatern32Kernel(np.array([0.5, 1.5])),
+        RationalQuadraticKernel(0.8, 1.2),
+        ARDRationalQuadraticKernel(2, 0.6, alpha=1.5),
+        PeriodicKernel(1.3, 0.9),
+        DotProductKernel(0.7),
+        PolynomialKernel(3, 1.2),
+        1.0 * RBFKernel(0.5) + WhiteNoiseKernel(0.1, 0, 1),
+        RBFKernel(2.0) * PeriodicKernel(1.0),
+        Const(0.5) * EyeKernel(),
+    ]
+    kernels.append(ThetaOverrideKernel(kernels[0], np.array([2.0])))
+    for k in kernels:
+        desc = k.describe(k.init_theta())
+        assert isinstance(desc, str), type(k).__name__
+        # Const(c)*Eye legitimately renders non-empty; everything must
+        # at least not crash, and non-Eye kernels must be non-empty
+        if not isinstance(k, type(Const(0.5) * EyeKernel())):
+            assert len(desc) > 0, type(k).__name__
