@@ -360,3 +360,23 @@ def test_fit_distributed_multiclass(rng, eight_device_mesh):
     )
     with pytest.raises(ValueError, match="integers"):
         make().fit_distributed(bad)
+
+
+def test_mean_only_multiclass_rejects_averaged_proba(rng):
+    from spark_gp_tpu import GaussianProcessMulticlassClassifier
+
+    x, y = _blobs(rng, n_per=30)
+    model = (
+        GaussianProcessMulticlassClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(45)
+        .setActiveSetSize(20)
+        .setMaxIter(5)
+        .setPredictiveVariance(False)
+        .fit(x, y)
+    )
+    # MAP probabilities still work on a mean-only model
+    proba = model.predict_proba(x[:10])
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    with pytest.raises(ValueError, match="setPredictiveVariance"):
+        model.predict_proba(x[:10], averaged=True)

@@ -309,3 +309,23 @@ def test_fit_distributed_poisson(rng, eight_device_mesh):
     bad = dist.distribute_global_experts(x, y + 0.5, 50, eight_device_mesh)
     with pytest.raises(ValueError, match="counts"):
         make().fit_distributed(bad)
+
+
+def test_mean_only_poisson_uses_map_rate(rng):
+    """setPredictiveVariance(False): predict_rate falls back to exp(mu)
+    (no lognormal correction) instead of failing."""
+    from spark_gp_tpu import GaussianProcessPoissonRegression
+
+    x, y, _ = _count_problem(rng, n=200)
+    model = (
+        GaussianProcessPoissonRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-2, 10.0))
+        .setActiveSetSize(40)
+        .setMaxIter(8)
+        .setPredictiveVariance(False)
+        .fit(x, y)
+    )
+    mean, var = model.predict_latent(x[:20])
+    assert var is None
+    rate = model.predict_rate(x[:20])
+    np.testing.assert_allclose(rate, np.exp(mean), rtol=1e-12)
