@@ -100,3 +100,21 @@ class OneVsRest:
             [m.predict_raw(x_test)[:, 1] for m in self.models_], axis=1
         )
         return self.classes_[np.argmax(scores, axis=1)]
+
+    def predict_proba(self, x_test: np.ndarray) -> np.ndarray:
+        """``[t, C]`` normalized per-class sigmoid scores.
+
+        The C binary models are fitted independently, so their sigmoids
+        need not sum to 1; this renormalizes them (the standard OvR
+        calibration compromise — for jointly calibrated probabilities use
+        the native ``GaussianProcessMulticlassClassifier``).  Computed in
+        log space (softmax over ``log_sigmoid`` of the raw latents), so
+        sigmoid saturation can neither zero out a row nor flip the argmax
+        away from :meth:`predict`.  Column order follows ``classes_``.
+        """
+        from scipy.special import log_expit, softmax
+
+        latents = np.stack(
+            [m.predict_raw(x_test)[:, 1] for m in self.models_], axis=1
+        )
+        return softmax(log_expit(latents), axis=1)

@@ -155,3 +155,26 @@ def test_profile_dir_produces_trace(tmp_path):
     for root, _dirs, files in os.walk(trace_dir):
         produced.extend(os.path.join(root, f) for f in files)
     assert produced, "no profiler trace files written"
+
+
+def test_ovr_predict_proba(rng):
+    """OvR normalized sigmoid scores: rows sum to 1, argmax agrees with
+    predict, column order follows classes_."""
+    from spark_gp_tpu import GaussianProcessClassifier, RBFKernel
+    from spark_gp_tpu.utils.validation import OneVsRest
+
+    x = rng.normal(size=(90, 2))
+    y = np.digitize(x.sum(axis=1), [-0.5, 0.5]).astype(np.float64)
+    ovr = OneVsRest(
+        lambda: GaussianProcessClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setDatasetSizeForExpert(45)
+        .setActiveSetSize(20)
+        .setMaxIter(5)
+    ).fit(x, y)
+    proba = ovr.predict_proba(x[:25])
+    assert proba.shape == (25, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-9)
+    np.testing.assert_array_equal(
+        ovr.classes_[np.argmax(proba, axis=1)], ovr.predict(x[:25])
+    )
