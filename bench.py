@@ -439,6 +439,12 @@ def supervise() -> int:
                 )
                 result["detail"] = result.get("detail", {})
                 result["detail"]["fallback"] = f"default plan failed: {reason}"
+                result["detail"]["fallback_note"] = (
+                    "CPU-fallback measurement (detail.fallback records why "
+                    "the default plan failed); not comparable to hardware "
+                    "rounds — see the latest BENCH_r*.json with "
+                    "platform=tpu for the chip throughput"
+                )
             print(json.dumps(result))
             return 0
         errors[name + "-worker"] = err or (
