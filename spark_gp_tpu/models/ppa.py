@@ -548,10 +548,18 @@ def sharded_magic_solve(
     raise NotPositiveDefiniteException()
 
 
-def _as_float(x_test):
-    """Integer test inputs must not drag theta/active/magic operators to an
-    integer dtype (a lengthscale of 1.2 would silently truncate to 1)."""
+def _as_float(x_test, n_features: int):
+    """Normalize test inputs before the jitted predict programs: integer
+    dtypes must not drag theta/active/magic operators to an integer dtype
+    (a lengthscale of 1.2 would silently truncate to 1), and a feature-
+    count mismatch must fail here with a readable message instead of a
+    broadcast error deep inside jit."""
     x_test = jnp.asarray(x_test)
+    if x_test.ndim != 2 or x_test.shape[1] != n_features:
+        raise ValueError(
+            f"x_test must be [t, {n_features}] (the model was fitted on "
+            f"{n_features} features); got shape {tuple(x_test.shape)}"
+        )
     if not jnp.issubdtype(x_test.dtype, jnp.floating):
         x_test = x_test.astype(jnp.promote_types(x_test.dtype, jnp.float32))
     return x_test
@@ -603,7 +611,7 @@ class ProjectedProcessRawPredictor:
                 "model was fitted with setPredictiveVariance(False); "
                 "no covariance operator is available"
             )
-        x_test = _as_float(x_test)
+        x_test = _as_float(x_test, self.active.shape[1])
         dtype = x_test.dtype
         return _predict_cov_jit(
             self.kernel,
@@ -619,7 +627,7 @@ class ProjectedProcessRawPredictor:
         return self._run(x_test, mean_only=self.magic_matrix is None)
 
     def _run(self, x_test, mean_only: bool):
-        x_test = _as_float(x_test)
+        x_test = _as_float(x_test, self.active.shape[1])
         dtype = x_test.dtype
         args = (
             self.kernel,

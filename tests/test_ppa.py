@@ -418,3 +418,22 @@ def test_mean_only_model_rejects_cov(rng):
     )
     with pytest.raises(ValueError, match="covariance"):
         model.predict_with_cov(x[:5])
+
+
+def test_predict_rejects_feature_mismatch(rng):
+    """A wrong feature count at predict time fails with a readable message
+    naming the expected dimensionality, not a jit broadcast error."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    x = rng.normal(size=(80, 3))
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-2, 10.0))
+        .setActiveSetSize(20)
+        .setMaxIter(3)
+        .fit(x, np.sin(x.sum(1)))
+    )
+    with pytest.raises(ValueError, match=r"\[t, 3\]"):
+        model.predict(rng.normal(size=(5, 2)))
+    with pytest.raises(ValueError, match=r"\[t, 3\]"):
+        model.predict_with_cov(rng.normal(size=(5,)))
