@@ -223,29 +223,58 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         )
 
     def _fit_device(self, instr, kernel, data):
-        if self._mesh is not None or self._checkpoint_dir is not None:
-            # segmented/sharded device variants are not wired for the
-            # generic-likelihood path yet — the host-driven sharded
-            # objective covers the mesh case
-            instr.log_info(
-                "device optimizer with mesh/checkpointing falls back to the "
-                "host-driven objective for Poisson regression"
-            )
-            return self._fit_host(instr, kernel, data)
+        """One-dispatch on-device fit — the same mesh/checkpoint dispatch as
+        the other three families (GaussianProcessCommons.scala:66-92 is one
+        skeleton for every estimator; so is this)."""
         dtype = data.x.dtype
         theta0 = jnp.asarray(kernel.init_theta(), dtype=dtype)
         lower, upper = kernel.bounds()
+        lower = jnp.asarray(lower, dtype=dtype)
+        upper = jnp.asarray(upper, dtype=dtype)
         log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
         with instr.phase("optimize_hypers"):
-            theta, f_final, nll, n_iter, n_fev, stalled = fit_generic_device(
-                self._likelihood, kernel, float(self._tol), log_space,
-                theta0,
-                jnp.asarray(lower, dtype=dtype),
-                jnp.asarray(upper, dtype=dtype),
-                data.x, data.y, data.mask,
-                jnp.asarray(self._max_iter, dtype=jnp.int32),
-            )
+            if self._checkpoint_dir is not None:
+                from spark_gp_tpu.models.laplace_generic import (
+                    fit_generic_device_checkpointed,
+                )
+                from spark_gp_tpu.utils.checkpoint import (
+                    DeviceOptimizerCheckpointer,
+                )
+
+                theta, f_final, nll, n_iter, n_fev, stalled = (
+                    fit_generic_device_checkpointed(
+                        self._likelihood, kernel, float(self._tol),
+                        self._mesh, log_space, theta0, lower, upper,
+                        data.x, data.y, data.mask, self._max_iter,
+                        self._checkpoint_interval,
+                        DeviceOptimizerCheckpointer(
+                            self._checkpoint_dir, "poisson"
+                        ),
+                    )
+                )
+            elif self._mesh is not None:
+                from spark_gp_tpu.models.laplace_generic import (
+                    fit_generic_device_sharded,
+                )
+
+                theta, f_final, nll, n_iter, n_fev, stalled = (
+                    fit_generic_device_sharded(
+                        self._likelihood, kernel, float(self._tol),
+                        self._mesh, log_space, theta0, lower, upper,
+                        data.x, data.y, data.mask,
+                        jnp.asarray(self._max_iter, dtype=jnp.int32),
+                    )
+                )
+            else:
+                theta, f_final, nll, n_iter, n_fev, stalled = (
+                    fit_generic_device(
+                        self._likelihood, kernel, float(self._tol), log_space,
+                        theta0, lower, upper,
+                        data.x, data.y, data.mask,
+                        jnp.asarray(self._max_iter, dtype=jnp.int32),
+                    )
+                )
         theta_host = np.asarray(theta, dtype=np.float64)
         self._log_device_optimizer_result(
             instr, kernel, theta_host, nll, n_iter, n_fev, stalled

@@ -358,6 +358,148 @@ def fit_generic_device(
     return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
 
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def fit_generic_device_sharded(
+    lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
+    theta0, lower, upper, x, y, mask, max_iter,
+):
+    """Multi-chip on-device fit for any likelihood inside one shard_map:
+    latent stacks stay device-resident and sharded for the entire
+    optimization (the generic-likelihood counterpart of
+    laplace.fit_gpc_device_sharded — one skeleton for every estimator,
+    GaussianProcessCommons.scala:66-92)."""
+    from jax.sharding import PartitionSpec as P
+
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_minimize_device,
+        log_reparam,
+    )
+    from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(), P(), P(),
+            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+            P(),
+        ),
+        out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
+    )
+    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_):
+        def vag(theta, f_carry):
+            value, grad, f_new = batched_neg_logz_generic(
+                lik, kernel, tol, theta, x_, y_, mask_, f_carry
+            )
+            return (
+                jax.lax.psum(value, EXPERT_AXIS),
+                jax.lax.psum(grad, EXPERT_AXIS),
+                f_new,
+            )
+
+        if log_space:
+            vag, t0, lo, hi, from_u = log_reparam(vag, theta0_, lower_, upper_)
+        else:
+            vag, t0, lo, hi, from_u = vag, theta0_, lower_, upper_, (lambda t: t)
+
+        f0 = jnp.zeros_like(y_)
+        theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
+            vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
+        )
+        return from_u(theta), f_final, f, n_iter, n_fev, stalled
+
+    return run(theta0, lower, upper, x, y, mask, max_iter)
+
+
+# --- segmented device fit: checkpoint/resume (laplace.py counterpart) ------
+
+
+def _generic_segment_vag(lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
+                         x, y, mask):
+    from spark_gp_tpu.optimize.lbfgs_device import log_transform_vag
+
+    if mesh is None:
+
+        def base(theta, f_carry):
+            return batched_neg_logz_generic(
+                lik, kernel, tol, theta, x, y, mask, f_carry
+            )
+
+    else:
+        core = _make_sharded_generic_logz(lik, kernel, tol, mesh)
+
+        def base(theta, f_carry):
+            return core(theta, f_carry, x, y, mask)
+
+    return log_transform_vag(base) if log_space else base
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def generic_device_segment_init(
+    lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
+    theta0, lower, upper, x, y, mask,
+):
+    from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
+
+    vag = _generic_segment_vag(lik, kernel, tol, mesh, log_space, x, y, mask)
+    t0 = jnp.log(theta0) if log_space else theta0
+    return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def generic_device_segment_run(
+    lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
+    state, lower, upper, x, y, mask, iter_limit,
+):
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_run_segment,
+        log_transform_bounds,
+    )
+
+    vag = _generic_segment_vag(lik, kernel, tol, mesh, log_space, x, y, mask)
+    lo, hi = (
+        log_transform_bounds(lower, upper) if log_space else (lower, upper)
+    )
+    return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
+
+
+def fit_generic_device_checkpointed(
+    lik: Likelihood, kernel: Kernel, tol, mesh, log_space, theta0, lower,
+    upper, x, y, mask, max_iter: int, chunk: int, saver,
+):
+    """Segmented on-device generic-likelihood fit with state persistence —
+    see laplace.fit_gpc_device_checkpointed.  The aux carry is the latent
+    warm-start stack, so a resume continues from the settled modes.
+    Returns ``(theta, f_latents, nll, n_iter, n_fev, stalled)``."""
+    from spark_gp_tpu.utils.checkpoint import data_fingerprint
+
+    meta = {
+        "kind": f"generic:{type(lik).__name__}{lik._spec()}",
+        "log_space": bool(log_space),
+        "theta_dim": int(theta0.shape[0]),
+        "num_experts": int(x.shape[0]),
+        "expert_size": int(x.shape[1]),
+        "data_fingerprint": data_fingerprint(x, y, mask),
+    }
+    init = partial(
+        generic_device_segment_init, lik, kernel, float(tol), mesh, log_space
+    )
+    # shapes/dtypes only — skips a full Newton mode solve on resume
+    template = jax.eval_shape(init, theta0, lower, upper, x, y, mask)
+    state = saver.load(template, meta)
+    if state is None:
+        state = init(theta0, lower, upper, x, y, mask)
+    while not bool(state.done) and int(state.n_iter) < max_iter:
+        limit = jnp.asarray(min(int(state.n_iter) + chunk, max_iter), jnp.int32)
+        state = generic_device_segment_run(
+            lik, kernel, float(tol), mesh, log_space, state, lower, upper,
+            x, y, mask, limit,
+        )
+        saver.save(state, meta)
+    theta = jnp.exp(state.theta) if log_space else state.theta
+    return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
+
+
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def fit_generic_device_multistart(
     lik: Likelihood, kernel: Kernel, tol, log_space,

@@ -329,3 +329,85 @@ def test_mean_only_poisson_uses_map_rate(rng):
     assert var is None
     rate = model.predict_rate(x[:20])
     np.testing.assert_allclose(rate, np.exp(mean), rtol=1e-12)
+
+
+def test_poisson_device_sharded_matches_single_device(rng, eight_device_mesh):
+    """The one-dispatch sharded generic-Laplace fit (VERDICT r3 item 3):
+    same theta as the single-device device fit, up to reduction order."""
+    from spark_gp_tpu import GaussianProcessPoissonRegression
+
+    x, y, rate = _count_problem(rng)
+
+    def make(mesh=None):
+        gp = (
+            GaussianProcessPoissonRegression()
+            .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-2, 10.0))
+            .setDatasetSizeForExpert(50)
+            .setActiveSetSize(60)
+            .setMaxIter(15)
+            .setOptimizer("device")
+        )
+        if mesh is not None:
+            gp.setMesh(mesh)
+        return gp
+
+    m_plain = make().fit(x, y)
+    m_sharded = make(eight_device_mesh).fit(x, y)
+    np.testing.assert_allclose(
+        m_sharded.raw_predictor.theta, m_plain.raw_predictor.theta, rtol=1e-5
+    )
+    rel = np.mean(np.abs(m_sharded.predict_rate(x) - rate) / rate)
+    assert rel < 0.25, rel
+
+
+def test_poisson_device_checkpointed_resume(rng, tmp_path):
+    """Segmented device fit with checkpointing: a run killed mid-way resumes
+    from the persisted L-BFGS state (incl. latent warm-start stack) and
+    reaches the one-shot theta (VERDICT r3 item 3)."""
+    from spark_gp_tpu import GaussianProcessPoissonRegression
+
+    x, y, _ = _count_problem(rng, n=300)
+
+    def gp(d):
+        return (
+            GaussianProcessPoissonRegression()
+            .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-2, 10.0))
+            .setDatasetSizeForExpert(50)
+            .setActiveSetSize(50)
+            .setMaxIter(15)
+            .setOptimizer("device")
+            .setCheckpointDir(str(d))
+            .setCheckpointInterval(4)
+        )
+
+    theta_full = gp(tmp_path / "a").fit(x, y).raw_predictor.theta
+    gp(tmp_path / "b").setMaxIter(3).fit(x, y)  # "killed" after 3 iters
+    resumed = gp(tmp_path / "b").fit(x, y)
+    np.testing.assert_allclose(
+        resumed.raw_predictor.theta, theta_full, rtol=1e-4
+    )
+
+
+def test_poisson_device_sharded_checkpointed(rng, tmp_path, eight_device_mesh):
+    """Segmented checkpointing composes with the sharded generic loop."""
+    from spark_gp_tpu import GaussianProcessPoissonRegression
+
+    x, y, _ = _count_problem(rng, n=300)
+
+    def gp(ck=None):
+        g = (
+            GaussianProcessPoissonRegression()
+            .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-2, 10.0))
+            .setDatasetSizeForExpert(50)
+            .setActiveSetSize(50)
+            .setMaxIter(12)
+            .setOptimizer("device")
+            .setMesh(eight_device_mesh)
+        )
+        if ck is not None:
+            g.setCheckpointDir(str(ck)).setCheckpointInterval(5)
+        return g
+
+    theta_ck = gp(tmp_path).fit(x, y).raw_predictor.theta
+    theta_plain = gp().fit(x, y).raw_predictor.theta
+    np.testing.assert_allclose(theta_ck, theta_plain, rtol=1e-5)
