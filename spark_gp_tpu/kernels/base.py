@@ -289,6 +289,33 @@ class _PairKernel(Kernel):
         return np.concatenate([lo1, lo2]), np.concatenate([hi1, hi2])
 
 
+def _carries_white_noise(kernel: Kernel) -> bool:
+    """Structurally, can this kernel spec EVER contribute a white-noise
+    ridge?  Walks the composition tree for ``EyeKernel`` instead of
+    evaluating ``white_noise_var`` at one theta: a trainable noise factor
+    *initialized at zero* (``WhiteNoiseKernel(0.0, 0.0, 1.0)``) evaluates
+    to 0 at ``init_theta`` yet can train to a nonzero ridge, so a numeric
+    probe at a single point under-rejects.  A ``Const(0) * ...`` branch is
+    genuinely inert (non-trainable zero) and passes."""
+    if isinstance(kernel, EyeKernel):
+        return True
+    if isinstance(kernel, _PairKernel):
+        return _carries_white_noise(kernel.k1) or _carries_white_noise(kernel.k2)
+    if isinstance(kernel, TrainableScaleKernel):
+        return _carries_white_noise(kernel.kernel)
+    if isinstance(kernel, ConstScaleKernel):
+        return kernel.c != 0.0 and _carries_white_noise(kernel.kernel)
+    if isinstance(kernel, ThetaOverrideKernel):
+        return _carries_white_noise(kernel.inner)
+    # custom kernel specs: numeric fallback at the initial point
+    return (
+        float(
+            np.asarray(kernel.white_noise_var(jnp.asarray(kernel.init_theta())))
+        )
+        != 0.0
+    )
+
+
 class ProductKernel(_PairKernel):
     """``k1 * k2`` — elementwise (Schur) product of two kernels, PSD by the
     Schur product theorem.  Capability beyond the reference (its algebra
@@ -299,18 +326,15 @@ class ProductKernel(_PairKernel):
     at construction: the delta-ridge part of a product involves cross terms
     between one factor's continuous part at zero distance and the other's
     ridge, which the flat-scalar accounting cannot represent — add noise at
-    the top level (``k1 * k2 + WhiteNoiseKernel(...)``) instead.
+    the top level (``k1 * k2 + WhiteNoiseKernel(...)``) instead.  The check
+    is structural (:func:`_carries_white_noise`), so a noise term that is
+    zero at ``init_theta`` but trainable to a nonzero ridge is rejected too.
     """
 
     def __init__(self, k1: Kernel, k2: Kernel) -> None:
         super().__init__(k1, k2)
         for factor in (k1, k2):
-            wn = float(
-                np.asarray(
-                    factor.white_noise_var(jnp.asarray(factor.init_theta()))
-                )
-            )
-            if wn != 0.0:
+            if _carries_white_noise(factor):
                 raise ValueError(
                     "kernel products cannot contain white-noise factors "
                     "(the product's delta ridge is not representable as a "

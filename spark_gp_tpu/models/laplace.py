@@ -426,34 +426,24 @@ def fit_gpc_device_checkpointed(
     not from zero latents.  Returns (theta, f_latents, nll, n_iter, n_fev,
     stalled).
     """
-    from spark_gp_tpu.utils.checkpoint import data_fingerprint
+    from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
-    meta = {
-        "kind": "gpc",
-        "log_space": bool(log_space),
-        "theta_dim": int(theta0.shape[0]),
-        "num_experts": int(data.x.shape[0]),
-        "expert_size": int(data.x.shape[1]),
-        "data_fingerprint": data_fingerprint(data.x, data.y, data.mask),
-    }
-    init = partial(gpc_device_segment_init, kernel, float(tol), mesh, log_space)
-    # shapes/dtypes only — skips a full Laplace Newton solve on resume
-    template = jax.eval_shape(
-        init, theta0, lower, upper, data.x, data.y, data.mask
+    meta = segment_meta(
+        "gpc", kernel, tol, log_space, theta0, data.x, data.y, data.mask
     )
-    state = saver.load(template, meta)
-    if state is None:
-        state = init(theta0, lower, upper, data.x, data.y, data.mask)
-    while not bool(state.done) and int(state.n_iter) < max_iter:
-        limit = jnp.asarray(
-            min(int(state.n_iter) + chunk, max_iter), jnp.int32
-        )
-        state = gpc_device_segment_run(
+    init = partial(gpc_device_segment_init, kernel, float(tol), mesh, log_space)
+
+    def run(state, limit):
+        return gpc_device_segment_run(
             kernel, float(tol), mesh, log_space, state, lower, upper,
             data.x, data.y, data.mask, limit,
         )
-        saver.save(state, meta)
-    theta = jnp.exp(state.theta) if log_space else state.theta
+
+    theta, state = run_segmented(
+        init, run, saver, meta,
+        (theta0, lower, upper, data.x, data.y, data.mask),
+        max_iter, chunk, log_space,
+    )
     return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
 
 

@@ -471,32 +471,26 @@ def fit_generic_device_checkpointed(
     see laplace.fit_gpc_device_checkpointed.  The aux carry is the latent
     warm-start stack, so a resume continues from the settled modes.
     Returns ``(theta, f_latents, nll, n_iter, n_fev, stalled)``."""
-    from spark_gp_tpu.utils.checkpoint import data_fingerprint
+    from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
-    meta = {
-        "kind": f"generic:{type(lik).__name__}{lik._spec()}",
-        "log_space": bool(log_space),
-        "theta_dim": int(theta0.shape[0]),
-        "num_experts": int(x.shape[0]),
-        "expert_size": int(x.shape[1]),
-        "data_fingerprint": data_fingerprint(x, y, mask),
-    }
+    meta = segment_meta(
+        f"generic:{type(lik).__name__}{lik._spec()}", kernel, tol, log_space,
+        theta0, x, y, mask,
+    )
     init = partial(
         generic_device_segment_init, lik, kernel, float(tol), mesh, log_space
     )
-    # shapes/dtypes only — skips a full Newton mode solve on resume
-    template = jax.eval_shape(init, theta0, lower, upper, x, y, mask)
-    state = saver.load(template, meta)
-    if state is None:
-        state = init(theta0, lower, upper, x, y, mask)
-    while not bool(state.done) and int(state.n_iter) < max_iter:
-        limit = jnp.asarray(min(int(state.n_iter) + chunk, max_iter), jnp.int32)
-        state = generic_device_segment_run(
+
+    def run(state, limit):
+        return generic_device_segment_run(
             lik, kernel, float(tol), mesh, log_space, state, lower, upper,
             x, y, mask, limit,
         )
-        saver.save(state, meta)
-    theta = jnp.exp(state.theta) if log_space else state.theta
+
+    theta, state = run_segmented(
+        init, run, saver, meta, (theta0, lower, upper, x, y, mask),
+        max_iter, chunk, log_space,
+    )
     return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
 
 

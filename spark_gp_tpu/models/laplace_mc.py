@@ -447,30 +447,24 @@ def fit_gpc_mc_device_checkpointed(
     — see laplace.fit_gpc_device_checkpointed; the aux carry here is the
     ``[E, s, C]`` latent warm-start stack.  Returns
     ``(theta, f_latents, nll, n_iter, n_fev, stalled)``."""
-    from spark_gp_tpu.utils.checkpoint import data_fingerprint
+    from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
-    meta = {
-        "kind": "gpc_mc",
-        "log_space": bool(log_space),
-        "theta_dim": int(theta0.shape[0]),
-        "num_experts": int(x.shape[0]),
-        "expert_size": int(x.shape[1]),
-        "num_classes": int(y1h.shape[-1]),
-        "data_fingerprint": data_fingerprint(x, y1h, mask),
-    }
+    meta = segment_meta(
+        "gpc_mc", kernel, tol, log_space, theta0, x, y1h, mask,
+        num_classes=int(y1h.shape[-1]),
+    )
     init = partial(gpc_mc_device_segment_init, kernel, float(tol), mesh, log_space)
-    template = jax.eval_shape(init, theta0, lower, upper, x, y1h, mask)
-    state = saver.load(template, meta)
-    if state is None:
-        state = init(theta0, lower, upper, x, y1h, mask)
-    while not bool(state.done) and int(state.n_iter) < max_iter:
-        limit = jnp.asarray(min(int(state.n_iter) + chunk, max_iter), jnp.int32)
-        state = gpc_mc_device_segment_run(
+
+    def run(state, limit):
+        return gpc_mc_device_segment_run(
             kernel, float(tol), mesh, log_space, state, lower, upper,
             x, y1h, mask, limit,
         )
-        saver.save(state, meta)
-    theta = jnp.exp(state.theta) if log_space else state.theta
+
+    theta, state = run_segmented(
+        init, run, saver, meta, (theta0, lower, upper, x, y1h, mask),
+        max_iter, chunk, log_space,
+    )
     return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
 
 

@@ -270,37 +270,25 @@ def fit_gpr_device_checkpointed(
     A valid prior checkpoint resumes the fit mid-run (same kernel/config,
     enforced via the saver's meta).  Returns (theta, nll, n_iter, n_fev).
     """
-    from spark_gp_tpu.utils.checkpoint import data_fingerprint
+    from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
-    meta = {
-        "kind": "gpr",
-        "log_space": bool(log_space),
-        "theta_dim": int(theta0.shape[0]),
-        "num_experts": int(data.x.shape[0]),
-        "expert_size": int(data.x.shape[1]),
-        # same-shaped but different data must not resume a finished run's
-        # state (it would return the stale theta with zero iterations)
-        "data_fingerprint": data_fingerprint(data.x, data.y, data.mask),
-    }
-    init = partial(gpr_device_segment_init, kernel, mesh, log_space)
-    # shapes/dtypes only — no objective evaluation unless we really init
-    template = jax.eval_shape(
-        init, theta0, lower, upper, data.x, data.y, data.mask
+    meta = segment_meta(
+        "gpr", kernel, tol, log_space, theta0, data.x, data.y, data.mask
     )
-    state = saver.load(template, meta)
-    if state is None:
-        state = init(theta0, lower, upper, data.x, data.y, data.mask)
-    tol = jnp.asarray(tol, state.theta.dtype)
-    while not bool(state.done) and int(state.n_iter) < max_iter:
-        limit = jnp.asarray(
-            min(int(state.n_iter) + chunk, max_iter), jnp.int32
-        )
-        state = gpr_device_segment_run(
+    init = partial(gpr_device_segment_init, kernel, mesh, log_space)
+    tol_arr = jnp.asarray(tol, theta0.dtype)
+
+    def run(state, limit):
+        return gpr_device_segment_run(
             kernel, mesh, log_space, state, lower, upper,
-            data.x, data.y, data.mask, limit, tol,
+            data.x, data.y, data.mask, limit, tol_arr,
         )
-        saver.save(state, meta)
-    theta = jnp.exp(state.theta) if log_space else state.theta
+
+    theta, state = run_segmented(
+        init, run, saver, meta,
+        (theta0, lower, upper, data.x, data.y, data.mask),
+        max_iter, chunk, log_space,
+    )
     return theta, state.f, state.n_iter, state.n_fev, state.stalled
 
 

@@ -320,6 +320,14 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
                 n_fev=ls.n_fev + 1,
             )
 
+        # Entry-point KKT check: an iterate already box-stationary has zero
+        # projected gradient — NO step can move it, so running the line
+        # search would burn max_ls futile objective evaluations before the
+        # convergence logic below certifies it (scipy likewise certifies on
+        # gtol before attempting a step).  Seeding accepted=True makes the
+        # search loop exit immediately with the unchanged iterate.
+        already_opt = proj_grad_norm(state.theta, state.grad) <= tol
+
         # First iteration has no curvature history: the raw steepest-descent
         # direction is unnormalized (its magnitude is the gradient's, which
         # for a summed-over-experts NLL can be ~1e4), so a unit step would
@@ -342,7 +350,7 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
             g_new=state.grad,
             aux_new=state.aux,
             theta_new=state.theta,
-            accepted=jnp.zeros((), jnp.bool_),
+            accepted=already_opt,
             armijo_seen=jnp.zeros((), jnp.bool_),
             n_ls=jnp.zeros((), jnp.int32),
             n_fev=jnp.zeros((), jnp.int32),
@@ -374,10 +382,10 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
             1.0, jnp.abs(ls.f_new)
         )
         g_small = proj_grad_norm(ls.theta_new, ls.g_new) <= tol
-        converged = ls.accepted & (f_change | g_small)
-        stalled = ~ls.accepted  # line search exhausted
+        converged = (ls.accepted & (f_change | g_small)) | already_opt
+        stalled = ~ls.accepted & ~already_opt  # line search exhausted
 
-        return _LbfgsState(
+        new_state = _LbfgsState(
             theta=ls.theta_new,
             f=ls.f_new,
             grad=ls.g_new,
@@ -391,6 +399,16 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
             n_fev=state.n_fev + ls.n_fev,
             done=converged | stalled,
             stalled=stalled,
+        )
+        # Freeze finished lanes.  Standalone, the while_loop exits the moment
+        # done is True and this guard is a no-op; under vmap (multistart) the
+        # batched loop keeps stepping every lane until ALL are done, and an
+        # unguarded body would let a converged lane keep moving — flipping
+        # its done/stalled flags (a converged lane whose line search can no
+        # longer move would end "stalled") and inflating its n_iter/n_fev to
+        # the global loop count.
+        return jax.tree.map(
+            lambda new, old: jnp.where(state.done, old, new), new_state, state
         )
 
     return body
@@ -408,11 +426,12 @@ def lbfgs_minimize_device_multistart(
 ):
     """ALL restarts of a multi-start minimization as ONE batched device
     program: ``vmap`` over the starting points runs the R optimizers in
-    lockstep (a lane that stalls freezes — its line search keeps rejecting
-    from the same state; a lane that converges early can only keep
-    improving, since every accepted step requires Armijo decrease), so a
-    multi-start fit costs one dispatch and the per-lane compute batches
-    onto the MXU instead of R sequential programs.
+    lockstep, so a multi-start fit costs one dispatch and the per-lane
+    compute batches onto the MXU instead of R sequential programs.  A lane
+    that terminates (converged or stalled) is frozen by the body's done
+    guard while the remaining lanes iterate, so its final state — iterate,
+    diagnostics, termination flags — is exactly what a standalone run would
+    report.
 
     ``theta0_batch`` is ``[R, h]``; ``aux0`` is shared (broadcast).
     Returns ``(theta_best, f_best, aux_best, n_iter_best, n_fev_best,

@@ -134,6 +134,80 @@ class DeviceOptimizerCheckpointer:
         return jax.tree.unflatten(treedef, leaves)
 
 
+def kernel_fingerprint(kernel) -> str:
+    """Process-stable FULL identity of a kernel spec: the type tree plus
+    every spec constant (initial values, bounds), rendered recursively.
+
+    Guards a device checkpoint against being resumed under a different
+    kernel — or the same kernel family with different bounds — that happens
+    to share ``theta_dim`` (``kernel_signature``'s describe-at-zeros is
+    weaker: it omits bounds and spec constants).  ``hash(kernel)`` cannot
+    serve here: it hashes the type object, which is id-based and not stable
+    across processes.
+    """
+    from spark_gp_tpu.kernels.base import Kernel
+
+    def render(v):
+        if isinstance(v, Kernel):
+            inner = ",".join(render(s) for s in v._spec())
+            return f"{type(v).__name__}({inner})"
+        if isinstance(v, tuple):
+            return "(" + ",".join(render(s) for s in v) + ")"
+        return repr(v)
+
+    return render(kernel)
+
+
+def segment_meta(kind, kernel, tol, log_space, theta0, x, y, mask, **extra) -> dict:
+    """One home for the segmented-fit resume guard (shared by all four
+    estimator families): everything that must match for a stored optimizer
+    state to be resumable — likelihood kind, full kernel identity, tol,
+    parameterization, stack shapes, and a content fingerprint of the data."""
+    meta = {
+        "kind": str(kind),
+        "kernel": kernel_fingerprint(kernel),
+        "tol": float(tol),
+        "log_space": bool(log_space),
+        # values, not just the count: a ThetaOverrideKernel (multi-start
+        # wrapper) deliberately excludes its starting point from _spec, so
+        # the kernel fingerprint alone cannot distinguish two fits of the
+        # same spec started from different points — a finished checkpoint
+        # from start A must not answer for a fit from start B
+        "theta0": [float(v) for v in np.asarray(theta0).ravel()],
+        "theta_dim": int(theta0.shape[0]),
+        "num_experts": int(x.shape[0]),
+        "expert_size": int(x.shape[1]),
+        # same-shaped but different data must not resume a finished run's
+        # state (it would return the stale theta with zero iterations)
+        "data_fingerprint": data_fingerprint(x, y, mask),
+    }
+    meta.update(extra)
+    return meta
+
+
+def run_segmented(init, run, saver, meta, init_args, max_iter, chunk, log_space):
+    """The shared resume loop of every family's checkpointed device fit:
+    load-or-init the optimizer state (``jax.eval_shape`` supplies the
+    template, so a resume skips the initial objective evaluation), advance
+    it in ``chunk``-iteration segments of one compiled program each
+    (``run(state, iter_limit) -> state``), and persist the full state
+    pytree between dispatches.  Returns ``(theta, final_state)`` with
+    ``theta`` mapped back out of log space."""
+    import jax
+    import jax.numpy as jnp
+
+    template = jax.eval_shape(init, *init_args)
+    state = saver.load(template, meta)
+    if state is None:
+        state = init(*init_args)
+    while not bool(state.done) and int(state.n_iter) < max_iter:
+        limit = jnp.asarray(min(int(state.n_iter) + chunk, max_iter), jnp.int32)
+        state = run(state, limit)
+        saver.save(state, meta)
+    theta = jnp.exp(state.theta) if log_space else state.theta
+    return theta, state
+
+
 def data_fingerprint(*arrays) -> list:
     """Cheap content fingerprint for checkpoint-staleness checks.
 

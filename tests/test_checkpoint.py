@@ -102,6 +102,69 @@ def test_finished_checkpoint_not_reused_for_different_data(tmp_path):
     )
 
 
+def test_checkpoint_not_reused_for_different_kernel_same_dim(tmp_path):
+    """A converged checkpoint from a DIFFERENT kernel with the SAME
+    theta_dim and data must be ignored (r4 review: meta previously keyed on
+    theta_dim only, so RBF->Matern with one hyperparameter each silently
+    resumed the old optimum).  Same for a tol change."""
+    from spark_gp_tpu import Matern52Kernel
+
+    x, y = _problem(seed=8)
+    _gp(tmp_path).fit(x, y)  # kernel: RBFKernel(1.0), theta_dim 1
+    gp2 = _gp(tmp_path).setKernel(lambda: Matern52Kernel(1.0))  # theta_dim 1
+    with pytest.warns(UserWarning, match="ignoring device checkpoint"):
+        model2 = gp2.fit(x, y)
+    theta_ref = (
+        _gp().setKernel(lambda: Matern52Kernel(1.0)).fit(x, y)
+        .raw_predictor.theta
+    )
+    np.testing.assert_allclose(model2.raw_predictor.theta, theta_ref, rtol=1e-5)
+
+    # different tol on the same kernel/data: state is also not resumable
+    with pytest.warns(UserWarning, match="ignoring device checkpoint"):
+        _gp(tmp_path).setKernel(lambda: Matern52Kernel(1.0)).setTol(1e-4).fit(x, y)
+
+
+def test_kernel_fingerprint_full_identity():
+    """The fingerprint sees bounds and nested structure, not just describe."""
+    from spark_gp_tpu import WhiteNoiseKernel
+    from spark_gp_tpu.utils.checkpoint import kernel_fingerprint
+
+    a = kernel_fingerprint(1.0 * RBFKernel(0.1, 1e-6, 10.0))
+    b = kernel_fingerprint(1.0 * RBFKernel(0.1, 1e-6, 20.0))  # bounds differ
+    c = kernel_fingerprint(
+        1.0 * RBFKernel(0.1, 1e-6, 10.0) + WhiteNoiseKernel(0.5, 0, 1)
+    )
+    assert a != b and a != c and b != c
+    # process-stable: a fresh equal spec renders identically
+    assert a == kernel_fingerprint(1.0 * RBFKernel(0.1, 1e-6, 10.0))
+
+
+def test_segment_meta_distinguishes_starting_points():
+    """ThetaOverrideKernel (the multi-start wrapper) excludes its starting
+    point from _spec by design, so the resume guard must carry theta0's
+    VALUES — a finished checkpoint from start A must not answer for a fit
+    from start B (r4 review)."""
+    from spark_gp_tpu import Matern52Kernel
+    from spark_gp_tpu.kernels.base import ThetaOverrideKernel
+    from spark_gp_tpu.utils.checkpoint import segment_meta
+
+    x = np.zeros((2, 4, 3))
+    y = np.zeros((2, 4))
+    mask = np.ones((2, 4))
+    k = Matern52Kernel(1.0)
+
+    def meta_for(t0):
+        wrapped = ThetaOverrideKernel(k, [t0])
+        return segment_meta(
+            "gpr", wrapped, 1e-6, True, wrapped.init_theta(), x, y, mask
+        )
+
+    a, b = meta_for(0.5), meta_for(2.0)
+    assert a["kernel"] == b["kernel"]  # spec identity intentionally equal
+    assert a != b  # ... but the recorded starting point differs
+
+
 def test_classifier_segmented_resume(tmp_path):
     rng = np.random.default_rng(3)
     x = rng.normal(size=(160, 2))

@@ -1,5 +1,6 @@
 """On-device L-BFGS parity with the host SciPy driver."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -134,6 +135,52 @@ def test_gpc_device_matches_host_quality(eight_device_mesh):
     a_dev_sh = accuracy(yb, gpc("device", eight_device_mesh).fit(x, yb).predict(x))
     assert a_dev >= a_host - 0.02
     assert a_dev_sh >= a_host - 0.02
+
+
+def test_multistart_frozen_lane_keeps_own_diagnostics():
+    """Under vmap the batched while_loop steps every lane until ALL are
+    done; the body's done guard must freeze finished lanes so a lane that
+    converged early reports its OWN n_iter/stalled, not the global loop
+    count (ADVICE r3: a converged lane whose line search could no longer
+    move used to end flagged 'stalled')."""
+    from spark_gp_tpu.optimize.lbfgs_device import (
+        lbfgs_minimize_device_multistart,
+    )
+
+    target = jnp.asarray([2.0, -1.0])
+
+    def vag(theta, aux):
+        return jnp.sum((theta - target) ** 2), 2 * (theta - target), aux
+
+    # lane 0 starts AT the optimum (converges on iteration 1);
+    # lane 1 starts far away (needs several iterations)
+    theta0 = jnp.stack([target, target + 40.0])
+    thetas, fs, _, iters, fevs, stalls = jax.vmap(
+        lambda t0: lbfgs_minimize_device(
+            vag, t0,
+            jnp.asarray([-jnp.inf, -jnp.inf]), jnp.asarray([jnp.inf, jnp.inf]),
+            jnp.zeros(()), max_iter=jnp.asarray(100), tol=jnp.asarray(1e-10),
+        )
+    )(theta0)
+    assert int(iters[1]) > int(iters[0])  # lanes genuinely differ
+    assert int(iters[0]) <= 2  # frozen at its own convergence, not global
+    # entry-point KKT: the stationary lane skips the line search entirely
+    # (n_fev stays at the init evaluation) instead of burning max_ls evals
+    assert int(fevs[0]) == 1
+    assert not bool(stalls[0])  # converged, never re-flagged as stalled
+    assert not bool(stalls[1])
+    np.testing.assert_allclose(np.asarray(thetas[0]), np.asarray(target), atol=1e-8)
+    np.testing.assert_allclose(np.asarray(thetas[1]), np.asarray(target), atol=1e-6)
+
+    # the multistart wrapper returns the winner's own diagnostics
+    theta_b, f_b, _, it_b, fev_b, st_b, f_all, best = (
+        lbfgs_minimize_device_multistart(
+            vag, theta0,
+            jnp.asarray([-jnp.inf, -jnp.inf]), jnp.asarray([jnp.inf, jnp.inf]),
+            jnp.zeros(()), max_iter=100, tol=1e-10,
+        )
+    )
+    assert int(best) == 0 and int(it_b) <= 2 and not bool(st_b)
 
 
 def test_invalid_optimizer_rejected():

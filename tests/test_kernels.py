@@ -553,12 +553,22 @@ def test_quasi_periodic_end_to_end_fit(rng):
 
 
 def test_product_kernel_rejects_noise_factors():
-    from spark_gp_tpu import EyeKernel, RBFKernel, WhiteNoiseKernel
+    from spark_gp_tpu import Const, EyeKernel, RBFKernel, Scalar, WhiteNoiseKernel
 
     with pytest.raises(ValueError, match="white-noise"):
         (RBFKernel(1.0) + WhiteNoiseKernel(0.1, 0, 1)) * RBFKernel(0.5)
     with pytest.raises(ValueError, match="white-noise"):
         RBFKernel(1.0) * EyeKernel()
+    # The guard is structural: noise that is ZERO at init_theta but can
+    # train to a nonzero ridge must be rejected too (a numeric probe at the
+    # initial point would let these through).
+    with pytest.raises(ValueError, match="white-noise"):
+        (RBFKernel(1.0) + WhiteNoiseKernel(0.0, 0.0, 1.0)) * RBFKernel(0.5)
+    with pytest.raises(ValueError, match="white-noise"):
+        (RBFKernel(1.0) + Scalar(0.0) * EyeKernel()) * RBFKernel(0.5)
+    # ... while a non-trainable zero coefficient is genuinely inert and OK.
+    k = (RBFKernel(1.0) + Const(0.0) * EyeKernel()) * RBFKernel(0.5)
+    assert float(k.white_noise_var(jnp.asarray(k.init_theta()))) == 0.0
 
 
 def test_ard_rational_quadratic(rng):
