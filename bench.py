@@ -199,12 +199,34 @@ def _cpu_proxy_eval_seconds(x, y, expert_size: int, sigma: float, sigma2: float)
 
 def worker() -> None:
     """Measurement body; prints the final JSON line. Runs in a subprocess."""
+    # Phase-boundary sync (utils/instrumentation.phase_sync): attribute each
+    # phase's wall-clock to the phase that computed it instead of letting the
+    # final device_get absorb the async pipeline (VERDICT r3 weak #2).  Costs
+    # three blocking syncs per fit — noise at bench workloads.
+    os.environ.setdefault("GP_SYNC_PHASES", "1")
+
     import numpy as np
+
+    import jax
+
+    # Persistent XLA compilation cache: the dominant cold-start cost is
+    # compiling the fused optimizer programs (~20-40s each on TPU), paid
+    # BEFORE the measurement.  Persisting compilations across bench
+    # invocations means any earlier successful run (same shapes) makes this
+    # one start hot — the difference between landing a number inside a brief
+    # tunnel-uptime window and blowing the watchdog (VERDICT r3 weak #1).
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        cache_dir = None
 
     from spark_gp_tpu import GaussianProcessRegression, RBFKernel
     from spark_gp_tpu.data import make_benchmark_data
-
-    import jax
 
     platform = jax.devices()[0].platform
     # 300k on hardware: throughput = N / (per-eval compute * nfev + fixed
@@ -342,6 +364,15 @@ def worker() -> None:
             "fit_phase_seconds": {
                 k: round(v, 4) for k, v in model.instr.timings.items()
             },
+            "phase_timing_note": (
+                "measured with GP_SYNC_PHASES=1: block_until_ready at phase "
+                "boundaries, so optimize_hypers/kmn_stats carry their own "
+                "compute instead of sync_fetch absorbing the async pipeline"
+                if os.environ.get("GP_SYNC_PHASES") == "1"
+                else "GP_SYNC_PHASES disabled: async pipeline — the final "
+                "sync (sync_fetch) absorbs upstream device compute"
+            ),
+            "compilation_cache_dir": cache_dir,
             "predict_points_per_sec": (
                 None if predict_seconds is None else n / predict_seconds
             ),

@@ -27,6 +27,9 @@ Parts:
   year_msd       515k-shape stand-in, subsampled: RMSE + wall-clock guard
   greedy_scale   greedy Seeger selection at the Year-MSD shape (m=512),
                  wall-clock + quality vs random at the same m
+  greedy_vs_random  the demonstrated-payoff regime (density-skewed data,
+                 small m): greedy must BEAT the best of 3 random seeds
+                 (asserted); the airfoil negative result is in PARITY.md
   weak_scaling   1/2/4/8 virtual CPU devices, fixed per-device load, the
                  sharded device-L-BFGS fit (records the curve's shape; on a
                  shared-core host this tracks compile/exec health, not true
@@ -46,7 +49,8 @@ import time
 
 _ALL_PARTS = (
     "airfoil", "iris", "iris_native_mc", "poisson", "gpc_mnist", "protein",
-    "year_msd", "greedy_scale", "weak_scaling", "pallas_sweep",
+    "year_msd", "greedy_scale", "greedy_vs_random", "weak_scaling",
+    "pallas_sweep",
 )
 
 
@@ -121,6 +125,8 @@ def part_iris() -> dict:
     )
     return {
         "accuracy_10fold": float(score),
+        "bar": 0.9,
+        "passed": bool(score > 0.9),
         "seconds": time.perf_counter() - start,
     }
 
@@ -144,6 +150,8 @@ def part_iris_native_mc() -> dict:
     )
     return {
         "accuracy_10fold": float(score),
+        "bar": 0.9,
+        "passed": bool(score > 0.9),
         "seconds": time.perf_counter() - start,
     }
 
@@ -174,6 +182,9 @@ def part_poisson() -> dict:
     rel = float(np.mean(np.abs(model.predict_rate(x) - rate) / rate))
     return {
         "mean_relative_rate_error": rel,
+        # examples/poisson.py asserts the same bar; r03 recorded 0.024
+        "bar": 0.1,
+        "passed": bool(rel < 0.1),
         "n": n,
         "fit_seconds": fit_seconds,
         "train_points_per_sec": n / fit_seconds,
@@ -206,6 +217,10 @@ def part_gpc_mnist() -> dict:
     n_train = int(0.8 * x.shape[0])
     return {
         "accuracy": float(score),
+        # stand-in task is separable; r03 recorded 1.0 — a drop below 0.95
+        # means the 784-d Laplace path regressed, not that the task got hard
+        "bar": 0.95,
+        "passed": bool(score > 0.95),
         "n_points": int(x.shape[0]),
         "n_features": int(x.shape[1]),
         "fit_predict_seconds": seconds,
@@ -244,7 +259,7 @@ def _ard_kernel_factory(p: int):
     )
 
 
-def _stress_regression(loader, n, expert, active, max_iter) -> dict:
+def _stress_regression(loader, n, expert, active, max_iter, bar) -> dict:
     _assert_platform()
     from spark_gp_tpu import GaussianProcessRegression
     from spark_gp_tpu.utils.validation import rmse
@@ -264,9 +279,15 @@ def _stress_regression(loader, n, expert, active, max_iter) -> dict:
     fit_seconds = time.perf_counter() - start
     pred_scaled = model.predict(x[te])
     y_te = ys[te] * y_std + y_mean
+    score = float(rmse(ys[te], pred_scaled))
     return {
         "rmse": float(rmse(y_te, pred_scaled * y_std + y_mean)),
-        "rmse_scaled": float(rmse(ys[te], pred_scaled)),
+        "rmse_scaled": score,
+        # bars vs the stand-in generators' known noise floor (r03 recorded
+        # 0.476 / 0.496): a silent quality regression now fails loudly
+        # (VERDICT r3 weak #4)
+        "bar": bar,
+        "passed": bool(score < bar),
         "n": int(x.shape[0]),
         "p": int(x.shape[1]),
         "expert": expert,
@@ -282,14 +303,14 @@ def part_protein() -> dict:
     from spark_gp_tpu.data import load_protein
 
     n = int(os.environ.get("QUALITY_PROTEIN_N", 8000))
-    return _stress_regression(load_protein, n, 100, 256, 15)
+    return _stress_regression(load_protein, n, 100, 256, 15, bar=0.55)
 
 
 def part_year_msd() -> dict:
     from spark_gp_tpu.data import load_year_msd
 
     n = int(os.environ.get("QUALITY_YEAR_N", 20000))
-    return _stress_regression(load_year_msd, n, 100, 256, 15)
+    return _stress_regression(load_year_msd, n, 100, 256, 15, bar=0.55)
 
 
 def part_greedy_scale() -> dict:
@@ -342,6 +363,74 @@ def part_greedy_scale() -> dict:
             "rmse_scaled": float(rmse(ys[te], model.predict(x[te]))),
         }
     return out
+
+
+def part_greedy_vs_random() -> dict:
+    """The regime where Seeger selection PAYS, with an asserted gap
+    (VERDICT r3 item 4): density-skewed data, small m.  95% of the points
+    crowd into 2.5% of the input range, so m=24 random picks land ~23:1 in
+    the crowd and leave the tail unmodelled, while the information-gain
+    criterion spreads the set (measured: greedy ~0.011 vs random ~0.15,
+    stable across data seeds).  Asserted: greedy beats the BEST of three
+    random seeds.  The flip side — on airfoil at m in {16, 32, 64} greedy
+    is 3-8x WORSE than random (info gain chases boundary/outlier points) —
+    is recorded in PARITY.md; the reference's own default is random
+    (GaussianProcessParams.scala:33)."""
+    _assert_platform()
+    import numpy as np
+
+    from spark_gp_tpu import (
+        GaussianProcessRegression,
+        GreedilyOptimizingActiveSetProvider,
+        RandomActiveSetProvider,
+        RBFKernel,
+    )
+    from spark_gp_tpu.utils.validation import rmse
+
+    rng = np.random.default_rng(7)
+    n = 2000
+    x = np.concatenate(
+        [rng.uniform(0.0, 0.5, size=int(0.95 * n)),
+         rng.uniform(0.5, 20.0, size=n - int(0.95 * n))]
+    )[:, None]
+    y = np.sin(1.5 * x[:, 0]) + 0.01 * rng.normal(size=n)
+    m = 24
+
+    def fit_rmse(provider, seed):
+        gp = (
+            GaussianProcessRegression()
+            .setKernel(lambda: RBFKernel(0.3, 1e-6, 10))
+            .setActiveSetSize(m)
+            .setActiveSetProvider(provider)
+            .setMaxIter(30)
+            .setSeed(seed)
+        )
+        start = time.perf_counter()
+        model = gp.fit(x, y)
+        return float(rmse(y, model.predict(x))), time.perf_counter() - start
+
+    greedy_rmse, greedy_seconds = fit_rmse(
+        GreedilyOptimizingActiveSetProvider(), 13
+    )
+    randoms = [fit_rmse(RandomActiveSetProvider, s) for s in (13, 17, 29)]
+    random_rmses = [r for r, _ in randoms]
+    best_random = min(random_rmses)
+    return {
+        "n": n,
+        "m": m,
+        "greedy_rmse": greedy_rmse,
+        "greedy_seconds": greedy_seconds,
+        "random_rmses": random_rmses,
+        "best_random_rmse": best_random,
+        "gap_vs_best_random": best_random - greedy_rmse,
+        # two asserted bars: greedy strictly beats the best random draw,
+        # and covers the sparse tail in absolute terms
+        "passed": bool(greedy_rmse < best_random and greedy_rmse < 0.05),
+        "regime": (
+            "density-skewed 1-d (95% of mass in 2.5% of the range), m=24; "
+            "greedy LOSES on airfoil at small m — see PARITY.md"
+        ),
+    }
 
 
 def part_weak_scaling() -> dict:
@@ -500,12 +589,23 @@ def main() -> int:
         out, err = _run_sub(["--part", part], timeout, dict(os.environ))
         report["parts"][part] = out if out is not None else {"error": err}
 
+    # Enforced bars: any part that ran and failed its threshold fails the
+    # whole run (VERDICT r3 weak #4 — silent quality regressions must not
+    # sail through).  Parts that errored/timed out are recorded but do not
+    # flip the exit code (a flaky tunnel is not a quality regression).
+    failed = sorted(
+        name
+        for name, part in report["parts"].items()
+        if isinstance(part, dict) and part.get("passed") is False
+    )
+    report["failed_bars"] = failed
+
     text = json.dumps(report, indent=1)
     print(text)
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(text + "\n")
-    return 0
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -24,7 +24,7 @@ from spark_gp_tpu.models import ppa
 from spark_gp_tpu.optimize.lbfgsb import minimize_lbfgsb
 from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
 from spark_gp_tpu.parallel.mesh import shard_experts
-from spark_gp_tpu.utils.instrumentation import Instrumentation
+from spark_gp_tpu.utils.instrumentation import Instrumentation, phase_sync
 
 
 class GaussianProcessParams:
@@ -686,6 +686,7 @@ class GaussianProcessCommons(GaussianProcessParams):
                 u1_dev, u2_dev, theta64_dev = ppa._kmn_stats_x64_from32_impl(
                     kernel, theta_dev, active_dev, data.x, data.y, data.mask
                 )
+            phase_sync(u1_dev, u2_dev)
 
         keys = list(pending.keys())
         with instr.phase("sync_fetch"):

@@ -33,7 +33,7 @@ from spark_gp_tpu.models.laplace_generic import (
     make_sharded_generic_objective,
 )
 from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
-from spark_gp_tpu.utils.instrumentation import Instrumentation
+from spark_gp_tpu.utils.instrumentation import Instrumentation, phase_sync
 
 
 @jax.jit
@@ -112,6 +112,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
                     )
                 )
+                phase_sync(theta, nll)
             theta_host = np.asarray(theta, dtype=np.float64)
             self._log_device_optimizer_result(
                 instr, kernel, theta_host, nll, n_iter, n_fev, stalled
@@ -275,6 +276,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
                     )
                 )
+            phase_sync(theta, nll)
         theta_host = np.asarray(theta, dtype=np.float64)
         self._log_device_optimizer_result(
             instr, kernel, theta_host, nll, n_iter, n_fev, stalled

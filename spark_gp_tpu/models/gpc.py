@@ -33,7 +33,7 @@ from spark_gp_tpu.models.laplace import (
 )
 from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
 from spark_gp_tpu.parallel.experts import ExpertData
-from spark_gp_tpu.utils.instrumentation import Instrumentation
+from spark_gp_tpu.utils.instrumentation import Instrumentation, phase_sync
 
 
 @jax.jit
@@ -122,6 +122,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
                     )
                 )
+                phase_sync(theta, nll)
             latent_y = f_final * data.mask
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
             pending = {
@@ -281,6 +282,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                     kernel, float(self._tol), log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter,
                 )
+            phase_sync(theta, f)
         pending = {
             "lbfgs_iters": n_iter,
             "lbfgs_nfev": n_fev,

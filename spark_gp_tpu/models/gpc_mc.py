@@ -41,7 +41,7 @@ from spark_gp_tpu.models.laplace_mc import (
     make_sharded_mc_objective,
 )
 from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
-from spark_gp_tpu.utils.instrumentation import Instrumentation
+from spark_gp_tpu.utils.instrumentation import Instrumentation, phase_sync
 
 
 @jax.jit
@@ -147,6 +147,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
                     )
                 )
+                phase_sync(theta, nll)
             theta_host = np.asarray(theta, dtype=np.float64)
             self._log_device_optimizer_result(
                 instr, kernel, theta_host, nll, n_iter, n_fev, stalled
@@ -291,6 +292,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                     data.x, y1h, data.mask,
                     jnp.asarray(self._max_iter, dtype=jnp.int32),
                 )
+            phase_sync(theta, nll)
         theta_host = np.asarray(theta, dtype=np.float64)
         self._log_device_optimizer_result(
             instr, kernel, theta_host, nll, n_iter, n_fev, stalled

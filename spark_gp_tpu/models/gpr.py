@@ -26,7 +26,7 @@ from spark_gp_tpu.models.likelihood import (
     make_value_and_grad,
 )
 from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
-from spark_gp_tpu.utils.instrumentation import Instrumentation
+from spark_gp_tpu.utils.instrumentation import Instrumentation, phase_sync
 
 
 class GaussianProcessRegression(GaussianProcessCommons):
@@ -101,6 +101,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                         jnp.asarray(self._tol, dtype=dtype),
                     )
                 )
+                phase_sync(theta, f)
             # the per-restart vector and the device-chosen winner index ride
             # the existing single deferred fetch (no extra host sync here);
             # non-scalar entries are returned un-logged
@@ -234,6 +235,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                     kernel, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
                 )
+            phase_sync(theta, f)
         pending = {
             "lbfgs_iters": n_iter,
             "lbfgs_nfev": n_fev,

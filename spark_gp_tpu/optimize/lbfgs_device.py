@@ -350,7 +350,12 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
             g_new=state.grad,
             aux_new=state.aux,
             theta_new=state.theta,
-            accepted=already_opt,
+            # already_opt: no step can move a box-stationary iterate.
+            # state.done: a frozen lane under vmap (its result is discarded
+            # by the freeze guard below) must not burn max_ls batched
+            # objective evaluations per outer iteration; standalone, done
+            # never reaches the body (the outer cond gates it).
+            accepted=already_opt | state.done,
             armijo_seen=jnp.zeros((), jnp.bool_),
             n_ls=jnp.zeros((), jnp.int32),
             n_fev=jnp.zeros((), jnp.int32),
