@@ -241,17 +241,29 @@ def worker() -> None:
 
     x, y = make_benchmark_data(n)
 
-    def make_gp(iters: int):
+    def make_gp(iters: int, s: int = expert_size):
         return (
             GaussianProcessRegression()
             .setKernel(lambda: RBFKernel(0.1))
-            .setDatasetSizeForExpert(expert_size)
-            .setActiveSetSize(expert_size)
+            .setDatasetSizeForExpert(s)
+            .setActiveSetSize(s)
             .setSeed(13)
             .setSigma2(1e-3)
             .setMaxIter(iters)
             .setOptimizer(os.environ.get("BENCH_OPTIMIZER", "device"))
         )
+
+    def optimizer_flops(s: int, nfev_: int) -> float:
+        """FLOP estimate for the optimizer phase at expert size s: per
+        expert per evaluation the dominant terms are the fused SPD
+        inverse+logdet (~2s^3), its custom VJP (two batched matmuls,
+        ~4s^3) and the gram + alpha matmuls (~4 s^2 (p+2)).  Excludes the
+        one-time PPA build — an estimate for utilization bookkeeping, not
+        an exact count.  ONE definition: the primary and the MXU-aligned
+        configs must stay comparable within a report."""
+        n_experts_ = -(-n // s)
+        per_eval = n_experts_ * (6.0 * s**3 + 4.0 * s**2 * (x.shape[1] + 2))
+        return per_eval * max(nfev_, 1)
 
     # Warm-up at the measured shapes but max_iter=1: pays jit compilation
     # (max_iter is a traced scalar, so the compiled program is shared with
@@ -330,20 +342,32 @@ def worker() -> None:
         GaussianProcessMulticlassClassifier, ymc
     )
 
+    # MXU-aligned secondary config (VERDICT r3 item 2): the reference
+    # config's s=100 experts leave the 128-lane MXU tiles ~40% empty and
+    # its ~0.02 TFLOP total can't distinguish 1% MFU from 10%.  One more
+    # timed fit at s=128 (lane-aligned Gram/factor tiles) over the same
+    # rows gives the utilization-defensible number; the primary metric
+    # stays at the reference's expert size for round-over-round
+    # comparability (PerformanceBenchmark.scala:41-47).
+    mxu_expert = int(os.environ.get("BENCH_MXU_EXPERT", 128))
+    mxu_seconds = None
+    mxu_error = None
+    mxu_nfev = None
+    try:
+        make_gp(1, mxu_expert).fit(x, y)  # warm-up (compile shared)
+        mxu_start = time.perf_counter()
+        mxu_model = make_gp(max_iter, mxu_expert).fit(x, y)
+        mxu_seconds = time.perf_counter() - mxu_start
+        mxu_nfev = int(mxu_model.instr.metrics.get("lbfgs_nfev", 1))
+    except Exception as exc:  # noqa: BLE001 — secondary metric only
+        mxu_error = f"{type(exc).__name__}: {exc}"[:200]
+
     # CPU f64 BLAS proxy of the reference's cost for the same work.
     proxy_eval_s = _cpu_proxy_eval_seconds(x, y, expert_size, sigma=0.1, sigma2=1e-3)
     cpu_fit_seconds = proxy_eval_s * nfev
     cpu_throughput = n / cpu_fit_seconds if cpu_fit_seconds > 0 else float("nan")
 
-    # FLOP estimate for the optimizer phase: per expert per evaluation the
-    # dominant terms are the fused SPD inverse+logdet (~2s^3), its custom
-    # VJP (two batched matmuls, ~4s^3) and the gram + alpha matmuls
-    # (~4 s^2 (p+2)).  Excludes the one-time PPA build — an estimate for
-    # utilization bookkeeping, not an exact count.
-    n_experts = -(-n // expert_size)
-    s = expert_size
-    flops_per_eval = n_experts * (6.0 * s**3 + 4.0 * s**2 * (x.shape[1] + 2))
-    total_flops = flops_per_eval * nfev
+    total_flops = optimizer_flops(expert_size, nfev)
     est_tflops_per_sec = total_flops / fit_seconds / 1e12
     # bf16 MXU peak by device generation (public figures); f32 runs at ~half
     peak_by_kind = {"v4": 275.0, "v5 lite": 197.0, "v5e": 197.0,
@@ -401,6 +425,26 @@ def worker() -> None:
             "est_tflops_per_sec": est_tflops_per_sec,
             "est_mfu_vs_bf16_peak": (
                 None if peak is None else est_tflops_per_sec / peak
+            ),
+            "mxu_config": (
+                {"error": mxu_error, "expert_size": mxu_expert}
+                if mxu_seconds is None
+                else {
+                    "expert_size": mxu_expert,
+                    "note": "lane-aligned s=128 tiles; same rows, same "
+                    "estimator — the utilization-defensible config",
+                    "fit_seconds": mxu_seconds,
+                    "train_points_per_sec": n / mxu_seconds,
+                    "lbfgs_evals": mxu_nfev,
+                    "est_optimizer_tflops": (
+                        mxu_flops := optimizer_flops(mxu_expert, mxu_nfev or 1)
+                    ) / 1e12,
+                    "est_tflops_per_sec": mxu_flops / mxu_seconds / 1e12,
+                    "est_mfu_vs_bf16_peak": (
+                        None if peak is None
+                        else mxu_flops / mxu_seconds / 1e12 / peak
+                    ),
+                }
             ),
             "platform": platform,
             "device": str(jax.devices()[0]),

@@ -8,15 +8,17 @@ instead of one per L-BFGS evaluation.  On dispatch-latency-heavy runtimes
 collective) this is the difference between latency-bound and compute-bound
 training.
 
-Algorithm: projected L-BFGS with backtracking Armijo line search over the
-clipped path — the standard compromise replacing Breeze's full LBFGSB
-(generalized Cauchy point + subspace minimization, GPC.scala:84-86): the
-two-loop recursion builds a quasi-Newton direction, candidate iterates are
-projected onto the box ``clip(theta + t*d, lower, upper)``, and curvature
-pairs are only stored when s.y > eps.  For the handful of smooth, box-bounded
-hyperparameters of a GP kernel its iterate path is not identical to LBFGSB's
-but converges to the same optima (the e2e parity tests hold with either
-optimizer).
+Algorithm: TRUE box-LBFGSB (Byrd, Lu, Nocedal & Zhu 1995 — the same method
+as Breeze's LBFGSB, GPC.scala:84-86): each iteration walks the generalized
+Cauchy point of the quadratic model along the projected-gradient path, then
+minimizes the model over the free variables with the Cauchy active set held
+fixed (dense subspace Newton solve — the hyperparameter count is 1..~100,
+so the compact-representation machinery of the large-n original is
+unnecessary), backtracks the subspace step into the box, and line-searches
+the proposal with weak-Wolfe bracketing over the clipped path.  Curvature
+pairs are stored only when s.y > eps.  v1 of this module shipped the
+projected-gradient compromise; the GCP + subspace step closed that last
+semantic delta from the reference optimizer (VERDICT r3 item 8).
 
 Generic over an auxiliary carry threaded through objective evaluations: GPR
 passes none; the Laplace objective carries its latent warm-start stack
@@ -79,7 +81,6 @@ class _LbfgsState(NamedTuple):
     aux: object  # pytree carried through objective evals
     s_hist: jax.Array  # [m, h]
     y_hist: jax.Array  # [m, h]
-    rho: jax.Array  # [m]
     hist_count: jax.Array  # int32
     hist_head: jax.Array  # int32 (next write slot)
     n_iter: jax.Array  # int32
@@ -88,44 +89,161 @@ class _LbfgsState(NamedTuple):
     stalled: jax.Array  # bool: line search exhausted without an acceptable step
 
 
-def _two_loop_direction(grad, s_hist, y_hist, rho, count, head, m_hist):
-    """Standard L-BFGS two-loop recursion over the (masked) circular history."""
+def _dense_b_from_history(s_hist, y_hist, count, head, m_hist):
+    """The L-BFGS Hessian approximation B as a DENSE [h, h] matrix.
 
-    def newest_to_oldest(i):
-        # i = 0 is newest
-        return (head - 1 - i) % m_hist
+    Classic LBFGSB (Byrd/Lu/Nocedal/Zhu 1995) keeps B in the compact
+    ``theta I - W M W^T`` form because n is large; here n = h is the kernel's
+    hyperparameter count (1 to ~100), so materializing B and applying the
+    stored curvature pairs as dense BFGS updates is both simpler and exact —
+    the SAME quasi-Newton matrix the two-loop recursion represents
+    implicitly.  Invalid (unfilled) slots are skipped by masking; the
+    initial scaling is the standard ``theta = y.y / s.y`` of the newest
+    pair.
+    """
+    h = s_hist.shape[1]
+    dtype = s_hist.dtype
+    newest = (head - 1) % m_hist
+    sy_n = jnp.dot(s_hist[newest], y_hist[newest])
+    yy_n = jnp.dot(y_hist[newest], y_hist[newest])
+    theta = jnp.where((count > 0) & (sy_n > 0), yy_n / jnp.maximum(sy_n, 1e-30), 1.0)
+    b0 = theta * jnp.eye(h, dtype=dtype)
 
-    def first_loop(i, carry):
-        q, alphas = carry
-        slot = newest_to_oldest(i)
+    def upd(i, b_mat):
+        # oldest -> newest (BFGS update order matters)
+        slot = (head - count + i) % m_hist
         valid = i < count
-        alpha = rho[slot] * jnp.dot(s_hist[slot], q)
-        alpha = jnp.where(valid, alpha, 0.0)
-        q = q - alpha * y_hist[slot]
-        alphas = alphas.at[slot].set(alpha)
-        return q, alphas
+        s = s_hist[slot]
+        y = y_hist[slot]
+        sy = jnp.dot(s, y)
+        bs = b_mat @ s
+        sbs = jnp.dot(s, bs)
+        b_new = (
+            b_mat
+            - jnp.outer(bs, bs) / jnp.maximum(sbs, 1e-30)
+            + jnp.outer(y, y) / jnp.maximum(sy, 1e-30)
+        )
+        return jnp.where(valid, b_new, b_mat)
 
-    q, alphas = jax.lax.fori_loop(
-        0, m_hist, first_loop, (grad, jnp.zeros_like(rho))
+    return jax.lax.fori_loop(0, m_hist, upd, b0)
+
+
+def _cauchy_point(x, g, lower, upper, b_mat):
+    """Generalized Cauchy point of the quadratic model over the box
+    (Byrd et al. 1995, CP algorithm): minimize
+    ``m(t) = g.z(t) + z(t)^T B z(t) / 2`` along the projected
+    steepest-descent path ``z(t) = P(x - t g) - x``, examining the
+    piecewise-linear segments between bound breakpoints in sorted order.
+
+    Returns ``(z_c, fixed)``: the step to the Cauchy point and the mask of
+    variables that hit their bound before the path minimizer (the active
+    set the subspace minimization holds fixed).  h is small, so each
+    segment recomputes its directional derivatives against dense B —
+    O(h^2) per segment, O(h^3) total.
+    """
+    dtype = x.dtype
+    h = x.shape[0]
+    inf = jnp.asarray(jnp.inf, dtype)
+    # breakpoint where each coordinate's projected path hits its bound
+    t_break = jnp.where(
+        g < 0.0,
+        (x - upper) / jnp.where(g < 0.0, g, 1.0),
+        jnp.where(g > 0.0, (x - lower) / jnp.where(g > 0.0, g, 1.0), inf),
     )
+    t_break = jnp.where(jnp.isnan(t_break), inf, t_break)  # inf bounds
+    order = jnp.argsort(t_break)
 
-    # initial Hessian scaling from the newest pair
-    newest = newest_to_oldest(0)
-    sy = jnp.dot(s_hist[newest], y_hist[newest])
-    yy = jnp.dot(y_hist[newest], y_hist[newest])
-    gamma = jnp.where((count > 0) & (yy > 0), sy / jnp.maximum(yy, 1e-30), 1.0)
-    r = gamma * q
+    class CP(NamedTuple):
+        t_prev: jax.Array
+        z: jax.Array  # [h] step so far
+        d: jax.Array  # [h] current segment direction
+        fixed: jax.Array  # [h] bool
+        done: jax.Array  # bool
 
-    def second_loop(i, r):
-        # oldest to newest
-        slot = newest_to_oldest(count - 1 - i) % m_hist
-        valid = i < count
-        beta = rho[slot] * jnp.dot(y_hist[slot], r)
-        upd = r + s_hist[slot] * (alphas[slot] - beta)
-        return jnp.where(valid, upd, r)
+    def seg(j, cp: CP):
+        idx = order[j]
+        t_j = t_break[idx]
+        bd = b_mat @ cp.d
+        f1 = jnp.dot(g, cp.d) + jnp.dot(cp.z, bd)
+        f2 = jnp.maximum(jnp.dot(cp.d, bd), 1e-30)
+        dt_star = -f1 / f2
+        seg_len = t_j - cp.t_prev
+        # minimizer inside this segment (or already behind us: f1 >= 0)
+        hit = (~cp.done) & ((f1 >= 0.0) | (dt_star <= seg_len))
+        dt = jnp.clip(dt_star, 0.0, jnp.minimum(seg_len, jnp.finfo(dtype).max))
+        z_min = cp.z + dt * cp.d
+        # otherwise advance to the breakpoint and fix variable idx exactly
+        # at its bound (exact snap: no fp drift into the infeasible side)
+        z_at_break = cp.z + seg_len * cp.d
+        z_at_break = z_at_break.at[idx].set(
+            jnp.where(g[idx] < 0.0, upper[idx] - x[idx], lower[idx] - x[idx])
+        )
+        advance = (~cp.done) & ~hit
+        return CP(
+            t_prev=jnp.where(cp.done | hit, cp.t_prev, t_j),
+            z=jnp.where(hit, z_min, jnp.where(advance, z_at_break, cp.z)),
+            d=jnp.where(advance, cp.d.at[idx].set(0.0), cp.d),
+            fixed=jnp.where(advance, cp.fixed.at[idx].set(True), cp.fixed),
+            done=cp.done | hit,
+        )
 
-    r = jax.lax.fori_loop(0, m_hist, second_loop, r)
-    return -r
+    init = CP(
+        t_prev=jnp.zeros((), dtype),
+        z=jnp.zeros_like(x),
+        d=-g,
+        fixed=jnp.zeros((h,), bool),
+        done=jnp.zeros((), bool),
+    )
+    cp = jax.lax.fori_loop(0, h, seg, init)
+    # final unbounded segment (every remaining coordinate is bound-free)
+    bd = b_mat @ cp.d
+    f1 = jnp.dot(g, cp.d) + jnp.dot(cp.z, bd)
+    f2 = jnp.maximum(jnp.dot(cp.d, bd), 1e-30)
+    dt = jnp.maximum(-f1 / f2, 0.0)
+    z_c = jnp.where(cp.done, cp.z, cp.z + dt * cp.d)
+    return z_c, cp.fixed
+
+
+def _lbfgsb_direction(x, g, lower, upper, s_hist, y_hist, count, head, m_hist):
+    """True box-LBFGSB step proposal ``x_bar - x`` (Byrd et al. 1995):
+    generalized Cauchy point, then minimization of the quadratic model over
+    the free variables with the Cauchy active set held fixed, backtracked
+    to the box.  Replaces the projected-gradient compromise this module
+    shipped first (the one semantic delta from Breeze's LBFGSB,
+    GaussianProcessCommons.scala:84-86, VERDICT r3 item 8).
+
+    In the interior with an interior minimizer this reduces exactly to the
+    unconstrained quasi-Newton step ``-B^-1 g``; with active bounds it
+    walks the Cauchy active set like the reference optimizer instead of
+    clipping a free-space step.
+    """
+    dtype = x.dtype
+    b_mat = _dense_b_from_history(s_hist, y_hist, count, head, m_hist)
+    z_c, fixed = _cauchy_point(x, g, lower, upper, b_mat)
+
+    # subspace Newton system on the free variables: rows/cols of fixed
+    # variables are replaced by identity so the dense solve leaves them 0
+    free = ~fixed
+    free_f = free.astype(dtype)
+    rhs = -(g + b_mat @ z_c) * free_f
+    m_free = (
+        b_mat * free_f[:, None] * free_f[None, :]
+        + jnp.diag(1.0 - free_f)
+    )
+    d_f = jnp.linalg.solve(m_free, rhs)
+    d_f = jnp.where(jnp.all(jnp.isfinite(d_f)), d_f, jnp.zeros_like(d_f))
+
+    # backtrack the subspace step into the box (alpha* in Byrd et al. 5.8)
+    x_c = x + z_c
+    big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+    room = jnp.where(
+        d_f > 0.0,
+        (upper - x_c) / jnp.where(d_f > 0.0, d_f, 1.0),
+        jnp.where(d_f < 0.0, (lower - x_c) / jnp.where(d_f < 0.0, d_f, 1.0), big),
+    )
+    room = jnp.where(jnp.isnan(room), big, room)  # inf bound / zero step
+    alpha = jnp.clip(jnp.min(room, initial=big, where=free), 0.0, 1.0)
+    return z_c + alpha * d_f
 
 
 def lbfgs_init_state(value_and_grad_aux, theta0, aux0, m_hist: int = 10):
@@ -147,7 +265,6 @@ def lbfgs_init_state(value_and_grad_aux, theta0, aux0, m_hist: int = 10):
         aux=aux1,
         s_hist=jnp.zeros((m_hist, h), dtype=dtype),
         y_hist=jnp.zeros((m_hist, h), dtype=dtype),
-        rho=jnp.zeros((m_hist,), dtype=dtype),
         hist_count=jnp.zeros((), jnp.int32),
         hist_head=jnp.zeros((), jnp.int32),
         n_iter=jnp.zeros((), jnp.int32),
@@ -235,11 +352,13 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
         return jnp.max(jnp.abs(step)) if step.size else jnp.zeros((), dtype)
 
     def body(state: _LbfgsState):
-        direction = _two_loop_direction(
-            state.grad, state.s_hist, state.y_hist, state.rho,
-            state.hist_count, state.hist_head, m_hist,
+        direction = _lbfgsb_direction(
+            state.theta, state.grad, lower, upper,
+            state.s_hist, state.y_hist, state.hist_count, state.hist_head,
+            m_hist,
         )
-        # safeguard: fall back to steepest descent if not a descent direction
+        # safeguard: fall back to steepest descent if the model step is not
+        # a descent direction (degenerate B / all-fixed Cauchy corner)
         descent = jnp.dot(direction, state.grad) < 0
         direction = jnp.where(descent, direction, -state.grad)
 
@@ -375,9 +494,6 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
         y_hist = jnp.where(
             store, state.y_hist.at[slot].set(y_vec), state.y_hist
         )
-        rho = jnp.where(
-            store, state.rho.at[slot].set(1.0 / jnp.maximum(sy, 1e-30)), state.rho
-        )
         head = jnp.where(store, (slot + 1) % m_hist, slot)
         count = jnp.where(
             store, jnp.minimum(state.hist_count + 1, m_hist), state.hist_count
@@ -397,7 +513,6 @@ def _make_body(value_and_grad_aux, lower, upper, tol, m_hist, max_ls, armijo_c1)
             aux=ls.aux_new,
             s_hist=s_hist,
             y_hist=y_hist,
-            rho=rho,
             hist_count=count,
             hist_head=head,
             n_iter=state.n_iter + 1,
