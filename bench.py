@@ -278,6 +278,38 @@ def worker() -> None:
 
     throughput = n / fit_seconds
 
+    # ONE definition of the primary payload, shared by the immediate
+    # partial emit below and the full result dict later — the supervisor
+    # treats whichever line is last as THE measurement, so the two must
+    # never drift structurally.
+    primary_fields = {"metric": METRIC, "value": round(throughput, 1), "unit": UNIT}
+    primary_detail = {
+        "n_points": n,
+        "expert_size": expert_size,
+        # full precision: value must be exactly n_points / fit_seconds
+        "fit_seconds": fit_seconds,
+        "lbfgs_evals": nfev,
+        "platform": platform,
+    }
+
+    # Emit the primary metric NOW, before any secondary work: the
+    # supervisor salvages the last complete JSON line from a killed
+    # worker, so a tunnel death during the secondaries below costs the
+    # extras, never the round's number (VERDICT r3 weak #1: the bench
+    # must land its measurement inside a brief uptime window).  The full
+    # result re-emits later and, being last, supersedes this line.
+    print(
+        json.dumps({
+            **primary_fields,
+            "vs_baseline": None,
+            "detail": {
+                **primary_detail,
+                "partial": "primary metric only; secondaries pending",
+            },
+        }),
+        flush=True,
+    )
+
     # Secondary metrics, all inside the failure fence (the supervisor's
     # hardening contract: always one parseable JSON line — nothing below
     # may cost the already-measured primary fit metric): prediction
@@ -376,15 +408,10 @@ def worker() -> None:
     peak = next((v for k, v in peak_by_kind.items() if k in kind), None)
 
     result = {
-        "metric": METRIC,
-        "value": round(throughput, 1),
-        "unit": UNIT,
+        **primary_fields,
         "vs_baseline": round(throughput / cpu_throughput, 2),
         "detail": {
-            "n_points": n,
-            "expert_size": expert_size,
-            # full precision: value must be exactly n_points / fit_seconds
-            "fit_seconds": fit_seconds,
+            **primary_detail,
             "fit_phase_seconds": {
                 k: round(v, 4) for k, v in model.instr.timings.items()
             },
@@ -401,7 +428,6 @@ def worker() -> None:
                 None if predict_seconds is None else n / predict_seconds
             ),
             **({"predict_error": predict_error} if predict_error else {}),
-            "lbfgs_evals": nfev,
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,
             "baseline_note": (
@@ -446,7 +472,6 @@ def worker() -> None:
                     ),
                 }
             ),
-            "platform": platform,
             "device": str(jax.devices()[0]),
         },
     }

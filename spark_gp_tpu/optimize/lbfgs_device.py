@@ -128,6 +128,22 @@ def _dense_b_from_history(s_hist, y_hist, count, head, m_hist):
     return jax.lax.fori_loop(0, m_hist, upd, b0)
 
 
+def _steps_to_bounds(origin, direction, lower, upper, fill):
+    """Per-coordinate step length along ``direction`` until each coordinate
+    of ``origin`` hits its box bound; ``fill`` where the direction component
+    is zero (no bound ever hit) or the ratio is indeterminate (infinite
+    bound).  Shared by the Cauchy breakpoint computation and the subspace
+    feasibility backtrack — one home for the guarded-division pattern."""
+    pos = direction > 0.0
+    neg = direction < 0.0
+    steps = jnp.where(
+        pos,
+        (upper - origin) / jnp.where(pos, direction, 1.0),
+        jnp.where(neg, (lower - origin) / jnp.where(neg, direction, 1.0), fill),
+    )
+    return jnp.where(jnp.isnan(steps), fill, steps)
+
+
 def _cauchy_point(x, g, lower, upper, b_mat):
     """Generalized Cauchy point of the quadratic model over the box
     (Byrd et al. 1995, CP algorithm): minimize
@@ -144,13 +160,9 @@ def _cauchy_point(x, g, lower, upper, b_mat):
     dtype = x.dtype
     h = x.shape[0]
     inf = jnp.asarray(jnp.inf, dtype)
-    # breakpoint where each coordinate's projected path hits its bound
-    t_break = jnp.where(
-        g < 0.0,
-        (x - upper) / jnp.where(g < 0.0, g, 1.0),
-        jnp.where(g > 0.0, (x - lower) / jnp.where(g > 0.0, g, 1.0), inf),
-    )
-    t_break = jnp.where(jnp.isnan(t_break), inf, t_break)  # inf bounds
+    # breakpoint where each coordinate's projected path (direction -g)
+    # hits its bound
+    t_break = _steps_to_bounds(x, -g, lower, upper, inf)
     order = jnp.argsort(t_break)
 
     class CP(NamedTuple):
@@ -236,12 +248,7 @@ def _lbfgsb_direction(x, g, lower, upper, s_hist, y_hist, count, head, m_hist):
     # backtrack the subspace step into the box (alpha* in Byrd et al. 5.8)
     x_c = x + z_c
     big = jnp.asarray(jnp.finfo(dtype).max, dtype)
-    room = jnp.where(
-        d_f > 0.0,
-        (upper - x_c) / jnp.where(d_f > 0.0, d_f, 1.0),
-        jnp.where(d_f < 0.0, (lower - x_c) / jnp.where(d_f < 0.0, d_f, 1.0), big),
-    )
-    room = jnp.where(jnp.isnan(room), big, room)  # inf bound / zero step
+    room = _steps_to_bounds(x_c, d_f, lower, upper, big)
     alpha = jnp.clip(jnp.min(room, initial=big, where=free), 0.0, 1.0)
     return z_c + alpha * d_f
 

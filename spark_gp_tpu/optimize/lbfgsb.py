@@ -8,10 +8,10 @@ so line-search re-evaluations don't re-launch jobs
 Here the objective is one fused XLA ``value_and_grad`` executable: an
 evaluation moves (1 + |theta|) floats host<->device — negligible next to the
 compute — so SciPy's L-BFGS-B on the host is the right v0 architecture, and
-memoization is pointless (value+grad is a single pass).  An on-device
-projected L-BFGS (``lax.while_loop``) is the planned v1 for pod-scale runs
-where even the host sync per step matters; the interface below is already
-shaped for that swap.
+memoization is pointless (value+grad is a single pass).  The on-device
+box-LBFGSB (``lbfgs_device.py`` — generalized Cauchy point + subspace
+minimization in a ``lax.while_loop``) is the v1 for pod-scale runs where
+even the host sync per step matters; both drivers share this interface.
 """
 
 from __future__ import annotations
