@@ -64,6 +64,7 @@ from spark_gp_tpu.models.gpc_mc import (
     GaussianProcessMulticlassModel,
 )
 from spark_gp_tpu.models.gp_poisson import (
+    GaussianProcessNegativeBinomialRegression,
     GaussianProcessPoissonModel,
     GaussianProcessPoissonRegression,
 )
@@ -104,6 +105,7 @@ __all__ = [
     "GaussianProcessMulticlassClassifier",
     "GaussianProcessMulticlassModel",
     "GaussianProcessPoissonRegression",
+    "GaussianProcessNegativeBinomialRegression",
     "GaussianProcessPoissonModel",
     "ActiveSetProvider",
     "RandomActiveSetProvider",

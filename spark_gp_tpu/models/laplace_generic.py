@@ -138,6 +138,39 @@ class BinomialLikelihood(Likelihood):
         return y - self.trials * pi, self.trials * pi * (1.0 - pi)
 
 
+class NegativeBinomialLikelihood(Likelihood):
+    """Overdispersed counts, log link: ``y | f ~ NB(mean = exp(f),
+    dispersion = r)`` (NB2: ``Var = mean + mean^2 / r``).
+
+    ``log p = y f - (y + r) log(r + e^f) + const(y, r)`` — every term
+    constant in ``f`` is dropped (same convention as the other
+    likelihoods).  Stable form via ``sigmoid``/``softplus`` shifted by
+    ``log r``: ``log p = y f - (y + r) softplus(f - log r)``,
+    ``W = (y + r) s (1 - s)`` with ``s = sigmoid(f - log r)`` — strictly
+    positive, so the likelihood is log-concave and the ``B = I + sqrt(W) K
+    sqrt(W)`` Laplace form applies.  As ``r -> inf`` this converges to
+    :class:`PoissonLikelihood` (tested).
+    """
+
+    def __init__(self, dispersion: float) -> None:
+        dispersion = float(dispersion)
+        if not dispersion > 0:
+            raise ValueError("dispersion must be positive")
+        self.dispersion = dispersion
+
+    def _spec(self) -> tuple:
+        return (self.dispersion,)
+
+    def log_lik(self, f, y):
+        r = self.dispersion
+        return y * f - (y + r) * jax.nn.softplus(f - jnp.log(r))
+
+    def grad_hess(self, f, y):
+        r = self.dispersion
+        s = jax.nn.sigmoid(f - jnp.log(r))
+        return y - (y + r) * s, (y + r) * s * (1.0 - s)
+
+
 class _GenNewtonState(NamedTuple):
     f: jax.Array  # [E, s]
     old_obj: jax.Array  # [E]
