@@ -1,0 +1,153 @@
+"""Property-based kernel-algebra tests (hypothesis).
+
+The example-based tests in test_kernels.py pin golden values and FD
+gradients for each family; these properties instead exercise RANDOM
+composite kernel trees (sums, trainable/const scales, Schur products over
+noise-free factors) and assert the algebraic invariants every composite
+must satisfy:
+
+* gram is symmetric PSD (Schur/sum/scale closure under the composition
+  rules, the reason ProductKernel rejects noise factors);
+* ``diag``/``self_diag`` agree with ``gram``'s diagonal;
+* the noise split invariant ``gram == cross(x, x) + white_noise_var * I``
+  (crossKernel carries no delta ridge, kernel/Kernel.scala:151-161 —
+  this is THE contract the PPA statistics and greedy scorer lean on);
+* theta layout: init/bounds lengths equal ``n_hypers`` and init is
+  feasible;
+* spec identity: an identically-reconstructed tree is ``==`` and hashes
+  equal (the jit-static cache key contract);
+* the summed gram is autodiff-differentiable with finite gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from spark_gp_tpu import (
+    ARDRBFKernel,
+    Const,
+    DotProductKernel,
+    EyeKernel,
+    Matern32Kernel,
+    Matern52Kernel,
+    PeriodicKernel,
+    RationalQuadraticKernel,
+    RBFKernel,
+    Scalar,
+    WhiteNoiseKernel,
+)
+
+P_DIM = 2  # ARD kernels must match the data dimension
+
+# positive hyperparameter values kept in a well-conditioned band
+pos = st.floats(0.3, 3.0)
+
+
+def _noise_free_leaf():
+    return st.one_of(
+        st.builds(lambda s: RBFKernel(s, 1e-6, 10.0), pos),
+        st.builds(lambda b: ARDRBFKernel(P_DIM, b), pos),
+        st.builds(lambda s: Matern32Kernel(s), pos),
+        st.builds(lambda s: Matern52Kernel(s), pos),
+        st.builds(lambda p, l: PeriodicKernel(p, l), pos, pos),
+        st.builds(lambda s, a: RationalQuadraticKernel(s, a), pos, pos),
+        st.builds(lambda s: DotProductKernel(s), pos),
+    )
+
+
+def _noise_free_tree(max_depth=2):
+    # products may only combine noise-free factors (ProductKernel guard)
+    return st.recursive(
+        _noise_free_leaf(),
+        lambda children: st.one_of(
+            st.builds(lambda a, b: a + b, children, children),
+            st.builds(lambda a, b: a * b, children, children),
+            st.builds(lambda c, a: Scalar(c) * a, pos, children),
+            st.builds(lambda c, a: Const(c) * a, pos, children),
+        ),
+        max_leaves=4,
+    )
+
+
+def _kernel_tree():
+    # optionally add noise at the top level, like every real model kernel
+    return st.one_of(
+        _noise_free_tree(),
+        st.builds(
+            lambda k, i: k + WhiteNoiseKernel(i, 0.0, 1.0),
+            _noise_free_tree(),
+            st.floats(0.0, 0.8),
+        ),
+        st.builds(
+            lambda k, c: k + Const(c) * EyeKernel(),
+            _noise_free_tree(),
+            st.floats(0.0, 0.5),
+        ),
+    )
+
+
+def _data(seed, n=6):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(n, P_DIM)))
+
+
+@settings(max_examples=30, deadline=None)
+@given(kernel=_kernel_tree(), seed=st.integers(0, 2**31 - 1))
+def test_gram_symmetric_psd_and_diag_consistent(kernel, seed):
+    x = _data(seed)
+    theta = jnp.asarray(kernel.init_theta())
+    gram = np.asarray(kernel.gram(theta, x))
+    np.testing.assert_allclose(gram, gram.T, atol=1e-10)
+    eigs = np.linalg.eigvalsh(gram + 1e-9 * np.eye(gram.shape[0]))
+    assert eigs.min() > -1e-8, eigs.min()
+    np.testing.assert_allclose(
+        np.asarray(kernel.diag(theta, x)), np.diagonal(gram), rtol=1e-10,
+        atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(kernel.self_diag(theta, x)),
+        np.asarray(kernel.diag(theta, x)),
+        rtol=1e-10, atol=1e-12,
+    )
+
+
+@settings(max_examples=30, deadline=None)
+@given(kernel=_kernel_tree(), seed=st.integers(0, 2**31 - 1))
+def test_noise_split_invariant(kernel, seed):
+    """gram == cross(x, x) + white_noise_var * I for EVERY composite —
+    crossKernel never carries the delta ridge."""
+    x = _data(seed)
+    theta = jnp.asarray(kernel.init_theta())
+    gram = np.asarray(kernel.gram(theta, x))
+    cross = np.asarray(kernel.cross(theta, x, x))
+    wn = float(kernel.white_noise_var(theta))
+    np.testing.assert_allclose(
+        gram, cross + wn * np.eye(gram.shape[0]), atol=1e-10
+    )
+
+
+@settings(max_examples=30, deadline=None)
+@given(kernel=_kernel_tree())
+def test_theta_layout_and_spec_identity(kernel):
+    theta0 = kernel.init_theta()
+    lo, hi = kernel.bounds()
+    assert theta0.shape == lo.shape == hi.shape == (kernel.n_hypers,)
+    assert np.all(lo <= theta0) and np.all(theta0 <= hi)
+    assert isinstance(kernel.describe(theta0), str)
+    # spec identity: the hash/eq contract jit-static caching relies on
+    import pickle
+
+    rebuilt = pickle.loads(pickle.dumps(kernel))
+    assert rebuilt == kernel and hash(rebuilt) == hash(kernel)
+
+
+@settings(max_examples=15, deadline=None)
+@given(kernel=_kernel_tree(), seed=st.integers(0, 2**31 - 1))
+def test_gram_autodiff_gradients_finite(kernel, seed):
+    x = _data(seed)
+    theta = jnp.asarray(kernel.init_theta())
+    if theta.size == 0:
+        return
+    grad = jax.grad(lambda t: jnp.sum(kernel.gram(t, x)))(theta)
+    assert np.all(np.isfinite(np.asarray(grad)))
