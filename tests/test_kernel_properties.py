@@ -22,7 +22,13 @@ must satisfy:
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis (baked into the "
+    "dev image; optional elsewhere)"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from spark_gp_tpu import (
     ARDRBFKernel,
