@@ -2,6 +2,7 @@
 (GaussianProcessCommons.scala:26-31)."""
 
 import numpy as np
+import pytest
 
 from spark_gp_tpu.parallel.experts import group_for_experts, num_experts_for
 
@@ -55,3 +56,42 @@ def test_pad_experts_to_device_multiple():
     np.testing.assert_allclose(np.asarray(padded.mask)[3:], 0.0)
     # original experts intact
     np.testing.assert_allclose(np.asarray(padded.x)[:3], np.asarray(data.x))
+
+
+def test_group_ungroup_roundtrip_property():
+    """Property sweep over random (N, s): grouping then ungrouping the
+    targets recovers them exactly in original order; the mask counts
+    exactly N real slots; every expert's width is the common s = ceil(N/E)
+    (the ragged-tail layout, SURVEY hard part #5)."""
+    pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    from spark_gp_tpu.parallel.experts import ungroup
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        n=st.integers(1, 400),
+        s=st.integers(1, 150),
+        seed=st.integers(0, 2**31 - 1),
+    )
+    def check(n, s, seed):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, 2))
+        y = rng.normal(size=n)
+        data = group_for_experts(x, y, s)
+        e = num_experts_for(n, s)
+        assert data.x.shape[0] == e
+        assert data.x.shape[1] == -(-n // e)  # common width = ceil(N/E)
+        assert int(np.sum(np.asarray(data.mask))) == n
+        # targets round-trip exactly, in original order
+        np.testing.assert_array_equal(
+            ungroup(np.asarray(data.y), n), y
+        )
+        # every real slot holds the right row of x
+        xg = np.asarray(data.x)
+        mask = np.asarray(data.mask).astype(bool)
+        width = xg.shape[1]
+        point = np.arange(e)[:, None] + np.arange(width)[None, :] * e
+        np.testing.assert_array_equal(xg[mask], x[point[mask]])
+
+    check()
