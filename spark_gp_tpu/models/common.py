@@ -255,6 +255,11 @@ def _default_kernel_factory() -> Kernel:
 class GaussianProcessCommons(GaussianProcessParams):
     """Shared training skeleton (GaussianProcessCommons.scala:15-115)."""
 
+    # Regression overrides to True: its PPA statistics sum over the raw
+    # targets, so they stay meaningful for incremental updates.  Laplace
+    # families sum over latent modes — stats are fit-internal there.
+    _keeps_update_statistics: bool = False
+
     @contextlib.contextmanager
     def _stack_mesh(self, data):
         """Context manager resolving the mesh for a ``fit_distributed`` call:
@@ -610,12 +615,21 @@ class GaussianProcessCommons(GaussianProcessParams):
                 kernel, theta, active64, u1, u2, mesh=self._mesh,
                 with_variance=self._predictive_variance,
             )
+        keep_stats = self._keeps_update_statistics
         return ppa.ProjectedProcessRawPredictor(
             kernel=kernel,
             theta=np.asarray(theta, dtype=np.float64),
             active=active64,
             magic_vector=magic_vector,
             magic_matrix=magic_matrix,
+            # the additive statistics behind the solve: kept ONLY on
+            # regression models, where they enable model.update()
+            # (ProjectedProcessRawPredictor.with_additional_data).  The
+            # Laplace families' statistics are sums over LATENT targets —
+            # folding raw labels/counts into them would be silently wrong,
+            # and storing an unusable [m, m] f64 per model is dead weight.
+            u1=np.asarray(u1, dtype=np.float64) if keep_stats else None,
+            u2=np.asarray(u2, dtype=np.float64) if keep_stats else None,
         )
 
     def _finalize_device_fit(

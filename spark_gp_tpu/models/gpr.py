@@ -41,6 +41,10 @@ class GaussianProcessRegression(GaussianProcessCommons):
     >>> mean = model.predict(x_test)
     """
 
+    # keep U1/u2 on the fitted model: regression targets are the raw y, so
+    # the additive statistics support model.update() (incremental data)
+    _keeps_update_statistics = True
+
     def fit(self, x: np.ndarray, y: np.ndarray) -> "GaussianProcessRegressionModel":
         instr = Instrumentation(name="GaussianProcessRegression")
         x = np.asarray(x, dtype=np.float64)
@@ -293,6 +297,27 @@ class GaussianProcessRegressionModel:
             (n_samples, mean.shape[0])
         )
         return mean[None, :] + eps @ chol.T
+
+    def update(self, x_new: np.ndarray, y_new: np.ndarray) -> "GaussianProcessRegressionModel":
+        """New model with ``(x_new, y_new)`` folded in WITHOUT a refit.
+
+        The PPA statistics are additive over observations, so an update is
+        one [m, t] cross kernel plus one O(m^3) magic re-solve at the
+        fitted hyperparameters and active set
+        (:meth:`ProjectedProcessRawPredictor.with_additional_data`) —
+        streaming/online data at prediction-grade cost, a capability the
+        reference's frozen produceModel cannot offer.  Functional: the
+        original model is untouched.  Re-fit instead when the new data
+        plausibly shifts the hyperparameters or deserves active-set slots.
+
+        Regression only: the classifier/count families would need a fresh
+        Laplace mode solve over the new points to produce their latent
+        targets — refit those.
+        """
+        model = GaussianProcessRegressionModel(
+            self.raw_predictor.with_additional_data(x_new, y_new)
+        )
+        return model
 
     def save(self, path: str) -> None:
         from spark_gp_tpu.utils.serialization import save_model

@@ -581,6 +581,90 @@ class ProjectedProcessRawPredictor:
     magic_vector: np.ndarray
     # None for mean-only models (setPredictiveVariance(False))
     magic_matrix: Optional[np.ndarray]
+    # The raw PPA statistics behind the solve (U1 = sum K_mn K_nm [m, m],
+    # u2 = sum K_mn y [m], f64).  They are ADDITIVE over data points, which
+    # is what makes incremental updates possible (with_additional_data):
+    # new observations fold in with one O(m^3) re-solve, no refit.  Only
+    # REGRESSION fits store them (common.py _keeps_update_statistics): the
+    # Laplace families' statistics sum over latent modes, where folding in
+    # raw labels/counts would be silently wrong; pre-r4 checkpoints lack
+    # them entirely.
+    u1: Optional[np.ndarray] = None
+    u2: Optional[np.ndarray] = None
+
+    def with_additional_data(self, x_new, y_new) -> "ProjectedProcessRawPredictor":
+        """Fold new observations into the fitted model: the PPA statistics
+        are per-point sums (U1 += C C^T, u2 += C y with C = K(active, x_new)
+        — PGPH.scala:27-29's treeAggregate is exactly this sum), so an
+        update costs one [m, t] cross kernel + one O(m^3) magic re-solve at
+        the FIXED hyperparameters and active set.  Capability beyond the
+        reference (whose model is frozen at produceModel); statistically
+        this is the projected process with its inducing set and kernel held
+        fixed — re-fit when the new data plausibly shifts the
+        hyperparameters.
+        """
+        if self.u1 is None or self.u2 is None:
+            raise ValueError(
+                "this model does not carry updatable PPA statistics: only "
+                "regression fits store them (the Laplace families' "
+                "statistics are over latent targets — refit those; pre-r4 "
+                "saves lack them — refit to enable incremental updates)"
+            )
+        x_new = np.asarray(x_new, dtype=np.float64)
+        y_new = np.asarray(y_new, dtype=np.float64)
+        if x_new.ndim != 2 or x_new.shape[1] != self.active.shape[1]:
+            raise ValueError(
+                f"x_new must be [t, {self.active.shape[1]}], got "
+                f"{tuple(x_new.shape)}"
+            )
+        if y_new.shape != (x_new.shape[0],):
+            raise ValueError(
+                f"y_new must be [{x_new.shape[0]}], got {tuple(y_new.shape)}"
+            )
+        # f64 on the host CPU regardless of the global x64 flag (same
+        # precision rationale as the fit-time statistics accumulation)
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            cpu = None
+        import contextlib
+
+        u1 = np.asarray(self.u1, dtype=np.float64).copy()
+        u2 = np.asarray(self.u2, dtype=np.float64).copy()
+        m = self.active.shape[0]
+        # bounded-memory accumulation, like the prediction path: the
+        # [m, chunk] cross intermediate is capped, so 'streaming update'
+        # holds for arbitrarily large t (u1 += c c^T per chunk is the same
+        # sum in a different bracketing)
+        chunk = max(1, self._PREDICT_CHUNK_ELEMS // max(1, m))
+        ctx = jax.default_device(cpu) if cpu is not None else contextlib.nullcontext()
+        with jax.enable_x64(), ctx:
+            theta64 = jnp.asarray(self.theta, dtype=jnp.float64)
+            active64 = jnp.asarray(self.active, dtype=jnp.float64)
+            for start in range(0, x_new.shape[0], chunk):
+                cross = np.asarray(
+                    self.kernel.cross(
+                        theta64, active64,
+                        jnp.asarray(x_new[start : start + chunk]),
+                    )
+                )  # [m, <=chunk]
+                u1 += cross @ cross.T
+                u2 += cross @ y_new[start : start + chunk]
+        magic_vector, magic_matrix = magic_solve(
+            self.kernel, self.theta, self.active, u1, u2,
+            with_variance=self.magic_matrix is not None,
+        )
+        return ProjectedProcessRawPredictor(
+            kernel=self.kernel,
+            theta=self.theta,
+            active=self.active,
+            magic_vector=np.asarray(magic_vector),
+            magic_matrix=(
+                None if self.magic_matrix is None else np.asarray(magic_matrix)
+            ),
+            u1=u1,
+            u2=u2,
+        )
 
     def predict_fn(self):
         """Returns a jittable ``x_test [t, p] -> (mean [t], var [t])``."""

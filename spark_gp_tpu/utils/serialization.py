@@ -24,6 +24,13 @@ def _normalize(path: str) -> str:
 
 def save_model(path: str, model, kind: str) -> None:
     raw = model.raw_predictor
+    extras = {}
+    # the additive PPA statistics, when the model carries them: persisting
+    # u1/u2 keeps a reloaded regression model incrementally updatable
+    # (ProjectedProcessRawPredictor.with_additional_data)
+    if getattr(raw, "u1", None) is not None:
+        extras["u1"] = raw.u1
+        extras["u2"] = raw.u2
     np.savez(
         _normalize(path),
         kind=np.array(kind),
@@ -38,6 +45,7 @@ def save_model(path: str, model, kind: str) -> None:
         kernel_pickle=np.frombuffer(
             pickle.dumps(raw.kernel), dtype=np.uint8
         ),
+        **extras,
     )
 
 
@@ -57,6 +65,9 @@ def load_model(path: str):
             active=data["active"],
             magic_vector=data["magic_vector"],
             magic_matrix=None if magic_matrix.size == 0 else magic_matrix,
+            # absent in pre-r4 files: loads fine, update() then refuses
+            u1=data["u1"] if "u1" in data else None,
+            u2=data["u2"] if "u2" in data else None,
         )
     if kind == "classification":
         return GaussianProcessClassificationModel(raw)
