@@ -73,6 +73,11 @@ def pytest_configure(config):
         "markers",
         "tpu: exercises real-hardware lowering; selected by GP_TEST_PLATFORM=tpu",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-subprocess artifact-contract guards (~30s each); "
+        "deselect with -m 'not slow' for a quick loop",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

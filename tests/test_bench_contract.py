@@ -1,0 +1,84 @@
+"""Contract guards for the round artifacts' producers (bench.py, quality.py).
+
+The round driver consumes these scripts' stdout directly (BENCH_r*.json /
+QUALITY_r*.json); a regression that breaks their output contract would
+otherwise surface only in the driver's end-of-round artifacts.  Tiny
+configs keep the guards to ~30 s on the CPU harness.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, env_extra, args=(), timeout=900):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the artifact producers manage their own subprocesses; drop the
+    # harness's forced 8-device flag so their workers start cleanly, and
+    # pin/drop every contract-bearing knob a developer shell might have
+    # exported (an inherited GP_SYNC_PHASES=0 would fail the phase
+    # attribution assertion on a perfectly healthy bench.py)
+    env.pop("XLA_FLAGS", None)
+    env["GP_SYNC_PHASES"] = "1"
+    for var in list(env):
+        if var.startswith("BENCH_") or var.startswith("QUALITY_"):
+            env.pop(var)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT,
+    )
+
+
+@pytest.mark.slow
+def test_bench_emits_one_parseable_result_line():
+    out = _run(
+        "bench.py",
+        {
+            "BENCH_N": "1500",
+            "BENCH_EXPERT": "50",
+            "BENCH_MXU_EXPERT": "64",
+            "BENCH_MAXITER": "3",
+            "BENCH_PREFLIGHT_TIMEOUT": "120",
+            "BENCH_PREFLIGHT_ATTEMPTS": "1",
+        },
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    # the driver's contract: metric/value/unit/vs_baseline always present
+    assert result["metric"] == "gpr_train_points_per_sec_per_chip"
+    assert result["value"] and result["value"] > 0
+    assert result["unit"] == "points/s/chip"
+    assert result["vs_baseline"] and result["vs_baseline"] > 0
+    detail = result["detail"]
+    # the final line is the FULL result, not the early partial emit
+    assert "partial" not in detail
+    assert detail["platform"] == "cpu"
+    # phase attribution: with GP_SYNC_PHASES (bench default) the optimizer
+    # phase must carry its own wall-clock, not hide in the final fetch
+    phases = detail["fit_phase_seconds"]
+    assert phases["optimize_hypers"] > phases.get("sync_fetch", 0.0)
+    # the MXU-aligned secondary config rode along
+    assert detail["mxu_config"]["expert_size"] == 64
+    assert detail["mxu_config"]["fit_seconds"] > 0
+
+
+@pytest.mark.slow
+def test_quality_single_part_report_contract():
+    out = _run("quality.py", {}, args=("--parts", "greedy_vs_random"))
+    # surface the real cause on a crash instead of an opaque JSON error
+    assert out.returncode in (0, 1), out.stderr[-500:]
+    report = json.loads(out.stdout)
+    part = report["parts"]["greedy_vs_random"]
+    assert "error" not in part, part
+    assert isinstance(part["passed"], bool)
+    assert report["failed_bars"] == ([] if part["passed"] else ["greedy_vs_random"])
+    # bars gate the exit code
+    assert out.returncode == (0 if not report["failed_bars"] else 1)
