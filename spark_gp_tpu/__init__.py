@@ -59,6 +59,10 @@ from spark_gp_tpu.models.gpc import (
     GaussianProcessClassifier,
     GaussianProcessClassificationModel,
 )
+from spark_gp_tpu.models.gpc_ep import (
+    GaussianProcessEPClassificationModel,
+    GaussianProcessEPClassifier,
+)
 from spark_gp_tpu.models.gpc_mc import (
     GaussianProcessMulticlassClassifier,
     GaussianProcessMulticlassModel,
@@ -105,6 +109,8 @@ __all__ = [
     "GaussianProcessMulticlassClassifier",
     "GaussianProcessMulticlassModel",
     "GaussianProcessPoissonRegression",
+    "GaussianProcessEPClassifier",
+    "GaussianProcessEPClassificationModel",
     "GaussianProcessNegativeBinomialRegression",
     "GaussianProcessPoissonModel",
     "ActiveSetProvider",

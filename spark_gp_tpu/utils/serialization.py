@@ -71,6 +71,12 @@ def load_model(path: str):
         )
     if kind == "classification":
         return GaussianProcessClassificationModel(raw)
+    if kind == "ep_classification":
+        from spark_gp_tpu.models.gpc_ep import (
+            GaussianProcessEPClassificationModel,
+        )
+
+        return GaussianProcessEPClassificationModel(raw)
     if kind == "multiclass":
         return GaussianProcessMulticlassModel(raw)
     if kind == "poisson":
