@@ -45,6 +45,17 @@ def make_native_gpc():
     )
 
 
+def make_ep_gpc():
+    """EP (probit) engine at the same expert/active configuration."""
+    from spark_gp_tpu import GaussianProcessEPClassifier
+
+    return (
+        GaussianProcessEPClassifier()
+        .setDatasetSizeForExpert(20)
+        .setActiveSetSize(30)
+    )
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--folds", type=int, default=10)

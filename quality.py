@@ -18,6 +18,8 @@ Parts:
                  prints it unasserted; recorded here)
   iris_native_mc 10-fold accuracy on iris through the NATIVE multiclass
                  (softmax Laplace) estimator, same folds as `iris`
+  iris_ep        10-fold accuracy on iris through the EP (probit) engine,
+                 same folds as `iris` (engines must agree in regime)
   poisson        count-regression rate-recovery error (the generic-
                  likelihood Laplace path), seeded synthetic; includes a
                  Negative Binomial sub-fit on overdispersed counts with
@@ -50,9 +52,9 @@ import sys
 import time
 
 _ALL_PARTS = (
-    "airfoil", "iris", "iris_native_mc", "poisson", "gpc_mnist", "protein",
-    "year_msd", "greedy_scale", "greedy_vs_random", "weak_scaling",
-    "pallas_sweep",
+    "airfoil", "iris", "iris_native_mc", "iris_ep", "poisson", "gpc_mnist",
+    "protein", "year_msd", "greedy_scale", "greedy_vs_random",
+    "weak_scaling", "pallas_sweep",
 )
 
 
@@ -149,6 +151,29 @@ def part_iris_native_mc() -> dict:
     # compared on identical splits
     score = cross_validate(
         make_native_gpc(), x, y, num_folds=10, metric=accuracy, seed=13
+    )
+    return {
+        "accuracy_10fold": float(score),
+        "bar": 0.9,
+        "passed": bool(score > 0.9),
+        "seconds": time.perf_counter() - start,
+    }
+
+
+def part_iris_ep() -> dict:
+    """10-fold accuracy on iris through the EP inference engine (probit,
+    OneVsRest over binary EP classifiers) on the same folds as `iris` —
+    the two engines approximate the same posterior and must land in the
+    same accuracy regime."""
+    _assert_platform()
+    from examples.iris import make_ep_gpc  # single source of the iris config
+    from spark_gp_tpu.data import load_iris
+    from spark_gp_tpu.utils.validation import OneVsRest, accuracy, cross_validate
+
+    x, y = load_iris()
+    start = time.perf_counter()
+    score = cross_validate(
+        OneVsRest(make_ep_gpc), x, y, num_folds=10, metric=accuracy, seed=13
     )
     return {
         "accuracy_10fold": float(score),

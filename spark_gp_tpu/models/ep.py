@@ -120,6 +120,11 @@ def _site_update(y_pm, mask, tau, nu, sigma_diag, mu):
 
     tau_new = jnp.maximum(1.0 / jnp.maximum(s2_hat, 1e-300) - tau_cav, 0.0)
     nu_new = mu_hat / jnp.maximum(s2_hat, 1e-300) - nu_cav
+    # Invariant _ep_log_z's guards rely on: a zero-precision site carries
+    # zero nu.  The clamp above can fire from float cancellation
+    # (s2_hat == s2_cav to precision at extreme theta) with nu_new still
+    # nonzero — zero it so the site is exactly flat, not inconsistent.
+    nu_new = jnp.where(tau_new > 0.0, nu_new, 0.0)
     # padded slots stay exactly zero-precision
     return tau_new * mask, nu_new * mask
 
@@ -318,7 +323,9 @@ def fit_gpc_ep_device(
     """Single-chip on-device EP classifier fit: the site pair rides as the
     optimizer's aux pytree carry (the Laplace latents' pattern — the
     optimizer is generic over the carry, so EP plugs straight in).
-    Returns ``(theta, (tau, nu), nll, n_iter, n_fev, stalled)``."""
+    Returns ``(theta, (tau, nu), latent_mu, nll, n_iter, n_fev,
+    stalled)`` — the latent posterior mean (the PPA targets) is computed
+    inside the same dispatch."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
@@ -338,7 +345,14 @@ def fit_gpc_ep_device(
     theta, f, sites, n_iter, n_fev, stalled = lbfgs_minimize_device(
         vag, theta0, lower, upper, sites0, max_iter=max_iter, tol=tol
     )
-    return from_u(theta), sites, f, n_iter, n_fev, stalled
+    theta = from_u(theta)
+    # latent mean at (theta*, converged sites) INSIDE the same dispatch —
+    # the PPA targets, without a second program recomputing the Gram stack
+    kmat = jax.vmap(
+        lambda xe, me: masked_kernel_matrix(kernel.gram(theta, xe), me)
+    )(x, mask)
+    _, mu, _ = _posterior_marginals(kmat, *sites)
+    return theta, sites, mu * mask, f, n_iter, n_fev, stalled
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
@@ -363,7 +377,8 @@ def fit_gpc_ep_device_sharded(
             P(),
         ),
         out_specs=(
-            P(), (P(EXPERT_AXIS), P(EXPERT_AXIS)), P(), P(), P(), P(),
+            P(), (P(EXPERT_AXIS), P(EXPERT_AXIS)), P(EXPERT_AXIS),
+            P(), P(), P(), P(),
         ),
     )
     def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_):
@@ -388,7 +403,12 @@ def fit_gpc_ep_device_sharded(
         theta, f, sites, n_iter, n_fev, stalled = lbfgs_minimize_device(
             vag, t0, lo, hi, sites0, max_iter=max_iter_, tol=tol
         )
-        return from_u(theta), sites, f, n_iter, n_fev, stalled
+        theta = from_u(theta)
+        kmat = jax.vmap(
+            lambda xe, me: masked_kernel_matrix(kernel.gram(theta, xe), me)
+        )(x_, mask_)
+        _, mu, _ = _posterior_marginals(kmat, *sites)
+        return theta, sites, mu * mask_, f, n_iter, n_fev, stalled
 
     return run(theta0, lower, upper, x, y, mask, max_iter)
 

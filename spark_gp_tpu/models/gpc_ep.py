@@ -56,9 +56,8 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
             self._resolved_optimizer() == "device"
             and self._checkpoint_dir is None
         ):
-            theta_dev, sites, pending = self._fit_ep_device(instr, kernel, data)
-            latent_y = ep_posterior_mean(
-                kernel, theta_dev, data.x, data.mask, *sites
+            theta_dev, latent_y, pending = self._fit_ep_device(
+                instr, kernel, data
             )
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
             raw, _ = self._finalize_device_fit(
@@ -107,7 +106,7 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
         )
         with instr.phase("optimize_hypers"):
             if self._mesh is not None:
-                theta, sites, f, n_iter, n_fev, stalled = (
+                theta, _sites, latent_mu, f, n_iter, n_fev, stalled = (
                     fit_gpc_ep_device_sharded(
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data.x, data.y, data.mask,
@@ -115,9 +114,11 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
                     )
                 )
             else:
-                theta, sites, f, n_iter, n_fev, stalled = fit_gpc_ep_device(
-                    kernel, float(self._tol), log_space, theta0, lower,
-                    upper, data.x, data.y, data.mask, max_iter,
+                theta, _sites, latent_mu, f, n_iter, n_fev, stalled = (
+                    fit_gpc_ep_device(
+                        kernel, float(self._tol), log_space, theta0, lower,
+                        upper, data.x, data.y, data.mask, max_iter,
+                    )
                 )
             phase_sync(theta, f)
         pending = {
@@ -126,7 +127,7 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
             "final_nll": f,
             "lbfgs_stalled": stalled,
         }
-        return theta, sites, pending
+        return theta, latent_mu, pending
 
     # fit()/fit_distributed() build the Laplace model class through the
     # parent; wrap to return the EP model (closed-form probit proba)
