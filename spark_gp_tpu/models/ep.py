@@ -355,6 +355,35 @@ def fit_gpc_ep_device(
     return theta, sites, mu * mask, f, n_iter, n_fev, stalled
 
 
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def fit_gpc_ep_device_multistart(
+    kernel: Kernel, tol, log_space, theta0_batch, lower, upper, x, y, mask,
+    max_iter,
+):
+    """Multi-start single-chip EP fit: R restarts as ONE vmapped device
+    program, the site pairs riding per lane.  Returns ``(theta_best,
+    latent_mu_best, nll_best, n_iter, n_fev, stalled, f_all [R], best)``
+    — the winner's latent mean computed in the same dispatch."""
+    from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
+
+    data = ExpertData(x=x, y=y, mask=mask)
+
+    def vag(theta, sites):
+        return batched_neg_logz_ep(kernel, tol, theta, data, sites)
+
+    sites0 = (jnp.zeros_like(y), jnp.zeros_like(y))
+    theta, sites, f, n_iter, n_fev, stalled, f_all, best = (
+        multistart_minimize(
+            vag, log_space, theta0_batch, lower, upper, sites0, max_iter, tol
+        )
+    )
+    kmat = jax.vmap(
+        lambda xe, me: masked_kernel_matrix(kernel.gram(theta, xe), me)
+    )(x, mask)
+    _, mu, _ = _posterior_marginals(kmat, *sites)
+    return theta, mu * mask, f, n_iter, n_fev, stalled, f_all, best
+
+
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def fit_gpc_ep_device_sharded(
     kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask,

@@ -90,14 +90,38 @@ class GaussianProcessClassifier(GaussianProcessCommons):
 
         return self._fit_with_restarts(instr, fit_once)
 
+    # human-readable engine tag for the multistart log line; the EP
+    # subclass overrides both this and _multistart_device_call
+    _engine_log_tag = ""
+
+    def _multistart_device_call(
+        self, kernel, log_space, theta_batch, lower, upper, data, max_iter
+    ):
+        """Engine hook for the shared multistart skeleton: run the vmapped
+        R-restart device fit and return ``(theta, latent_y, nll, n_iter,
+        n_fev, stalled, f_all, best)`` with ``latent_y`` the winner's PPA
+        targets (masked latent stack)."""
+        from spark_gp_tpu.models.laplace import fit_gpc_device_multistart
+
+        theta, f_final, nll, n_iter, n_fev, stalled, f_all, best = (
+            fit_gpc_device_multistart(
+                kernel, float(self._tol), log_space, theta_batch,
+                lower, upper, data.x, data.y, data.mask, max_iter,
+            )
+        )
+        return (
+            theta, f_final * data.mask, nll, n_iter, n_fev, stalled, f_all,
+            best,
+        )
+
     def _fit_device_multistart(
         self, instr, data, x, make_targets_fn
     ) -> "GaussianProcessClassificationModel":
         """Batched on-device multi-start (single chip): R starting points
-        run in one vmapped Laplace + L-BFGS dispatch
-        (laplace.fit_gpc_device_multistart); the winner's latent modes feed
-        one PPA build."""
-        from spark_gp_tpu.models.laplace import fit_gpc_device_multistart
+        run in one vmapped inference + L-BFGS dispatch (the engine hook
+        ``_multistart_device_call``); the winner's latent targets feed one
+        PPA build.  ONE skeleton for both inference engines (Laplace here,
+        EP in gpc_ep.py)."""
         from spark_gp_tpu.utils.instrumentation import maybe_profile
 
         with maybe_profile(self._profile_dir):
@@ -110,20 +134,20 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             log_space = self._use_log_space(kernel)
             instr.log_info(
                 "Optimising the kernel hyperparameters "
-                f"(on-device, {self._num_restarts} batched restarts)"
+                f"(on-device{self._engine_log_tag}, "
+                f"{self._num_restarts} batched restarts)"
             )
             with instr.phase("optimize_hypers"):
-                theta, f_final, nll, n_iter, n_fev, stalled, f_all, best = (
-                    fit_gpc_device_multistart(
-                        kernel, float(self._tol), log_space, theta_batch,
+                theta, latent_y, nll, n_iter, n_fev, stalled, f_all, best = (
+                    self._multistart_device_call(
+                        kernel, log_space, theta_batch,
                         jnp.asarray(lower, dtype=dtype),
                         jnp.asarray(upper, dtype=dtype),
-                        data.x, data.y, data.mask,
+                        data,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
                     )
                 )
                 phase_sync(theta, nll)
-            latent_y = f_final * data.mask
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
             pending = {
                 "lbfgs_iters": n_iter,

@@ -16,8 +16,7 @@ integrates with Gauss–Hermite).
 Engine differences from :class:`GaussianProcessClassifier`: the
 checkpointed device variant is not wired (a checkpoint dir falls back to
 the host driver, whose theta-per-iteration checkpointing works
-unchanged); batched multi-start falls back to the sequential restart
-driver.
+unchanged).
 """
 
 from __future__ import annotations
@@ -45,9 +44,20 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
     """Binary classifier with the EP inference engine; the fluent API and
     every orchestration feature come from the shared skeleton."""
 
-    def _use_batched_multistart(self) -> bool:
-        # multi-start runs through the sequential restart driver
-        return False
+    _engine_log_tag = " EP"
+
+    def _multistart_device_call(
+        self, kernel, log_space, theta_batch, lower, upper, data, max_iter
+    ):
+        """Engine hook for the parent's multistart skeleton: the vmapped
+        EP + L-BFGS dispatch, site pairs riding per lane; the winner's
+        latent mean comes back from the same program."""
+        from spark_gp_tpu.models.ep import fit_gpc_ep_device_multistart
+
+        return fit_gpc_ep_device_multistart(
+            kernel, float(self._tol), log_space, theta_batch,
+            lower, upper, data.x, data.y, data.mask, max_iter,
+        )
 
     def _fit_from_stack_profiled(
         self, instr, kernel, data, x, make_targets_fn, active_override=None

@@ -308,3 +308,32 @@ def test_ep_f32_device_path_is_finite(rng):
     latent = ungroup(mu_np, n)
     agree = float(np.mean((latent > 0) == (y > 0.5)))
     assert agree > 0.8, agree
+
+
+def test_ep_batched_multistart(rng):
+    """setNumRestarts with the device optimizer runs all EP restarts as one
+    vmapped dispatch and reports the winner's diagnostics."""
+    from spark_gp_tpu import GaussianProcessEPClassifier
+
+    n = 240
+    x = rng.normal(size=(n, 2))
+    y = (np.sin(x[:, 0]) + x[:, 1] > 0).astype(np.float64)
+    flip = rng.random(n) < 0.1
+    y = np.where(flip, 1.0 - y, y)
+
+    model = (
+        GaussianProcessEPClassifier()
+        .setKernel(lambda: 1.0 * RBFKernel(1.0, 1e-3, 10.0))
+        .setDatasetSizeForExpert(60)
+        .setActiveSetSize(50)
+        .setMaxIter(15)
+        .setOptimizer("device")
+        .setNumRestarts(3)
+        .setSeed(5)
+        .fit(x, y)
+    )
+    acc = float(np.mean(model.predict(x) == y))
+    assert acc > 0.85, acc
+    assert "best_restart" in model.instr.metrics
+    assert model.instr.metrics["num_restarts"] == 3
+    assert all(f"restart_{r}_nll" in model.instr.metrics for r in range(3))
