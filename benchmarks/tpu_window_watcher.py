@@ -83,9 +83,48 @@ def _run(cmd, out_path, timeout_s, env=None):
             "stdout_tail": _decode(exc.stdout)[-20000:],
             "stderr_tail": _decode(exc.stderr)[-4000:],
         }
-    with open(os.path.join(ROOT, out_path), "w") as fh:
+    # Never clobber previously-captured good evidence with a worse capture:
+    # park the new envelope alongside the artifact instead when this run
+    # failed/timed out while the prior recorded a clean exit, OR when the
+    # prior measured on TPU and this run didn't reach the chip (bench.py's
+    # CPU-fallback plan exits 0 but its numbers are not comparable).
+    target = os.path.join(ROOT, out_path)
+    prior = None
+    if os.path.exists(target):
+        try:
+            with open(target) as fh:
+                prior = json.load(fh)
+        except Exception:  # noqa: BLE001 — unreadable prior: overwrite it
+            prior = None
+    if isinstance(prior, dict):
+        failed_vs_clean = (
+            envelope.get("returncode") != 0 and prior.get("returncode") == 0
+        )
+        lost_the_chip = (
+            _captured_platform(prior) == "tpu"
+            and _captured_platform(envelope) != "tpu"
+        )
+        if failed_vs_clean or lost_the_chip:
+            target = target + ".failed"
+    with open(target, "w") as fh:
         json.dump(envelope, fh, indent=1)
         fh.write("\n")
+
+
+def _captured_platform(envelope):
+    """Platform recorded in an envelope's last parseable stdout JSON line
+    (bench.py detail.platform), or None for non-bench artifacts."""
+    for line in reversed((envelope.get("stdout_tail") or "").splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            detail = parsed.get("detail")
+            if isinstance(detail, dict) and "platform" in detail:
+                return detail["platform"]
+            return parsed.get("platform")
+    return None
 
 
 def main() -> None:

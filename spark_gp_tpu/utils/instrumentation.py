@@ -62,13 +62,20 @@ def phase_sync(*arrays) -> None:
     materialized, so each phase's timing carries its own compute; in
     production it is a no-op and the pipeline stays fully async.
     """
-    import os
-
-    if os.environ.get("GP_SYNC_PHASES", "").strip() in ("", "0"):
+    if not sync_enabled():
         return
     import jax
 
     jax.block_until_ready([a for a in arrays if a is not None])
+
+
+def sync_enabled() -> bool:
+    """ONE definition of the ``GP_SYNC_PHASES`` gate, read at call time
+    (bench.py toggles the variable between fits and reports the mode a fit
+    actually ran in — both must agree with ``phase_sync`` above)."""
+    import os
+
+    return os.environ.get("GP_SYNC_PHASES", "").strip() not in ("", "0")
 
 
 @contextlib.contextmanager
