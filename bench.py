@@ -36,9 +36,10 @@ Environment knobs: BENCH_N (default 300000 on accelerators; 20000 on CPU),
 BENCH_EXPERT (100), BENCH_MAXITER (30), BENCH_OPTIMIZER (device),
 BENCH_PREFLIGHT_TIMEOUT (150 s), BENCH_PREFLIGHT_ATTEMPTS (4),
 BENCH_WORKER_TIMEOUT (2400 s), BENCH_PALLAS_SWEEP / BENCH_AIRFOIL /
-BENCH_SYNCED_BREAKDOWN (TPU only: "1" [default] appends the Pallas-vs-XLA
-expert-size sweep / the airfoil 10-fold parity bar / the synced
-phase-breakdown fit to the result detail; any other value disables), and
+BENCH_SCALING_N / BENCH_SYNCED_BREAKDOWN (TPU only: "1" [default] appends
+the Pallas-vs-XLA expert-size sweep / the airfoil 10-fold parity bar / the
+N-linearity curve / the synced phase-breakdown fit to the result detail;
+any other value disables), and
 GP_SYNC_PHASES (unset [default]: TPU primaries run async with a fenced
 synced breakdown fit afterwards, CPU primaries run synced; explicit 0/1
 forces the primary's own mode and skips the extra fit).
@@ -239,8 +240,12 @@ def worker() -> None:
     from spark_gp_tpu.data import make_benchmark_data
 
     platform = jax.devices()[0].platform
+    # BENCH_FORCE_EXTRAS=1 makes a CPU run adopt the full TPU policy
+    # (async primary + every extra's code path) so CI can exercise it.
+    force_extras = os.environ.get("BENCH_FORCE_EXTRAS") == "1"
     if sync_override is None:
-        os.environ["GP_SYNC_PHASES"] = "0" if platform == "tpu" else "1"
+        tpu_policy = platform == "tpu" or force_extras
+        os.environ["GP_SYNC_PHASES"] = "0" if tpu_policy else "1"
     # 300k on hardware: throughput = N / (per-eval compute * nfev + fixed
     # dispatch/sync overhead); the fixed term was ~25% of the fit at 100k
     # (fit_phase_seconds in r2's detail), so a larger same-family workload
@@ -438,6 +443,16 @@ def worker() -> None:
     proxy_eval_s = _cpu_proxy_eval_seconds(x, y, expert_size, sigma=0.1, sigma2=1e-3)
     cpu_fit_seconds = proxy_eval_s * nfev
     cpu_throughput = n / cpu_fit_seconds if cpu_fit_seconds > 0 else float("nan")
+    # The pool only parallelizes as far as the host allows: on a 1-core host
+    # the 8 workers serialize and the measured proxy is ~8x slower than a
+    # real 8-executor cluster would be.  Record the host's core budget and,
+    # when it starves the pool, the linear-scaling-corrected conservative
+    # ratio (vs an IDEAL perfectly-parallel 8-core proxy) alongside the
+    # measured one — the honest bracket is [conservative, measured].
+    try:
+        host_cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        host_cores = os.cpu_count() or 1
 
     total_flops = optimizer_flops(expert_size, nfev)
     est_tflops_per_sec = total_flops / fit_seconds / 1e12
@@ -461,11 +476,34 @@ def worker() -> None:
             **({"predict_error": predict_error} if predict_error else {}),
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,
+            "cpu_proxy_host_cores": host_cores,
+            **(
+                {
+                    "vs_baseline_vs_ideal_parallel_proxy": round(
+                        throughput
+                        / cpu_throughput
+                        * host_cores
+                        / _PROXY_WORKERS,
+                        2,
+                    )
+                }
+                if host_cores < _PROXY_WORKERS
+                else {}
+            ),
             "baseline_note": (
                 "proxy = same per-expert LAPACK f64 work across an "
                 f"{_PROXY_WORKERS}-process pool (~{_PROXY_WORKERS}-executor "
                 "Spark, minus JVM/scheduler overheads); vs_baseline is a "
                 "lower bound on speedup vs the reference stack"
+                + (
+                    f"; CAVEAT: this host exposes {host_cores} core(s), so "
+                    f"the {_PROXY_WORKERS}-process pool serializes — "
+                    "vs_baseline_vs_ideal_parallel_proxy linearly rescales "
+                    f"the proxy to {_PROXY_WORKERS} dedicated cores and is "
+                    "the conservative end of the honest bracket"
+                    if host_cores < _PROXY_WORKERS
+                    else ""
+                )
             ),
             "gpc_n_points": gpc_n,
             "gpc_fit_seconds": gpc_seconds,
@@ -518,7 +556,12 @@ def worker() -> None:
     # assert, Airfoil.scala:24 — quality.py records it on CPU; this is the
     # on-chip number).
     def _fenced_extra(env_var: str, key: str, fn) -> None:
-        if platform != "tpu" or os.environ.get(env_var, "1") != "1":
+        # BENCH_FORCE_EXTRAS=1 lifts the TPU gate so CI can exercise every
+        # extra's code path on CPU (tiny shapes) before it spends real
+        # tunnel-uptime; per-extra env vars still select which ones run.
+        if not (platform == "tpu" or force_extras):
+            return
+        if os.environ.get(env_var, "1") != "1":
             return
         try:
             result["detail"][key] = fn()
@@ -561,8 +604,45 @@ def worker() -> None:
 
         return part_airfoil()
 
+    def _run_scaling_n():
+        # The reference's ONLY published performance claim is asymptotic:
+        # "The thing works in linear time" (README.md:4; fit is
+        # O(N s^2 (p+|th|) + (N/s) s^3) per eval, GPR.scala:19-27).  Measure
+        # it: points/s should hold roughly flat in N.  Each size pays one
+        # warm-up fit (compile; persisted in the cache for later runs).
+        from spark_gp_tpu.data import make_benchmark_data as _mk
+
+        sizes = tuple(
+            int(v)
+            for v in os.environ.get(
+                "BENCH_SCALING_SIZES", "30000,100000,300000,1000000"
+            ).split(",")
+        )
+        rows = []
+        for n_i in sizes:
+            xi, yi = _mk(n_i) if n_i != n else (x, y)
+            make_gp(1).fit(xi, yi)
+            t0 = time.perf_counter()
+            mi = make_gp(max_iter).fit(xi, yi)
+            dt = time.perf_counter() - t0
+            rows.append({
+                "n_points": n_i,
+                "fit_seconds": round(dt, 4),
+                "points_per_sec": round(n_i / dt, 1),
+                "lbfgs_evals": int(mi.instr.metrics.get("lbfgs_nfev", 1)),
+            })
+        return {
+            "note": (
+                "linear-time claim check (reference README.md:4): "
+                "points_per_sec should hold roughly flat as N grows 33x; "
+                "per-eval cost is O(N) at fixed expert size"
+            ),
+            "rows": rows,
+        }
+
     _fenced_extra("BENCH_PALLAS_SWEEP", "pallas_sweep", _run_pallas_sweep)
     _fenced_extra("BENCH_AIRFOIL", "airfoil_10fold", _run_airfoil)
+    _fenced_extra("BENCH_SCALING_N", "scaling_n", _run_scaling_n)
     # LAST by design: this one blocks at every phase boundary, so over a
     # degraded tunnel it is the likeliest to hang — after the other extras
     # a watchdog kill here forfeits only the breakdown itself.

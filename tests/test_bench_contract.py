@@ -75,6 +75,43 @@ def test_bench_emits_one_parseable_result_line():
 
 
 @pytest.mark.slow
+def test_bench_forced_extras_run_on_cpu():
+    """BENCH_FORCE_EXTRAS exercises the TPU-gated extras' code paths on CPU
+    (tiny shapes) so new extras never execute for the first time on real
+    tunnel-uptime.  Pallas/airfoil stay off (Mosaic needs a chip; airfoil
+    has no small config); the N-scaling curve and the synced phase
+    breakdown run for real."""
+    out = _run(
+        "bench.py",
+        {
+            "BENCH_N": "1500",
+            "BENCH_EXPERT": "50",
+            "BENCH_MXU_EXPERT": "64",
+            "BENCH_MAXITER": "3",
+            "BENCH_PREFLIGHT_TIMEOUT": "120",
+            "BENCH_PREFLIGHT_ATTEMPTS": "1",
+            "BENCH_FORCE_EXTRAS": "1",
+            "BENCH_PALLAS_SWEEP": "0",
+            "BENCH_AIRFOIL": "0",
+            "BENCH_SCALING_SIZES": "800,1600",
+        },
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    detail = result["detail"]
+    rows = detail["scaling_n"]["rows"]
+    assert [r["n_points"] for r in rows] == [800, 1600]
+    assert all(r["points_per_sec"] > 0 for r in rows)
+    # the synced-breakdown extra replaced the phases and said so
+    assert detail["fit_phase_seconds_synced"]["status"].startswith("ok")
+    assert "separate synced fit" in detail["phase_timing_note"]
+    assert detail["fit_phase_seconds"]["optimize_hypers"] > 0
+    # un-selected extras stayed off
+    assert "pallas_sweep" not in detail
+    assert "airfoil_10fold" not in detail
+
+
+@pytest.mark.slow
 def test_quality_single_part_report_contract():
     out = _run("quality.py", {}, args=("--parts", "greedy_vs_random"))
     # surface the real cause on a crash instead of an opaque JSON error
