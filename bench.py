@@ -39,7 +39,10 @@ BENCH_WORKER_TIMEOUT (2400 s), BENCH_PALLAS_SWEEP / BENCH_AIRFOIL /
 BENCH_SCALING_N / BENCH_SYNCED_BREAKDOWN (TPU only: "1" [default] appends
 the Pallas-vs-XLA expert-size sweep / the airfoil 10-fold parity bar / the
 N-linearity curve / the synced phase-breakdown fit to the result detail;
-any other value disables), and
+any other value disables), BENCH_SCALING_SIZES (comma-separated N values
+for the linearity curve, default "30000,100000,300000,1000000"),
+BENCH_FORCE_EXTRAS ("1": a CPU run adopts the full TPU policy — async
+primary + extras — so CI can exercise those paths at tiny shapes), and
 GP_SYNC_PHASES (unset [default]: TPU primaries run async with a fenced
 synced breakdown fit afterwards, CPU primaries run synced; explicit 0/1
 forces the primary's own mode and skips the extra fit).
@@ -620,7 +623,16 @@ def worker() -> None:
         )
         rows = []
         for n_i in sizes:
-            xi, yi = _mk(n_i) if n_i != n else (x, y)
+            if n_i == n:
+                # the primary measurement IS this row — don't spend
+                # watchdog budget re-fitting the same shape
+                rows.append({
+                    "n_points": n, "fit_seconds": round(fit_seconds, 4),
+                    "points_per_sec": round(throughput, 1),
+                    "lbfgs_evals": nfev, "source": "primary measurement",
+                })
+                continue
+            xi, yi = _mk(n_i)
             make_gp(1).fit(xi, yi)
             t0 = time.perf_counter()
             mi = make_gp(max_iter).fit(xi, yi)

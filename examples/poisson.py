@@ -23,6 +23,10 @@ import sys as _sys
 # root (the spark_gp_tpu package home) ahead of the script's own dir
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+# imported early (cheap); called in main() after argparse so --help and
+# bad-args invocations never pay the probe (utils/platform.py)
+from spark_gp_tpu.utils.platform import preflight_backend
+
 import argparse
 
 import numpy as np
@@ -43,6 +47,10 @@ def main():
         "counts; default is Poisson)",
     )
     args = parser.parse_args()
+
+    # never wedge on a half-dead accelerator tunnel: probe the default
+    # backend in a subprocess and fall back to CPU if it hangs
+    preflight_backend()
 
     rng = np.random.default_rng(42)
     x = np.linspace(0, 4, args.n)[:, None]

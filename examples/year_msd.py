@@ -16,6 +16,10 @@ import sys as _sys
 # root (the spark_gp_tpu package home) ahead of the script's own dir
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+# imported early (cheap); called in main() after argparse so --help and
+# bad-args invocations never pay the probe (utils/platform.py)
+from spark_gp_tpu.utils.platform import preflight_backend
+
 import argparse
 import time
 
@@ -37,6 +41,10 @@ def main():
     parser.add_argument("--devices", type=int, default=0,
                         help="shard experts over a K-device mesh (0 = single device)")
     args = parser.parse_args()
+
+    # never wedge on a half-dead accelerator tunnel: probe the default
+    # backend in a subprocess and fall back to CPU if it hangs
+    preflight_backend()
 
     x, y = load_year_msd(args.csv, n=args.n)
 

@@ -31,6 +31,57 @@ def honor_platform_env() -> None:
     jax.config.update("jax_platforms", plats)
 
 
+def preflight_backend(timeout_s: float = 90.0, fallback: str = "cpu") -> str:
+    """Probe the default JAX backend in a SUBPROCESS; pin this process to
+    ``fallback`` if the probe hangs or dies.  Returns the platform this
+    process will use.
+
+    A half-dead device tunnel hangs *inside a C call* during backend init,
+    where no in-process timeout can interrupt it (the supervisor/worker
+    rationale of ``bench.py``) — the only safe probe is a throwaway
+    subprocess.  Entry points that must never wedge on a flaky accelerator
+    (the ``examples/``) call this before their first jax touch.
+
+    No-op when the user pinned ``JAX_PLATFORMS`` explicitly (their choice
+    is re-asserted and honored, hang or not) or when a backend is already
+    initialized in this process (too late to switch safely).
+    """
+    pinned = os.environ.get("JAX_PLATFORMS")
+    if pinned:
+        honor_platform_env()
+        return pinned.split(",")[0]
+    if backends_already_initialized():
+        import jax
+
+        return jax.default_backend()
+
+    import subprocess
+    import sys
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if probe.returncode == 0 and probe.stdout.strip():
+            return probe.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "default JAX backend failed its %.0fs preflight probe "
+        "(unreachable or hung device runtime); falling back to %s for "
+        "this process", timeout_s, fallback,
+    )
+    os.environ["JAX_PLATFORMS"] = fallback
+    import jax
+
+    jax.config.update("jax_platforms", fallback)
+    return fallback
+
+
 def backends_already_initialized() -> bool:
     """True once any XLA backend client exists in this process.
 

@@ -93,15 +93,18 @@ def test_bench_forced_extras_run_on_cpu():
             "BENCH_FORCE_EXTRAS": "1",
             "BENCH_PALLAS_SWEEP": "0",
             "BENCH_AIRFOIL": "0",
-            "BENCH_SCALING_SIZES": "800,1600",
+            "BENCH_SCALING_SIZES": "800,1500",
         },
     )
     assert out.returncode == 0, out.stderr[-500:]
     result = json.loads(out.stdout.strip().splitlines()[-1])
     detail = result["detail"]
     rows = detail["scaling_n"]["rows"]
-    assert [r["n_points"] for r in rows] == [800, 1600]
+    assert [r["n_points"] for r in rows] == [800, 1500]
     assert all(r["points_per_sec"] > 0 for r in rows)
+    # the size matching the primary N reuses the primary fit, not a re-run
+    assert rows[1]["source"] == "primary measurement"
+    assert rows[1]["fit_seconds"] == round(detail["fit_seconds"], 4)
     # the synced-breakdown extra replaced the phases and said so
     assert detail["fit_phase_seconds_synced"]["status"].startswith("ok")
     assert "separate synced fit" in detail["phase_timing_note"]
