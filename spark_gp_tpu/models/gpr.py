@@ -70,6 +70,39 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
         return self._fit_with_restarts(instr, fit_once)
 
+    def loo(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        model: "Optional[GaussianProcessRegressionModel]" = None,
+    ) -> dict:
+        """Exact per-expert leave-one-out diagnostics (R&W §5.4.2).
+
+        Evaluated at ``model``'s fitted hyperparameters when given (the
+        usual post-fit model criticism: ``gp.loo(x, y, model)``), else at
+        the kernel's initial theta.  Uses this estimator's expert grouping
+        — the same conditioning structure the training objective sums
+        over — at one batched factorization's cost; see
+        :mod:`spark_gp_tpu.models.loo` for the formulas and summaries.
+        """
+        from spark_gp_tpu.models.loo import loo_diagnostics
+
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        if x.ndim != 2:
+            raise ValueError(f"x must be [N, p], got shape {x.shape}")
+        if y.shape != (x.shape[0],):
+            raise ValueError(f"y must be [N], got shape {y.shape}")
+        if model is not None:
+            kernel = model.raw_predictor.kernel
+            theta = model.raw_predictor.theta
+        else:
+            kernel = self._get_kernel()
+            theta = kernel.init_theta()
+        return loo_diagnostics(
+            kernel, theta, x, y, self._dataset_size_for_expert
+        )
+
     def _fit_device_multistart(
         self, instr, data, x, y
     ) -> "GaussianProcessRegressionModel":

@@ -1,0 +1,94 @@
+"""Per-expert leave-one-out cross-validation diagnostics (R&W §5.4.2).
+
+For a GP regressor whose per-expert noise-augmented Gram is ``K``, the
+exact LOO predictive moments at fixed hyperparameters are closed-form in
+one factorization (Rasmussen & Williams eqs. 5.10-5.12):
+
+    mu_{-i}     = y_i - [K^-1 y]_i / [K^-1]_ii
+    sigma2_{-i} = 1 / [K^-1]_ii
+    log p(y_i | y_{-i}) = -1/2 log(2 pi sigma2_{-i})
+                          - (y_i - mu_{-i})^2 / (2 sigma2_{-i})
+
+The BCM expert split makes this exact *within each expert*: each point's
+LOO conditions on its expert's other points — the same conditioning
+structure the training objective itself sums over
+(GaussianProcessRegression.scala:55-68 treats experts as independent), so
+the per-expert LOO is the honest diagnostic for the model actually being
+fit.  One batched ``[E, s, s]`` inverse (the Pallas fused pass on TPU,
+Cholesky elsewhere — ``ops.pallas_linalg.spd_inv_logdet``) yields every
+point's diagnostics; nothing here is O(N^2).
+
+The reference has no model-criticism tooling at all; this module is a
+TPU-native addition in the spirit of its quality bars.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.ops.linalg import masked_kernel_matrix
+from spark_gp_tpu.parallel.experts import group_for_experts, ungroup
+
+
+@partial(jax.jit, static_argnums=0)
+def _loo_impl(kernel: Kernel, theta, x, y, mask):
+    """``[E, s, ...]`` expert stack -> per-slot (mu, var, log_density).
+
+    Padded slots ride through the identity embedding of
+    ``masked_kernel_matrix`` (K^-1 diagonal 1, alpha 0) and are dropped by
+    the caller via the mask — their values are benign, never NaN.
+    """
+    from spark_gp_tpu.ops.pallas_linalg import spd_inv_logdet
+
+    kmat = jax.vmap(
+        lambda xi, mi: masked_kernel_matrix(kernel.gram(theta, xi), mi)
+    )(x, mask)
+    ym = y * mask
+    kinv, _ = spd_inv_logdet(kmat)
+    alpha = jnp.einsum("eij,ej->ei", kinv, ym)
+    diag = jnp.diagonal(kinv, axis1=-2, axis2=-1)
+    var = 1.0 / diag
+    resid = alpha * var  # y_i - mu_{-i}
+    mu = ym - resid
+    log_density = -0.5 * (
+        jnp.log(2.0 * math.pi * var) + resid * resid / var
+    )
+    return mu, var, log_density
+
+
+def loo_diagnostics(
+    kernel: Kernel,
+    theta,
+    x: np.ndarray,
+    y: np.ndarray,
+    dataset_size_for_expert: int,
+    dtype=None,
+) -> dict:
+    """Exact per-expert LOO diagnostics for ``(x [N, p], y [N])``.
+
+    Returns original-point-order arrays ``loo_mean`` / ``loo_var`` /
+    ``loo_log_density`` ``[N]`` plus the two classic scalar summaries:
+    ``loo_rmse`` and ``loo_log_pseudo_likelihood`` (the sum of per-point
+    log densities — R&W eq. 5.11, the model-selection criterion L_LOO).
+    """
+    data = group_for_experts(x, y, dataset_size_for_expert, dtype=dtype)
+    theta = jnp.asarray(theta, dtype=data.x.dtype)
+    mu, var, logp = _loo_impl(kernel, theta, data.x, data.y, data.mask)
+    n = int(np.asarray(x).shape[0])
+    loo_mean = ungroup(np.asarray(mu), n)
+    loo_var = ungroup(np.asarray(var), n)
+    loo_logp = ungroup(np.asarray(logp), n)
+    resid = np.asarray(y, dtype=loo_mean.dtype) - loo_mean
+    return {
+        "loo_mean": loo_mean,
+        "loo_var": loo_var,
+        "loo_log_density": loo_logp,
+        "loo_rmse": float(np.sqrt(np.mean(resid**2))),
+        "loo_log_pseudo_likelihood": float(loo_logp.sum()),
+    }

@@ -55,9 +55,14 @@ def preflight_backend(timeout_s: float = 90.0, fallback: str = "cpu") -> str:
 
         return jax.default_backend()
 
+    cached = _read_healthy_marker()
+    if cached is not None:
+        return cached
+
     import subprocess
     import sys
 
+    why = None
     try:
         probe = subprocess.run(
             [sys.executable, "-c",
@@ -65,21 +70,73 @@ def preflight_backend(timeout_s: float = 90.0, fallback: str = "cpu") -> str:
             capture_output=True, text=True, timeout=timeout_s,
         )
         if probe.returncode == 0 and probe.stdout.strip():
-            return probe.stdout.strip().splitlines()[-1]
+            platform = probe.stdout.strip().splitlines()[-1]
+            _write_healthy_marker(platform)
+            return platform
+        why = (
+            f"probe exited rc={probe.returncode}; stderr tail: "
+            + (probe.stderr or "").strip()[-300:]
+        )
     except subprocess.TimeoutExpired:
-        pass
+        why = f"probe hung past {timeout_s:.0f}s (wedged device runtime)"
     import logging
 
     logging.getLogger(__name__).warning(
-        "default JAX backend failed its %.0fs preflight probe "
-        "(unreachable or hung device runtime); falling back to %s for "
-        "this process", timeout_s, fallback,
+        "default JAX backend failed its preflight probe — %s; falling "
+        "back to %s for this process", why, fallback,
     )
     os.environ["JAX_PLATFORMS"] = fallback
     import jax
 
     jax.config.update("jax_platforms", fallback)
     return fallback
+
+
+def _marker_path() -> str:
+    import tempfile
+
+    return os.path.join(
+        tempfile.gettempdir(), f"spark_gp_tpu_preflight_uid{os.getuid()}"
+    )
+
+
+def _read_healthy_marker():
+    """Recent healthy-probe verdict, or None.
+
+    A fresh verdict (default TTL 300 s; ``GP_PREFLIGHT_CACHE_TTL`` seconds,
+    0 disables caching) lets back-to-back example runs on a healthy host
+    skip the throwaway probe subprocess — a full jax import + backend init
+    per invocation.  The TTL bounds the stale-verdict risk: a tunnel that
+    died within the window can still wedge one run, exactly as it would
+    have mid-computation anyway."""
+    import json
+    import time
+
+    try:
+        ttl = float(os.environ.get("GP_PREFLIGHT_CACHE_TTL", "300"))
+    except ValueError:
+        ttl = 300.0
+    if ttl <= 0:
+        return None
+    try:
+        with open(_marker_path()) as fh:
+            marker = json.load(fh)
+        if time.time() - float(marker["ts"]) < ttl:
+            return str(marker["platform"])
+    except Exception:  # noqa: BLE001 — unreadable/absent marker: just probe
+        pass
+    return None
+
+
+def _write_healthy_marker(platform: str) -> None:
+    import json
+    import time
+
+    try:
+        with open(_marker_path(), "w") as fh:
+            json.dump({"ts": time.time(), "platform": platform}, fh)
+    except OSError:  # unwritable tmp: caching is best-effort only
+        pass
 
 
 def backends_already_initialized() -> bool:

@@ -197,13 +197,16 @@ def test_preflight_backend_honors_pinned_env(monkeypatch):
     assert plat.preflight_backend() == "cpu"
 
 
-def test_preflight_backend_healthy_probe_reports_platform(monkeypatch):
+def test_preflight_backend_healthy_probe_reports_platform(
+    monkeypatch, tmp_path
+):
     import subprocess as sp
 
     from spark_gp_tpu.utils import platform as plat
 
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setattr(plat, "backends_already_initialized", lambda: False)
+    monkeypatch.setattr(plat, "_marker_path", lambda: str(tmp_path / "m"))
 
     def _healthy(cmd, **kw):
         return sp.CompletedProcess(cmd, 0, stdout="tpu\n", stderr="")
@@ -213,14 +216,27 @@ def test_preflight_backend_healthy_probe_reports_platform(monkeypatch):
     # a healthy probe must NOT pin the environment
     assert "JAX_PLATFORMS" not in __import__("os").environ
 
+    # ...and its verdict is cached: a second call within the TTL must not
+    # spawn another probe subprocess
+    def _no_probe(*a, **k):  # pragma: no cover - failure mode
+        raise AssertionError("fresh healthy verdict must skip the probe")
 
-def test_preflight_backend_hung_probe_pins_fallback(monkeypatch):
+    monkeypatch.setattr(sp, "run", _no_probe)
+    assert plat.preflight_backend(timeout_s=5.0) == "tpu"
+    # TTL=0 disables the cache and probes again
+    monkeypatch.setenv("GP_PREFLIGHT_CACHE_TTL", "0")
+    monkeypatch.setattr(sp, "run", _healthy)
+    assert plat.preflight_backend(timeout_s=5.0) == "tpu"
+
+
+def test_preflight_backend_hung_probe_pins_fallback(monkeypatch, tmp_path):
     import subprocess as sp
 
     from spark_gp_tpu.utils import platform as plat
 
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setattr(plat, "backends_already_initialized", lambda: False)
+    monkeypatch.setattr(plat, "_marker_path", lambda: str(tmp_path / "m"))
 
     def _hang(cmd, **kw):
         raise sp.TimeoutExpired(cmd, kw.get("timeout"))
@@ -235,3 +251,29 @@ def test_preflight_backend_hung_probe_pins_fallback(monkeypatch):
         pytest.skip("backend already initialized; config update refused")
     assert got == "cpu"
     assert __import__("os").environ.get("JAX_PLATFORMS") == "cpu"
+
+
+def test_preflight_backend_fast_failure_reports_cause(monkeypatch, tmp_path, caplog):
+    """A probe that dies quickly (broken install, not a hang) must surface
+    its returncode and stderr in the warning, not the hang message."""
+    import logging
+    import subprocess as sp
+
+    from spark_gp_tpu.utils import platform as plat
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(plat, "backends_already_initialized", lambda: False)
+    monkeypatch.setattr(plat, "_marker_path", lambda: str(tmp_path / "m"))
+
+    def _dies(cmd, **kw):
+        return sp.CompletedProcess(
+            cmd, 1, stdout="", stderr="ImportError: libfoo.so missing"
+        )
+
+    monkeypatch.setattr(sp, "run", _dies)
+    with caplog.at_level(logging.WARNING, logger="spark_gp_tpu.utils.platform"):
+        got = plat.preflight_backend(timeout_s=5.0)
+    assert got == "cpu"
+    assert "rc=1" in caplog.text
+    assert "libfoo.so missing" in caplog.text
+    assert "hung" not in caplog.text
