@@ -34,6 +34,9 @@ Parts:
   greedy_vs_random  the demonstrated-payoff regime (density-skewed data,
                  small m): greedy must BEAT the best of 3 random seeds
                  (asserted); the airfoil negative result is in PARITY.md
+  loo            LOO diagnostics vs reality on synthetics: the one-
+                 factorization loo_rmse must track the true 10-fold CV
+                 RMSE (ratio bar) and clear the example's 0.11 quality bar
   weak_scaling   1/2/4/8 virtual CPU devices, fixed per-device load, the
                  sharded device-L-BFGS fit (records the curve's shape; on a
                  shared-core host this tracks compile/exec health, not true
@@ -53,7 +56,7 @@ import time
 
 _ALL_PARTS = (
     "airfoil", "iris", "iris_native_mc", "iris_ep", "poisson", "gpc_mnist",
-    "protein", "year_msd", "greedy_scale", "greedy_vs_random",
+    "protein", "year_msd", "greedy_scale", "greedy_vs_random", "loo",
     "weak_scaling", "pallas_sweep",
 )
 
@@ -503,6 +506,58 @@ def part_greedy_vs_random() -> dict:
             "density-skewed 1-d (95% of mass in 2.5% of the range), m=24; "
             "greedy LOSES on airfoil at small m — see PARITY.md"
         ),
+    }
+
+
+def part_loo() -> dict:
+    """LOO diagnostics vs reality (models/loo.py, R&W §5.4.2).
+
+    The whole point of the one-factorization LOO summary is predicting
+    generalization without refits — so assert it does: on the synthetics
+    config, ``loo_rmse`` at the fitted hyperparameters must land within a
+    factor-2 band of the true 10-fold CV RMSE (which refits per fold) and
+    clear the example's 0.11 bar itself."""
+    _assert_platform()
+    import numpy as np
+
+    from spark_gp_tpu import (
+        GaussianProcessRegression, KMeansActiveSetProvider, RBFKernel,
+        WhiteNoiseKernel,
+    )
+    from spark_gp_tpu.data import make_synthetics
+    from spark_gp_tpu.utils.validation import cross_validate, rmse
+
+    x, y = make_synthetics()
+
+    def mk():
+        return (
+            GaussianProcessRegression()
+            .setKernel(
+                lambda: 1.0 * RBFKernel(0.1, 1e-6, 10)
+                + WhiteNoiseKernel(0.5, 0, 1)
+            )
+            .setDatasetSizeForExpert(100)
+            .setActiveSetProvider(KMeansActiveSetProvider())
+            .setActiveSetSize(100)
+            .setSigma2(1e-3)
+            .setSeed(13)
+        )
+
+    start = time.perf_counter()
+    gp = mk()
+    model = gp.fit(x, y)
+    diag = gp.loo(x, y, model)
+    cv_rmse = float(cross_validate(mk(), x, y, num_folds=10, metric=rmse, seed=13))
+    ratio = diag["loo_rmse"] / cv_rmse
+    return {
+        "loo_rmse": diag["loo_rmse"],
+        "cv_rmse_10fold": cv_rmse,
+        "ratio": float(ratio),
+        "loo_log_pseudo_likelihood": diag["loo_log_pseudo_likelihood"],
+        "ratio_band": [0.5, 2.0],
+        "bar": 0.11,
+        "passed": bool(0.5 < ratio < 2.0 and diag["loo_rmse"] < 0.11),
+        "seconds": time.perf_counter() - start,
     }
 
 
