@@ -350,13 +350,21 @@ class GaussianProcessCommons(GaussianProcessParams):
             data = shard_experts(data, self._mesh)
         return data
 
+    def _checkpoint_tag(self) -> str:
+        """Checkpoint file tag: class name, plus the objective when it is
+        not the default — a marginal-NLL checkpoint must never seed (or be
+        overwritten by) a ``setObjective("loo")`` fit in the same dir."""
+        objective = getattr(self, "_objective", "marginal")
+        name = type(self).__name__
+        return name if objective == "marginal" else f"{name}-{objective}"
+
     def _make_checkpointer(self, kernel):
         if self._checkpoint_dir is None:
             return None
         from spark_gp_tpu.utils.checkpoint import LbfgsCheckpointer
 
         return LbfgsCheckpointer(
-            self._checkpoint_dir, kernel, tag=type(self).__name__
+            self._checkpoint_dir, kernel, tag=self._checkpoint_tag()
         )
 
     def _optimize_hypers(
@@ -377,7 +385,7 @@ class GaussianProcessCommons(GaussianProcessParams):
                 load_checkpoint,
             )
 
-            ck = load_checkpoint(self._checkpoint_dir, tag=type(self).__name__)
+            ck = load_checkpoint(self._checkpoint_dir, tag=self._checkpoint_tag())
             if (
                 ck is not None
                 and np.asarray(ck[1]).shape == theta0.shape

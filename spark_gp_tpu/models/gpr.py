@@ -45,6 +45,26 @@ class GaussianProcessRegression(GaussianProcessCommons):
     # the additive statistics support model.update() (incremental data)
     _keeps_update_statistics = True
 
+    # hyperparameter objective: the BCM marginal NLL (the reference's,
+    # GPR.scala:55-68) or the negative LOO log pseudo-likelihood
+    # (R&W eq. 5.13 — setObjective("loo"), models/loo.py)
+    _objective = "marginal"
+
+    def setObjective(self, objective: str) -> "GaussianProcessRegression":
+        """``"marginal"`` (default) or ``"loo"``: optimize the LOO log
+        pseudo-likelihood instead of the marginal NLL — more robust under
+        model misspecification (R&W §5.4.2); every fit path (host, device,
+        sharded, checkpointed, multi-start, distributed) honors it."""
+        if objective not in ("marginal", "loo"):
+            raise ValueError(
+                f"unknown objective {objective!r}; "
+                "expected 'marginal' or 'loo'"
+            )
+        self._objective = objective
+        return self
+
+    set_objective = setObjective
+
     def fit(self, x: np.ndarray, y: np.ndarray) -> "GaussianProcessRegressionModel":
         instr = Instrumentation(name="GaussianProcessRegression")
         x = np.asarray(x, dtype=np.float64)
@@ -136,6 +156,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                         data.x, data.y, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
                         jnp.asarray(self._tol, dtype=dtype),
+                        objective=self._objective,
                     )
                 )
                 phase_sync(theta, f)
@@ -178,9 +199,11 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 )
             else:
                 if self._mesh is not None:
-                    vag = make_sharded_value_and_grad(kernel, data, self._mesh)
+                    vag = make_sharded_value_and_grad(
+                        kernel, data, self._mesh, self._objective
+                    )
                 else:
-                    vag = make_value_and_grad(kernel, data)
+                    vag = make_value_and_grad(kernel, data, self._objective)
 
                 checkpointer = self._make_checkpointer(kernel)
                 theta_opt = self._optimize_hypers(
@@ -257,20 +280,30 @@ class GaussianProcessRegression(GaussianProcessCommons):
                     DeviceOptimizerCheckpointer,
                 )
 
+                # the objective is part of the FILE tag too (not only the
+                # resume-meta family): a loo fit must not overwrite a
+                # marginal fit's resumable state in the same dir
+                file_tag = (
+                    "gpr" if self._objective == "marginal"
+                    else f"gpr-{self._objective}"
+                )
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device_checkpointed(
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data, self._max_iter, tol, self._checkpoint_interval,
-                    DeviceOptimizerCheckpointer(self._checkpoint_dir, "gpr"),
+                    DeviceOptimizerCheckpointer(self._checkpoint_dir, file_tag),
+                    objective=self._objective,
                 )
             elif self._mesh is not None:
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device_sharded(
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
+                    objective=self._objective,
                 )
             else:
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device(
                     kernel, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
+                    objective=self._objective,
                 )
             phase_sync(theta, f)
         pending = {

@@ -72,13 +72,33 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData):
     )
 
 
-@partial(jax.jit, static_argnums=0)
-def _vag_impl(kernel: Kernel, theta, x, y, mask):
+def objective_fn(objective: str):
+    """The per-expert-stack objective ``setObjective`` selects: the BCM
+    marginal NLL (default, the reference's objective) or the negative LOO
+    log pseudo-likelihood (R&W eq. 5.13, ``models/loo.py``).  Both share
+    the ``(kernel, theta, data) -> scalar`` signature, so every fit entry
+    point swaps them via one static argument."""
+    if objective == "marginal":
+        return batched_nll
+    if objective == "loo":
+        from spark_gp_tpu.models.loo import batched_loo_nll
+
+        return batched_loo_nll
+    raise ValueError(
+        f"unknown objective {objective!r}; expected 'marginal' or 'loo'"
+    )
+
+
+@partial(jax.jit, static_argnums=0, static_argnames=("objective",))
+def _vag_impl(kernel: Kernel, theta, x, y, mask, *, objective="marginal"):
     data = ExpertData(x=x, y=y, mask=mask)
-    return jax.value_and_grad(lambda t: batched_nll(kernel, t, data))(theta)
+    obj = objective_fn(objective)
+    return jax.value_and_grad(lambda t: obj(kernel, t, data))(theta)
 
 
-def make_value_and_grad(kernel: Kernel, data: ExpertData):
+def make_value_and_grad(
+    kernel: Kernel, data: ExpertData, objective: str = "marginal"
+):
     """Single-device jitted ``theta -> (nll, grad)``.
 
     The kernel spec is a static (hashable) argument of a module-level jit, so
@@ -88,12 +108,14 @@ def make_value_and_grad(kernel: Kernel, data: ExpertData):
 
     def vag(theta):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
-        return _vag_impl(kernel, theta, data.x, data.y, data.mask)
+        return _vag_impl(
+            kernel, theta, data.x, data.y, data.mask, objective=objective
+        )
 
     return vag
 
 
-def _make_sharded_vag(kernel: Kernel, mesh):
+def _make_sharded_vag(kernel: Kernel, mesh, objective: str = "marginal"):
     """shard_map'd ``(theta, x, y, mask) -> (nll, grad)`` core, reusable
     inside larger jitted programs (the one-dispatch fits, the segmented
     checkpointing loop)."""
@@ -106,8 +128,9 @@ def _make_sharded_vag(kernel: Kernel, mesh):
     )
     def sharded(theta_, x_, y_, mask_):
         local = ExpertData(x=x_, y=y_, mask=mask_)
+        obj = objective_fn(objective)
         value, grad = jax.value_and_grad(
-            lambda t: batched_nll(kernel, t, local)
+            lambda t: obj(kernel, t, local)
         )(theta_)
         # theta is replicated (P()): shard_map's transpose already inserts
         # the cross-device psum for its gradient, so only the value needs an
@@ -118,12 +141,16 @@ def _make_sharded_vag(kernel: Kernel, mesh):
     return sharded
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _sharded_vag_impl(kernel: Kernel, mesh, theta, x, y, mask):
-    return _make_sharded_vag(kernel, mesh)(theta, x, y, mask)
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective",))
+def _sharded_vag_impl(
+    kernel: Kernel, mesh, theta, x, y, mask, *, objective="marginal"
+):
+    return _make_sharded_vag(kernel, mesh, objective)(theta, x, y, mask)
 
 
-def make_sharded_value_and_grad(kernel: Kernel, data: ExpertData, mesh):
+def make_sharded_value_and_grad(
+    kernel: Kernel, data: ExpertData, mesh, objective: str = "marginal"
+):
     """Multi-chip ``theta -> (nll, grad)`` via ``shard_map`` + ``psum``.
 
     ``theta`` is replicated; the expert stack is sharded on its leading axis;
@@ -135,7 +162,10 @@ def make_sharded_value_and_grad(kernel: Kernel, data: ExpertData, mesh):
 
     def vag(theta):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
-        return _sharded_vag_impl(kernel, mesh, theta, data.x, data.y, data.mask)
+        return _sharded_vag_impl(
+            kernel, mesh, theta, data.x, data.y, data.mask,
+            objective=objective,
+        )
 
     return vag
 
@@ -143,9 +173,10 @@ def make_sharded_value_and_grad(kernel: Kernel, data: ExpertData, mesh):
 # --- fully on-device fits: the entire L-BFGS loop is ONE dispatch ---------
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective",))
 def fit_gpr_device(
-    kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter, tol
+    kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter,
+    tol, *, objective="marginal",
 ):
     """Single-chip on-device fit: objective + projected L-BFGS in one XLA
     program.  Returns (theta_opt, final_nll, n_iter, n_fev, stalled)."""
@@ -155,9 +186,10 @@ def fit_gpr_device(
     )
 
     data = ExpertData(x=x, y=y, mask=mask)
+    obj = objective_fn(objective)
 
     def vag(theta, aux):
-        value, grad = jax.value_and_grad(lambda t: batched_nll(kernel, t, data))(theta)
+        value, grad = jax.value_and_grad(lambda t: obj(kernel, t, data))(theta)
         return value, grad, aux
 
     if log_space:
@@ -171,10 +203,10 @@ def fit_gpr_device(
     return from_u(theta), f, n_iter, n_fev, stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective",))
 def fit_gpr_device_multistart(
     kernel: Kernel, log_space, theta0_batch, lower, upper, x, y, mask,
-    max_iter, tol,
+    max_iter, tol, *, objective="marginal",
 ):
     """Multi-start single-chip fit: the R restarts run as ONE vmapped
     on-device L-BFGS program (optimize/lbfgs_device.py multistart docs) and
@@ -184,9 +216,10 @@ def fit_gpr_device_multistart(
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
     data = ExpertData(x=x, y=y, mask=mask)
+    obj = objective_fn(objective)
 
     def vag(theta, aux):
-        value, grad = jax.value_and_grad(lambda t: batched_nll(kernel, t, data))(theta)
+        value, grad = jax.value_and_grad(lambda t: obj(kernel, t, data))(theta)
         return value, grad, aux
 
     theta, _, f, n_iter, n_fev, stalled, f_all, best = multistart_minimize(
@@ -199,21 +232,24 @@ def fit_gpr_device_multistart(
 # --- segmented device fit: checkpoint/resume for long runs ----------------
 
 
-def _gpr_segment_vag(kernel: Kernel, mesh, log_space, data: ExpertData):
+def _gpr_segment_vag(
+    kernel: Kernel, mesh, log_space, data: ExpertData, objective="marginal"
+):
     """The (possibly sharded, possibly log-space) objective used by the
     segmented fit — identical math to the one-dispatch fits above."""
     from spark_gp_tpu.optimize.lbfgs_device import log_transform_vag
 
     if mesh is None:
+        obj = objective_fn(objective)
 
         def base(theta, aux):
             value, grad = jax.value_and_grad(
-                lambda t: batched_nll(kernel, t, data)
+                lambda t: obj(kernel, t, data)
             )(theta)
             return value, grad, aux
 
     else:
-        core = _make_sharded_vag(kernel, mesh)
+        core = _make_sharded_vag(kernel, mesh, objective)
 
         def base(theta, aux):
             value, grad = core(theta, data.x, data.y, data.mask)
@@ -222,24 +258,25 @@ def _gpr_segment_vag(kernel: Kernel, mesh, log_space, data: ExpertData):
     return log_transform_vag(base) if log_space else base
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective",))
 def gpr_device_segment_init(
-    kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask
+    kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
+    *, objective="marginal",
 ):
     """One objective evaluation -> the optimizer's carried state (the
     checkpoint unit)."""
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
     data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpr_segment_vag(kernel, mesh, log_space, data)
+    vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective)
     t0 = jnp.log(theta0) if log_space else theta0
     return lbfgs_init_state(vag, t0, jnp.zeros((), theta0.dtype))
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective",))
 def gpr_device_segment_run(
     kernel: Kernel, mesh, log_space, state, lower, upper, x, y, mask,
-    iter_limit, tol,
+    iter_limit, tol, *, objective="marginal",
 ):
     """Advance the device L-BFGS to ``iter_limit`` total iterations (one
     compiled program, reused for every segment — iter_limit is traced)."""
@@ -249,7 +286,7 @@ def gpr_device_segment_run(
     )
 
     data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpr_segment_vag(kernel, mesh, log_space, data)
+    vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective)
     lo, hi = (
         log_transform_bounds(lower, upper) if log_space else (lower, upper)
     )
@@ -258,7 +295,7 @@ def gpr_device_segment_run(
 
 def fit_gpr_device_checkpointed(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, data: ExpertData,
-    max_iter: int, tol, chunk: int, saver,
+    max_iter: int, tol, chunk: int, saver, objective: str = "marginal",
 ):
     """On-device fit in K-iteration segments with state persistence.
 
@@ -272,16 +309,21 @@ def fit_gpr_device_checkpointed(
     """
     from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
+    # the objective participates in the resume fingerprint: a checkpoint
+    # from a marginal-NLL fit must never silently seed a LOO fit
+    family = "gpr" if objective == "marginal" else f"gpr-{objective}"
     meta = segment_meta(
-        "gpr", kernel, tol, log_space, theta0, data.x, data.y, data.mask
+        family, kernel, tol, log_space, theta0, data.x, data.y, data.mask
     )
-    init = partial(gpr_device_segment_init, kernel, mesh, log_space)
+    init = partial(
+        gpr_device_segment_init, kernel, mesh, log_space, objective=objective
+    )
     tol_arr = jnp.asarray(tol, theta0.dtype)
 
     def run(state, limit):
         return gpr_device_segment_run(
             kernel, mesh, log_space, state, lower, upper,
-            data.x, data.y, data.mask, limit, tol_arr,
+            data.x, data.y, data.mask, limit, tol_arr, objective=objective,
         )
 
     theta, state = run_segmented(
@@ -292,9 +334,10 @@ def fit_gpr_device_checkpointed(
     return theta, state.f, state.n_iter, state.n_fev, state.stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective",))
 def fit_gpr_device_sharded(
-    kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask, max_iter, tol
+    kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
+    max_iter, tol, *, objective="marginal",
 ):
     """Multi-chip on-device fit: the WHOLE optimizer runs inside shard_map —
     per-iteration communication is exactly one psum of the scalar NLL plus
@@ -316,10 +359,11 @@ def fit_gpr_device_sharded(
     )
     def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, tol_):
         local = ExpertData(x=x_, y=y_, mask=mask_)
+        obj = objective_fn(objective)
 
         def vag(theta, aux):
             value, grad = jax.value_and_grad(
-                lambda t: batched_nll(kernel, t, local)
+                lambda t: obj(kernel, t, local)
             )(theta)
             # value is the local shard's partial sum -> explicit psum;
             # grad w.r.t. replicated theta is already globally reduced by

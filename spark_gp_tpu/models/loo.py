@@ -36,13 +36,15 @@ from spark_gp_tpu.ops.linalg import masked_kernel_matrix
 from spark_gp_tpu.parallel.experts import group_for_experts, ungroup
 
 
-@partial(jax.jit, static_argnums=0)
-def _loo_impl(kernel: Kernel, theta, x, y, mask):
+def loo_moments(kernel: Kernel, theta, x, y, mask):
     """``[E, s, ...]`` expert stack -> per-slot (mu, var, log_density).
 
-    Padded slots ride through the identity embedding of
-    ``masked_kernel_matrix`` (K^-1 diagonal 1, alpha 0) and are dropped by
-    the caller via the mask — their values are benign, never NaN.
+    Traceable core, shared by the jitted diagnostics below and the LOO
+    training objective (:func:`batched_loo_nll`) — autodiff flows through
+    the batched inverse's custom VJP.  Padded slots ride through the
+    identity embedding of ``masked_kernel_matrix`` (K^-1 diagonal 1,
+    alpha 0): their values are benign constants with zero theta-gradient,
+    never NaN; callers drop them via the mask.
     """
     from spark_gp_tpu.ops.pallas_linalg import spd_inv_logdet
 
@@ -60,6 +62,25 @@ def _loo_impl(kernel: Kernel, theta, x, y, mask):
         jnp.log(2.0 * math.pi * var) + resid * resid / var
     )
     return mu, var, log_density
+
+
+def batched_loo_nll(kernel: Kernel, theta, data):
+    """Negative LOO log pseudo-likelihood over the expert stack.
+
+    ``-L_LOO(theta)`` of R&W eq. 5.13 — the alternative hyperparameter
+    objective ``setObjective("loo")`` minimizes in place of the marginal
+    NLL (``models/likelihood.batched_nll``).  More robust under model
+    misspecification: it scores held-out predictive density rather than
+    data fit (R&W §5.4.2 discussion).  Same signature as ``batched_nll``
+    so every fit entry point can swap it in.
+    """
+    _, _, log_density = loo_moments(kernel, theta, data.x, data.y, data.mask)
+    return -jnp.sum(log_density * data.mask)
+
+
+@partial(jax.jit, static_argnums=0)
+def _loo_impl(kernel: Kernel, theta, x, y, mask):
+    return loo_moments(kernel, theta, x, y, mask)
 
 
 def loo_diagnostics(

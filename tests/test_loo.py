@@ -119,3 +119,161 @@ def test_loo_validates_shapes():
         gp.loo(np.zeros(5), np.zeros(5))
     with pytest.raises(ValueError, match=r"y must be \[N\]"):
         gp.loo(np.zeros((5, 2)), np.zeros(4))
+
+
+# --- the LOO training objective (setObjective("loo")) ------------------------
+
+
+def test_batched_loo_nll_gradient_matches_fd(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from spark_gp_tpu.models.loo import batched_loo_nll
+    from spark_gp_tpu.parallel.experts import group_for_experts
+
+    x = rng.normal(size=(33, 2))
+    y = np.sin(x.sum(axis=1)) + 0.1 * rng.normal(size=33)
+    data = group_for_experts(x, y, 12)
+    kernel = _make_kernel()
+    theta0 = jnp.asarray(kernel.init_theta())
+
+    f = lambda t: batched_loo_nll(kernel, t, data)
+    grad = np.asarray(jax.grad(f)(theta0))
+    eps = 1e-6
+    for k in range(theta0.shape[0]):
+        dt = np.zeros(theta0.shape[0])
+        dt[k] = eps
+        fd = (float(f(theta0 + dt)) - float(f(theta0 - dt))) / (2 * eps)
+        np.testing.assert_allclose(grad[k], fd, rtol=1e-5, atol=1e-7)
+
+
+def test_loo_objective_fit_improves_pseudo_likelihood(rng):
+    """A fit under setObjective('loo') must (a) report the LOO objective as
+    its final objective value and (b) reach at least as good a LOO pseudo-
+    likelihood as the marginal-NLL fit evaluated post hoc."""
+    x = rng.normal(size=(80, 2))
+    y = np.sin(1.3 * x.sum(axis=1)) + 0.1 * rng.normal(size=80)
+
+    def mk(objective):
+        return (
+            GaussianProcessRegression()
+            .setKernel(
+                lambda: 1.0 * RBFKernel(1.0, 1e-3, 20)
+                + WhiteNoiseKernel(0.3, 1e-4, 1.0)
+            )
+            .setDatasetSizeForExpert(40)
+            .setActiveSetSize(30)
+            .setSigma2(1e-3)
+            .setSeed(3)
+            .setObjective(objective)
+        )
+
+    loo_fit = mk("loo").fit(x, y)
+    marg_fit = mk("marginal").fit(x, y)
+
+    gp = mk("loo")
+    at_loo = gp.loo(x, y, loo_fit)["loo_log_pseudo_likelihood"]
+    at_marg = gp.loo(x, y, marg_fit)["loo_log_pseudo_likelihood"]
+    assert at_loo >= at_marg - 1e-6
+
+    # the reported final objective is the LOO objective at the winner
+    from spark_gp_tpu.models.loo import batched_loo_nll
+    from spark_gp_tpu.parallel.experts import group_for_experts
+
+    import jax.numpy as jnp
+
+    data = group_for_experts(x, y, 40)
+    recomputed = float(
+        batched_loo_nll(
+            loo_fit.raw_predictor.kernel,
+            jnp.asarray(loo_fit.raw_predictor.theta, dtype=data.x.dtype),
+            data,
+        )
+    )
+    assert loo_fit.instr.metrics["final_nll"] == pytest.approx(
+        recomputed, rel=1e-5
+    )
+    # and -sum(log densities) from the diagnostics agrees with the objective
+    assert -at_loo == pytest.approx(recomputed, rel=1e-5)
+
+
+def test_loo_objective_host_and_device_optimizers_agree(rng):
+    x = rng.normal(size=(48, 2))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=48)
+
+    def mk(opt):
+        return (
+            GaussianProcessRegression()
+            .setKernel(lambda: _make_kernel())
+            .setDatasetSizeForExpert(24)
+            .setActiveSetSize(20)
+            .setSigma2(1e-3)
+            .setSeed(7)
+            .setObjective("loo")
+            .setOptimizer(opt)
+        )
+
+    m_host = mk("host").fit(x, y)
+    m_dev = mk("device").fit(x, y)
+    assert m_host.instr.metrics["final_nll"] == pytest.approx(
+        m_dev.instr.metrics["final_nll"], rel=1e-3
+    )
+
+
+def test_set_objective_validates():
+    with pytest.raises(ValueError, match="unknown objective"):
+        GaussianProcessRegression().setObjective("elbo")
+
+
+def test_loo_objective_checkpoints_isolated_from_marginal(rng, tmp_path):
+    """Checkpoints are objective-keyed on BOTH optimizer paths: a loo fit
+    in the same dir neither resumes from nor overwrites a marginal fit's
+    state."""
+    from spark_gp_tpu.utils.checkpoint import load_checkpoint
+
+    x = rng.normal(size=(40, 2))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=40)
+
+    def mk(objective, opt):
+        return (
+            GaussianProcessRegression()
+            .setKernel(lambda: _make_kernel())
+            .setDatasetSizeForExpert(20)
+            .setActiveSetSize(16)
+            .setSigma2(1e-3)
+            .setMaxIter(4)
+            .setOptimizer(opt)
+            .setObjective(objective)
+            .setCheckpointDir(str(tmp_path))
+        )
+
+    # host path: per-iteration json, tag = class name [+ objective]
+    mk("marginal", "host").fit(x, y)
+    marg_state = load_checkpoint(
+        str(tmp_path), tag="GaussianProcessRegression"
+    )
+    assert marg_state is not None
+
+    mk("loo", "host").fit(x, y)
+    loo_state = load_checkpoint(
+        str(tmp_path), tag="GaussianProcessRegression-loo"
+    )
+    assert loo_state is not None
+    # the marginal state survived the loo fit untouched
+    after = load_checkpoint(str(tmp_path), tag="GaussianProcessRegression")
+    np.testing.assert_array_equal(np.asarray(after[1]), np.asarray(marg_state[1]))
+
+    # device segmented path: distinct npz file tags
+    import os
+
+    dev_dir = tmp_path / "dev"
+    mk("marginal", "device").setCheckpointInterval(2).setCheckpointDir(
+        str(dev_dir)
+    ).fit(x, y)
+    assert os.path.exists(dev_dir / "gpr_device_lbfgs.npz")
+    before = (dev_dir / "gpr_device_lbfgs.npz").read_bytes()
+    mk("loo", "device").setCheckpointInterval(2).setCheckpointDir(
+        str(dev_dir)
+    ).fit(x, y)
+    assert os.path.exists(dev_dir / "gpr-loo_device_lbfgs.npz")
+    assert (dev_dir / "gpr_device_lbfgs.npz").read_bytes() == before
