@@ -107,6 +107,16 @@ class GaussianProcessRegression(GaussianProcessCommons):
         """
         from spark_gp_tpu.models.loo import loo_diagnostics
 
+        x, y, kernel, theta = self._resolve_eval_inputs(x, y, model)
+        return loo_diagnostics(
+            kernel, theta, x, y, self._dataset_size_for_expert
+        )
+
+    def _resolve_eval_inputs(self, x, y, model):
+        """Shared validation + kernel/theta resolution for the post-fit
+        evaluation entry points (``loo``, ``poe_predictor``): the model's
+        fitted hyperparameters when given, else the kernel's initial
+        theta."""
         x = np.asarray(x, dtype=np.float64)
         y = np.asarray(y, dtype=np.float64)
         if x.ndim != 2:
@@ -114,13 +124,30 @@ class GaussianProcessRegression(GaussianProcessCommons):
         if y.shape != (x.shape[0],):
             raise ValueError(f"y must be [N], got shape {y.shape}")
         if model is not None:
-            kernel = model.raw_predictor.kernel
-            theta = model.raw_predictor.theta
-        else:
-            kernel = self._get_kernel()
-            theta = kernel.init_theta()
-        return loo_diagnostics(
-            kernel, theta, x, y, self._dataset_size_for_expert
+            return x, y, model.raw_predictor.kernel, model.raw_predictor.theta
+        kernel = self._get_kernel()
+        return x, y, kernel, kernel.init_theta()
+
+    def poe_predictor(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        model: "Optional[GaussianProcessRegressionModel]" = None,
+        mode: str = "rbcm",
+    ):
+        """Product-of-experts predictor (Deisenroth & Ng ICML'15) over this
+        estimator's expert split — the inducing-set-free alternative to the
+        PPA model: each expert answers from its exact s-point posterior and
+        the answers combine by precision weighting (``mode``: ``"rbcm"``
+        [robust default] / ``"gpoe"`` / ``"bcm"`` / ``"poe"``).  Evaluated
+        at ``model``'s fitted hyperparameters when given, else at the
+        kernel's initial theta.  See :mod:`spark_gp_tpu.models.poe`.
+        """
+        from spark_gp_tpu.models.poe import make_poe_predictor
+
+        x, y, kernel, theta = self._resolve_eval_inputs(x, y, model)
+        return make_poe_predictor(
+            kernel, theta, x, y, self._dataset_size_for_expert, mode=mode
         )
 
     def _fit_device_multistart(

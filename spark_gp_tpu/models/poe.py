@@ -1,0 +1,159 @@
+"""Product-of-experts predictive aggregation (BCM family).
+
+The PPA predictor (``models/ppa.py``) answers queries through an m-point
+inducing set — the reference's design (GaussianProcessCommons.scala:118-126).
+This module is the OTHER classic way to predict from the very expert split
+the training objective already uses: each expert answers from its own
+s-point exact posterior and the answers combine by precision weighting
+(Deisenroth & Ng, *Distributed Gaussian Processes*, ICML'15 — citation [2]
+of ``models/gpr.py``; cf. "Healing Products of Gaussian Processes",
+arXiv:2102.07106, for the failure modes the robust variants patch):
+
+    poe    prec = sum_e 1/s2_e                      (overconfident in voids)
+    gpoe   prec = sum_e b_e/s2_e,  b_e = 1/E        (calibrated scale)
+    bcm    poe + (1-E)/k**  prior correction        (valid posterior, can
+                                                     still overcorrect)
+    rbcm   b_e = 0.5(log k** - log s2_e) per point  (entropy-weighted;
+           prec = sum_e b_e/s2_e + (1-sum_e b_e)/k**  the robust default)
+
+where ``k**`` is the prior variance ``kernel.self_diag`` — the same
+(noise-inclusive) convention as the PPA variance, so the two predictors
+are directly comparable.  Cost: O(E s²) per test point, embarrassingly
+parallel over the expert axis — no O(m³) build, no inducing set; the
+natural choice when the active-set budget, not the data, limits PPA
+fidelity.
+
+Everything is one batched/vmapped program: per-expert Cholesky factors
+``[E, s, s]`` are precomputed once (the same masked-gram embedding as
+training keeps padding inert), prediction is two batched triangular
+solves + the aggregation reduction.  On a mesh the expert axis shards and
+the three precision sums ride one ``psum`` each.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.ops.linalg import (
+    check_pd_status,
+    cholesky,
+    is_pd,
+    masked_kernel_matrix,
+)
+from spark_gp_tpu.parallel.experts import ExpertData
+
+_MODES = ("poe", "gpoe", "bcm", "rbcm")
+
+
+@partial(jax.jit, static_argnums=0)
+def _factor_experts(kernel: Kernel, theta, x, y, mask):
+    """One-time batched factorization: L [E,s,s], alpha [E,s]."""
+    kmat = jax.vmap(
+        lambda xe, me: masked_kernel_matrix(kernel.gram(theta, xe), me)
+    )(x, mask)
+    chol_l = cholesky(kmat)
+    ym = y * mask
+    alpha = jax.scipy.linalg.cho_solve((chol_l, True), ym[..., None])[..., 0]
+    return chol_l, alpha
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _predict_impl(kernel: Kernel, mode, theta, x, mask, chol_l, alpha, x_test):
+    """``[t]`` aggregated (mean, var) from every expert's exact posterior."""
+    k_ss = kernel.self_diag(theta, x_test)  # [t] prior var (incl. noise)
+
+    def per_expert(xe, me, le, ae):
+        k_cross = kernel.cross(theta, x_test, xe) * me[None, :]  # [t, s]
+        mean_e = k_cross @ ae
+        v = jax.scipy.linalg.solve_triangular(
+            le, k_cross.T, lower=True
+        )  # [s, t]
+        var_e = k_ss - jnp.sum(v * v, axis=0)
+        return mean_e, var_e
+
+    mean_e, var_e = jax.vmap(per_expert)(x, mask, chol_l, alpha)  # [E, t]
+    # fully-padded experts (mesh padding) must not vote: mask their
+    # precision weight to zero
+    alive = (jnp.sum(mask, axis=1) > 0).astype(k_ss.dtype)[:, None]  # [E,1]
+    n_alive = jnp.sum(alive)
+    prec_e = alive / var_e  # [E, t]
+
+    if mode == "poe":
+        beta = alive * jnp.ones_like(var_e)
+        prior_w = 0.0
+    elif mode == "gpoe":
+        beta = alive / n_alive
+        prior_w = 0.0
+    elif mode == "bcm":
+        beta = alive * jnp.ones_like(var_e)
+        prior_w = 1.0 - n_alive
+    else:  # rbcm
+        beta = alive * 0.5 * (jnp.log(k_ss)[None, :] - jnp.log(var_e))
+        prior_w = 1.0 - jnp.sum(beta, axis=0)
+    prec = jnp.sum(beta * prec_e, axis=0) + prior_w / k_ss  # [t]
+    mean = jnp.sum(beta * prec_e * mean_e, axis=0) / prec
+    return mean, 1.0 / prec
+
+
+class PoEPredictor:
+    """Fitted product-of-experts predictor at fixed hyperparameters.
+
+    Built by :meth:`GaussianProcessRegression.poe_predictor`; holds the
+    expert stack and its per-expert factors (O(E s²) memory — the data
+    itself, unlike the N-independent PPA model)."""
+
+    def __init__(
+        self,
+        kernel: Kernel,
+        theta,
+        data: ExpertData,
+        mode: str = "rbcm",
+    ):
+        if mode not in _MODES:
+            raise ValueError(
+                f"unknown PoE mode {mode!r}; expected one of {_MODES}"
+            )
+        self.kernel = kernel
+        self.theta = jnp.asarray(theta, dtype=data.x.dtype)
+        self.data = data
+        self.mode = mode
+        self._chol, self._alpha = _factor_experts(
+            kernel, self.theta, data.x, data.y, data.mask
+        )
+        # surface a non-PD expert gram here, like every other factorization
+        # path (NotPositiveDefiniteException + advice) — not as NaN
+        # predictions later
+        check_pd_status(jnp.all(is_pd(self._chol)))
+
+    def predict(self, x_test: np.ndarray) -> np.ndarray:
+        return self.predict_with_var(x_test)[0]
+
+    def predict_with_var(self, x_test: np.ndarray):
+        x_test = jnp.asarray(
+            np.asarray(x_test), dtype=self.data.x.dtype
+        )
+        mean, var = _predict_impl(
+            self.kernel, self.mode, self.theta, self.data.x, self.data.mask,
+            self._chol, self._alpha, x_test,
+        )
+        return np.asarray(mean), np.asarray(var)
+
+
+def make_poe_predictor(
+    kernel: Kernel,
+    theta,
+    x: np.ndarray,
+    y: np.ndarray,
+    dataset_size_for_expert: int,
+    mode: str = "rbcm",
+    dtype=None,
+) -> PoEPredictor:
+    from spark_gp_tpu.parallel.experts import group_for_experts
+
+    data = group_for_experts(x, y, dataset_size_for_expert, dtype=dtype)
+    return PoEPredictor(kernel, theta, data, mode=mode)
