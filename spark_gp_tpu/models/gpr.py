@@ -147,7 +147,8 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
         x, y, kernel, theta = self._resolve_eval_inputs(x, y, model)
         return make_poe_predictor(
-            kernel, theta, x, y, self._dataset_size_for_expert, mode=mode
+            kernel, theta, x, y, self._dataset_size_for_expert, mode=mode,
+            mesh=self._mesh,
         )
 
     def _fit_device_multistart(

@@ -62,10 +62,11 @@ def _factor_experts(kernel: Kernel, theta, x, y, mask):
     return chol_l, alpha
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _predict_impl(kernel: Kernel, mode, theta, x, mask, chol_l, alpha, x_test):
-    """``[t]`` aggregated (mean, var) from every expert's exact posterior."""
-    k_ss = kernel.self_diag(theta, x_test)  # [t] prior var (incl. noise)
+def _local_moments(kernel: Kernel, mode, theta, x, mask, chol_l, alpha,
+                   x_test, k_ss, psum_axis=None):
+    """The (possibly device-local) expert reduction behind both predict
+    paths: three sums over the expert axis — sum(beta*prec),
+    sum(beta*prec*mean), sum(beta) — each a ``psum`` when sharded."""
 
     def per_expert(xe, me, le, ae):
         k_cross = kernel.cross(theta, x_test, xe) * me[None, :]  # [t, s]
@@ -83,21 +84,82 @@ def _predict_impl(kernel: Kernel, mode, theta, x, mask, chol_l, alpha, x_test):
     n_alive = jnp.sum(alive)
     prec_e = alive / var_e  # [E, t]
 
-    if mode == "poe":
+    if mode == "rbcm":
+        beta = alive * 0.5 * (jnp.log(k_ss)[None, :] - jnp.log(var_e))
+    elif mode == "gpoe":
+        # per-shard count is wrong under sharding: normalize by the GLOBAL
+        # expert count after the reduction, via the beta sum
         beta = alive * jnp.ones_like(var_e)
+    else:  # poe / bcm: unit weights
+        beta = alive * jnp.ones_like(var_e)
+
+    sums = (
+        jnp.sum(beta * prec_e, axis=0),           # [t]
+        jnp.sum(beta * prec_e * mean_e, axis=0),  # [t]
+        jnp.sum(beta, axis=0),                    # [t] (== n_alive for
+                                                  #  unit-weight modes)
+        n_alive,
+    )
+    if psum_axis is not None:
+        sums = jax.lax.psum(sums, psum_axis)
+    return sums
+
+
+def _aggregate(mode, sums, k_ss):
+    prec_sum, wmean_sum, beta_sum, n_alive = sums
+    if mode == "poe":
         prior_w = 0.0
     elif mode == "gpoe":
-        beta = alive / n_alive
+        # beta = 1/E_global: scale the unit-weight sums after the reduction
+        prec_sum = prec_sum / n_alive
+        wmean_sum = wmean_sum / n_alive
         prior_w = 0.0
     elif mode == "bcm":
-        beta = alive * jnp.ones_like(var_e)
         prior_w = 1.0 - n_alive
     else:  # rbcm
-        beta = alive * 0.5 * (jnp.log(k_ss)[None, :] - jnp.log(var_e))
-        prior_w = 1.0 - jnp.sum(beta, axis=0)
-    prec = jnp.sum(beta * prec_e, axis=0) + prior_w / k_ss  # [t]
-    mean = jnp.sum(beta * prec_e * mean_e, axis=0) / prec
-    return mean, 1.0 / prec
+        prior_w = 1.0 - beta_sum
+    prec = prec_sum + prior_w / k_ss  # [t]
+    return wmean_sum / prec, 1.0 / prec
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _predict_impl(kernel: Kernel, mode, theta, x, mask, chol_l, alpha, x_test):
+    """``[t]`` aggregated (mean, var) from every expert's exact posterior."""
+    k_ss = kernel.self_diag(theta, x_test)  # [t] prior var (incl. noise)
+    sums = _local_moments(
+        kernel, mode, theta, x, mask, chol_l, alpha, x_test, k_ss
+    )
+    return _aggregate(mode, sums, k_ss)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _predict_sharded_impl(
+    kernel: Kernel, mode, mesh, theta, x, mask, chol_l, alpha, x_test
+):
+    """Mesh-sharded prediction: the expert axis (data AND factors) shards,
+    the test block and the three reduction sums replicate via one psum."""
+    from jax.sharding import PartitionSpec as P
+
+    from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+            P(EXPERT_AXIS), P(),
+        ),
+        out_specs=(P(), P()),
+    )
+    def run(theta_, x_, mask_, chol_, alpha_, x_test_):
+        k_ss = kernel.self_diag(theta_, x_test_)
+        sums = _local_moments(
+            kernel, mode, theta_, x_, mask_, chol_, alpha_, x_test_, k_ss,
+            psum_axis=EXPERT_AXIS,
+        )
+        return _aggregate(mode, sums, k_ss)
+
+    return run(theta, x, mask, chol_l, alpha, x_test)
 
 
 class PoEPredictor:
@@ -113,6 +175,7 @@ class PoEPredictor:
         theta,
         data: ExpertData,
         mode: str = "rbcm",
+        mesh=None,
     ):
         if mode not in _MODES:
             raise ValueError(
@@ -122,6 +185,7 @@ class PoEPredictor:
         self.theta = jnp.asarray(theta, dtype=data.x.dtype)
         self.data = data
         self.mode = mode
+        self.mesh = mesh
         self._chol, self._alpha = _factor_experts(
             kernel, self.theta, data.x, data.y, data.mask
         )
@@ -137,10 +201,16 @@ class PoEPredictor:
         x_test = jnp.asarray(
             np.asarray(x_test), dtype=self.data.x.dtype
         )
-        mean, var = _predict_impl(
-            self.kernel, self.mode, self.theta, self.data.x, self.data.mask,
-            self._chol, self._alpha, x_test,
-        )
+        if self.mesh is not None:
+            mean, var = _predict_sharded_impl(
+                self.kernel, self.mode, self.mesh, self.theta, self.data.x,
+                self.data.mask, self._chol, self._alpha, x_test,
+            )
+        else:
+            mean, var = _predict_impl(
+                self.kernel, self.mode, self.theta, self.data.x,
+                self.data.mask, self._chol, self._alpha, x_test,
+            )
         return np.asarray(mean), np.asarray(var)
 
 
@@ -152,8 +222,13 @@ def make_poe_predictor(
     dataset_size_for_expert: int,
     mode: str = "rbcm",
     dtype=None,
+    mesh=None,
 ) -> PoEPredictor:
     from spark_gp_tpu.parallel.experts import group_for_experts
 
     data = group_for_experts(x, y, dataset_size_for_expert, dtype=dtype)
-    return PoEPredictor(kernel, theta, data, mode=mode)
+    if mesh is not None:
+        from spark_gp_tpu.parallel.mesh import shard_experts
+
+        data = shard_experts(data, mesh)
+    return PoEPredictor(kernel, theta, data, mode=mode, mesh=mesh)
