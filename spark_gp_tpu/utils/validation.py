@@ -23,6 +23,23 @@ def accuracy(y_true: np.ndarray, y_pred: np.ndarray) -> float:
     return float(np.mean(np.asarray(y_true) == np.asarray(y_pred)))
 
 
+def nlpd(y_true: np.ndarray, mean: np.ndarray, var: np.ndarray) -> float:
+    """Mean negative log predictive density under Gaussian predictive
+    marginals — the proper scoring rule RMSE is not: it penalizes both
+    error and miscalibrated uncertainty (R&W eq. 2.34; standard GP
+    benchmark metric).  Consumes ``model.predict_with_var`` output;
+    ``cross_validate`` routes to it via the ``needs_variance`` marker."""
+    y = np.asarray(y_true, dtype=np.float64)
+    mu = np.asarray(mean, dtype=np.float64)
+    v = np.asarray(var, dtype=np.float64)
+    return float(
+        np.mean(0.5 * (np.log(2.0 * np.pi * v) + (y - mu) ** 2 / v))
+    )
+
+
+nlpd.needs_variance = True
+
+
 def kfold_indices(n: int, num_folds: int, seed: int = 0):
     """Shuffled k-fold split; yields (train_idx, test_idx)."""
     rng = np.random.default_rng(seed)
@@ -50,7 +67,11 @@ def cross_validate(
     for train_idx, test_idx in kfold_indices(x.shape[0], num_folds, seed):
         est = copy.copy(estimator)
         model = est.fit(x[train_idx], y[train_idx])
-        scores.append(metric(y[test_idx], model.predict(x[test_idx])))
+        if getattr(metric, "needs_variance", False):
+            mean, var = model.predict_with_var(x[test_idx])
+            scores.append(metric(y[test_idx], mean, var))
+        else:
+            scores.append(metric(y[test_idx], model.predict(x[test_idx])))
     return float(np.mean(scores))
 
 

@@ -277,3 +277,40 @@ def test_preflight_backend_fast_failure_reports_cause(monkeypatch, tmp_path, cap
     assert "rc=1" in caplog.text
     assert "libfoo.so missing" in caplog.text
     assert "hung" not in caplog.text
+
+
+def test_nlpd_formula_and_calibration(rng):
+    """nlpd matches the Gaussian log-density by hand, and a miscalibrated
+    variance (too small OR too large) scores worse than the truth."""
+    from spark_gp_tpu.utils.validation import nlpd
+
+    y = rng.normal(size=500)
+    mu = np.zeros(500)
+    v = np.ones(500)
+    by_hand = np.mean(0.5 * (np.log(2 * np.pi * v) + (y - mu) ** 2 / v))
+    assert nlpd(y, mu, v) == pytest.approx(by_hand)
+    assert nlpd(y, mu, v) < nlpd(y, mu, v * 25)
+    assert nlpd(y, mu, v) < nlpd(y, mu, v / 25)
+
+
+def test_cross_validate_routes_variance_metric(rng):
+    """cross_validate must call predict_with_var for needs_variance
+    metrics and produce a finite, sane NLPD on an easy problem."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel, WhiteNoiseKernel
+    from spark_gp_tpu.data import make_synthetics
+    from spark_gp_tpu.utils.validation import cross_validate, nlpd
+
+    x, y = make_synthetics(n=300)
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: 1.0 * RBFKernel(0.1, 1e-6, 10) + WhiteNoiseKernel(0.5, 0, 1))
+        .setDatasetSizeForExpert(100)
+        .setActiveSetSize(50)
+        .setSigma2(1e-3)
+        .setSeed(13)
+    )
+    score = cross_validate(gp, x, y, num_folds=3, metric=nlpd, seed=13)
+    # a calibrated GP on sin(x)+N(0,0.01): NLPD should be strongly negative
+    # (densities > 1); an uninformative N(0,1) predictor scores ~1.42
+    assert np.isfinite(score)
+    assert score < 0.0
