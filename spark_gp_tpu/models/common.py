@@ -353,10 +353,19 @@ class GaussianProcessCommons(GaussianProcessParams):
     def _checkpoint_tag(self) -> str:
         """Checkpoint file tag: class name, plus the objective when it is
         not the default — a marginal-NLL checkpoint must never seed (or be
-        overwritten by) a ``setObjective("loo")`` fit in the same dir."""
+        overwritten by) a ``setObjective("loo")`` fit in the same dir.
+        ELBO fits additionally carry the objective-surface digest (set by
+        ``_elbo_setup``): two ELBO fits over different inducing sets or
+        sigma2 are DIFFERENT objectives."""
         objective = getattr(self, "_objective", "marginal")
         name = type(self).__name__
-        return name if objective == "marginal" else f"{name}-{objective}"
+        if objective == "marginal":
+            return name
+        name = f"{name}-{objective}"
+        salt = getattr(self, "_objective_salt", None)
+        if objective == "elbo" and salt:
+            name += f"-{salt}"
+        return name
 
     def _make_checkpointer(self, kernel):
         if self._checkpoint_dir is None:
@@ -529,6 +538,36 @@ class GaussianProcessCommons(GaussianProcessParams):
             )
         instr.log_info("Optimal kernel: " + kernel.describe(theta_host))
 
+    def _select_active(self, kernel, theta, x, y_targets, data) -> np.ndarray:
+        """Run the configured provider — ONE home for the selection logic,
+        used at the reference's point in the pipeline (post-optimization,
+        ``_projected_process``) and, for the ELBO objective, up front at
+        the initial theta (the inducing set must exist before training)."""
+        provider = self._active_set_provider
+        if x is None:
+            # distributed mode: no host holds the rows — the provider
+            # selects from the sharded stack itself (data.y carries the
+            # targets: labels for GPR, latent modes for GPC)
+            active = provider.from_stack(
+                self._active_set_size, data, kernel,
+                np.asarray(theta, dtype=np.float64), self._seed,
+                self._mesh,
+            )
+        elif getattr(provider, "uses_fit_outputs", True):
+            # The provider receives the noise-augmented model kernel, as
+            # the reference passes getKernel
+            # (GaussianProcessCommons.scala:43) — the greedy provider's
+            # Seeger scores divide by its whiteNoiseVar.
+            targets = y_targets() if callable(y_targets) else y_targets
+            active = provider(
+                self._active_set_size, x, targets, kernel, theta, self._seed
+            )
+        else:
+            active = provider(
+                self._active_set_size, x, None, kernel, None, self._seed
+            )
+        return np.asarray(active)
+
     def _projected_process(
         self,
         instr: Instrumentation,
@@ -550,35 +589,15 @@ class GaussianProcessCommons(GaussianProcessParams):
         """
         import jax.numpy as jnp
 
-        with instr.phase("active_set"):
-            provider = self._active_set_provider
-            if active_override is not None:
-                # explicitly-supplied set (fit_distributed(active_set=...))
-                active = active_override
-            elif x is None:
-                # distributed mode: no host holds the rows — the provider
-                # selects from the sharded stack itself (data.y carries the
-                # targets: labels for GPR, latent modes for GPC)
-                active = provider.from_stack(
-                    self._active_set_size, data, kernel,
-                    np.asarray(theta_opt, dtype=np.float64), self._seed,
-                    self._mesh,
+        if active_override is not None:
+            # explicitly-supplied set (fit_distributed(active_set=...), or
+            # an objective that selected it before optimization)
+            active = np.asarray(active_override)
+        else:
+            with instr.phase("active_set"):
+                active = self._select_active(
+                    kernel, theta_opt, x, y_targets, data
                 )
-            elif getattr(provider, "uses_fit_outputs", True):
-                # The provider receives the noise-augmented model kernel, as
-                # the reference passes getKernel
-                # (GaussianProcessCommons.scala:43) — the greedy provider's
-                # Seeger scores divide by its whiteNoiseVar.
-                targets = y_targets() if callable(y_targets) else y_targets
-                active = provider(
-                    self._active_set_size, x, targets, kernel, theta_opt,
-                    self._seed,
-                )
-            else:
-                active = provider(
-                    self._active_set_size, x, None, kernel, None, self._seed
-                )
-        active = np.asarray(active)
 
         # The (U1, u2) accumulation runs in float64 (XLA emulates f64 on TPU;
         # this stage is one-time, not the per-iteration hot loop).  In f32 the

@@ -46,22 +46,85 @@ class GaussianProcessRegression(GaussianProcessCommons):
     _keeps_update_statistics = True
 
     # hyperparameter objective: the BCM marginal NLL (the reference's,
-    # GPR.scala:55-68) or the negative LOO log pseudo-likelihood
-    # (R&W eq. 5.13 — setObjective("loo"), models/loo.py)
+    # GPR.scala:55-68), the negative LOO log pseudo-likelihood
+    # (R&W eq. 5.13 — setObjective("loo"), models/loo.py), or the Titsias
+    # collapsed SGPR ELBO (setObjective("elbo"), models/sgpr.py)
     _objective = "marginal"
 
     def setObjective(self, objective: str) -> "GaussianProcessRegression":
-        """``"marginal"`` (default) or ``"loo"``: optimize the LOO log
-        pseudo-likelihood instead of the marginal NLL — more robust under
-        model misspecification (R&W §5.4.2); every fit path (host, device,
-        sharded, checkpointed, multi-start, distributed) honors it."""
-        if objective not in ("marginal", "loo"):
+        """``"marginal"`` (default), ``"loo"`` — the LOO log
+        pseudo-likelihood, more robust under model misspecification
+        (R&W §5.4.2) — or ``"elbo"`` — the Titsias collapsed SGPR bound
+        (``models/sgpr.py``): the active set is selected up front and the
+        hyperparameters train against a principled variational lower
+        bound with sigma2 as the likelihood noise.  Every fit path (host,
+        device, sharded, checkpointed, multi-start, distributed) honors
+        the choice."""
+        if objective not in ("marginal", "loo", "elbo"):
             raise ValueError(
                 f"unknown objective {objective!r}; "
-                "expected 'marginal' or 'loo'"
+                "expected 'marginal', 'loo' or 'elbo'"
             )
         self._objective = objective
         return self
+
+    def _elbo_extra(self, active, data):
+        """The (active, sigma2) traced-operand tuple the ELBO objective
+        consumes (likelihood.objective_fn signature note)."""
+        import jax.numpy as jnp
+
+        return (
+            jnp.asarray(np.asarray(active), dtype=data.x.dtype),
+            jnp.asarray(self._sigma2, dtype=data.x.dtype),
+        )
+
+    def _elbo_setup(self, instr, kernel, x, targets_fn, data, active_override):
+        """ONE home for the ELBO pre-selection (used by the plain and the
+        batched-multistart fit drivers): the inducing set must exist
+        BEFORE training — select it at the initial theta unless supplied —
+        and the (active, sigma2) operand tuple rides every evaluation.
+        Returns ``(active_override, extra)``."""
+        from spark_gp_tpu.models.active_set import (
+            GreedilyOptimizingActiveSetProvider,
+        )
+
+        theta0 = kernel.init_theta()
+        provider = self._active_set_provider
+        is_greedy = provider is GreedilyOptimizingActiveSetProvider or (
+            isinstance(provider, GreedilyOptimizingActiveSetProvider)
+        )
+        if (
+            is_greedy
+            and float(kernel.white_noise_var(np.asarray(theta0))) == 0.0
+        ):
+            # the model kernel is user kernel + sigma2*Eye, so this fires
+            # only at setSigma2(0) with no kernel noise of its own
+            raise ValueError(
+                "setObjective('elbo') with the greedy provider needs "
+                "nonzero white noise (the Seeger scores divide by it); "
+                "set a nonzero sigma2, or use the random/k-means provider"
+            )
+        if active_override is None:
+            with instr.phase("active_set"):
+                active_override = self._select_active(
+                    kernel, theta0, x, targets_fn, data
+                )
+        extra = self._elbo_extra(active_override, data)
+        # the host checkpoint tag (common._checkpoint_tag) carries this:
+        # two ELBO fits over different surfaces must not share state files
+        self._objective_salt = self._elbo_checkpoint_salt(extra)
+        return active_override, extra
+
+    def _elbo_checkpoint_salt(self, extra) -> str:
+        """Digest of the ELBO objective surface (inducing set + sigma2):
+        checkpoint tags carry it so fits of DIFFERENT bounds sharing a dir
+        neither resume from nor clobber each other."""
+        import hashlib
+
+        h = hashlib.sha1()
+        for e in extra:
+            h.update(np.asarray(e, dtype=np.float64).tobytes())
+        return h.hexdigest()[:10]
 
     set_objective = setObjective
 
@@ -171,6 +234,14 @@ class GaussianProcessRegression(GaussianProcessCommons):
             )
             lower, upper = kernel.bounds()
             log_space = self._use_log_space(kernel)
+            extra = ()
+            active_override = None
+            if self._objective == "elbo":
+                # one inducing set, shared by every restart lane and the
+                # PPA build below
+                active_override, extra = self._elbo_setup(
+                    instr, kernel, x, lambda: y, data, active_override
+                )
             instr.log_info(
                 "Optimising the kernel hyperparameters "
                 f"(on-device, {self._num_restarts} batched restarts)"
@@ -184,6 +255,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                         data.x, data.y, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
                         jnp.asarray(self._tol, dtype=dtype),
+                        extra,
                         objective=self._objective,
                     )
                 )
@@ -200,7 +272,8 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 "restart_nlls": f_all,
             }
             raw, fetched = self._finalize_device_fit(
-                instr, kernel, theta, pending, x, lambda: y, data
+                instr, kernel, theta, pending, x, lambda: y, data,
+                active_override=active_override,
             )
             self._report_multistart_nlls(instr, fetched)
         instr.log_success()
@@ -216,22 +289,35 @@ class GaussianProcessRegression(GaussianProcessCommons):
         from spark_gp_tpu.utils.instrumentation import maybe_profile
 
         with maybe_profile(self._profile_dir):
+            extra = ()
+            if self._objective == "elbo":
+                # selected once up front, reused for the PPA build below
+                active_override, extra = self._elbo_setup(
+                    instr, kernel, x, targets_fn, data, active_override
+                )
             if self._resolved_optimizer() == "device":
                 # Fully async pipeline: the on-device L-BFGS, the f64 PPA
                 # statistics and the scalar diagnostics drain in one host
                 # sync inside _finalize_device_fit.
-                theta_dev, pending = self._fit_device(instr, kernel, data)
+                theta_dev, pending = self._fit_device(
+                    instr, kernel, data, extra
+                )
                 raw, _ = self._finalize_device_fit(
                     instr, kernel, theta_dev, pending, x, targets_fn, data,
                     active_override=active_override,
                 )
             else:
-                if self._mesh is not None:
+                if self._mesh is not None and self._objective != "elbo":
                     vag = make_sharded_value_and_grad(
                         kernel, data, self._mesh, self._objective
                     )
                 else:
-                    vag = make_value_and_grad(kernel, data, self._objective)
+                    # the ELBO (a nonlinear function of global sums) rides
+                    # jit/GSPMD over the possibly-sharded stack instead of
+                    # the shard_map path (models/sgpr.py)
+                    vag = make_value_and_grad(
+                        kernel, data, self._objective, extra
+                    )
 
                 checkpointer = self._make_checkpointer(kernel)
                 theta_opt = self._optimize_hypers(
@@ -275,7 +361,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
             "GaussianProcessRegression", data, active_set, prepare
         )
 
-    def _fit_device(self, instr: Instrumentation, kernel, data):
+    def _fit_device(self, instr: Instrumentation, kernel, data, extra=()):
         """Dispatch the one-program on-device optimization
         (optimize/lbfgs_device.py) WITHOUT blocking: returns the device theta
         plus the pending diagnostic scalars for a single deferred fetch."""
@@ -310,27 +396,32 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
                 # the objective is part of the FILE tag too (not only the
                 # resume-meta family): a loo fit must not overwrite a
-                # marginal fit's resumable state in the same dir
+                # marginal fit's resumable state in the same dir; for the
+                # elbo the tag also carries the objective-surface digest
                 file_tag = (
                     "gpr" if self._objective == "marginal"
                     else f"gpr-{self._objective}"
                 )
+                if extra:
+                    file_tag += "-" + self._elbo_checkpoint_salt(extra)
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device_checkpointed(
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data, self._max_iter, tol, self._checkpoint_interval,
                     DeviceOptimizerCheckpointer(self._checkpoint_dir, file_tag),
-                    objective=self._objective,
+                    objective=self._objective, extra=extra,
                 )
-            elif self._mesh is not None:
+            elif self._mesh is not None and self._objective != "elbo":
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device_sharded(
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol,
                     objective=self._objective,
                 )
             else:
+                # elbo + mesh lands here too: jit/GSPMD partitions the
+                # sharded stack and replicates the [m, m] algebra
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device(
                     kernel, log_space, theta0, lower, upper,
-                    data.x, data.y, data.mask, max_iter, tol,
+                    data.x, data.y, data.mask, max_iter, tol, extra,
                     objective=self._objective,
                 )
             phase_sync(theta, f)

@@ -72,32 +72,64 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData):
     )
 
 
+def objective_supports_shard_map(objective: str) -> bool:
+    """ONE home for the dispatch invariant: per-expert-sum objectives
+    (psum of local scalars) ride the hand-written shard_map paths; the
+    ELBO — a nonlinear function of global sums — rides jit/GSPMD instead
+    (models/sgpr.py distribution note).  Consulted by every sharded entry
+    point and by the estimator's mesh dispatch."""
+    return objective != "elbo"
+
+
+def _require_shard_map_support(objective: str) -> None:
+    if not objective_supports_shard_map(objective):
+        raise ValueError(
+            f"the {objective!r} objective rides jit/GSPMD over sharded "
+            "arrays, not the shard_map paths (models/sgpr.py "
+            "distribution note)"
+        )
+
+
 def objective_fn(objective: str):
     """The per-expert-stack objective ``setObjective`` selects: the BCM
-    marginal NLL (default, the reference's objective) or the negative LOO
-    log pseudo-likelihood (R&W eq. 5.13, ``models/loo.py``).  Both share
-    the ``(kernel, theta, data) -> scalar`` signature, so every fit entry
-    point swaps them via one static argument."""
+    marginal NLL (default, the reference's objective), the negative LOO
+    log pseudo-likelihood (R&W eq. 5.13, ``models/loo.py``), or the
+    negative Titsias collapsed ELBO (``models/sgpr.py``).  Uniform
+    signature ``(kernel, theta, data, *extra) -> scalar`` — ``extra`` is
+    empty for the first two and ``(active, sigma2)`` for the ELBO — so
+    every fit entry point swaps them via one static argument plus one
+    traced operand tuple."""
     if objective == "marginal":
-        return batched_nll
+        return lambda kernel, theta, data, *extra: batched_nll(
+            kernel, theta, data
+        )
     if objective == "loo":
         from spark_gp_tpu.models.loo import batched_loo_nll
 
-        return batched_loo_nll
+        return lambda kernel, theta, data, *extra: batched_loo_nll(
+            kernel, theta, data
+        )
+    if objective == "elbo":
+        from spark_gp_tpu.models.sgpr import batched_elbo_nll
+
+        return batched_elbo_nll
     raise ValueError(
-        f"unknown objective {objective!r}; expected 'marginal' or 'loo'"
+        f"unknown objective {objective!r}; "
+        "expected 'marginal', 'loo' or 'elbo'"
     )
 
 
 @partial(jax.jit, static_argnums=0, static_argnames=("objective",))
-def _vag_impl(kernel: Kernel, theta, x, y, mask, *, objective="marginal"):
+def _vag_impl(
+    kernel: Kernel, theta, x, y, mask, extra=(), *, objective="marginal"
+):
     data = ExpertData(x=x, y=y, mask=mask)
     obj = objective_fn(objective)
-    return jax.value_and_grad(lambda t: obj(kernel, t, data))(theta)
+    return jax.value_and_grad(lambda t: obj(kernel, t, data, *extra))(theta)
 
 
 def make_value_and_grad(
-    kernel: Kernel, data: ExpertData, objective: str = "marginal"
+    kernel: Kernel, data: ExpertData, objective: str = "marginal", extra=()
 ):
     """Single-device jitted ``theta -> (nll, grad)``.
 
@@ -109,7 +141,8 @@ def make_value_and_grad(
     def vag(theta):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
         return _vag_impl(
-            kernel, theta, data.x, data.y, data.mask, objective=objective
+            kernel, theta, data.x, data.y, data.mask, extra,
+            objective=objective,
         )
 
     return vag
@@ -119,6 +152,7 @@ def _make_sharded_vag(kernel: Kernel, mesh, objective: str = "marginal"):
     """shard_map'd ``(theta, x, y, mask) -> (nll, grad)`` core, reusable
     inside larger jitted programs (the one-dispatch fits, the segmented
     checkpointing loop)."""
+    _require_shard_map_support(objective)
 
     @partial(
         jax.shard_map,
@@ -176,7 +210,7 @@ def make_sharded_value_and_grad(
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective",))
 def fit_gpr_device(
     kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter,
-    tol, *, objective="marginal",
+    tol, extra=(), *, objective="marginal",
 ):
     """Single-chip on-device fit: objective + projected L-BFGS in one XLA
     program.  Returns (theta_opt, final_nll, n_iter, n_fev, stalled)."""
@@ -189,7 +223,9 @@ def fit_gpr_device(
     obj = objective_fn(objective)
 
     def vag(theta, aux):
-        value, grad = jax.value_and_grad(lambda t: obj(kernel, t, data))(theta)
+        value, grad = jax.value_and_grad(
+            lambda t: obj(kernel, t, data, *extra)
+        )(theta)
         return value, grad, aux
 
     if log_space:
@@ -206,7 +242,7 @@ def fit_gpr_device(
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective",))
 def fit_gpr_device_multistart(
     kernel: Kernel, log_space, theta0_batch, lower, upper, x, y, mask,
-    max_iter, tol, *, objective="marginal",
+    max_iter, tol, extra=(), *, objective="marginal",
 ):
     """Multi-start single-chip fit: the R restarts run as ONE vmapped
     on-device L-BFGS program (optimize/lbfgs_device.py multistart docs) and
@@ -219,7 +255,9 @@ def fit_gpr_device_multistart(
     obj = objective_fn(objective)
 
     def vag(theta, aux):
-        value, grad = jax.value_and_grad(lambda t: obj(kernel, t, data))(theta)
+        value, grad = jax.value_and_grad(
+            lambda t: obj(kernel, t, data, *extra)
+        )(theta)
         return value, grad, aux
 
     theta, _, f, n_iter, n_fev, stalled, f_all, best = multistart_minimize(
@@ -233,18 +271,21 @@ def fit_gpr_device_multistart(
 
 
 def _gpr_segment_vag(
-    kernel: Kernel, mesh, log_space, data: ExpertData, objective="marginal"
+    kernel: Kernel, mesh, log_space, data: ExpertData, objective="marginal",
+    extra=(),
 ):
     """The (possibly sharded, possibly log-space) objective used by the
-    segmented fit — identical math to the one-dispatch fits above."""
+    segmented fit — identical math to the one-dispatch fits above.  The
+    ELBO rides jit/GSPMD rather than shard_map (see models/sgpr.py), so
+    its mesh variant is the mesh=None build over sharded arrays."""
     from spark_gp_tpu.optimize.lbfgs_device import log_transform_vag
 
-    if mesh is None:
+    if mesh is None or objective == "elbo":
         obj = objective_fn(objective)
 
         def base(theta, aux):
             value, grad = jax.value_and_grad(
-                lambda t: obj(kernel, t, data)
+                lambda t: obj(kernel, t, data, *extra)
             )(theta)
             return value, grad, aux
 
@@ -261,14 +302,14 @@ def _gpr_segment_vag(
 @partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective",))
 def gpr_device_segment_init(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    *, objective="marginal",
+    extra=(), *, objective="marginal",
 ):
     """One objective evaluation -> the optimizer's carried state (the
     checkpoint unit)."""
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
     data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective)
+    vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective, extra)
     t0 = jnp.log(theta0) if log_space else theta0
     return lbfgs_init_state(vag, t0, jnp.zeros((), theta0.dtype))
 
@@ -276,7 +317,7 @@ def gpr_device_segment_init(
 @partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective",))
 def gpr_device_segment_run(
     kernel: Kernel, mesh, log_space, state, lower, upper, x, y, mask,
-    iter_limit, tol, *, objective="marginal",
+    iter_limit, tol, extra=(), *, objective="marginal",
 ):
     """Advance the device L-BFGS to ``iter_limit`` total iterations (one
     compiled program, reused for every segment — iter_limit is traced)."""
@@ -286,7 +327,7 @@ def gpr_device_segment_run(
     )
 
     data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective)
+    vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective, extra)
     lo, hi = (
         log_transform_bounds(lower, upper) if log_space else (lower, upper)
     )
@@ -296,6 +337,7 @@ def gpr_device_segment_run(
 def fit_gpr_device_checkpointed(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, data: ExpertData,
     max_iter: int, tol, chunk: int, saver, objective: str = "marginal",
+    extra=(),
 ):
     """On-device fit in K-iteration segments with state persistence.
 
@@ -310,20 +352,33 @@ def fit_gpr_device_checkpointed(
     from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
     # the objective participates in the resume fingerprint: a checkpoint
-    # from a marginal-NLL fit must never silently seed a LOO fit
+    # from a marginal-NLL fit must never silently seed a LOO fit — and
+    # for the ELBO the whole objective SURFACE (inducing set + sigma2)
+    # must match, or a state optimal for a different bound resumes
     family = "gpr" if objective == "marginal" else f"gpr-{objective}"
+    import numpy as np
+
+    extra_meta = {
+        f"objective_extra_{i}": [float(v) for v in np.asarray(e).ravel()]
+        for i, e in enumerate(extra)
+    }
     meta = segment_meta(
-        family, kernel, tol, log_space, theta0, data.x, data.y, data.mask
+        family, kernel, tol, log_space, theta0, data.x, data.y, data.mask,
+        **extra_meta,
     )
-    init = partial(
-        gpr_device_segment_init, kernel, mesh, log_space, objective=objective
-    )
+    def init(theta0_, lower_, upper_, x_, y_, mask_):
+        return gpr_device_segment_init(
+            kernel, mesh, log_space, theta0_, lower_, upper_, x_, y_, mask_,
+            extra, objective=objective,
+        )
+
     tol_arr = jnp.asarray(tol, theta0.dtype)
 
     def run(state, limit):
         return gpr_device_segment_run(
             kernel, mesh, log_space, state, lower, upper,
-            data.x, data.y, data.mask, limit, tol_arr, objective=objective,
+            data.x, data.y, data.mask, limit, tol_arr, extra,
+            objective=objective,
         )
 
     theta, state = run_segmented(
@@ -346,6 +401,8 @@ def fit_gpr_device_sharded(
         lbfgs_minimize_device,
         log_reparam,
     )
+
+    _require_shard_map_support(objective)
 
     @partial(
         jax.shard_map,

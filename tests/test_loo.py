@@ -222,7 +222,7 @@ def test_loo_objective_host_and_device_optimizers_agree(rng):
 
 def test_set_objective_validates():
     with pytest.raises(ValueError, match="unknown objective"):
-        GaussianProcessRegression().setObjective("elbo")
+        GaussianProcessRegression().setObjective("evidence")
 
 
 def test_loo_objective_checkpoints_isolated_from_marginal(rng, tmp_path):
