@@ -63,38 +63,44 @@ def batched_elbo_nll(kernel: Kernel, theta, data, active, sigma2):
     """
     m = active.shape[0]
     sigma2 = jnp.asarray(sigma2, dtype=data.x.dtype)
+    sigma = jnp.sqrt(sigma2)
+
+    # Replicated [m, m] factor first: the per-expert statistics below are
+    # accumulated in the WHITENED domain a_e = L^-1 K_me / sigma.  Summing
+    # a_e a_e^T keeps B = I + sum PSD by construction — whiten-then-square.
+    # (Square-then-whiten, i.e. L^-1 U1 L^-T from the PPA's U1 statistic,
+    # carries the normal equations' squared conditioning: in float32 its
+    # solve noise exceeds B's unit eigenvalue floor and chol(B) NaNs — the
+    # same conditioning hazard models/common.py documents for the f64 PPA
+    # build, solved there by precision and here by formulation.)
+    kmm = kernel.gram(theta, active)
+    jitter = 1e-6 * jnp.mean(jnp.diagonal(kmm))
+    chol_l = jnp.linalg.cholesky(kmm + jitter * jnp.eye(m, dtype=kmm.dtype))
 
     # --- global statistics: linear sums over the (shardable) expert axis
     def per_expert(xe, ye, me):
         kme = kernel.cross(theta, active, xe) * me[None, :]  # [m, s]
+        ae = (
+            jax.scipy.linalg.solve_triangular(chol_l, kme, lower=True)
+            / sigma
+        )  # [m, s] whitened
         yem = ye * me
         return (
-            kme @ kme.T,                                    # [m, m]
-            kme @ yem,                                      # [m]
+            ae @ ae.T,                                      # [m, m]
+            ae @ (yem / sigma),                             # [m]
             jnp.sum(yem * yem),
             jnp.sum(kernel.self_diag(theta, xe) * me),
             jnp.sum(me),
         )
 
-    u1, u2, yy, tr_knn, n = jax.tree.map(
+    aat, ay, yy, tr_knn, n = jax.tree.map(
         lambda s: jnp.sum(s, axis=0),
         jax.vmap(per_expert)(data.x, data.y, data.mask),
     )
 
-    # --- replicated [m, m] algebra
-    kmm = kernel.gram(theta, active)
-    jitter = 1e-6 * jnp.mean(jnp.diagonal(kmm))
-    chol_l = jnp.linalg.cholesky(kmm + jitter * jnp.eye(m, dtype=kmm.dtype))
-    # AAT = L^-1 U1 L^-T / sigma2
-    w = jax.scipy.linalg.solve_triangular(chol_l, u1, lower=True)
-    aat = (
-        jax.scipy.linalg.solve_triangular(chol_l, w.T, lower=True).T / sigma2
-    )
     b = jnp.eye(m, dtype=aat.dtype) + aat
     chol_b = jnp.linalg.cholesky(b)
-    # c = L_B^-1 L^-1 u2 / sigma2
-    lu2 = jax.scipy.linalg.solve_triangular(chol_l, u2, lower=True)
-    c = jax.scipy.linalg.solve_triangular(chol_b, lu2, lower=True) / sigma2
+    c = jax.scipy.linalg.solve_triangular(chol_b, ay, lower=True)
 
     log_det_b = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol_b)))
     elbo = (

@@ -281,3 +281,41 @@ def test_elbo_rejects_greedy_without_white_noise(rng):
     gp2 = _mk().setActiveSetProvider(GreedilyOptimizingActiveSetProvider())
     model = gp2.fit(x, y)
     assert np.isfinite(model.instr.metrics["final_nll"])
+
+
+def test_elbo_finite_in_float32(rng):
+    """The f32 hazard that motivated the whitened formulation: on a
+    kmeans-selected inducing set over clustered data, the objective and
+    its gradient must stay finite in float32 at the init theta (the
+    square-then-whiten formulation NaN'd here)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_gp_tpu import KMeansActiveSetProvider
+
+    from spark_gp_tpu.data import make_synthetics
+
+    x, y = make_synthetics(n=1500)
+    gp = (
+        GaussianProcessRegression()
+        .setDatasetSizeForExpert(100)
+        .setActiveSetProvider(KMeansActiveSetProvider())
+        .setActiveSetSize(100)
+        .setSigma2(1e-2)
+        .setSeed(13)
+        .setObjective("elbo")
+        .setKernel(lambda: 1.0 * RBFKernel(0.1, 1e-6, 10))
+    )
+    kernel = gp._get_kernel()
+    data32 = group_for_experts(x, y, 100, dtype=np.float32)
+    active = gp._select_active(kernel, kernel.init_theta(), x, lambda: y, data32)
+    theta32 = jnp.asarray(kernel.init_theta(), dtype=jnp.float32)
+    active32 = jnp.asarray(active, dtype=jnp.float32)
+
+    f = lambda t: batched_elbo_nll(
+        kernel, t, data32, active32, np.float32(1e-2)
+    )
+    v, g = jax.value_and_grad(f)(theta32)
+    assert v.dtype == jnp.float32
+    assert np.isfinite(float(v))
+    assert np.all(np.isfinite(np.asarray(g)))
