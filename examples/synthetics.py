@@ -33,6 +33,12 @@ from spark_gp_tpu.utils.validation import cross_validate, rmse
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--folds", type=int, default=10)
+    parser.add_argument(
+        "--objective", choices=("marginal", "loo", "elbo"), default="marginal",
+        help="training objective: the reference's marginal NLL, the LOO "
+        "pseudo-likelihood (R&W 5.4.2), or the Titsias SGPR bound — all "
+        "three clear the 0.11 bar (quality.py part 'objectives')",
+    )
     args = parser.parse_args()
 
     # never wedge on a half-dead accelerator tunnel: probe the default
@@ -41,14 +47,26 @@ def main():
 
     x, y = make_synthetics()
 
+    if args.objective == "elbo":
+        # sigma2 is the likelihood noise under the bound; no stacked
+        # trainable nugget (models/sgpr.py kernel note)
+        kernel_factory = lambda: 1.0 * RBFKernel(0.1, 1e-6, 10)
+        sigma2 = 1e-2
+    else:
+        kernel_factory = lambda: (
+            1.0 * RBFKernel(0.1, 1e-6, 10) + WhiteNoiseKernel(0.5, 0, 1)
+        )
+        sigma2 = 1e-3
+
     gp = (
         GaussianProcessRegression()
-        .setKernel(lambda: 1.0 * RBFKernel(0.1, 1e-6, 10) + WhiteNoiseKernel(0.5, 0, 1))
+        .setKernel(kernel_factory)
         .setDatasetSizeForExpert(100)
         .setActiveSetProvider(KMeansActiveSetProvider())
         .setActiveSetSize(100)
         .setSeed(13)
-        .setSigma2(1e-3)
+        .setSigma2(sigma2)
+        .setObjective(args.objective)
     )
 
     score = cross_validate(gp, x, y, num_folds=args.folds, metric=rmse, seed=13)
