@@ -274,3 +274,32 @@ def test_gpc_fit_distributed_with_greedy_provider():
             .fit_distributed(data)
         )
     assert accuracy(y, model.predict(x)) >= 0.9
+
+
+def test_fit_distributed_elbo_objective():
+    """setObjective('elbo') through fit_distributed: the provider selects
+    the inducing set from the sharded stack up front (no host holds the
+    rows), the GSPMD objective trains over the mesh, and the same set
+    builds the PPA model."""
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(400, 3))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=400)
+    mesh = dist.global_expert_mesh()
+    data = dist.distribute_global_experts(x, y, 50, mesh)
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(60)
+        .setMaxIter(15)
+        .setSigma2(1e-2)
+        .setObjective("elbo")
+        .setMesh(mesh)
+        .fit_distributed(data)
+    )
+    pred = model.predict(x)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    assert rmse < 0.2
+    assert np.isfinite(model.instr.metrics["final_nll"])
+    assert model.raw_predictor.active.shape == (60, 3)
