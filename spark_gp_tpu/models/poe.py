@@ -194,6 +194,11 @@ class PoEPredictor:
         # predictions later
         check_pd_status(jnp.all(is_pd(self._chol)))
 
+    # per-chunk element budget for the [E*s, t_chunk] cross-kernel /
+    # solve intermediates — bounds device memory at ANY test-set size
+    # (the same streaming contract as the PPA predictor)
+    _PREDICT_CHUNK_ELEMS = 4_000_000
+
     def predict(self, x_test: np.ndarray) -> np.ndarray:
         return self.predict_with_var(x_test)[0]
 
@@ -201,17 +206,36 @@ class PoEPredictor:
         x_test = jnp.asarray(
             np.asarray(x_test), dtype=self.data.x.dtype
         )
+        t = x_test.shape[0]
+        rows = max(1, self.data.num_experts * self.data.expert_size)
+        chunk = max(1, self._PREDICT_CHUNK_ELEMS // rows)
+        if t <= chunk:
+            mean, var = self._predict_block(x_test)
+            return np.asarray(mean), np.asarray(var)
+        # fixed chunk shape (last chunk padded) -> one compiled executable
+        means, vars_ = [], []
+        for start in range(0, t, chunk):
+            part = x_test[start : start + chunk]
+            pad = chunk - part.shape[0]
+            if pad:
+                part = jnp.concatenate(
+                    [part, jnp.broadcast_to(part[:1], (pad, part.shape[1]))]
+                )
+            mean, var = self._predict_block(part)
+            means.append(np.asarray(mean[: chunk - pad] if pad else mean))
+            vars_.append(np.asarray(var[: chunk - pad] if pad else var))
+        return np.concatenate(means), np.concatenate(vars_)
+
+    def _predict_block(self, x_test):
         if self.mesh is not None:
-            mean, var = _predict_sharded_impl(
+            return _predict_sharded_impl(
                 self.kernel, self.mode, self.mesh, self.theta, self.data.x,
                 self.data.mask, self._chol, self._alpha, x_test,
             )
-        else:
-            mean, var = _predict_impl(
-                self.kernel, self.mode, self.theta, self.data.x,
-                self.data.mask, self._chol, self._alpha, x_test,
-            )
-        return np.asarray(mean), np.asarray(var)
+        return _predict_impl(
+            self.kernel, self.mode, self.theta, self.data.x,
+            self.data.mask, self._chol, self._alpha, x_test,
+        )
 
 
 def make_poe_predictor(

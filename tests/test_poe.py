@@ -202,3 +202,20 @@ def test_sharded_poe_matches_single_device(rng, eight_device_mesh, mode):
     m2, v2 = sharded.predict_with_var(x_test)
     np.testing.assert_allclose(m2, m1, rtol=1e-10)
     np.testing.assert_allclose(v2, v1, rtol=1e-10)
+
+
+def test_poe_predict_streams_large_test_sets(rng):
+    """The chunked predict path (forced tiny chunk) must agree exactly
+    with one-block prediction — bounded memory at any test-set size."""
+    x = rng.normal(size=(40, 2))
+    y = np.sin(x.sum(axis=1))
+    x_test = rng.normal(size=(57, 2))
+    pred = make_poe_predictor(
+        _make_kernel(), _make_kernel().init_theta(), x, y, 10
+    )
+    m1, v1 = pred.predict_with_var(x_test)
+
+    pred._PREDICT_CHUNK_ELEMS = 40 * 7  # -> 7-point chunks, ragged tail
+    m2, v2 = pred.predict_with_var(x_test)
+    np.testing.assert_allclose(m2, m1, rtol=1e-12)
+    np.testing.assert_allclose(v2, v1, rtol=1e-12)
