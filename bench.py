@@ -405,12 +405,18 @@ def worker() -> None:
 
     from spark_gp_tpu import (
         GaussianProcessClassifier,
+        GaussianProcessEPClassifier,
         GaussianProcessMulticlassClassifier,
     )
 
     yc = (y[:gpc_n] > np.median(y[:gpc_n])).astype(np.float64)
     gpc_seconds, gpc_error = _classifier_fit_seconds(
         GaussianProcessClassifier, yc
+    )
+    # EP engine at the same shape: the probit inference alternative —
+    # its damped-sweep inner loop is the second novel expensive path
+    gpc_ep_seconds, gpc_ep_error = _classifier_fit_seconds(
+        GaussianProcessEPClassifier, yc
     )
     # Native multiclass (softmax Laplace) at the same shape: 3 quantile-
     # bucket classes — C per-class factorizations per Newton iteration,
@@ -514,6 +520,11 @@ def worker() -> None:
                 None if gpc_seconds is None else gpc_n / gpc_seconds
             ),
             **({"gpc_error": gpc_error} if gpc_error else {}),
+            "gpc_ep_fit_seconds": gpc_ep_seconds,
+            "gpc_ep_train_points_per_sec": (
+                None if gpc_ep_seconds is None else gpc_n / gpc_ep_seconds
+            ),
+            **({"gpc_ep_error": gpc_ep_error} if gpc_ep_error else {}),
             "gpc_mc_fit_seconds": gpc_mc_seconds,
             "gpc_mc_train_points_per_sec": (
                 None if gpc_mc_seconds is None else gpc_n / gpc_mc_seconds
