@@ -46,6 +46,7 @@ from spark_gp_tpu.kernels import (
     ProductKernel,
     PolynomialKernel,
     RationalQuadraticKernel,
+    SpectralMixtureKernel,
     RBFKernel,
     Scalar,
     SumKernel,
@@ -96,6 +97,7 @@ __all__ = [
     "PeriodicKernel",
     "DotProductKernel",
     "PolynomialKernel",
+    "SpectralMixtureKernel",
     "EyeKernel",
     "WhiteNoiseKernel",
     "SumKernel",

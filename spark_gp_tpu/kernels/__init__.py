@@ -23,6 +23,7 @@ from spark_gp_tpu.kernels.families import (
     PeriodicKernel,
     PolynomialKernel,
     RationalQuadraticKernel,
+    SpectralMixtureKernel,
 )
 from spark_gp_tpu.kernels.matern import (
     ARDMatern32Kernel,
@@ -56,4 +57,5 @@ __all__ = [
     "PeriodicKernel",
     "DotProductKernel",
     "PolynomialKernel",
+    "SpectralMixtureKernel",
 ]

@@ -637,6 +637,7 @@ def test_every_family_describes_itself(rng):
         PolynomialKernel,
         RationalQuadraticKernel,
         RBFKernel,
+        SpectralMixtureKernel,
         WhiteNoiseKernel,
     )
     from spark_gp_tpu.kernels.base import ThetaOverrideKernel
@@ -653,6 +654,7 @@ def test_every_family_describes_itself(rng):
         PeriodicKernel(1.3, 0.9),
         DotProductKernel(0.7),
         PolynomialKernel(3, 1.2),
+        SpectralMixtureKernel(2, 2),
         1.0 * RBFKernel(0.5) + WhiteNoiseKernel(0.1, 0, 1),
         RBFKernel(2.0) * PeriodicKernel(1.0),
         Const(0.5) * EyeKernel(),
@@ -665,3 +667,106 @@ def test_every_family_describes_itself(rng):
         # at least not crash, and non-Eye kernels must be non-empty
         if not isinstance(k, type(Const(0.5) * EyeKernel())):
             assert len(desc) > 0, type(k).__name__
+
+
+# --- SpectralMixtureKernel (Wilson & Adams '13) ------------------------------
+
+
+def test_spectral_mixture_matches_literal_formula(rng):
+    from spark_gp_tpu import SpectralMixtureKernel
+
+    p, q = 2, 3
+    k = SpectralMixtureKernel(p, q)
+    theta = np.asarray(k.init_theta()) * (1 + 0.3 * rng.random(k.n_hypers))
+    xa = rng.normal(size=(6, p))
+    xb = rng.normal(size=(5, p))
+
+    got = np.asarray(k.cross(jnp.asarray(theta), jnp.asarray(xa), jnp.asarray(xb)))
+    w = theta[:q]
+    mu = theta[q:q + q * p].reshape(q, p)
+    v = theta[q + q * p:].reshape(q, p)
+    expect = np.zeros((6, 5))
+    for i in range(6):
+        for j in range(5):
+            tau = xa[i] - xb[j]
+            for c in range(q):
+                expect[i, j] += w[c] * np.prod(
+                    np.exp(-2 * np.pi**2 * tau**2 * v[c])
+                    * np.cos(2 * np.pi * tau * mu[c])
+                )
+    np.testing.assert_allclose(got, expect, rtol=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(k.diag(jnp.asarray(theta), jnp.asarray(xa))), w.sum()
+    )
+
+
+def test_spectral_mixture_q1_mu0_is_ard_rbf(rng):
+    """Q=1, mu=0: k = w exp(-2 pi^2 sum_d tau_d^2 v_d) — the ARD RBF with
+    beta_d = sqrt(2) pi sqrt(v_d) (reference convention: beta multiplies,
+    gram = exp(-sum (beta_d tau_d)^2))."""
+    from spark_gp_tpu import ARDRBFKernel, SpectralMixtureKernel
+
+    p = 3
+    v = np.array([0.4, 1.0, 2.5])
+    sm = SpectralMixtureKernel(
+        p, 1, weights=[1.0], means=np.zeros((1, p)), scales=v[None, :]
+    )
+    beta = np.sqrt(2.0) * np.pi * np.sqrt(v)
+    ard = ARDRBFKernel(beta)
+    x = rng.normal(size=(8, p)) * 0.2
+
+    g_sm = np.asarray(sm.gram(jnp.asarray(sm.init_theta()), jnp.asarray(x)))
+    g_ard = np.asarray(ard.gram(jnp.asarray(ard.init_theta()), jnp.asarray(x)))
+    np.testing.assert_allclose(g_sm, g_ard, rtol=1e-6, atol=1e-9)
+
+
+def test_spectral_mixture_fd_gradients(rng):
+    from spark_gp_tpu import SpectralMixtureKernel
+
+    k = SpectralMixtureKernel(2, 2)
+    x = rng.normal(size=(7, 2))
+    y = rng.normal(size=7)
+    theta0 = np.asarray(k.init_theta()) * (1 + 0.2 * rng.random(k.n_hypers))
+
+    def functional(t):
+        g = k.gram(jnp.asarray(t), jnp.asarray(x))
+        return float(y @ np.asarray(g) @ y)
+
+    grad = np.asarray(
+        jax.grad(
+            lambda t: jnp.asarray(y) @ k.gram(t, jnp.asarray(x)) @ jnp.asarray(y)
+        )(jnp.asarray(theta0))
+    )
+    fd = _fd_grad(functional, theta0)
+    np.testing.assert_allclose(grad, fd, rtol=1e-5, atol=1e-7)
+
+
+def test_spectral_mixture_psd_and_fit(rng):
+    """Gram PSD on random inputs; a 1-D periodic-plus-trend signal fits
+    through the estimator end-to-end and interpolates well."""
+    from spark_gp_tpu import GaussianProcessRegression, SpectralMixtureKernel
+
+    k = SpectralMixtureKernel(1, 2)
+    x = rng.normal(size=(40, 1))
+    g = np.asarray(k.gram(jnp.asarray(k.init_theta()), jnp.asarray(x)))
+    eigs = np.linalg.eigvalsh(0.5 * (g + g.T))
+    assert eigs.min() > -1e-8
+
+    xs = np.linspace(0, 4, 120)[:, None]
+    ys = np.cos(2 * np.pi * 1.5 * xs[:, 0]) + 0.05 * rng.normal(size=120)
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(
+            lambda: 1.0 * SpectralMixtureKernel(
+                1, 2, means=np.array([[0.5], [1.5]])
+            )
+        )
+        .setDatasetSizeForExpert(60)
+        .setActiveSetSize(40)
+        .setSigma2(1e-3)
+        .setSeed(3)
+        .setMaxIter(60)
+    )
+    model = gp.fit(xs, ys)
+    pred = model.predict(xs)
+    assert np.sqrt(np.mean((pred - ys) ** 2)) < 0.2
