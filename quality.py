@@ -41,6 +41,10 @@ Parts:
                  head-to-head on held-out synthetics: RMSE + NLPD per
                  objective; every objective must clear the example's
                  RMSE bar (none is allowed to be broken)
+  spectral_mixture  pattern extrapolation: an SM kernel + batched
+                 multi-start must extrapolate a two-frequency signal a
+                 full period past the data (asserted < 0.1 RMSE) where
+                 the RBF kernel reverts to the mean (~0.8, recorded)
   weak_scaling   1/2/4/8 virtual CPU devices, fixed per-device load, the
                  sharded device-L-BFGS fit (records the curve's shape; on a
                  shared-core host this tracks compile/exec health, not true
@@ -61,7 +65,7 @@ import time
 _ALL_PARTS = (
     "airfoil", "iris", "iris_native_mc", "iris_ep", "poisson", "gpc_mnist",
     "protein", "year_msd", "greedy_scale", "greedy_vs_random", "loo",
-    "objectives", "weak_scaling", "pallas_sweep",
+    "objectives", "spectral_mixture", "weak_scaling", "pallas_sweep",
 )
 
 
@@ -622,6 +626,75 @@ def part_objectives() -> dict:
         **out,
         "bar": bar,
         "passed": bool(passed),
+        "seconds": time.perf_counter() - start,
+    }
+
+
+def part_spectral_mixture() -> dict:
+    """Pattern extrapolation (Wilson & Adams '13, the SM kernel's raison
+    d'etre): train on three periods of a two-frequency signal, predict a
+    full period PAST the data.  The SM kernel with batched device
+    multi-start recovers the spectral peaks and extrapolates to the noise
+    floor (asserted); the RBF kernel — the best the reference could field
+    — reverts to the prior mean (recorded as the contrast).  Also the
+    demonstrated payoff of the one-dispatch vmapped multi-start: restart 0
+    alone lands in a local optimum at ~0.79 RMSE."""
+    _assert_platform()
+    import numpy as np
+
+    from spark_gp_tpu import (
+        GaussianProcessRegression, RBFKernel, SpectralMixtureKernel,
+        WhiteNoiseKernel,
+    )
+    from spark_gp_tpu.utils.validation import rmse
+
+    rng = np.random.default_rng(0)
+    xs = np.linspace(0, 3, 240)[:, None]
+    xe = np.linspace(3, 4, 60)[:, None]
+
+    def f(x):
+        return (
+            np.cos(2 * np.pi * 1.0 * x[:, 0])
+            + 0.5 * np.cos(2 * np.pi * 2.6 * x[:, 0])
+        )
+
+    ys = f(xs) + 0.03 * rng.normal(size=240)
+    ye = f(xe)
+
+    def fit(kernel_factory, restarts):
+        return (
+            GaussianProcessRegression()
+            .setKernel(kernel_factory)
+            .setDatasetSizeForExpert(120)
+            .setActiveSetSize(100)
+            .setSigma2(1e-3)
+            .setSeed(3)
+            .setMaxIter(150)
+            .setNumRestarts(restarts)
+            .fit(xs, ys)
+        )
+
+    start = time.perf_counter()
+    sm = fit(
+        lambda: 1.0 * SpectralMixtureKernel(
+            1, 3, means=np.array([[0.8], [2.0], [3.0]])
+        ) + WhiteNoiseKernel(0.05, 0, 1),
+        8,
+    )
+    sm_rmse = float(rmse(ye, sm.predict(xe)))
+    rbf = fit(
+        lambda: 1.0 * RBFKernel(1.0, 1e-3, 100)
+        + WhiteNoiseKernel(0.05, 0, 1),
+        8,
+    )
+    rbf_rmse = float(rmse(ye, rbf.predict(xe)))
+    return {
+        "sm_extrapolation_rmse": sm_rmse,
+        "rbf_extrapolation_rmse": rbf_rmse,
+        "signal_std": float(np.std(ye)),
+        "noise_std": 0.03,
+        "bar": 0.1,
+        "passed": bool(sm_rmse < 0.1),
         "seconds": time.perf_counter() - start,
     }
 
