@@ -229,10 +229,19 @@ def worker() -> None:
     # invocations means any earlier successful run (same shapes) makes this
     # one start hot — the difference between landing a number inside a brief
     # tunnel-uptime window and blowing the watchdog (VERDICT r3 weak #1).
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-    )
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir is None:
+        # machine-fingerprinted: XLA CPU AOT entries are not portable
+        # across CPU generations — a cache written by a previous round on
+        # different hardware must never be loaded here (it segfaults;
+        # utils/platform.machine_cache_dir rationale)
+        from spark_gp_tpu.utils.platform import machine_cache_dir
+
+        cache_dir = machine_cache_dir(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+            )
+        )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

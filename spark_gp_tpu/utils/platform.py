@@ -152,3 +152,35 @@ def backends_already_initialized() -> bool:
         return bool(xla_bridge.backends_are_initialized())
     except Exception:
         return False
+
+
+def machine_cache_dir(base: str) -> str:
+    """``base`` extended with a host-machine fingerprint, for use as a
+    persistent XLA compilation-cache directory.
+
+    XLA's CPU backend persists ahead-of-time executables whose cache key
+    does NOT include the host's CPU feature set; loading an entry written
+    on a different CPU generation warns ``Target machine feature ... is
+    not supported on the host machine`` and can SIGILL/segfault outright
+    (observed: a cache written on an avx512+amx host crashed the test
+    suite on a smaller host mid-``pjit``).  Keying the directory by a
+    digest of the CPU model + feature flags makes every machine read only
+    its own entries; stale directories from other machines are left
+    behind, never loaded.
+    """
+    import hashlib
+    import platform as _platform
+
+    h = hashlib.sha1()
+    h.update(_platform.machine().encode())
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("model name", "flags")):
+                    h.update(line.encode())
+                    # one physical CPU is enough; flags repeat per core
+                    if line.startswith("flags"):
+                        break
+    except OSError:
+        h.update(_platform.processor().encode())
+    return f"{base}-{h.hexdigest()[:12]}"

@@ -43,9 +43,18 @@ import jax
 if _PLATFORM == "cpu":
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
-# Persistent compile cache: repeated test runs skip recompilation.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Persistent compile cache: repeated test runs skip recompilation.  The
+# directory is keyed by a host-machine fingerprint: XLA's CPU AOT
+# executables are NOT portable across CPU generations, and loading a
+# cache written on a different host segfaulted the suite mid-pjit
+# (utils/platform.machine_cache_dir rationale).
+from spark_gp_tpu.utils.platform import machine_cache_dir
+
+if os.environ.get("GP_TEST_NO_COMPILE_CACHE") != "1":
+    jax.config.update(
+        "jax_compilation_cache_dir", machine_cache_dir("/tmp/jax_test_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np
 import pytest
@@ -66,6 +75,22 @@ if _PLATFORM == "tpu":
             ' hardware tests gate on default_backend() == "tpu" and cannot'
             " run against a differently-named backend."
         )
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_live_executables():
+    """Clear jax's in-process executable caches between test MODULES.
+
+    The full suite compiles ~350 distinct programs into one process; past
+    roughly 300 live XLA:CPU executables the next compile (or persistent-
+    cache load) segfaults inside XLA — reproducibly at the same test, with
+    and without the on-disk cache, with and without the ctypes native
+    loader, while any sub-suite passes alone.  Bounding the live count per
+    module keeps the process far from that ceiling; the machine-keyed
+    persistent cache (above) makes the post-clear reloads cheap.
+    """
+    yield
+    jax.clear_caches()
 
 
 def pytest_configure(config):
