@@ -1,0 +1,97 @@
+"""Pattern extrapolation with the spectral mixture kernel.
+
+Trains on three periods of a two-frequency signal and predicts a FULL
+PERIOD past the data — the task Wilson & Adams '13 built the SM kernel
+for, and one the reference's RBF family cannot do (it reverts to the
+prior mean outside the data; run with ``--rbf`` to see).  Multi-start
+matters: the SM likelihood is multimodal in the frequencies, and the
+batched device multi-start (all restarts in one vmapped dispatch) is
+what finds the spectral peaks.
+
+Run: python examples/timeseries.py [--restarts 8] [--rbf]
+Asserts extrapolation RMSE < 0.1 on the SM path (noise floor 0.03).
+"""
+
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+from spark_gp_tpu import (
+    GaussianProcessRegression,
+    RBFKernel,
+    SpectralMixtureKernel,
+    WhiteNoiseKernel,
+)
+from spark_gp_tpu.utils.validation import rmse
+
+# imported early (cheap); called in main() after argparse so --help and
+# bad-args invocations never pay the probe (utils/platform.py)
+from spark_gp_tpu.utils.platform import preflight_backend
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--restarts", type=int, default=8)
+    parser.add_argument(
+        "--rbf", action="store_true",
+        help="fit the RBF kernel instead (demonstrates the failure mode: "
+        "reverts to the mean outside the data, no assertion)",
+    )
+    args = parser.parse_args()
+
+    # never wedge on a half-dead accelerator tunnel: probe the default
+    # backend in a subprocess and fall back to CPU if it hangs
+    preflight_backend()
+
+    rng = np.random.default_rng(0)
+    xs = np.linspace(0, 3, 240)[:, None]
+    xe = np.linspace(3, 4, 60)[:, None]
+
+    def f(x):
+        return (
+            np.cos(2 * np.pi * 1.0 * x[:, 0])
+            + 0.5 * np.cos(2 * np.pi * 2.6 * x[:, 0])
+        )
+
+    ys = f(xs) + 0.03 * rng.normal(size=240)
+
+    if args.rbf:
+        kernel_factory = lambda: (
+            1.0 * RBFKernel(1.0, 1e-3, 100) + WhiteNoiseKernel(0.05, 0, 1)
+        )
+    else:
+        kernel_factory = lambda: (
+            1.0 * SpectralMixtureKernel(
+                1, 3, means=np.array([[0.8], [2.0], [3.0]])
+            )
+            + WhiteNoiseKernel(0.05, 0, 1)
+        )
+
+    model = (
+        GaussianProcessRegression()
+        .setKernel(kernel_factory)
+        .setDatasetSizeForExpert(120)
+        .setActiveSetSize(100)
+        .setSigma2(1e-3)
+        .setSeed(3)
+        .setMaxIter(150)
+        .setNumRestarts(args.restarts)
+        .fit(xs, ys)
+    )
+    score = rmse(f(xe), model.predict(xe))
+    which = "RBF" if args.rbf else "SM"
+    print(f"{which} extrapolation RMSE over (3, 4]: {score}")
+    if not args.rbf:
+        assert score < 0.1, "spectral peaks not recovered"
+        print("OK (< 0.1)")
+
+
+if __name__ == "__main__":
+    main()
