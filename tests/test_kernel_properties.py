@@ -41,6 +41,7 @@ from spark_gp_tpu import (
     RationalQuadraticKernel,
     RBFKernel,
     Scalar,
+    SpectralMixtureKernel,
     WhiteNoiseKernel,
 )
 
@@ -58,6 +59,12 @@ def _noise_free_leaf():
         st.builds(lambda s: Matern52Kernel(s), pos),
         st.builds(lambda p, l: PeriodicKernel(p, l), pos, pos),
         st.builds(lambda s, a: RationalQuadraticKernel(s, a), pos, pos),
+        st.builds(
+            lambda m1, m2: SpectralMixtureKernel(
+                P_DIM, 2, means=np.array([[m1] * P_DIM, [m2] * P_DIM])
+            ),
+            pos, pos,
+        ),
         st.builds(lambda s: DotProductKernel(s), pos),
     )
 
