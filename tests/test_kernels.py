@@ -770,3 +770,37 @@ def test_spectral_mixture_psd_and_fit(rng):
     model = gp.fit(xs, ys)
     pred = model.predict(xs)
     assert np.sqrt(np.mean((pred - ys) ** 2)) < 0.2
+
+
+def test_spectral_mixture_model_serialization_roundtrip(rng):
+    """A model fitted with an SM composite must save/load to identical
+    predictions (the spec-based kernel reconstruction is generic, but the
+    newest family locks the contract in)."""
+    import os
+    import tempfile
+
+    from spark_gp_tpu import (
+        GaussianProcessRegression, SpectralMixtureKernel, WhiteNoiseKernel,
+    )
+    from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
+
+    x = rng.normal(size=(60, 1))
+    y = np.sin(3 * x[:, 0])
+    m = (
+        GaussianProcessRegression()
+        .setKernel(
+            lambda: 1.0 * SpectralMixtureKernel(1, 2)
+            + WhiteNoiseKernel(0.05, 0, 1)
+        )
+        .setDatasetSizeForExpert(30)
+        .setActiveSetSize(20)
+        .setSigma2(1e-3)
+        .setSeed(1)
+        .setMaxIter(20)
+        .fit(x, y)
+    )
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "m.npz")
+        m.save(path)
+        m2 = GaussianProcessRegressionModel.load(path)
+    np.testing.assert_allclose(m2.predict(x), m.predict(x), rtol=1e-10)
