@@ -30,6 +30,32 @@ from spark_gp_tpu.data import make_synthetics
 from spark_gp_tpu.utils.validation import cross_validate, rmse
 
 
+def make_gp(objective: str = "marginal"):
+    """The Synthetics.scala:11-34 configuration, parameterized by the
+    training objective — SINGLE source for this example and the quality
+    parts that guard it (quality.py loo / objectives)."""
+    if objective == "elbo":
+        # sigma2 is the likelihood noise under the bound; no stacked
+        # trainable nugget (models/sgpr.py kernel note)
+        kernel_factory = lambda: 1.0 * RBFKernel(0.1, 1e-6, 10)
+        sigma2 = 1e-2
+    else:
+        kernel_factory = lambda: (
+            1.0 * RBFKernel(0.1, 1e-6, 10) + WhiteNoiseKernel(0.5, 0, 1)
+        )
+        sigma2 = 1e-3
+    return (
+        GaussianProcessRegression()
+        .setKernel(kernel_factory)
+        .setDatasetSizeForExpert(100)
+        .setActiveSetProvider(KMeansActiveSetProvider())
+        .setActiveSetSize(100)
+        .setSeed(13)
+        .setSigma2(sigma2)
+        .setObjective(objective)
+    )
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--folds", type=int, default=10)
@@ -46,28 +72,7 @@ def main():
     preflight_backend()
 
     x, y = make_synthetics()
-
-    if args.objective == "elbo":
-        # sigma2 is the likelihood noise under the bound; no stacked
-        # trainable nugget (models/sgpr.py kernel note)
-        kernel_factory = lambda: 1.0 * RBFKernel(0.1, 1e-6, 10)
-        sigma2 = 1e-2
-    else:
-        kernel_factory = lambda: (
-            1.0 * RBFKernel(0.1, 1e-6, 10) + WhiteNoiseKernel(0.5, 0, 1)
-        )
-        sigma2 = 1e-3
-
-    gp = (
-        GaussianProcessRegression()
-        .setKernel(kernel_factory)
-        .setDatasetSizeForExpert(100)
-        .setActiveSetProvider(KMeansActiveSetProvider())
-        .setActiveSetSize(100)
-        .setSeed(13)
-        .setSigma2(sigma2)
-        .setObjective(args.objective)
-    )
+    gp = make_gp(args.objective)
 
     score = cross_validate(gp, x, y, num_folds=args.folds, metric=rmse, seed=13)
     print("RMSE: " + str(score))

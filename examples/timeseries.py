@@ -36,6 +36,48 @@ from spark_gp_tpu.utils.validation import rmse
 from spark_gp_tpu.utils.platform import preflight_backend
 
 
+def signal(x):
+    """The two-frequency target — SINGLE source for this example and the
+    quality part that guards it (quality.py spectral_mixture)."""
+    return (
+        np.cos(2 * np.pi * 1.0 * x[:, 0])
+        + 0.5 * np.cos(2 * np.pi * 2.6 * x[:, 0])
+    )
+
+
+def make_data():
+    """(x_train, y_train, x_extrap, y_extrap): three periods in, one out."""
+    rng = np.random.default_rng(0)
+    xs = np.linspace(0, 3, 240)[:, None]
+    xe = np.linspace(3, 4, 60)[:, None]
+    return xs, signal(xs) + 0.03 * rng.normal(size=240), xe, signal(xe)
+
+
+def make_gp(kind: str = "sm", restarts: int = 8):
+    """``kind``: "sm" (spectral mixture) or "rbf" (the failure mode)."""
+    if kind == "rbf":
+        kernel_factory = lambda: (
+            1.0 * RBFKernel(1.0, 1e-3, 100) + WhiteNoiseKernel(0.05, 0, 1)
+        )
+    else:
+        kernel_factory = lambda: (
+            1.0 * SpectralMixtureKernel(
+                1, 3, means=np.array([[0.8], [2.0], [3.0]])
+            )
+            + WhiteNoiseKernel(0.05, 0, 1)
+        )
+    return (
+        GaussianProcessRegression()
+        .setKernel(kernel_factory)
+        .setDatasetSizeForExpert(120)
+        .setActiveSetSize(100)
+        .setSigma2(1e-3)
+        .setSeed(3)
+        .setMaxIter(150)
+        .setNumRestarts(restarts)
+    )
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--restarts", type=int, default=8)
@@ -50,42 +92,9 @@ def main():
     # backend in a subprocess and fall back to CPU if it hangs
     preflight_backend()
 
-    rng = np.random.default_rng(0)
-    xs = np.linspace(0, 3, 240)[:, None]
-    xe = np.linspace(3, 4, 60)[:, None]
-
-    def f(x):
-        return (
-            np.cos(2 * np.pi * 1.0 * x[:, 0])
-            + 0.5 * np.cos(2 * np.pi * 2.6 * x[:, 0])
-        )
-
-    ys = f(xs) + 0.03 * rng.normal(size=240)
-
-    if args.rbf:
-        kernel_factory = lambda: (
-            1.0 * RBFKernel(1.0, 1e-3, 100) + WhiteNoiseKernel(0.05, 0, 1)
-        )
-    else:
-        kernel_factory = lambda: (
-            1.0 * SpectralMixtureKernel(
-                1, 3, means=np.array([[0.8], [2.0], [3.0]])
-            )
-            + WhiteNoiseKernel(0.05, 0, 1)
-        )
-
-    model = (
-        GaussianProcessRegression()
-        .setKernel(kernel_factory)
-        .setDatasetSizeForExpert(120)
-        .setActiveSetSize(100)
-        .setSigma2(1e-3)
-        .setSeed(3)
-        .setMaxIter(150)
-        .setNumRestarts(args.restarts)
-        .fit(xs, ys)
-    )
-    score = rmse(f(xe), model.predict(xe))
+    xs, ys, xe, ye = make_data()
+    model = make_gp("rbf" if args.rbf else "sm", args.restarts).fit(xs, ys)
+    score = rmse(ye, model.predict(xe))
     which = "RBF" if args.rbf else "SM"
     print(f"{which} extrapolation RMSE over (3, 4]: {score}")
     if not args.rbf:

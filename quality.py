@@ -526,30 +526,13 @@ def part_loo() -> dict:
     factor-2 band of the true 10-fold CV RMSE (which refits per fold) and
     clear the example's 0.11 bar itself."""
     _assert_platform()
-    import numpy as np
+    from examples.synthetics import make_gp  # single source of the config
 
-    from spark_gp_tpu import (
-        GaussianProcessRegression, KMeansActiveSetProvider, RBFKernel,
-        WhiteNoiseKernel,
-    )
     from spark_gp_tpu.data import make_synthetics
     from spark_gp_tpu.utils.validation import cross_validate, rmse
 
     x, y = make_synthetics()
-
-    def mk():
-        return (
-            GaussianProcessRegression()
-            .setKernel(
-                lambda: 1.0 * RBFKernel(0.1, 1e-6, 10)
-                + WhiteNoiseKernel(0.5, 0, 1)
-            )
-            .setDatasetSizeForExpert(100)
-            .setActiveSetProvider(KMeansActiveSetProvider())
-            .setActiveSetSize(100)
-            .setSigma2(1e-3)
-            .setSeed(13)
-        )
+    mk = make_gp
 
     start = time.perf_counter()
     gp = mk()
@@ -578,37 +561,14 @@ def part_objectives() -> dict:
     _assert_platform()
     import numpy as np
 
-    from spark_gp_tpu import (
-        GaussianProcessRegression, KMeansActiveSetProvider, RBFKernel,
-        WhiteNoiseKernel,
-    )
+    from examples.synthetics import make_gp as mk  # single config source
+
     from spark_gp_tpu.data import make_synthetics
     from spark_gp_tpu.utils.validation import nlpd, rmse
 
     x, y = make_synthetics()
     perm = np.random.default_rng(5).permutation(len(y))
     tr, te = perm[:1500], perm[1500:]
-
-    def mk(objective):
-        gp = (
-            GaussianProcessRegression()
-            .setDatasetSizeForExpert(100)
-            .setActiveSetProvider(KMeansActiveSetProvider())
-            .setActiveSetSize(100)
-            .setSigma2(1e-3)
-            .setSeed(13)
-            .setObjective(objective)
-        )
-        if objective == "elbo":
-            # sigma2 is the likelihood noise under the bound; no stacked
-            # trainable nugget (models/sgpr.py kernel note)
-            return gp.setKernel(
-                lambda: 1.0 * RBFKernel(0.1, 1e-6, 10)
-            ).setSigma2(1e-2)
-        return gp.setKernel(
-            lambda: 1.0 * RBFKernel(0.1, 1e-6, 10)
-            + WhiteNoiseKernel(0.5, 0, 1)
-        )
 
     start = time.perf_counter()
     out, bar, passed = {}, 0.11, True
@@ -642,51 +602,16 @@ def part_spectral_mixture() -> dict:
     _assert_platform()
     import numpy as np
 
-    from spark_gp_tpu import (
-        GaussianProcessRegression, RBFKernel, SpectralMixtureKernel,
-        WhiteNoiseKernel,
-    )
+    from examples.timeseries import make_data, make_gp  # single source
+
     from spark_gp_tpu.utils.validation import rmse
 
-    rng = np.random.default_rng(0)
-    xs = np.linspace(0, 3, 240)[:, None]
-    xe = np.linspace(3, 4, 60)[:, None]
-
-    def f(x):
-        return (
-            np.cos(2 * np.pi * 1.0 * x[:, 0])
-            + 0.5 * np.cos(2 * np.pi * 2.6 * x[:, 0])
-        )
-
-    ys = f(xs) + 0.03 * rng.normal(size=240)
-    ye = f(xe)
-
-    def fit(kernel_factory, restarts):
-        return (
-            GaussianProcessRegression()
-            .setKernel(kernel_factory)
-            .setDatasetSizeForExpert(120)
-            .setActiveSetSize(100)
-            .setSigma2(1e-3)
-            .setSeed(3)
-            .setMaxIter(150)
-            .setNumRestarts(restarts)
-            .fit(xs, ys)
-        )
+    xs, ys, xe, ye = make_data()
 
     start = time.perf_counter()
-    sm = fit(
-        lambda: 1.0 * SpectralMixtureKernel(
-            1, 3, means=np.array([[0.8], [2.0], [3.0]])
-        ) + WhiteNoiseKernel(0.05, 0, 1),
-        8,
-    )
+    sm = make_gp("sm", 8).fit(xs, ys)
     sm_rmse = float(rmse(ye, sm.predict(xe)))
-    rbf = fit(
-        lambda: 1.0 * RBFKernel(1.0, 1e-3, 100)
-        + WhiteNoiseKernel(0.05, 0, 1),
-        8,
-    )
+    rbf = make_gp("rbf", 8).fit(xs, ys)
     rbf_rmse = float(rmse(ye, rbf.predict(xe)))
     return {
         "sm_extrapolation_rmse": sm_rmse,

@@ -242,10 +242,20 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                 from spark_gp_tpu.models.laplace_generic import (
                     fit_generic_device_checkpointed,
                 )
+                import hashlib
+
                 from spark_gp_tpu.utils.checkpoint import (
                     DeviceOptimizerCheckpointer,
                 )
 
+                # likelihood-keyed FILE tag: NB and Poisson fits (or two NB
+                # fits with different dispersions) sharing a dir must not
+                # clobber each other's resumable state — the same hazard
+                # gpr.py's objective-keyed file_tag closes for objectives
+                lik = self._likelihood
+                lik_digest = hashlib.sha1(
+                    repr((type(lik).__name__, lik._spec())).encode()
+                ).hexdigest()[:10]
                 theta, f_final, nll, n_iter, n_fev, stalled = (
                     fit_generic_device_checkpointed(
                         self._likelihood, kernel, float(self._tol),
@@ -253,7 +263,8 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         data.x, data.y, data.mask, self._max_iter,
                         self._checkpoint_interval,
                         DeviceOptimizerCheckpointer(
-                            self._checkpoint_dir, "poisson"
+                            self._checkpoint_dir,
+                            f"generic-{type(lik).__name__}-{lik_digest}",
                         ),
                     )
                 )

@@ -49,7 +49,9 @@ class GaussianProcessClassifier(GaussianProcessCommons):
     """Binary GP classifier with the reference's fluent parameter API."""
 
     def fit(self, x: np.ndarray, y: np.ndarray) -> "GaussianProcessClassificationModel":
-        instr = Instrumentation(name="GaussianProcessClassifier")
+        # subclasses (the EP engine) must log and report under their own
+        # estimator name, mirroring gp_poisson.py's NB convention
+        instr = Instrumentation(name=type(self).__name__)
         x = np.asarray(x, dtype=np.float64)
         y = np.asarray(y, dtype=np.float64)
         if x.ndim != 2:
@@ -201,7 +203,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             return fit_once
 
         return self._run_fit_distributed(
-            "GaussianProcessClassifier", data, active_set, prepare
+            type(self).__name__, data, active_set, prepare
         )
 
     def _fit_from_stack(

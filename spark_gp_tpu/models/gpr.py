@@ -148,8 +148,24 @@ class GaussianProcessRegression(GaussianProcessCommons):
             # full-fit-per-restart)
             return self._fit_device_multistart(instr, data, x, y)
 
+        # ELBO: ONE inducing set, selected up front at the base kernel's
+        # init theta and shared by every sequential restart — matching the
+        # batched path's semantics (each restart's ThetaOverrideKernel has
+        # a different init theta, so per-restart selection would both
+        # repeat the work and, for theta-dependent providers, optimize
+        # each restart over a different surface)
+        active_shared = None
+        if self._objective == "elbo":
+            base_kernel = self._get_kernel()
+            with instr.phase("active_set"):
+                active_shared = self._select_active(
+                    base_kernel, base_kernel.init_theta(), x, lambda: y, data
+                )
+
         def fit_once(kernel, instr_r):
-            return self._fit_from_stack(instr_r, kernel, data, x, lambda: y, None)
+            return self._fit_from_stack(
+                instr_r, kernel, data, x, lambda: y, active_shared
+            )
 
         return self._fit_with_restarts(instr, fit_once)
 
@@ -350,6 +366,17 @@ class GaussianProcessRegression(GaussianProcessCommons):
         Single-process it is equivalent to ``fit`` with a pre-grouped stack.
         """
         def prepare(instr, active64):
+            if active64 is None and self._objective == "elbo":
+                # same shared-inducing-set semantics as fit(): select once
+                # from the sharded stack at the base kernel's init theta,
+                # not once per restart
+                base_kernel = self._get_kernel()
+                with instr.phase("active_set"):
+                    active64 = self._select_active(
+                        base_kernel, base_kernel.init_theta(), None, None,
+                        data,
+                    )
+
             def fit_once(kernel, instr_r):
                 return self._fit_from_stack(
                     instr_r, kernel, data, None, None, active64

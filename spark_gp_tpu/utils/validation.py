@@ -31,7 +31,10 @@ def nlpd(y_true: np.ndarray, mean: np.ndarray, var: np.ndarray) -> float:
     ``cross_validate`` routes to it via the ``needs_variance`` marker."""
     y = np.asarray(y_true, dtype=np.float64)
     mu = np.asarray(mean, dtype=np.float64)
-    v = np.asarray(var, dtype=np.float64)
+    # floor: a degenerate zero predictive variance (sigma2=0 + noise-free
+    # kernel at an inducing point) must score astronomically badly, not
+    # poison the whole CV mean with log(0)/0-division inf/nan
+    v = np.maximum(np.asarray(var, dtype=np.float64), np.finfo(np.float64).tiny)
     return float(
         np.mean(0.5 * (np.log(2.0 * np.pi * v) + (y - mu) ** 2 / v))
     )
