@@ -1,0 +1,122 @@
+"""Matched-config reconciliation lane (VERDICT r4 #3).
+
+Round 2's driver-captured bench recorded **247k pts/s** (N=100k, s=100,
+async pipeline, healthy tunnel); round 4's salvaged window recorded
+**80.7k pts/s** (N=300k, GP_SYNC_PHASES=1, ~200 ms tunnel RTT).  The 3x
+gap was attributed to RTT + sync mode in prose only.  This script settles
+it with data from ONE window at the r2-matched config:
+
+* N=100,000 rows of ``make_benchmark_data`` (PerformanceBenchmark.scala
+  shape), s=100 experts, RBF(0.1), sigma2=1e-3, seed 13, maxIter 30,
+  device optimizer — byte-for-byte the bench.py primary at BENCH_N=100000;
+* the SAME compiled programs timed twice: async (GP_SYNC_PHASES=0, the
+  TPU default r2 ran under) and sync-phase (GP_SYNC_PHASES=1, what r4's
+  window was forced into);
+* the tunnel RTT measured around the fits (median of 20 trivial
+  device round trips), so the per-phase sync tax is quantified, not
+  asserted.
+
+Emits ONE JSON line; the watcher saves it as TPU_WINDOW_MATCHED.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("MATCHED_N", 100_000))
+EXPERT = int(os.environ.get("MATCHED_EXPERT", 100))
+MAX_ITER = int(os.environ.get("MATCHED_MAXITER", 30))
+
+
+def _rtt_ms(reps: int = 20) -> dict:
+    """Median/p90 device round-trip latency: dispatch one trivial op and
+    block — the floor every synced phase boundary pays."""
+    import jax
+    import jax.numpy as jnp
+
+    one = jnp.ones(())
+    f = jax.jit(lambda v: v + 1.0)
+    jax.block_until_ready(f(one))  # compile outside the timed reps
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(one))
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return {
+        "median_ms": round(times[len(times) // 2], 3),
+        "p90_ms": round(times[int(len(times) * 0.9) - 1], 3),
+        "reps": reps,
+    }
+
+
+def main() -> None:
+    import jax
+
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+    from spark_gp_tpu.data import make_benchmark_data
+
+    x, y = make_benchmark_data(N)
+
+    def make_gp(iters: int):
+        return (
+            GaussianProcessRegression()
+            .setKernel(lambda: RBFKernel(0.1))
+            .setDatasetSizeForExpert(EXPERT)
+            .setActiveSetSize(EXPERT)
+            .setSeed(13)
+            .setSigma2(1e-3)
+            .setMaxIter(iters)
+            .setOptimizer("device")
+        )
+
+    result = {
+        "config": {
+            "n_points": N, "expert_size": EXPERT, "max_iter": MAX_ITER,
+            "note": "byte-for-byte the r2 BENCH primary config "
+            "(BENCH_r02.json: 247124.8 pts/s, fit 0.405s, 14 evals)",
+        },
+        "platform": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "rtt_before": _rtt_ms(),
+    }
+
+    rows = {}
+    for mode, flag in (("async", "0"), ("sync_phases", "1")):
+        os.environ["GP_SYNC_PHASES"] = flag
+        make_gp(1).fit(x, y)  # compile (shared: max_iter is traced)
+        t0 = time.perf_counter()
+        model = make_gp(MAX_ITER).fit(x, y)
+        dt = time.perf_counter() - t0
+        rows[mode] = {
+            "fit_seconds": round(dt, 4),
+            "train_points_per_sec": round(N / dt, 1),
+            "lbfgs_evals": int(model.instr.metrics.get("lbfgs_nfev", 1)),
+            "phase_seconds": {
+                k: round(v, 4) for k, v in model.instr.timings.items()
+            },
+        }
+    result["rows"] = rows
+    result["rtt_after"] = _rtt_ms()
+
+    a, s = rows["async"]["train_points_per_sec"], rows["sync_phases"]["train_points_per_sec"]
+    result["summary"] = {
+        "async_vs_sync_ratio": round(a / s, 3) if s else None,
+        "r2_reference_pts_per_sec": 247124.8,
+        "async_vs_r2_ratio": round(a / 247124.8, 3),
+        "note": (
+            "async_vs_r2_ratio ~1 closes the r2/r4 gap as config+mode; "
+            "substantially <1 with a high RTT points at tunnel latency; "
+            "<1 with r2-like RTT means a real regression to chase"
+        ),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

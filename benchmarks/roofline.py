@@ -1,0 +1,265 @@
+"""Measured roofline for the fit hot loop (VERDICT r4 #1).
+
+The r4 verdict's top item: the recorded MFU is ~0.2% and nothing on record
+says WHY — small-s batched linalg could be memory-bound (low MFU is then
+the hardware's answer, not a bug), or the stack could be leaving compute
+on the table.  This script measures, per expert size s in {128, 256, 512}:
+
+* the batched Gram build (``kernel.gram`` — sq-dist matmul + exp),
+* the fused SPD inverse+logdet forward (``spd_inv_logdet`` — the Pallas
+  Mosaic kernel on TPU f32, exactly the production routing),
+* the full L-BFGS objective evaluation (value+grad through both),
+
+each with analytic FLOPs and HBM bytes, achieved TFLOP/s and GB/s, and the
+fractions of the chip's bf16-matmul and HBM-bandwidth peaks — plus a
+big-matmul calibration row per precision mode showing what THIS stack can
+reach on THIS chip (the realistic ceiling, net of runtime overheads).
+
+Mixed-precision lane: ``GP_MATMUL_PRECISION`` (ops/pallas_linalg.py) is a
+trace-time knob, so the parent process measures ``highest`` (the
+production default) and re-runs itself in a child with
+``GP_MATMUL_PRECISION=high`` (3-pass bf16x3, ~2x matmul rate at ~1e-6
+error), then fits the synthetics config at both settings and records the
+RMSE/NLL deltas as the quality guard — ``high`` is only worth shipping if
+the guard holds on hardware.
+
+Emits ONE JSON line (last line of stdout), watcher-envelope friendly.
+Run: ``python benchmarks/roofline.py`` (any backend; the verdict-grade
+numbers need the real chip — the watcher runs it inside TPU windows).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# public chip specs (same convention as bench.py): nominal bf16 MXU peak
+# TFLOP/s and HBM GB/s by device-kind substring
+PEAK_TFLOPS = {"v4": 275.0, "v5 lite": 197.0, "v5e": 197.0,
+               "v5p": 459.0, "v6e": 918.0, "v6 lite": 918.0}
+PEAK_GBPS = {"v4": 1228.0, "v5 lite": 819.0, "v5e": 819.0,
+             "v5p": 2765.0, "v6e": 1640.0, "v6 lite": 1640.0}
+# f32 emulation cost in bf16 passes: the matmul-rate ceiling is peak/passes
+PRECISION_PASSES = {"highest": 6, "high": 3, "default": 1}
+
+TOTAL_POINTS = int(os.environ.get("ROOFLINE_TOTAL", 65536))
+EXPERT_SIZES = tuple(
+    int(v) for v in os.environ.get("ROOFLINE_SIZES", "128,256,512").split(",")
+)
+P_DIM = 8
+REPEATS = int(os.environ.get("ROOFLINE_REPEATS", 3))
+
+
+def _timed(fn, *args):
+    """Min wall time over REPEATS (1 warm-up/compile call first)."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _peaks():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    tf = next((v for k, v in PEAK_TFLOPS.items() if k in kind), None)
+    bw = next((v for k, v in PEAK_GBPS.items() if k in kind), None)
+    return kind, tf, bw
+
+
+def _row(name, seconds, flops, bytes_, tflops_peak, gbps_peak, passes=6):
+    tfs = flops / seconds / 1e12
+    gbs = bytes_ / seconds / 1e9
+    row = {
+        "op": name,
+        "seconds": round(seconds, 6),
+        "gflops_nominal": round(flops / 1e9, 3),
+        "gbytes_hbm_min": round(bytes_ / 1e9, 4),
+        "achieved_tflops_per_sec": round(tfs, 4),
+        "achieved_gb_per_sec": round(gbs, 2),
+    }
+    if tflops_peak:
+        # two ceilings: raw bf16 peak (the MFU denominator every round
+        # reports) and the precision-adjusted matmul-rate ceiling
+        row["mfu_vs_bf16_peak"] = round(tfs / tflops_peak, 5)
+        row["frac_of_precision_ceiling"] = round(
+            tfs / (tflops_peak / passes), 5
+        )
+    if gbps_peak:
+        row["frac_of_hbm_peak"] = round(gbs / gbps_peak, 5)
+    if tflops_peak and gbps_peak:
+        row["bound"] = (
+            "memory" if row["frac_of_hbm_peak"] >= row["frac_of_precision_ceiling"]
+            else "compute"
+        )
+    return row
+
+
+def measure(precision: str) -> dict:
+    os.environ["GP_MATMUL_PRECISION"] = precision
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_gp_tpu import RBFKernel
+    from spark_gp_tpu.kernels.base import Const, EyeKernel
+    from spark_gp_tpu.ops.pallas_linalg import spd_inv_logdet
+
+    kind, tflops_peak, gbps_peak = _peaks()
+    passes = PRECISION_PASSES[precision]
+    report = {
+        "precision": precision,
+        "device_kind": kind,
+        "platform": jax.default_backend(),
+        "bf16_peak_tflops": tflops_peak,
+        "hbm_peak_gbps": gbps_peak,
+        "total_points": TOTAL_POINTS,
+    }
+
+    # calibration: one big matmul at this precision — the stack's ceiling
+    dim = 4096
+    a = jnp.asarray(np.random.default_rng(0).normal(size=(dim, dim)), jnp.float32)
+    mm = jax.jit(lambda u, v: u @ v)
+    secs = _timed(mm, a, a)
+    report["calibration_matmul_4096"] = _row(
+        f"matmul {dim}^3 f32 (trace-time precision={precision})",
+        secs, 2.0 * dim**3, 3 * dim * dim * 4, tflops_peak, gbps_peak, passes,
+    )
+
+    kernel = 1.0 * RBFKernel(0.5, 1e-6, 10) + Const(1e-3) * EyeKernel()
+    theta = jnp.asarray(kernel.init_theta(), jnp.float32)
+    rows = []
+    for s in EXPERT_SIZES:
+        e = max(1, TOTAL_POINTS // s)
+        rng = np.random.default_rng(s)
+        xe = jnp.asarray(rng.normal(size=(e, s, P_DIM)), jnp.float32)
+        ye = jnp.asarray(rng.normal(size=(e, s)), jnp.float32)
+
+        gram = jax.jit(jax.vmap(lambda xb: kernel.gram(theta, xb)))
+        g_secs = _timed(gram, xe)
+        # nominal: the sq-dist inner product (2 e s^2 p) + exp/elementwise
+        rows.append(_row(
+            f"gram_build s={s} E={e}", g_secs,
+            2.0 * e * s * s * P_DIM,
+            (e * s * P_DIM + e * s * s) * 4.0,
+            tflops_peak, gbps_peak, 6,  # sq_dist pins HIGHEST by design
+        ))
+
+        kmat = gram(xe)
+        fwd = jax.jit(lambda k: spd_inv_logdet(k))
+        f_secs = _timed(fwd, kmat)
+        rows.append(_row(
+            f"spd_inv_logdet_fwd s={s} E={e}", f_secs,
+            2.0 * e * s**3,
+            2.0 * e * s * s * 4.0,
+            tflops_peak, gbps_peak, passes,
+        ))
+
+        def objective(th, xb, yb):
+            km = jax.vmap(lambda x1: kernel.gram(th, x1))(xb)
+            kinv, logdet = spd_inv_logdet(km)
+            alpha = jnp.einsum("eij,ej->ei", kinv, yb)
+            return 0.5 * jnp.einsum("ei,ei->", yb, alpha) + 0.5 * jnp.sum(logdet)
+
+        vg = jax.jit(jax.value_and_grad(objective))
+        vg_secs = _timed(vg, theta, xe, ye)
+        rows.append(_row(
+            f"objective_value_and_grad s={s} E={e}", vg_secs,
+            6.0 * e * s**3 + 4.0 * e * s * s * (P_DIM + 2),
+            4.0 * e * s * s * 4.0,
+            tflops_peak, gbps_peak, passes,
+        ))
+    report["rows"] = rows
+    return report
+
+
+def quality_fit() -> dict:
+    """Synthetics-config fit at the ambient GP_MATMUL_PRECISION: the
+    mixed-precision quality guard (RMSE bar + converged NLL)."""
+    from examples.synthetics import make_gp
+    from spark_gp_tpu.data import make_synthetics
+    from spark_gp_tpu.utils.validation import rmse
+
+    x, y = make_synthetics()
+    cut = 1600
+    gp = make_gp()
+    model = gp.fit(x[:cut], y[:cut])
+    pred = model.predict(x[cut:])
+    return {
+        "precision": os.environ.get("GP_MATMUL_PRECISION", "highest"),
+        "rmse_holdout": float(rmse(y[cut:], pred)),
+        "nll": float(model.instr.metrics.get("final_nll", float("nan"))),
+    }
+
+
+def _run_child(precision: str) -> dict:
+    """One precision lane in a fresh process.  Two reasons this is a
+    subprocess and the parent NEVER touches jax: the precision knob is
+    trace-time (a fresh process is the only clean full retrace), and libtpu
+    is single-process-exclusive — a parent holding the chip would doom
+    every child to an init failure."""
+    env = dict(os.environ)
+    env["GP_MATMUL_PRECISION"] = precision
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        capture_output=True, text=True,
+        timeout=float(os.environ.get("ROOFLINE_CHILD_TIMEOUT", 900)), env=env,
+    )
+    for line in reversed(child.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise RuntimeError(
+        f"no JSON from {precision} lane (rc={child.returncode}): "
+        + (child.stderr or "")[-300:]
+    )
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        out = {"measure": measure(os.environ["GP_MATMUL_PRECISION"]),
+               "quality": quality_fit()}
+        print(json.dumps(out))
+        return
+
+    report = {"captured": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    for precision in ("highest", "high"):
+        try:
+            payload = _run_child(precision)
+            report[precision] = payload["measure"]
+            report[f"quality_{precision}"] = payload["quality"]
+        except Exception as exc:  # noqa: BLE001 — record and keep going
+            report[f"{precision}_error"] = f"{type(exc).__name__}: {exc}"[:300]
+
+    if "quality_high" in report and "quality_highest" in report:
+        q_hi, q3 = report["quality_highest"], report["quality_high"]
+        bar = 0.11  # Synthetics.scala:33
+        report["mixed_precision_guard"] = {
+            "rmse_delta": abs(q3["rmse_holdout"] - q_hi["rmse_holdout"]),
+            "both_under_bar": bool(
+                q_hi["rmse_holdout"] < bar and q3["rmse_holdout"] < bar
+            ),
+            "bar": bar,
+            "verdict": (
+                "high is quality-safe on this config"
+                if q3["rmse_holdout"] < bar
+                else "high BREACHES the quality bar — keep highest"
+            ),
+        }
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,12 +4,17 @@ The axon TPU tunnel has been down for the whole round, hanging inside
 backend init rather than failing fast.  This watcher polls in a detached
 loop; the moment a probe subprocess reports a real TPU it
 
-1. runs ``python bench.py`` (which persists the XLA compile cache and
-   emits its primary metric line immediately — see bench.py), saving the
-   JSON to ``TPU_WINDOW_BENCH.json``;
-2. runs the Pallas expert-size sweep, saving ``TPU_WINDOW_PALLAS.json``;
-3. runs the Mosaic-lowering parity tests, saving the pytest tail to
-   ``TPU_WINDOW_PYTEST.json``;
+1. runs ``python bench.py`` (which persists the XLA compile cache, emits
+   its primary metric line immediately, and — r5 — appends the
+   post-worker roofline/mixed-precision lane), saving the JSON to
+   ``TPU_WINDOW_BENCH.json``;
+2. runs the Mosaic-lowering parity tests PLUS the asserted on-chip
+   quality slice (``tests/test_tpu_quality_slice.py``), saving the pytest
+   tail to ``TPU_WINDOW_TESTS.json``;
+3. runs the r2-reconciliation matched-config lane
+   (``TPU_WINDOW_MATCHED.json``) and the large-m lane
+   (``TPU_WINDOW_LARGE_M.json``) when their scripts exist;
+4. runs the Pallas expert-size sweep, saving ``TPU_WINDOW_PALLAS.json``;
 
 then keeps polling (later windows refresh the artifacts).  Everything is
 best-effort and timeout-fenced; the watcher itself never touches the
@@ -36,7 +41,11 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBE = (
-    "import jax; d = jax.devices(); print(d[0].platform)"
+    # a computed round trip, not just enumeration: the r5 tunnel failure
+    # mode can register the platform / list devices yet hang on first
+    # compute — a window only "opens" if the chip actually runs something
+    "import jax, jax.numpy as jnp; d = jax.devices(); "
+    "jax.block_until_ready(jnp.ones(()) + 1); print(d[0].platform)"
 )
 
 
@@ -144,23 +153,44 @@ def main() -> None:
             env.pop("JAX_PLATFORMS", None)
             # bench first: it lands the round's headline number and warms
             # the persistent compile cache for any subsequent run
-            _run([sys.executable, "bench.py"], "TPU_WINDOW_BENCH.json", 2700, env)
+            # 4500s: worker watchdog (2400) + post-worker roofline (1500)
+            # + preflight, with slack; bench prints the primary line before
+            # the roofline so even a fence trip salvages the measurement
+            _run([sys.executable, "bench.py"], "TPU_WINDOW_BENCH.json", 4500, env)
             note("bench done")
+            # VERDICT r4 #2: an ON-CHIP asserted quality bar (synthetics
+            # RMSE < 0.11) + the Mosaic compiled-lowering parity tests,
+            # captured together so every window carries kernel validation
+            tenv = dict(env)
+            tenv["GP_TEST_PLATFORM"] = "tpu"
+            _run(
+                [sys.executable, "-m", "pytest", "tests/test_pallas_linalg.py",
+                 "tests/test_tpu_quality_slice.py", "-q"],
+                "TPU_WINDOW_TESTS.json", 1500, tenv,
+            )
+            note("mosaic + quality-slice tests done")
+            # VERDICT r4 #3/#4: matched-config r2-reconciliation lane and
+            # the large-m (sharded magic solve + airfoil m=1000) lane
+            if os.path.exists(os.path.join(ROOT, "benchmarks/matched_config.py")):
+                _run(
+                    [sys.executable, "benchmarks/matched_config.py"],
+                    "TPU_WINDOW_MATCHED.json", 1800, env,
+                )
+                note("matched-config lane done")
+            if os.path.exists(os.path.join(ROOT, "benchmarks/large_m.py")):
+                _run(
+                    [sys.executable, "benchmarks/large_m.py"],
+                    "TPU_WINDOW_LARGE_M.json", 1800, env,
+                )
+                note("large-m lane done")
             _run(
                 [sys.executable, "benchmarks/pallas_sweep.py"],
                 "TPU_WINDOW_PALLAS.json", 1800, env,
             )
-            note("pallas sweep done")
-            tenv = dict(env)
-            tenv["GP_TEST_PLATFORM"] = "tpu"
-            _run(
-                [sys.executable, "-m", "pytest", "tests/test_pallas_linalg.py", "-q"],
-                "TPU_WINDOW_PYTEST.json", 1200, tenv,
-            )
-            note("mosaic tests done; sleeping 30 min before re-probe")
-            time.sleep(1800)
+            note("pallas sweep done; sleeping 15 min before re-probe")
+            time.sleep(900)
         else:
-            time.sleep(300)
+            time.sleep(180)
 
 
 if __name__ == "__main__":

@@ -284,7 +284,9 @@ def part_gpc_mnist() -> dict:
     from spark_gp_tpu.ops.scaling import scale
     from spark_gp_tpu.utils.validation import accuracy, train_validation_split
 
-    x, y = load_mnist_binary()  # synthetic 784-d stand-in, MNIST.scala shape
+    from spark_gp_tpu.data import dataset_provenance
+
+    x, y = load_mnist_binary()  # real CSV when discoverable, else stand-in
     x = np.asarray(scale(x))
     gp = (
         GaussianProcessClassifier()
@@ -309,7 +311,7 @@ def part_gpc_mnist() -> dict:
         "n_features": int(x.shape[1]),
         "fit_predict_seconds": seconds,
         "train_points_per_sec": n_train / seconds,
-        "data": "synthetic stand-in (reference blob missing upstream)",
+        "data": dataset_provenance("mnist"),
     }
 
 
@@ -343,10 +345,27 @@ def _ard_kernel_factory(p: int):
     )
 
 
-def _stress_regression(loader, n, expert, active, max_iter, bar) -> dict:
+def _stress_regression(
+    loader, n, expert, active, max_iter, bar, dataset, real_bar=0.9,
+) -> dict:
     _assert_platform()
     from spark_gp_tpu import GaussianProcessRegression
+    from spark_gp_tpu.data import dataset_provenance, find_dataset_file
     from spark_gp_tpu.utils.validation import rmse
+
+    # real-data snap-in (VERDICT r4 #5): the loader auto-discovers a real
+    # CSV under $GP_DATA_DIR; the part records which source it used and
+    # switches to the real-data bar (the stand-in bars are calibrated on
+    # the generators' known noise floor and don't transfer)
+    is_real = find_dataset_file(dataset) is not None
+    if is_real:
+        bar, bar_source = real_bar, (
+            "real-data catastrophe guard (scaled RMSE; no published "
+            "reference number exists for this config — BASELINE.json "
+            "records configs only)"
+        )
+    else:
+        bar_source = "stand-in generator noise floor (r03 calibration)"
 
     x, ys, tr, te, y_mean, y_std = _prep_regression(loader, n)
 
@@ -367,10 +386,11 @@ def _stress_regression(loader, n, expert, active, max_iter, bar) -> dict:
     return {
         "rmse": float(rmse(y_te, pred_scaled * y_std + y_mean)),
         "rmse_scaled": score,
-        # bars vs the stand-in generators' known noise floor (r03 recorded
-        # 0.476 / 0.496): a silent quality regression now fails loudly
-        # (VERDICT r3 weak #4)
+        # stand-in bars: the generators' known noise floor (r03 recorded
+        # 0.476 / 0.496), so a silent quality regression fails loudly
+        # (VERDICT r3 weak #4); real data swaps in the catastrophe guard
         "bar": bar,
+        "bar_source": bar_source,
         "passed": bool(score < bar),
         "n": int(x.shape[0]),
         "p": int(x.shape[1]),
@@ -379,7 +399,7 @@ def _stress_regression(loader, n, expert, active, max_iter, bar) -> dict:
         "max_iter": max_iter,
         "fit_seconds": fit_seconds,
         "train_points_per_sec": len(tr) / fit_seconds,
-        "data": "synthetic stand-in (zero-egress env)",
+        "data": dataset_provenance(dataset),
     }
 
 
@@ -387,14 +407,22 @@ def part_protein() -> dict:
     from spark_gp_tpu.data import load_protein
 
     n = int(os.environ.get("QUALITY_PROTEIN_N", 8000))
-    return _stress_regression(load_protein, n, 100, 256, 15, bar=0.55)
+    return _stress_regression(
+        load_protein, n, 100, 256, 15, bar=0.55, dataset="protein",
+        # sparse-GP literature lands ~0.6-0.75 scaled RMSE on CASP at
+        # comparable m; 0.9 only catches a broken fit, not a mediocre one
+        real_bar=0.9,
+    )
 
 
 def part_year_msd() -> dict:
     from spark_gp_tpu.data import load_year_msd
 
     n = int(os.environ.get("QUALITY_YEAR_N", 20000))
-    return _stress_regression(load_year_msd, n, 100, 256, 15, bar=0.55)
+    return _stress_regression(
+        load_year_msd, n, 100, 256, 15, bar=0.55, dataset="year_msd",
+        real_bar=0.95,  # year prediction: scaled RMSE ~0.85-0.95 is typical
+    )
 
 
 def part_greedy_scale() -> dict:

@@ -1,6 +1,9 @@
 """Dataset loaders for the examples / acceptance tests."""
 
 from spark_gp_tpu.data.datasets import (
+    DATASET_FILES,
+    dataset_provenance,
+    find_dataset_file,
     load_airfoil,
     load_iris,
     load_mnist_binary,
@@ -18,4 +21,7 @@ __all__ = [
     "load_protein",
     "load_year_msd",
     "make_benchmark_data",
+    "DATASET_FILES",
+    "find_dataset_file",
+    "dataset_provenance",
 ]

@@ -86,11 +86,9 @@ def _local_moments(kernel: Kernel, mode, theta, x, mask, chol_l, alpha,
 
     if mode == "rbcm":
         beta = alive * 0.5 * (jnp.log(k_ss)[None, :] - jnp.log(var_e))
-    elif mode == "gpoe":
-        # per-shard count is wrong under sharding: normalize by the GLOBAL
-        # expert count after the reduction, via the beta sum
-        beta = alive * jnp.ones_like(var_e)
-    else:  # poe / bcm: unit weights
+    else:  # poe / bcm / gpoe: unit weights here.  gpoe's 1/E_global weight
+        # cannot be applied per shard (the local expert count is wrong under
+        # sharding) — _aggregate divides by n_alive AFTER the reduction.
         beta = alive * jnp.ones_like(var_e)
 
     sums = (

@@ -53,6 +53,7 @@ fallback for CPU, float64, or n > 512.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +64,33 @@ _LANE = 128  # TPU lane width; full-utilization tile width for packing
 _N_MAX = 512  # largest matrix the Pallas path handles (VMEM at T=1)
 
 _HI = jax.lax.Precision.HIGHEST
+
+
+def _matmul_precision():
+    """MXU precision for the blocked-inverse matmuls and the VJP.
+
+    ``GP_MATMUL_PRECISION``: ``highest`` (default; 6-pass bf16 = true f32,
+    ceiling ~peak/6), ``high`` (3-pass bf16x3, ~2x the matmul rate at
+    ~1e-6 relative error — the MFU-campaign candidate, r5), or ``default``
+    (1-pass bf16, ~1e-3 error — measured fatal for L-BFGS line-search
+    consistency, exposed for experiments only).  Read at TRACE time: set
+    the env var before the first fit in a process; benchmarks vary it via
+    subprocesses (benchmarks/roofline.py).
+    """
+    name = os.environ.get("GP_MATMUL_PRECISION", "highest").strip().lower()
+    table = {
+        "highest": jax.lax.Precision.HIGHEST,
+        "high": jax.lax.Precision.HIGH,
+        "default": jax.lax.Precision.DEFAULT,
+    }
+    if name not in table:
+        # fail loud and NAMED — a bare KeyError from inside a jit trace
+        # never mentions the env var
+        raise ValueError(
+            f"GP_MATMUL_PRECISION={name!r} is not supported; use one of "
+            f"{sorted(table)}"
+        )
+    return table[name]
 
 
 def _blocks_for(n_pad: int) -> tuple:
@@ -90,10 +118,13 @@ def _bmm(a, b, contract=(2, 1)):
     counted with the batch dim present), so transposes never materialize:
     ``(2,1)`` = a @ b, ``(2,2)`` = a @ b^T, ``(1,1)`` = a^T @ b.
 
-    HIGHEST precision: the default bf16 MXU path costs ~1e-3 relative error
-    on the inverse — fatal for L-BFGS line-search consistency; the 6-pass
-    f32 emulation keeps everything at true f32 accuracy.
+    Precision from :func:`_matmul_precision` (default HIGHEST): the 1-pass
+    bf16 path costs ~1e-3 relative error on the inverse — fatal for L-BFGS
+    line-search consistency; the 6-pass f32 emulation keeps everything at
+    true f32 accuracy, and the 3-pass HIGH option trades ~1e-6 error for
+    ~2x matmul rate (quality-gated in benchmarks/roofline.py).
     """
+    precision = _matmul_precision()
     return jnp.stack(
         [
             jax.lax.dot_general(
@@ -101,7 +132,7 @@ def _bmm(a, b, contract=(2, 1)):
                 b[t],
                 (((contract[0] - 1,), (contract[1] - 1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-                precision=_HI,
+                precision=precision,
             )
             for t in range(a.shape[0])
         ]
@@ -370,8 +401,10 @@ def _spd_bwd(kinv, cotangents):
     g_kinv, g_logdet = cotangents
     # d logdet / dK = K^-1 (symmetric); d K^-1 / dK applied to a cotangent G
     # is -K^-1 G K^-1.  Two batched MXU matmuls — no triangular solves.
+    # This is the single largest matmul term of an L-BFGS eval (~4s^3 per
+    # expert vs ~2s^3 forward), so it rides the same precision knob.
     kbar = -jnp.einsum(
-        "bij,bjk,bkl->bil", kinv, g_kinv, kinv, precision=_HI
+        "bij,bjk,bkl->bil", kinv, g_kinv, kinv, precision=_matmul_precision()
     )
     kbar = kbar + g_logdet[:, None, None] * kinv
     return (kbar,)

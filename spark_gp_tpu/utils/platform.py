@@ -42,31 +42,53 @@ def preflight_backend(timeout_s: float = 90.0, fallback: str = "cpu") -> str:
     subprocess.  Entry points that must never wedge on a flaky accelerator
     (the ``examples/``) call this before their first jax touch.
 
-    No-op when the user pinned ``JAX_PLATFORMS`` explicitly (their choice
-    is re-asserted and honored, hang or not) or when a backend is already
-    initialized in this process (too late to switch safely).
+    ``JAX_PLATFORMS`` pins are still PROBED (r5): device-site shell
+    profiles export ``JAX_PLATFORMS=<tunnel>`` globally, so a pin is not
+    reliable evidence of per-run user intent, and honoring a wedged pin
+    forever is exactly the failure this function exists to prevent.  A
+    pinned-but-hung backend falls back like an unpinned one; set
+    ``GP_HONOR_PINNED_PLATFORM=1`` to wedge-on-principle instead.  No-op
+    when a backend is already initialized in this process (too late to
+    switch safely).
     """
     pinned = os.environ.get("JAX_PLATFORMS")
+    first = pinned.split(",")[0] if pinned else None
     if pinned:
         honor_platform_env()
-        return pinned.split(",")[0]
+        if first == fallback or os.environ.get("GP_HONOR_PINNED_PLATFORM") == "1":
+            return first
     if backends_already_initialized():
         import jax
 
         return jax.default_backend()
 
     cached = _read_healthy_marker()
-    if cached is not None:
+    # a cached verdict only covers the platform it was measured on — a
+    # healthy-cpu marker must not green-light an axon pin
+    if cached is not None and (not pinned or cached == first):
         return cached
 
     import subprocess
     import sys
 
     why = None
+    # The probe must do REAL device work, not just name the backend:
+    # today's axon tunnel failure mode (r5) registers the platform and
+    # answers default_backend() in <1s while jax.devices() / the first
+    # computation hangs forever — a name-only probe passes, caches a
+    # healthy verdict, and the example wedges anyway.  One tiny computed
+    # round trip catches every init-or-compute hang mode seen so far.
+    probe_code = (
+        "import os, jax, jax.numpy as jnp; "
+        # re-assert any pin over site hooks, as honor_platform_env does
+        "p = os.environ.get('JAX_PLATFORMS'); "
+        "p and jax.config.update('jax_platforms', p); "
+        "jax.block_until_ready(jnp.ones(()) + 1); "
+        "print(jax.default_backend())"
+    )
     try:
         probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend())"],
+            [sys.executable, "-c", probe_code],
             capture_output=True, text=True, timeout=timeout_s,
         )
         if probe.returncode == 0 and probe.stdout.strip():
@@ -82,8 +104,12 @@ def preflight_backend(timeout_s: float = 90.0, fallback: str = "cpu") -> str:
     import logging
 
     logging.getLogger(__name__).warning(
-        "default JAX backend failed its preflight probe — %s; falling "
-        "back to %s for this process", why, fallback,
+        "%s JAX backend failed its preflight probe — %s; falling back to "
+        "%s for this process%s",
+        f"pinned (JAX_PLATFORMS={pinned})" if pinned else "default",
+        why, fallback,
+        " (set GP_HONOR_PINNED_PLATFORM=1 to honor the pin regardless)"
+        if pinned else "",
     )
     os.environ["JAX_PLATFORMS"] = fallback
     import jax
@@ -92,12 +118,51 @@ def preflight_backend(timeout_s: float = 90.0, fallback: str = "cpu") -> str:
     return fallback
 
 
-def _marker_path() -> str:
+def _marker_path():
+    """Marker file under a private 0700 per-user directory, or None when no
+    trustworthy location exists (callers then skip caching).
+
+    A fixed-name file in world-writable /tmp lets another local user
+    pre-plant a symlink (followed by open-for-write) or a spoofed verdict
+    that suppresses the probe.  The marker therefore lives in a directory
+    we create 0700 and verify (not a symlink, owned by us, no group/other
+    bits) before trusting; any anomaly falls back to a probe-always path.
+    The file name carries an interpreter + jax-install fingerprint so a
+    verdict from one python/jax environment can never suppress the probe
+    in a different one whose backend init could still hang.
+    """
+    import hashlib
+    import stat
+    import sys
     import tempfile
 
-    return os.path.join(
-        tempfile.gettempdir(), f"spark_gp_tpu_preflight_uid{os.getuid()}"
-    )
+    base = os.environ.get("XDG_RUNTIME_DIR") or tempfile.gettempdir()
+    d = os.path.join(base, f"spark_gp_tpu-{os.getuid()}")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        st = os.lstat(d)
+        if (
+            not stat.S_ISDIR(st.st_mode)
+            or st.st_uid != os.getuid()
+            or (st.st_mode & 0o077)
+        ):
+            raise OSError("untrusted marker dir")
+    except OSError:
+        # unusable private dir (symlinked, group-writable, wrong owner):
+        # disable caching outright — callers treat None as "always probe".
+        # (A per-call mkdtemp would leak one directory per invocation.)
+        return None
+    h = hashlib.sha1()
+    h.update(sys.executable.encode())
+    h.update(sys.version.encode())
+    try:
+        import importlib.util
+
+        spec = importlib.util.find_spec("jax")
+        h.update((spec.origin or "").encode() if spec else b"nojax")
+    except Exception:  # noqa: BLE001 — fingerprint is best-effort
+        h.update(b"nojax")
+    return os.path.join(d, f"preflight-{h.hexdigest()[:12]}")
 
 
 def _read_healthy_marker():
@@ -118,9 +183,18 @@ def _read_healthy_marker():
         ttl = 300.0
     if ttl <= 0:
         return None
+    path = _marker_path()
+    if path is None:
+        return None
     try:
-        with open(_marker_path()) as fh:
+        with open(path) as fh:
             marker = json.load(fh)
+        # the verdict is only valid under the SAME effective pin: a
+        # healthy probe under JAX_PLATFORMS=axon says nothing about what
+        # an unpinned process's default backend resolution would do (and
+        # vice versa)
+        if marker.get("pin", "") != os.environ.get("JAX_PLATFORMS", ""):
+            return None
         if time.time() - float(marker["ts"]) < ttl:
             return str(marker["platform"])
     except Exception:  # noqa: BLE001 — unreadable/absent marker: just probe
@@ -132,9 +206,26 @@ def _write_healthy_marker(platform: str) -> None:
     import json
     import time
 
+    path = _marker_path()
+    if path is None:
+        return
     try:
-        with open(_marker_path(), "w") as fh:
-            json.dump({"ts": time.time(), "platform": platform}, fh)
+        # O_NOFOLLOW: refuse to write through a pre-planted symlink even if
+        # the directory checks in _marker_path were somehow bypassed
+        fd = os.open(
+            path,
+            os.O_WRONLY | os.O_CREAT | os.O_TRUNC | getattr(os, "O_NOFOLLOW", 0),
+            0o600,
+        )
+        with os.fdopen(fd, "w") as fh:
+            json.dump(
+                {
+                    "ts": time.time(),
+                    "platform": platform,
+                    "pin": os.environ.get("JAX_PLATFORMS", ""),
+                },
+                fh,
+            )
     except OSError:  # unwritable tmp: caching is best-effort only
         pass
 

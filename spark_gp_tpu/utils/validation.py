@@ -23,6 +23,9 @@ def accuracy(y_true: np.ndarray, y_pred: np.ndarray) -> float:
     return float(np.mean(np.asarray(y_true) == np.asarray(y_pred)))
 
 
+accuracy.greater_is_better = True  # Spark evaluator isLargerBetter=true
+
+
 def nlpd(y_true: np.ndarray, mean: np.ndarray, var: np.ndarray) -> float:
     """Mean negative log predictive density under Gaussian predictive
     marginals — the proper scoring rule RMSE is not: it penalizes both
@@ -32,9 +35,13 @@ def nlpd(y_true: np.ndarray, mean: np.ndarray, var: np.ndarray) -> float:
     y = np.asarray(y_true, dtype=np.float64)
     mu = np.asarray(mean, dtype=np.float64)
     # floor: a degenerate zero predictive variance (sigma2=0 + noise-free
-    # kernel at an inducing point) must score astronomically badly, not
-    # poison the whole CV mean with log(0)/0-division inf/nan
-    v = np.maximum(np.asarray(var, dtype=np.float64), np.finfo(np.float64).tiny)
+    # kernel at an inducing point) must score finitely terribly, not poison
+    # the whole CV mean with inf.  float64.tiny fails that purpose both
+    # ways: residual^2/tiny overflows to inf, while an exactly-interpolated
+    # point scores log(tiny) ~ -354 (astronomically GOOD).  1e-12 keeps the
+    # penalty finite (~1e12 per unit residual^2) and caps the reward for
+    # exact interpolation at log(1e-12) ~ -13.8.
+    v = np.maximum(np.asarray(var, dtype=np.float64), 1e-12)
     return float(
         np.mean(0.5 * (np.log(2.0 * np.pi * v) + (y - mu) ** 2 / v))
     )
@@ -54,18 +61,62 @@ def kfold_indices(n: int, num_folds: int, seed: int = 0):
         yield train, test
 
 
-def cross_validate(
-    estimator,
-    x: np.ndarray,
-    y: np.ndarray,
-    num_folds: int = 10,
-    metric=rmse,
-    seed: int = 0,
-) -> float:
-    """Mean metric over k folds (CrossValidator with an empty param grid —
-    exactly how every reference example uses it)."""
-    x = np.asarray(x)
-    y = np.asarray(y)
+class ParamGridBuilder:
+    """Cartesian parameter grid over estimator SETTER names — the
+    counterpart of Spark ML's ``ParamGridBuilder`` (Iris.scala:29-33, wired
+    there with an empty grid).  Values are applied via
+    ``getattr(est, name)(value)``, so any reference-named setter
+    (``setSigma2``, ``setActiveSetSize``, ...) works unchanged::
+
+        grid = (ParamGridBuilder()
+                .addGrid("setSigma2", [1e-3, 1e-2])
+                .addGrid("setActiveSetSize", [50, 100])
+                .build())                       # 4 cells
+    """
+
+    def __init__(self):
+        self._grid: dict = {}
+
+    def addGrid(self, setter_name: str, values) -> "ParamGridBuilder":
+        self._grid[setter_name] = list(values)
+        return self
+
+    def build(self) -> list:
+        cells = [{}]
+        for name, values in self._grid.items():
+            cells = [dict(c, **{name: v}) for c in cells for v in values]
+        return cells
+
+
+class CrossValidationResult:
+    """Grid-search outcome: per-cell mean scores, the winning cell, and
+    (when ``refit``) the model refitted on the full data with the winning
+    config — CrossValidator's ``bestModel`` semantics."""
+
+    def __init__(self, scores, best_params, best_score, best_model):
+        self.scores = scores          # list of (params_dict, mean_score)
+        self.best_params = best_params
+        self.best_score = best_score
+        self.best_model = best_model
+
+    def __repr__(self):
+        return (
+            f"CrossValidationResult(best_params={self.best_params}, "
+            f"best_score={self.best_score:.6g}, cells={len(self.scores)})"
+        )
+
+
+def _apply_params(estimator, params: dict):
+    est = copy.copy(estimator)
+    for name, value in params.items():
+        setter = getattr(est, name)
+        ret = setter(value)
+        # reference setters chain (return this); tolerate void setters too
+        est = ret if ret is not None else est
+    return est
+
+
+def _score_folds(estimator, x, y, num_folds, metric, seed) -> float:
     scores = []
     for train_idx, test_idx in kfold_indices(x.shape[0], num_folds, seed):
         est = copy.copy(estimator)
@@ -76,6 +127,56 @@ def cross_validate(
         else:
             scores.append(metric(y[test_idx], model.predict(x[test_idx])))
     return float(np.mean(scores))
+
+
+def cross_validate(
+    estimator,
+    x: np.ndarray,
+    y: np.ndarray,
+    num_folds: int = 10,
+    metric=rmse,
+    seed: int = 0,
+    param_grid=None,
+    refit: bool = True,
+):
+    """K-fold cross-validation, optionally grid-searched.
+
+    With ``param_grid=None`` (every reference example: CrossValidator with
+    an empty grid, GPExample.scala:18-24) returns the mean metric over the
+    folds as a float — the historical signature.
+
+    With ``param_grid`` (a ``ParamGridBuilder().build()`` list, or any list
+    of ``{setter_name: value}`` dicts) evaluates every cell on the SAME
+    fold split, picks the best mean score — direction from
+    ``metric.greater_is_better`` (default: lower is better, matching
+    rmse/nlpd) — and, when ``refit``, refits the winning config on the full
+    data.  Returns a :class:`CrossValidationResult`.
+    """
+    x = np.asarray(x)
+    y = np.asarray(y)
+    if param_grid is None:
+        return _score_folds(estimator, x, y, num_folds, metric, seed)
+
+    cells = list(param_grid) or [{}]
+    larger_better = bool(getattr(metric, "greater_is_better", False))
+    scores = []
+    for params in cells:
+        est = _apply_params(estimator, params)
+        scores.append((dict(params), _score_folds(est, x, y, num_folds, metric, seed)))
+    # a NaN-scoring cell (degenerate fit) must never win: min/max keep a
+    # NaN first element because every comparison with NaN is False
+    finite = [ps for ps in scores if np.isfinite(ps[1])]
+    if not finite:
+        raise ValueError(
+            "every param-grid cell produced a non-finite CV score; "
+            f"scores={scores}"
+        )
+    pick = max if larger_better else min
+    best_params, best_score = pick(finite, key=lambda ps: ps[1])
+    best_model = None
+    if refit:
+        best_model = _apply_params(estimator, best_params).fit(x, y)
+    return CrossValidationResult(scores, best_params, best_score, best_model)
 
 
 def train_validation_split(

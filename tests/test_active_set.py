@@ -161,7 +161,7 @@ def test_greedy_matches_dense_seeger_oracle(rng):
     m, first = 25, 17
 
     oracle_idx = _dense_seeger_order(kernel, theta, x, y, m, first)
-    got_pts, got_idx = _greedy_select(
+    got_pts, got_idx, _ = _greedy_select(
         kernel, m, jnp.asarray(theta), jnp.asarray(x), jnp.asarray(y),
         jnp.ones(200), jnp.asarray(first, dtype="int32"),
     )
@@ -191,16 +191,20 @@ def test_greedy_sharded_matches_single_device(rng, eight_device_mesh):
     mf = jnp.asarray(np.asarray(data.mask).reshape(-1))
     first = int(np.flatnonzero(np.asarray(mf) > 0)[5])
 
-    single, single_idx = _greedy_select(
+    single, single_idx, single_d = _greedy_select(
         kernel, 12, theta, xf, yf, mf, jnp.asarray(first, dtype="int32")
     )
-    sharded, sharded_idx = _greedy_select_sharded(
+    sharded, sharded_idx, sharded_d = _greedy_select_sharded(
         kernel, 12, eight_device_mesh, theta, data.x, data.y, data.mask,
         jnp.asarray(first, dtype="int32"),
     )
     single, sharded = np.asarray(single), np.asarray(sharded)
     np.testing.assert_array_equal(np.asarray(sharded_idx), np.asarray(single_idx))
     np.testing.assert_allclose(sharded, single, atol=1e-10)
+    # the Δ-profile diagnostic must agree across the two paths too
+    np.testing.assert_allclose(
+        np.asarray(sharded_d), np.asarray(single_d), atol=1e-10
+    )
     # every selected point is a real (unpadded) data row
     rows = {tuple(np.round(r, 12)) for r in x}
     for r in sharded:
@@ -227,3 +231,49 @@ def test_kmeans_from_stack_matches_clusters(rng, eight_device_mesh):
     centers = np.sort(np.asarray(active), axis=0)
     np.testing.assert_allclose(centers[0], [0.0, 0.0], atol=0.5)
     np.testing.assert_allclose(centers[1], [5.0, 5.0], atol=0.5)
+
+
+def test_greedy_flat_delta_profile_warning(rng, caplog):
+    """The airfoil-shaped pathology (late picks remote in kernel space,
+    Δ-profile never decays — PARITY.md) must warn at SELECTION time; the
+    payoff regime (density-skewed data, decaying profile) must stay quiet."""
+    import logging
+
+    from spark_gp_tpu.models.greedy import (
+        greedy_active_set,
+        warn_on_flat_delta_profile,
+    )
+
+    # unit-level: synthetic profiles on both sides of the calibrated 0.95 bar
+    flat = np.concatenate([[np.nan], np.full(23, 100.0)])
+    with caplog.at_level(logging.WARNING, logger="spark_gp_tpu"):
+        ratio = warn_on_flat_delta_profile(flat)
+    assert ratio is not None and ratio >= 0.95
+    assert any("not decaying" in r.message for r in caplog.records)
+
+    caplog.clear()
+    decaying = np.concatenate([[np.nan], np.geomspace(100.0, 1.0, 23)])
+    with caplog.at_level(logging.WARNING, logger="spark_gp_tpu"):
+        ratio = warn_on_flat_delta_profile(decaying)
+    assert ratio is not None and ratio < 0.95
+    assert not caplog.records
+    # too-short profiles never accuse anyone
+    assert warn_on_flat_delta_profile(np.full(5, 1.0)) is None
+
+    # end-to-end on the airfoil-shaped regime: heavy-tailed targets whose
+    # outliers sit far apart in kernel space (the measured r5 calibration
+    # used real airfoil: ratios 1.05-5.7 vs 0.22-0.84 in the payoff regime)
+    from spark_gp_tpu.data import load_airfoil
+    from spark_gp_tpu.kernels.base import Const, EyeKernel
+    from spark_gp_tpu import ARDRBFKernel
+
+    xa, ya = load_airfoil()
+    xa = (xa - xa.mean(0)) / xa.std(0)
+    ya = (ya - ya.mean()) / ya.std()
+    kernel = 1.0 * ARDRBFKernel(np.full(xa.shape[1], 1.0), 1e-6, 10) + (
+        Const(1e-4) * EyeKernel()
+    )
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="spark_gp_tpu"):
+        greedy_active_set(32, xa, ya, kernel, kernel.init_theta(), seed=13)
+    assert any("not decaying" in r.message for r in caplog.records)

@@ -126,3 +126,80 @@ def test_quality_single_part_report_contract():
     assert report["failed_bars"] == ([] if part["passed"] else ["greedy_vs_random"])
     # bars gate the exit code
     assert out.returncode == (0 if not report["failed_bars"] else 1)
+
+
+def test_parse_bench_payload_shapes():
+    """_parse_bench_payload must read all four artifact shapes: raw emit,
+    builder side artifact, watcher envelope, driver capture."""
+    sys.path.insert(0, ROOT)
+    import bench
+
+    raw = {"metric": "m", "value": 1.0, "unit": "u", "detail": {"platform": "tpu"}}
+    assert bench._parse_bench_payload(raw) == raw
+    assert bench._parse_bench_payload({"parsed": raw}) == raw
+    line = json.dumps(raw)
+    assert bench._parse_bench_payload(
+        {"stdout_tail": "noise\n" + line + "\n"}
+    ) == raw
+    assert bench._parse_bench_payload({"tail": line + "\n"}) == raw
+    assert bench._parse_bench_payload({"tail": "no json here"}) is None
+    assert bench._parse_bench_payload("not a dict") is None
+
+
+def test_freshest_hardware_evidence_finds_committed_artifact():
+    """The repo carries at least one on-TPU side artifact (BENCH_r04_tpu.json);
+    the evidence scanner must surface a pointer with the driver-readable
+    fields (VERDICT r4 #6)."""
+    sys.path.insert(0, ROOT)
+    import bench
+
+    ev = bench._freshest_hardware_evidence()
+    assert ev is not None, "no TPU evidence found despite committed artifacts"
+    for key in ("file", "metric", "value", "unit", "captured"):
+        assert key in ev, key
+    assert ev["value"] and ev["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_fallback_embeds_hardware_evidence_pointer():
+    """When the default plan fails preflight, the CPU-fallback artifact must
+    carry detail.freshest_hardware_evidence so it can never masquerade as
+    the round's hardware number."""
+    out = _run(
+        "bench.py",
+        {
+            # an unloadable platform makes the default plan fail FAST and
+            # deterministically; the cpu-fallback plan then measures
+            "JAX_PLATFORMS": "no_such_platform",
+            "BENCH_N": "1500",
+            "BENCH_EXPERT": "50",
+            "BENCH_MXU_EXPERT": "64",
+            "BENCH_MAXITER": "3",
+            "BENCH_PREFLIGHT_TIMEOUT": "120",
+            "BENCH_PREFLIGHT_ATTEMPTS": "1",
+        },
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    detail = result["detail"]
+    assert "fallback" in detail
+    ev = detail["freshest_hardware_evidence"]
+    assert isinstance(ev, dict), ev  # this checkout has committed evidence
+    assert ev["value"] > 0
+    assert "file" in ev and "captured" in ev
+
+
+def test_freshest_hardware_evidence_prefers_stamped_artifacts():
+    """A capture-stamped TPU artifact must outrank unstamped ones even
+    when the unstamped file's mtime is newer (fresh-clone mtimes are all
+    checkout time): the evidence pointer must name the newest STAMPED
+    on-chip number, not whichever file git wrote last."""
+    sys.path.insert(0, ROOT)
+    import bench
+
+    ev = bench._freshest_hardware_evidence()
+    assert ev is not None
+    # BENCH_r04_tpu.json is the only committed artifact carrying a capture
+    # stamp with platform=tpu; BENCH_r02.json (also tpu) is unstamped and
+    # its checkout mtime is newer — the stamp must win
+    assert ev["captured"] is not None

@@ -126,3 +126,37 @@ def test_non_pd_yields_nan():
     kinv, ld = _pallas_inv_logdet(jnp.asarray(k), interpret=True)
     assert np.isfinite(np.asarray(ld)[0])
     assert not np.isfinite(np.asarray(ld)[1])
+
+
+def test_matmul_precision_knob(monkeypatch):
+    """GP_MATMUL_PRECISION maps to the lax.Precision enum (trace-time knob
+    for the blocked-inverse matmuls and the VJP, r5 MFU campaign), defaults
+    to HIGHEST, and the interpreter-mode kernel stays numerically correct
+    under the 'high' setting (on CPU all settings lower identically — this
+    pins the plumbing; the accuracy/speed trade is measured on hardware by
+    benchmarks/roofline.py)."""
+    from spark_gp_tpu.ops.pallas_linalg import _matmul_precision
+
+    monkeypatch.delenv("GP_MATMUL_PRECISION", raising=False)
+    assert _matmul_precision() == jax.lax.Precision.HIGHEST
+    for name, want in (
+        ("highest", jax.lax.Precision.HIGHEST),
+        ("high", jax.lax.Precision.HIGH),
+        ("default", jax.lax.Precision.DEFAULT),
+        ("HIGH", jax.lax.Precision.HIGH),  # case-insensitive
+    ):
+        monkeypatch.setenv("GP_MATMUL_PRECISION", name)
+        assert _matmul_precision() == want
+    with pytest.raises(ValueError, match="GP_MATMUL_PRECISION"):
+        monkeypatch.setenv("GP_MATMUL_PRECISION", "bf16")
+        _matmul_precision()
+
+    monkeypatch.setenv("GP_MATMUL_PRECISION", "high")
+    k = _spd_batch(2, 36, seed=9)
+    with jax.disable_jit():  # fresh trace so the knob is actually read
+        kinv, ld = _pallas_inv_logdet(jnp.asarray(k), interpret=True)
+    want_inv = np.linalg.inv(k.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(kinv), want_inv, atol=5e-4)
+    np.testing.assert_allclose(
+        np.asarray(ld), np.linalg.slogdet(k.astype(np.float64))[1], rtol=1e-5
+    )
