@@ -484,11 +484,11 @@ def worker() -> None:
 
     total_flops = optimizer_flops(expert_size, nfev)
     est_tflops_per_sec = total_flops / fit_seconds / 1e12
-    # bf16 MXU peak by device generation (public figures); f32 runs at ~half
-    peak_by_kind = {"v4": 275.0, "v5 lite": 197.0, "v5e": 197.0,
-                    "v5p": 459.0, "v6e": 918.0, "v6 lite": 918.0}
-    kind = jax.devices()[0].device_kind.lower()
-    peak = next((v for k, v in peak_by_kind.items() if k in kind), None)
+    # bf16 MXU peak from the shared chip-spec table (ops/precision.py) so
+    # this number and detail.roofline's can never use different peaks
+    from spark_gp_tpu.ops.precision import chip_peaks
+
+    peak, _ = chip_peaks(jax.devices()[0].device_kind)
 
     result = {
         **primary_fields,
@@ -910,7 +910,6 @@ def supervise() -> int:
                 reason = errors.get("default-worker") or errors.get(
                     "default-preflight"
                 )
-                result["detail"] = result.get("detail", {})
                 result["detail"]["fallback"] = f"default plan failed: {reason}"
                 result["detail"]["fallback_note"] = (
                     "CPU-fallback measurement (detail.fallback records why "

@@ -38,14 +38,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# public chip specs (same convention as bench.py): nominal bf16 MXU peak
-# TFLOP/s and HBM GB/s by device-kind substring
-PEAK_TFLOPS = {"v4": 275.0, "v5 lite": 197.0, "v5e": 197.0,
-               "v5p": 459.0, "v6e": 918.0, "v6 lite": 918.0}
-PEAK_GBPS = {"v4": 1228.0, "v5 lite": 819.0, "v5e": 819.0,
-             "v5p": 2765.0, "v6e": 1640.0, "v6 lite": 1640.0}
-# f32 emulation cost in bf16 passes: the matmul-rate ceiling is peak/passes
-PRECISION_PASSES = {"highest": 6, "high": 3, "default": 1}
+# chip peaks and precision-pass costs: ONE importable home shared with
+# bench.py so the two can never disagree about a chip's peak
+from spark_gp_tpu.ops.precision import PRECISION_PASSES, chip_peaks  # noqa: E402
 
 TOTAL_POINTS = int(os.environ.get("ROOFLINE_TOTAL", 65536))
 EXPERT_SIZES = tuple(
@@ -73,9 +68,7 @@ def _peaks():
     import jax
 
     kind = jax.devices()[0].device_kind.lower()
-    tf = next((v for k, v in PEAK_TFLOPS.items() if k in kind), None)
-    bw = next((v for k, v in PEAK_GBPS.items() if k in kind), None)
-    return kind, tf, bw
+    return (kind, *chip_peaks(kind))
 
 
 def _row(name, seconds, flops, bytes_, tflops_peak, gbps_peak, passes=6):
@@ -211,10 +204,12 @@ def _run_child(precision: str) -> dict:
     every child to an init failure."""
     env = dict(os.environ)
     env["GP_MATMUL_PRECISION"] = precision
+    # 600s default: both lanes must fit inside bench.py's outer
+    # BENCH_ROOFLINE_TIMEOUT=1500s fence with slack
     child = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child"],
         capture_output=True, text=True,
-        timeout=float(os.environ.get("ROOFLINE_CHILD_TIMEOUT", 900)), env=env,
+        timeout=float(os.environ.get("ROOFLINE_CHILD_TIMEOUT", 600)), env=env,
     )
     for line in reversed(child.stdout.strip().splitlines()):
         try:
@@ -242,6 +237,10 @@ def main() -> None:
             report[f"quality_{precision}"] = payload["quality"]
         except Exception as exc:  # noqa: BLE001 — record and keep going
             report[f"{precision}_error"] = f"{type(exc).__name__}: {exc}"[:300]
+        # incremental emit after EVERY lane: consumers parse the LAST JSON
+        # line, so a kill during the second lane still salvages the first
+        # (the same early-emit convention as bench.py's primary metric)
+        print(json.dumps(report), flush=True)
 
     if "quality_high" in report and "quality_highest" in report:
         q_hi, q3 = report["quality_highest"], report["quality_high"]

@@ -146,8 +146,10 @@ def main() -> None:
         log.flush()
 
     note("watcher started")
+    failed_probes = 0
     while True:
         if _probe_tpu():
+            failed_probes = 0
             note("TPU REACHABLE — capturing artifacts")
             env = dict(os.environ)
             env.pop("JAX_PLATFORMS", None)
@@ -190,6 +192,12 @@ def main() -> None:
             note("pallas sweep done; sleeping 15 min before re-probe")
             time.sleep(900)
         else:
+            # heartbeat every ~30 min of failed probes: a silent log reads
+            # as "watcher died", not "tunnel stayed down" — post-mortems
+            # need to tell the two apart
+            failed_probes += 1
+            if failed_probes % 10 == 0:
+                note(f"tunnel still down ({failed_probes} failed probes)")
             time.sleep(180)
 
 

@@ -60,9 +60,13 @@ def _flat_stats(kernel: Kernel, theta, active, xf, yf, maskf):
     ``[c]`` (single target) or ``[c, C]`` (multi-target: the multiclass
     latent heads share U1 and differ only in the right-hand sides)."""
     from spark_gp_tpu.ops.distance import mxu_inner
+    from spark_gp_tpu.ops.precision import matmul_precision
 
     kmn = kernel.cross(theta, active, xf) * maskf[None, :]  # [m, c]
-    u1 = mxu_inner(kmn, kmn)
+    # not a cancellation: U1's accuracy is bounded by kmn's f32 storage
+    # either way, so this matmul rides the measured GP_MATMUL_PRECISION
+    # trade (roofline mixed-precision lane) instead of pinning HIGHEST
+    u1 = mxu_inner(kmn, kmn, precision=matmul_precision())
     ym = yf * (maskf if yf.ndim == 1 else maskf[:, None])
     u2 = kmn @ ym
     return u1, u2

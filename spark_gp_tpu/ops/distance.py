@@ -16,15 +16,22 @@ import jax
 import jax.numpy as jnp
 
 
-def mxu_inner(x1: jax.Array, x2: jax.Array) -> jax.Array:
+def mxu_inner(x1: jax.Array, x2: jax.Array, precision=None) -> jax.Array:
     """``[n1, p], [n2, p] -> [n1, n2]`` pairwise inner products as one MXU
-    matmul at HIGHEST precision — the single home of the "contract feature
-    dim, full-f32 accumulation" convention every kernel rides."""
+    matmul — the single home of the "contract feature dim, full-f32
+    accumulation" convention every kernel rides.
+
+    Default HIGHEST (6-pass bf16 = true f32): mandatory for the sq-dist
+    cancellation below, where a bf16-noisy inner product destroys small
+    distances.  Callers whose output is NOT fed into a cancellation (e.g.
+    the PPA ``K_mn K_nm`` statistics, where f32 storage already bounds the
+    result's accuracy) may pass the measured-trade precision from
+    ``ops.precision.matmul_precision`` instead."""
     return jax.lax.dot_general(
         x1,
         x2,
         dimension_numbers=(((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
+        precision=jax.lax.Precision.HIGHEST if precision is None else precision,
     )
 
 

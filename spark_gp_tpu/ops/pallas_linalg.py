@@ -66,31 +66,9 @@ _N_MAX = 512  # largest matrix the Pallas path handles (VMEM at T=1)
 _HI = jax.lax.Precision.HIGHEST
 
 
-def _matmul_precision():
-    """MXU precision for the blocked-inverse matmuls and the VJP.
-
-    ``GP_MATMUL_PRECISION``: ``highest`` (default; 6-pass bf16 = true f32,
-    ceiling ~peak/6), ``high`` (3-pass bf16x3, ~2x the matmul rate at
-    ~1e-6 relative error — the MFU-campaign candidate, r5), or ``default``
-    (1-pass bf16, ~1e-3 error — measured fatal for L-BFGS line-search
-    consistency, exposed for experiments only).  Read at TRACE time: set
-    the env var before the first fit in a process; benchmarks vary it via
-    subprocesses (benchmarks/roofline.py).
-    """
-    name = os.environ.get("GP_MATMUL_PRECISION", "highest").strip().lower()
-    table = {
-        "highest": jax.lax.Precision.HIGHEST,
-        "high": jax.lax.Precision.HIGH,
-        "default": jax.lax.Precision.DEFAULT,
-    }
-    if name not in table:
-        # fail loud and NAMED — a bare KeyError from inside a jit trace
-        # never mentions the env var
-        raise ValueError(
-            f"GP_MATMUL_PRECISION={name!r} is not supported; use one of "
-            f"{sorted(table)}"
-        )
-    return table[name]
+# the GP_MATMUL_PRECISION knob lives in ops/precision.py (it also governs
+# the PPA statistics matmul); re-exported here for the kernel's callers
+from spark_gp_tpu.ops.precision import matmul_precision as _matmul_precision
 
 
 def _blocks_for(n_pad: int) -> tuple:

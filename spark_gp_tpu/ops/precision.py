@@ -1,0 +1,65 @@
+"""Matmul-precision policy and public chip-spec tables.
+
+ONE home for two things several modules were starting to duplicate:
+
+* :func:`matmul_precision` — the ``GP_MATMUL_PRECISION`` knob governing
+  every f32 matmul that is NOT a cancellation (the Pallas blocked-inverse
+  panels, the SPD VJP, the PPA ``K_mn K_nm`` statistics).  The sq-dist
+  contraction in :mod:`ops.distance` deliberately does NOT ride it.
+* ``PEAK_TFLOPS`` / ``PEAK_GBPS`` — nominal per-chip bf16-matmul and HBM
+  peaks (public figures), keyed by ``device_kind`` substring, consumed by
+  ``bench.py`` and ``benchmarks/roofline.py`` so their MFU/bandwidth
+  fractions can never disagree about what a chip's peak is.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+# nominal bf16 MXU peak TFLOP/s by device-kind substring (public figures);
+# f32 emulation runs at peak/passes — see PRECISION_PASSES
+PEAK_TFLOPS = {"v4": 275.0, "v5 lite": 197.0, "v5e": 197.0,
+               "v5p": 459.0, "v6e": 918.0, "v6 lite": 918.0}
+# nominal HBM bandwidth GB/s by device-kind substring (public figures)
+PEAK_GBPS = {"v4": 1228.0, "v5 lite": 819.0, "v5e": 819.0,
+             "v5p": 2765.0, "v6e": 1640.0, "v6 lite": 1640.0}
+# f32-emulation cost of each precision mode, in bf16 MXU passes
+PRECISION_PASSES = {"highest": 6, "high": 3, "default": 1}
+
+
+def chip_peaks(device_kind: str):
+    """``(bf16_peak_tflops, hbm_peak_gbps)`` for a ``device_kind`` string,
+    either possibly None when the generation is unknown."""
+    kind = device_kind.lower()
+    tf = next((v for k, v in PEAK_TFLOPS.items() if k in kind), None)
+    bw = next((v for k, v in PEAK_GBPS.items() if k in kind), None)
+    return tf, bw
+
+
+def matmul_precision():
+    """MXU precision for non-cancellation f32 matmuls.
+
+    ``GP_MATMUL_PRECISION``: ``highest`` (default; 6-pass bf16 = true f32,
+    matmul-rate ceiling ~peak/6), ``high`` (3-pass bf16x3, ~2x the rate at
+    ~1e-6 relative error — the measured-trade candidate, quality-gated in
+    ``benchmarks/roofline.py``), or ``default`` (1-pass bf16, ~1e-3 error
+    — measured fatal for L-BFGS line-search consistency; exposed for
+    experiments only).  Read at TRACE time: set the env var before the
+    first fit in a process; benchmarks vary it via subprocesses.
+    """
+    name = os.environ.get("GP_MATMUL_PRECISION", "highest").strip().lower()
+    table = {
+        "highest": jax.lax.Precision.HIGHEST,
+        "high": jax.lax.Precision.HIGH,
+        "default": jax.lax.Precision.DEFAULT,
+    }
+    if name not in table:
+        # fail loud and NAMED — a bare KeyError from inside a jit trace
+        # never mentions the env var
+        raise ValueError(
+            f"GP_MATMUL_PRECISION={name!r} is not supported; use one of "
+            f"{sorted(table)}"
+        )
+    return table[name]

@@ -203,3 +203,74 @@ def test_freshest_hardware_evidence_prefers_stamped_artifacts():
     # stamp with platform=tpu; BENCH_r02.json (also tpu) is unstamped and
     # its checkout mtime is newer — the stamp must win
     assert ev["captured"] is not None
+
+
+@pytest.mark.slow
+def test_matched_config_lane_contract():
+    """benchmarks/matched_config.py must emit one JSON line with both
+    timing modes, RTT measurements, and the r2-comparison summary."""
+    out = _run(
+        "benchmarks/matched_config.py",
+        {"MATCHED_N": "2000", "MATCHED_EXPERT": "50", "MATCHED_MAXITER": "3"},
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    for mode in ("async", "sync_phases"):
+        row = result["rows"][mode]
+        assert row["train_points_per_sec"] > 0
+        assert row["phase_seconds"]
+    assert result["rtt_before"]["median_ms"] >= 0
+    assert result["rtt_after"]["median_ms"] >= 0
+    assert result["summary"]["r2_reference_pts_per_sec"] == 247124.8
+    assert result["summary"]["async_vs_sync_ratio"] is not None
+
+
+@pytest.mark.slow
+def test_large_m_lane_contract():
+    """benchmarks/large_m.py must engage the device magic-solve dispatch
+    (m >= _DEVICE_SOLVE_MIN_M), pass both RMSE bars, and carry phase
+    timings that show where the m^3 work ran."""
+    out = _run(
+        "benchmarks/large_m.py",
+        {"LARGE_M": "2048", "LARGE_M_N": "12000", "LARGE_M_MAXITER": "2"},
+        timeout=900,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    lane = result["m4096_synthetic"]
+    assert lane["m"] >= result["device_solve_min_m"]
+    assert lane["passed"], lane
+    assert lane["phase_seconds"]["magic_solve"] > 0
+    assert result["airfoil_m1000"]["passed"], result["airfoil_m1000"]
+    assert result["passed"]
+
+
+@pytest.mark.slow
+def test_roofline_lane_contract():
+    """benchmarks/roofline.py must emit one JSON line with both precision
+    lanes (run as separate child processes), per-op rows carrying the
+    achieved-rate fields, and the mixed-precision quality guard."""
+    out = _run(
+        "benchmarks/roofline.py",
+        {
+            "ROOFLINE_TOTAL": "2048",
+            "ROOFLINE_SIZES": "64",
+            "ROOFLINE_REPEATS": "1",
+            "ROOFLINE_CHILD_TIMEOUT": "420",
+        },
+        timeout=900,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    for lane in ("highest", "high"):
+        assert lane in result, result.keys()
+        rows = result[lane]["rows"]
+        ops = [r["op"] for r in rows]
+        assert any(o.startswith("gram_build") for o in ops)
+        assert any(o.startswith("spd_inv_logdet_fwd") for o in ops)
+        assert any(o.startswith("objective_value_and_grad") for o in ops)
+        assert all(r["achieved_tflops_per_sec"] > 0 for r in rows)
+        assert "calibration_matmul_4096" in result[lane]
+    guard = result["mixed_precision_guard"]
+    assert guard["both_under_bar"], guard
+    assert "verdict" in guard
