@@ -876,7 +876,16 @@ def _roofline_after_worker(env: dict, platform) -> dict:
             timeout=float(os.environ.get("BENCH_ROOFLINE_TIMEOUT", 1500)),
             env=renv,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        # roofline prints its report incrementally per precision lane —
+        # salvage whatever completed before the fence tripped
+        stdout = exc.stdout
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        parsed = _parse_last_json(stdout or "")
+        if parsed is not None:
+            parsed["truncated"] = "outer roofline fence tripped"
+            return parsed
         return {"error": "roofline timed out"}
     parsed = _parse_last_json(r.stdout)
     if parsed is not None:

@@ -13,10 +13,12 @@ loop; the moment a probe subprocess reports a real TPU it
    tail to ``TPU_WINDOW_TESTS.json``;
 3. runs the r2-reconciliation matched-config lane
    (``TPU_WINDOW_MATCHED.json``) and the large-m lane
-   (``TPU_WINDOW_LARGE_M.json``) when their scripts exist;
+   (``TPU_WINDOW_LARGE_M.json``);
 4. runs the Pallas expert-size sweep, saving ``TPU_WINDOW_PALLAS.json``;
 
-then keeps polling (later windows refresh the artifacts).  Everything is
+re-probing between lanes (a tunnel that dies mid-window abandons the
+remaining lanes instead of serially burning their timeouts), then keeps
+polling — later windows refresh the artifacts.  Everything is
 best-effort and timeout-fenced; the watcher itself never touches the
 device in-process (a hung init inside this process would kill the loop).
 
@@ -153,43 +155,40 @@ def main() -> None:
             note("TPU REACHABLE — capturing artifacts")
             env = dict(os.environ)
             env.pop("JAX_PLATFORMS", None)
-            # bench first: it lands the round's headline number and warms
-            # the persistent compile cache for any subsequent run
-            # 4500s: worker watchdog (2400) + post-worker roofline (1500)
-            # + preflight, with slack; bench prints the primary line before
-            # the roofline so even a fence trip salvages the measurement
-            _run([sys.executable, "bench.py"], "TPU_WINDOW_BENCH.json", 4500, env)
-            note("bench done")
-            # VERDICT r4 #2: an ON-CHIP asserted quality bar (synthetics
-            # RMSE < 0.11) + the Mosaic compiled-lowering parity tests,
-            # captured together so every window carries kernel validation
             tenv = dict(env)
             tenv["GP_TEST_PLATFORM"] = "tpu"
-            _run(
-                [sys.executable, "-m", "pytest", "tests/test_pallas_linalg.py",
-                 "tests/test_tpu_quality_slice.py", "-q"],
-                "TPU_WINDOW_TESTS.json", 1500, tenv,
-            )
-            note("mosaic + quality-slice tests done")
-            # VERDICT r4 #3/#4: matched-config r2-reconciliation lane and
-            # the large-m (sharded magic solve + airfoil m=1000) lane
-            if os.path.exists(os.path.join(ROOT, "benchmarks/matched_config.py")):
-                _run(
-                    [sys.executable, "benchmarks/matched_config.py"],
-                    "TPU_WINDOW_MATCHED.json", 1800, env,
-                )
-                note("matched-config lane done")
-            if os.path.exists(os.path.join(ROOT, "benchmarks/large_m.py")):
-                _run(
-                    [sys.executable, "benchmarks/large_m.py"],
-                    "TPU_WINDOW_LARGE_M.json", 1800, env,
-                )
-                note("large-m lane done")
-            _run(
-                [sys.executable, "benchmarks/pallas_sweep.py"],
-                "TPU_WINDOW_PALLAS.json", 1800, env,
-            )
-            note("pallas sweep done; sleeping 15 min before re-probe")
+            # bench first: it lands the round's headline number and warms
+            # the persistent compile cache for any subsequent run.
+            # 4500s: worker watchdog (2400) + post-worker roofline (1500)
+            # + preflight, with slack; bench prints the primary line before
+            # the roofline so even a fence trip salvages the measurement.
+            # The quality-slice/Mosaic tests (VERDICT r4 #2) and the
+            # matched-config / large-m lanes (r4 #3/#4) follow.
+            lanes = [
+                ([sys.executable, "bench.py"],
+                 "TPU_WINDOW_BENCH.json", 4500, env, "bench"),
+                ([sys.executable, "-m", "pytest",
+                  "tests/test_pallas_linalg.py",
+                  "tests/test_tpu_quality_slice.py", "-q"],
+                 "TPU_WINDOW_TESTS.json", 1500, tenv,
+                 "mosaic + quality-slice tests"),
+                ([sys.executable, "benchmarks/matched_config.py"],
+                 "TPU_WINDOW_MATCHED.json", 1800, env, "matched-config lane"),
+                ([sys.executable, "benchmarks/large_m.py"],
+                 "TPU_WINDOW_LARGE_M.json", 1800, env, "large-m lane"),
+                ([sys.executable, "benchmarks/pallas_sweep.py"],
+                 "TPU_WINDOW_PALLAS.json", 1800, env, "pallas sweep"),
+            ]
+            for i, (cmd, out_path, timeout_s, lane_env, name) in enumerate(lanes):
+                _run(cmd, out_path, timeout_s, lane_env)
+                note(f"{name} done")
+                # windows can be shorter than the full capture sequence:
+                # a dead tunnel makes every remaining lane burn its whole
+                # timeout for nothing — re-probe between lanes and bail
+                if i + 1 < len(lanes) and not _probe_tpu():
+                    note("tunnel died mid-window — abandoning remaining lanes")
+                    break
+            note("window capture finished; sleeping 15 min before re-probe")
             time.sleep(900)
         else:
             # heartbeat every ~30 min of failed probes: a silent log reads

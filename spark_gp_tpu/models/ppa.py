@@ -60,13 +60,13 @@ def _flat_stats(kernel: Kernel, theta, active, xf, yf, maskf):
     ``[c]`` (single target) or ``[c, C]`` (multi-target: the multiclass
     latent heads share U1 and differ only in the right-hand sides)."""
     from spark_gp_tpu.ops.distance import mxu_inner
-    from spark_gp_tpu.ops.precision import matmul_precision
 
     kmn = kernel.cross(theta, active, xf) * maskf[None, :]  # [m, c]
-    # not a cancellation: U1's accuracy is bounded by kmn's f32 storage
-    # either way, so this matmul rides the measured GP_MATMUL_PRECISION
-    # trade (roofline mixed-precision lane) instead of pinning HIGHEST
-    u1 = mxu_inner(kmn, kmn, precision=matmul_precision())
+    # NOT on the GP_MATMUL_PRECISION knob: every caller runs the (U1, u2)
+    # accumulation in f64 (models/common.py casts under jax.enable_x64 —
+    # the one-time stats feed a condition-squared normal-equations solve),
+    # and lax.Precision only selects bf16 pass counts for f32 inputs
+    u1 = mxu_inner(kmn, kmn)
     ym = yf * (maskf if yf.ndim == 1 else maskf[:, None])
     u2 = kmn @ ym
     return u1, u2

@@ -16,22 +16,17 @@ import jax
 import jax.numpy as jnp
 
 
-def mxu_inner(x1: jax.Array, x2: jax.Array, precision=None) -> jax.Array:
+def mxu_inner(x1: jax.Array, x2: jax.Array) -> jax.Array:
     """``[n1, p], [n2, p] -> [n1, n2]`` pairwise inner products as one MXU
-    matmul — the single home of the "contract feature dim, full-f32
-    accumulation" convention every kernel rides.
-
-    Default HIGHEST (6-pass bf16 = true f32): mandatory for the sq-dist
-    cancellation below, where a bf16-noisy inner product destroys small
-    distances.  Callers whose output is NOT fed into a cancellation (e.g.
-    the PPA ``K_mn K_nm`` statistics, where f32 storage already bounds the
-    result's accuracy) may pass the measured-trade precision from
-    ``ops.precision.matmul_precision`` instead."""
+    matmul at HIGHEST precision — the single home of the "contract feature
+    dim, full-f32 accumulation" convention every kernel rides.  (The f64
+    PPA statistics path also routes through here; lax.Precision is inert
+    on f64 inputs, so the pin costs those callers nothing.)"""
     return jax.lax.dot_general(
         x1,
         x2,
         dimension_numbers=(((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST if precision is None else precision,
+        precision=jax.lax.Precision.HIGHEST,
     )
 
 

@@ -3,9 +3,11 @@
 ONE home for two things several modules were starting to duplicate:
 
 * :func:`matmul_precision` — the ``GP_MATMUL_PRECISION`` knob governing
-  every f32 matmul that is NOT a cancellation (the Pallas blocked-inverse
-  panels, the SPD VJP, the PPA ``K_mn K_nm`` statistics).  The sq-dist
-  contraction in :mod:`ops.distance` deliberately does NOT ride it.
+  the hot-loop f32 matmuls that are NOT a cancellation: the Pallas
+  blocked-inverse panels and the SPD VJP (together the dominant matmul
+  work of every L-BFGS eval).  The sq-dist contraction in
+  :mod:`ops.distance` deliberately does NOT ride it, and the one-time PPA
+  statistics run in f64 where ``lax.Precision`` is inert.
 * ``PEAK_TFLOPS`` / ``PEAK_GBPS`` — nominal per-chip bf16-matmul and HBM
   peaks (public figures), keyed by ``device_kind`` substring, consumed by
   ``bench.py`` and ``benchmarks/roofline.py`` so their MFU/bandwidth
