@@ -52,7 +52,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -91,23 +90,21 @@ def _parse_last_json(text: str):
 
 
 def _run_sub(code_or_args, timeout_s: float, env: dict):
-    """Run a python subprocess; returns (parsed-last-JSON-line | None, err)."""
-    try:
-        out = subprocess.run(
-            [sys.executable] + code_or_args,
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=env,
-        )
-    except subprocess.TimeoutExpired as exc:
+    """Run a python subprocess; returns (parsed-last-JSON-line | None, err).
+
+    Uses utils.subproc.run_captured, NOT subprocess.run: run()'s timeout
+    path drains the killed child's pipes with an UNBOUNDED communicate(),
+    so a tunnel helper process inheriting the pipes would wedge this
+    supervisor past its own watchdog.
+    """
+    from spark_gp_tpu.utils.subproc import run_captured
+
+    out = run_captured([sys.executable] + code_or_args, timeout_s, env=env)
+    if out.timed_out:
         # salvage: the worker prints its primary result line BEFORE the
         # optional trailing extras (Pallas sweep), so a watchdog kill during
         # the extras must not discard an already-measured metric
-        stdout = exc.stdout
-        if isinstance(stdout, bytes):
-            stdout = stdout.decode(errors="replace")
-        parsed = _parse_last_json(stdout)
+        parsed = _parse_last_json(out.stdout)
         if parsed is not None:
             parsed.setdefault("detail", {})["truncated"] = (
                 f"worker timed out after {timeout_s:.0f}s past this result"
@@ -867,22 +864,19 @@ def _roofline_after_worker(env: dict, platform) -> dict:
         renv.setdefault("ROOFLINE_SIZES", "64,128")
         renv.setdefault("ROOFLINE_REPEATS", "1")
         renv.setdefault("ROOFLINE_CHILD_TIMEOUT", "300")
-    try:
-        r = subprocess.run(
-            [sys.executable,
-             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "benchmarks", "roofline.py")],
-            capture_output=True, text=True,
-            timeout=float(os.environ.get("BENCH_ROOFLINE_TIMEOUT", 1500)),
-            env=renv,
-        )
-    except subprocess.TimeoutExpired as exc:
+    from spark_gp_tpu.utils.subproc import run_captured
+
+    r = run_captured(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "benchmarks", "roofline.py")],
+        float(os.environ.get("BENCH_ROOFLINE_TIMEOUT", 1500)),
+        env=renv,
+    )
+    if r.timed_out:
         # roofline prints its report incrementally per precision lane —
         # salvage whatever completed before the fence tripped
-        stdout = exc.stdout
-        if isinstance(stdout, bytes):
-            stdout = stdout.decode(errors="replace")
-        parsed = _parse_last_json(stdout or "")
+        parsed = _parse_last_json(r.stdout)
         if parsed is not None:
             parsed["truncated"] = "outer roofline fence tripped"
             return parsed

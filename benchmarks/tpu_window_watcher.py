@@ -37,9 +37,12 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_gp_tpu.utils.subproc import run_captured  # noqa: E402
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBE = (
@@ -54,46 +57,26 @@ PROBE = (
 def _probe_tpu(timeout_s: float = 90.0) -> bool:
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", PROBE],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    return r.stdout.strip().endswith("tpu")
-
-
-def _decode(v):
-    if v is None:
-        return ""
-    return v.decode(errors="replace") if isinstance(v, bytes) else v
+    r = run_captured([sys.executable, "-c", PROBE], timeout_s, env=env)
+    return (not r.timed_out) and r.stdout.strip().endswith("tpu")
 
 
 def _run(cmd, out_path, timeout_s, env=None):
     stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
-    try:
-        r = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout_s,
-            env=env or dict(os.environ), cwd=ROOT,
-        )
-        envelope = {
-            "captured": stamp,
-            "command": cmd,
-            "returncode": r.returncode,
-            "stdout_tail": _decode(r.stdout)[-20000:],
-            "stderr_tail": _decode(r.stderr)[-4000:],
-        }
-    except subprocess.TimeoutExpired as exc:
-        # keep BOTH streams: the hang this watcher exists to work around
-        # reports its libtpu/XLA diagnostics on stderr
-        envelope = {
-            "captured": stamp,
-            "command": cmd,
-            "timed_out_after_s": timeout_s,
-            "stdout_tail": _decode(exc.stdout)[-20000:],
-            "stderr_tail": _decode(exc.stderr)[-4000:],
-        }
+    # run_captured, not subprocess.run: run()'s post-kill pipe drain is
+    # unbounded, so a tunnel helper holding the pipes would wedge the
+    # watcher loop forever — exactly the failure mode being monitored
+    r = run_captured(cmd, timeout_s, env=env or dict(os.environ), cwd=ROOT)
+    envelope = {
+        "captured": stamp,
+        "command": cmd,
+        "stdout_tail": r.stdout[-20000:],
+        "stderr_tail": r.stderr[-4000:],
+    }
+    if r.timed_out:
+        envelope["timed_out_after_s"] = timeout_s
+    else:
+        envelope["returncode"] = r.returncode
     # Never clobber previously-captured good evidence with a worse capture:
     # park the new envelope alongside the artifact instead when this run
     # failed/timed out while the prior recorded a clean exit, OR when the

@@ -58,7 +58,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -722,10 +721,12 @@ def part_pallas_sweep() -> dict:
             "skipped": f"backend={jax.default_backend()}; the fused-kernel "
             "sweep is only meaningful on real TPU hardware"
         }
-    proc = subprocess.run(
+    from spark_gp_tpu.utils.subproc import run_captured
+
+    proc = run_captured(
         [sys.executable, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                       "benchmarks", "pallas_sweep.py")],
-        capture_output=True, text=True, timeout=1800,
+        1800,
     )
     rows = []
     for line in proc.stdout.strip().splitlines():
@@ -733,19 +734,24 @@ def part_pallas_sweep() -> dict:
             rows.append(json.loads(line))
         except ValueError:
             pass
-    return {"rows": rows} if rows else {"error": proc.stderr[-300:]}
+    out = {"rows": rows} if rows else {"error": proc.stderr[-300:]}
+    if proc.timed_out:
+        # partial rows must never read as a complete sweep
+        out["truncated"] = "sweep timed out after 1800s"
+    return out
 
 
 # ---------------------------------------------------------- supervisor ----
 
 def _run_sub(args, timeout_s, env):
+    # run_captured (group kill + fenced drain): a wedged tunnel helper
+    # holding this part-worker's pipes must not hang the supervisor past
+    # its own per-part timeout (utils/subproc.py rationale)
+    from spark_gp_tpu.utils.subproc import run_captured
+
     me = os.path.abspath(__file__)
-    try:
-        out = subprocess.run(
-            [sys.executable, me] + args,
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-        )
-    except subprocess.TimeoutExpired:
+    out = run_captured([sys.executable, me] + args, timeout_s, env=env)
+    if out.timed_out:
         return None, f"timed out after {timeout_s:.0f}s"
     for line in reversed(out.stdout.strip().splitlines()):
         try:
@@ -785,11 +791,16 @@ def main() -> int:
     # Backend probe in a subprocess (never in-process: the TPU tunnel can
     # hang inside a C call during init — bench.py's supervisor rationale).
     try:
-        probe = subprocess.run(
-            [sys.executable, "-c", _PREFLIGHT_CODE],
-            capture_output=True, text=True, timeout=120, env=dict(os.environ),
+        from spark_gp_tpu.utils.subproc import run_captured
+
+        probe = run_captured(
+            [sys.executable, "-c", _PREFLIGHT_CODE], 120,
+            env=dict(os.environ),
         )
-        report.update(json.loads(probe.stdout.strip().splitlines()[-1]))
+        if probe.timed_out:
+            report["backend"] = "unavailable: probe hung past 120s"
+        else:
+            report.update(json.loads(probe.stdout.strip().splitlines()[-1]))
     except Exception as exc:
         report["backend"] = f"unavailable: {type(exc).__name__}"
 

@@ -68,7 +68,6 @@ def preflight_backend(timeout_s: float = 90.0, fallback: str = "cpu") -> str:
     if cached is not None and (not pinned or cached == first):
         return cached
 
-    import subprocess
     import sys
 
     why = None
@@ -86,21 +85,23 @@ def preflight_backend(timeout_s: float = 90.0, fallback: str = "cpu") -> str:
         "jax.block_until_ready(jnp.ones(()) + 1); "
         "print(jax.default_backend())"
     )
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", probe_code],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-        if probe.returncode == 0 and probe.stdout.strip():
-            platform = probe.stdout.strip().splitlines()[-1]
-            _write_healthy_marker(platform)
-            return platform
+    # run_captured, not subprocess.run: a wedged tunnel runtime can spawn
+    # helpers that inherit the probe's pipes — run()'s unbounded post-kill
+    # drain would then defeat this very watchdog (utils/subproc.py)
+    from spark_gp_tpu.utils.subproc import run_captured
+
+    probe = run_captured([sys.executable, "-c", probe_code], timeout_s)
+    if probe.timed_out:
+        why = f"probe hung past {timeout_s:.0f}s (wedged device runtime)"
+    elif probe.returncode == 0 and probe.stdout.strip():
+        platform = probe.stdout.strip().splitlines()[-1]
+        _write_healthy_marker(platform)
+        return platform
+    else:
         why = (
             f"probe exited rc={probe.returncode}; stderr tail: "
             + (probe.stderr or "").strip()[-300:]
         )
-    except subprocess.TimeoutExpired:
-        why = f"probe hung past {timeout_s:.0f}s (wedged device runtime)"
     import logging
 
     logging.getLogger(__name__).warning(

@@ -1,0 +1,106 @@
+"""Timeout-fenced subprocess capture hardened for half-dead device tunnels.
+
+``subprocess.run(capture_output=True, timeout=...)`` has three hazards
+around WEDGED accelerator runtimes, all rooted in one design choice: it
+tracks the CHILD's lifetime through the PIPES' lifetime.
+
+1. its post-kill pipe drain is an unbounded ``communicate()`` — a helper
+   process spawned by the child (device tunnel shims do this) inherits
+   the pipe write ends and keeps them open, so the drain blocks forever
+   and the caller's own watchdog is defeated;
+2. a child that EXITS cleanly while such a helper holds the pipes open
+   still blocks ``communicate()`` for the full fence and gets misreported
+   as a timeout — its exit code and a perfectly good result annotated
+   away;
+3. only the direct child is killed on timeout — the helpers survive and
+   can hold the device or respawn the hang.
+
+:func:`run_captured` separates the two lifetimes: daemon reader threads
+drain the pipes continuously into buffers (no pipe-full deadlock, output
+survives any kill), the main thread waits on the CHILD's exit with the
+fence, and a timeout SIGKILLs the child's entire process group (it runs
+in its own session) and reaps it.  The readers use raw ``os.read`` —
+which returns WHATEVER bytes are available — never buffered-stream
+``read(n)``, which blocks until n chars or EOF and would trap a small
+result inside the read while a pipe holder postpones EOF forever.
+Decoding is incremental with ``errors="replace"``: a kill can truncate
+output mid-UTF-8-sequence, and libtpu/XLA stderr diagnostics are not
+guaranteed clean UTF-8.
+"""
+
+from __future__ import annotations
+
+import codecs
+import os
+import signal
+import subprocess
+import threading
+from typing import NamedTuple
+
+
+class CapturedRun(NamedTuple):
+    returncode: int | None  # None = timed out (process group killed)
+    stdout: str
+    stderr: str
+
+    @property
+    def timed_out(self) -> bool:
+        return self.returncode is None
+
+
+def run_captured(cmd, timeout_s: float, env=None, cwd=None) -> CapturedRun:
+    """Run ``cmd`` capturing text stdout/stderr; on timeout, kill the
+    child's whole process group and STILL return the partial output."""
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        cwd=cwd,
+        start_new_session=True,
+    )
+    buffers = {"out": [], "err": []}
+
+    def _drain(stream, key):
+        # raw os.read: returns as soon as ANY bytes are available, so
+        # every chunk lands in the buffer immediately — a buffered
+        # stream.read(n) would hold a sub-n result hostage until EOF
+        decoder = codecs.getincrementaldecoder("utf-8")("replace")
+        fd = stream.fileno()
+        try:
+            while True:
+                chunk = os.read(fd, 65536)
+                if not chunk:
+                    break
+                buffers[key].append(decoder.decode(chunk))
+            buffers[key].append(decoder.decode(b"", True))
+        except Exception:  # noqa: BLE001 — fd closed under us: keep buffer
+            pass
+
+    readers = [
+        threading.Thread(target=_drain, args=(proc.stdout, "out"), daemon=True),
+        threading.Thread(target=_drain, args=(proc.stderr, "err"), daemon=True),
+    ]
+    for t in readers:
+        t.start()
+
+    returncode = None
+    try:
+        returncode = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.wait(timeout=10)  # reap; bounded for unkillable D-state
+        except subprocess.TimeoutExpired:
+            pass
+    # give the readers a moment to pull what's buffered; they may never
+    # see EOF (a surviving pipe holder) — daemon threads, so not joining
+    # to completion is safe, and the buffers keep everything read so far
+    for t in readers:
+        t.join(timeout=5)
+    return CapturedRun(
+        returncode, "".join(buffers["out"]), "".join(buffers["err"])
+    )

@@ -190,28 +190,30 @@ def test_preflight_backend_honors_pinned_env(monkeypatch):
 
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
 
+    from spark_gp_tpu.utils import subproc
+
     def _no_probe(*a, **k):  # pragma: no cover - failure mode
         raise AssertionError("pinned env must not spawn a probe subprocess")
 
-    monkeypatch.setattr("subprocess.run", _no_probe)
+    monkeypatch.setattr(subproc, "run_captured", _no_probe)
     assert plat.preflight_backend() == "cpu"
 
 
 def test_preflight_backend_healthy_probe_reports_platform(
     monkeypatch, tmp_path
 ):
-    import subprocess as sp
-
     from spark_gp_tpu.utils import platform as plat
+    from spark_gp_tpu.utils import subproc
+    from spark_gp_tpu.utils.subproc import CapturedRun
 
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setattr(plat, "backends_already_initialized", lambda: False)
     monkeypatch.setattr(plat, "_marker_path", lambda: str(tmp_path / "m"))
 
-    def _healthy(cmd, **kw):
-        return sp.CompletedProcess(cmd, 0, stdout="tpu\n", stderr="")
+    def _healthy(cmd, timeout_s, **kw):
+        return CapturedRun(0, "tpu\n", "")
 
-    monkeypatch.setattr(sp, "run", _healthy)
+    monkeypatch.setattr(subproc, "run_captured", _healthy)
     assert plat.preflight_backend(timeout_s=5.0) == "tpu"
     # a healthy probe must NOT pin the environment
     assert "JAX_PLATFORMS" not in __import__("os").environ
@@ -221,27 +223,27 @@ def test_preflight_backend_healthy_probe_reports_platform(
     def _no_probe(*a, **k):  # pragma: no cover - failure mode
         raise AssertionError("fresh healthy verdict must skip the probe")
 
-    monkeypatch.setattr(sp, "run", _no_probe)
+    monkeypatch.setattr(subproc, "run_captured", _no_probe)
     assert plat.preflight_backend(timeout_s=5.0) == "tpu"
     # TTL=0 disables the cache and probes again
     monkeypatch.setenv("GP_PREFLIGHT_CACHE_TTL", "0")
-    monkeypatch.setattr(sp, "run", _healthy)
+    monkeypatch.setattr(subproc, "run_captured", _healthy)
     assert plat.preflight_backend(timeout_s=5.0) == "tpu"
 
 
 def test_preflight_backend_hung_probe_pins_fallback(monkeypatch, tmp_path):
-    import subprocess as sp
-
     from spark_gp_tpu.utils import platform as plat
+    from spark_gp_tpu.utils import subproc
+    from spark_gp_tpu.utils.subproc import CapturedRun
 
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setattr(plat, "backends_already_initialized", lambda: False)
     monkeypatch.setattr(plat, "_marker_path", lambda: str(tmp_path / "m"))
 
-    def _hang(cmd, **kw):
-        raise sp.TimeoutExpired(cmd, kw.get("timeout"))
+    def _hang(cmd, timeout_s, **kw):
+        return CapturedRun(None, "", "")
 
-    monkeypatch.setattr(sp, "run", _hang)
+    monkeypatch.setattr(subproc, "run_captured", _hang)
     # jax.config.update("jax_platforms", ...) may be rejected once a backend
     # exists in this test process; the contract under test is the env pin +
     # returned platform, so tolerate the config update either way
@@ -257,20 +259,19 @@ def test_preflight_backend_fast_failure_reports_cause(monkeypatch, tmp_path, cap
     """A probe that dies quickly (broken install, not a hang) must surface
     its returncode and stderr in the warning, not the hang message."""
     import logging
-    import subprocess as sp
 
     from spark_gp_tpu.utils import platform as plat
+    from spark_gp_tpu.utils import subproc
+    from spark_gp_tpu.utils.subproc import CapturedRun
 
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setattr(plat, "backends_already_initialized", lambda: False)
     monkeypatch.setattr(plat, "_marker_path", lambda: str(tmp_path / "m"))
 
-    def _dies(cmd, **kw):
-        return sp.CompletedProcess(
-            cmd, 1, stdout="", stderr="ImportError: libfoo.so missing"
-        )
+    def _dies(cmd, timeout_s, **kw):
+        return CapturedRun(1, "", "ImportError: libfoo.so missing")
 
-    monkeypatch.setattr(sp, "run", _dies)
+    monkeypatch.setattr(subproc, "run_captured", _dies)
     with caplog.at_level(logging.WARNING, logger="spark_gp_tpu.utils.platform"):
         got = plat.preflight_backend(timeout_s=5.0)
     assert got == "cpu"
@@ -434,8 +435,6 @@ def test_preflight_backend_probes_pinned_platform(monkeypatch, tmp_path):
     profiles export the tunnel platform globally — r5); a hung pinned
     backend falls back, and GP_HONOR_PINNED_PLATFORM=1 restores the old
     wedge-on-principle contract."""
-    import subprocess as sp
-
     from spark_gp_tpu.utils import platform as plat
 
     monkeypatch.setenv("JAX_PLATFORMS", "axon")
@@ -444,10 +443,13 @@ def test_preflight_backend_probes_pinned_platform(monkeypatch, tmp_path):
     monkeypatch.setattr(plat, "honor_platform_env", lambda: None)
     monkeypatch.setattr(plat, "_marker_path", lambda: str(tmp_path / "m"))
 
-    def _hang(cmd, **kw):
-        raise sp.TimeoutExpired(cmd, kw.get("timeout"))
+    from spark_gp_tpu.utils import subproc
+    from spark_gp_tpu.utils.subproc import CapturedRun
 
-    monkeypatch.setattr(sp, "run", _hang)
+    def _hang(cmd, timeout_s, **kw):
+        return CapturedRun(None, "", "")
+
+    monkeypatch.setattr(subproc, "run_captured", _hang)
     try:
         got = plat.preflight_backend(timeout_s=0.1)
     except RuntimeError:
@@ -463,16 +465,16 @@ def test_preflight_backend_probes_pinned_platform(monkeypatch, tmp_path):
     def _no_probe(*a, **k):  # pragma: no cover - failure mode
         raise AssertionError("honored pin must not spawn a probe")
 
-    monkeypatch.setattr(sp, "run", _no_probe)
+    monkeypatch.setattr(subproc, "run_captured", _no_probe)
     assert plat.preflight_backend(timeout_s=0.1) == "axon"
 
 
 def test_preflight_cached_verdict_is_platform_scoped(monkeypatch, tmp_path):
     """A cached healthy-cpu verdict must not green-light a different pinned
     platform."""
-    import subprocess as sp
-
     from spark_gp_tpu.utils import platform as plat
+    from spark_gp_tpu.utils import subproc
+    from spark_gp_tpu.utils.subproc import CapturedRun
 
     marker = tmp_path / "m"
     monkeypatch.setattr(plat, "_marker_path", lambda: str(marker))
@@ -485,18 +487,18 @@ def test_preflight_cached_verdict_is_platform_scoped(monkeypatch, tmp_path):
     def _no_probe(*a, **k):  # pragma: no cover - failure mode
         raise AssertionError("cached verdict must short-circuit the probe")
 
-    monkeypatch.setattr(sp, "run", _no_probe)
+    monkeypatch.setattr(subproc, "run_captured", _no_probe)
     assert plat.preflight_backend(timeout_s=0.1) == "cpu"
     # pinned to a different platform: cached cpu verdict must NOT apply
     monkeypatch.setenv("JAX_PLATFORMS", "axon")
 
     probed = {}
 
-    def _probe_runs(cmd, **kw):
+    def _probe_runs(cmd, timeout_s, **kw):
         probed["yes"] = True
-        return sp.CompletedProcess(cmd, 0, stdout="axon\n", stderr="")
+        return CapturedRun(0, "axon\n", "")
 
-    monkeypatch.setattr(sp, "run", _probe_runs)
+    monkeypatch.setattr(subproc, "run_captured", _probe_runs)
     assert plat.preflight_backend(timeout_s=0.1) == "axon"
     assert probed.get("yes")
 
