@@ -38,6 +38,24 @@ from spark_gp_tpu import (
 )
 
 
+def _configure(gp):
+    """The example's count-regression configuration, applied to either
+    estimator (Poisson / Negative Binomial share it)."""
+    return (
+        gp
+        .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-2, 10.0))
+        .setActiveSetSize(100)
+        .setMaxIter(25)
+    )
+
+
+def make_poisson_gp():
+    """The example's Poisson configuration — SINGLE source for this script
+    and the on-chip quality slice that certifies it
+    (tests/test_tpu_quality_slice.py)."""
+    return _configure(GaussianProcessPoissonRegression())
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--n", type=int, default=2000)
@@ -58,23 +76,19 @@ def main():
 
     if args.nb is None:
         y = rng.poisson(rate).astype(np.float64)
-        gp = GaussianProcessPoissonRegression()
+        gp = make_poisson_gp()
         bar = 0.1
     else:
         # estimator first: its likelihood validates dispersion > 0 with a
         # clear message before any division by args.nb below
-        gp = GaussianProcessNegativeBinomialRegression(dispersion=args.nb)
+        gp = _configure(
+            GaussianProcessNegativeBinomialRegression(dispersion=args.nb)
+        )
         lam = rate * rng.gamma(shape=args.nb, scale=1.0 / args.nb, size=args.n)
         y = rng.poisson(lam).astype(np.float64)
         bar = 0.15
 
-    model = (
-        gp
-        .setKernel(lambda: 1.0 * RBFKernel(0.5, 1e-2, 10.0))
-        .setActiveSetSize(100)
-        .setMaxIter(25)
-        .fit(x, y)
-    )
+    model = gp.fit(x, y)
     rel = float(np.mean(np.abs(model.predict_rate(x) - rate) / rate))
     print("Mean relative rate error: " + str(rel))
     assert rel < bar, rel

@@ -9,10 +9,11 @@ window watcher (benchmarks/tpu_window_watcher.py) executes them inside
 every captured TPU window, writing the pytest tail to
 ``TPU_WINDOW_TESTS.json``.
 
-Bars mirror the reference examples' own assertions: synthetics 10-fold CV
-RMSE < 0.11 (Synthetics.scala:33, run here at 3 folds for window budget —
-the bar is per-fold-mean and fold-count-insensitive on this easy problem)
-and iris accuracy >= 0.9 (Iris.scala:35-38).
+Bars mirror the examples' own assertions: synthetics 10-fold CV RMSE
+< 0.11 (Synthetics.scala:33, run here at 3 folds for window budget — the
+bar is per-fold-mean and fold-count-insensitive on this easy problem),
+iris accuracy >= 0.9 (Iris.scala:35-38), and the Poisson (generic
+Laplace) rate-recovery relative error < 0.1 (examples/poisson.py).
 """
 
 import jax
@@ -50,3 +51,20 @@ def test_iris_accuracy_bar_on_chip():
         OneVsRest(make_gpc), x, y, train_ratio=0.8, metric=accuracy, seed=5,
     )
     assert score >= 0.9, f"on-chip iris OvR accuracy {score} below the 0.9 bar"
+
+
+def test_poisson_rate_recovery_on_chip():
+    """Generic-likelihood Laplace on hardware: the Poisson regressor must
+    recover a known rate surface within the example's own 0.1 bar
+    (examples/poisson.py config via its shared factory; smaller n for
+    window budget)."""
+    from examples.poisson import make_poisson_gp
+
+    rng = np.random.default_rng(42)
+    n = 800
+    x = np.linspace(0, 4, n)[:, None]
+    rate = np.exp(1.0 + np.sin(2 * x[:, 0]))
+    y = rng.poisson(rate).astype(np.float64)
+    model = make_poisson_gp().fit(x, y)
+    rel = float(np.mean(np.abs(model.predict_rate(x) - rate) / rate))
+    assert rel < 0.1, f"on-chip poisson rate error {rel} breaches the 0.1 bar"
