@@ -536,3 +536,20 @@ def test_cross_validate_param_grid_nan_cell_never_wins():
         cross_validate(
             NaNable(), x, y, num_folds=3, param_grid=[{"setMode": "nan"}]
         )
+
+
+def test_chip_peaks_and_precision_passes():
+    """The shared chip-spec table (ops/precision.py): known generations
+    resolve both peaks, unknown kinds resolve to None (consumers then
+    report MFU as null rather than guessing), and the pass-count table
+    covers exactly the knob's vocabulary."""
+    from spark_gp_tpu.ops.precision import PRECISION_PASSES, chip_peaks
+
+    tf, bw = chip_peaks("TPU v5 lite")
+    assert (tf, bw) == (197.0, 819.0)
+    tf, bw = chip_peaks("TPU v4")
+    assert (tf, bw) == (275.0, 1228.0)
+    assert chip_peaks("TFRT_CPU_0 whatever") == (None, None)
+    # the knob's vocabulary (its HIGHEST default is pinned by
+    # test_matmul_precision_knob in test_pallas_linalg.py)
+    assert set(PRECISION_PASSES) == {"highest", "high", "default"}
