@@ -36,16 +36,23 @@ Environment knobs: BENCH_N (default 300000 on accelerators; 20000 on CPU),
 BENCH_EXPERT (100), BENCH_MAXITER (30), BENCH_OPTIMIZER (device),
 BENCH_PREFLIGHT_TIMEOUT (150 s), BENCH_PREFLIGHT_ATTEMPTS (4),
 BENCH_WORKER_TIMEOUT (2400 s), BENCH_PALLAS_SWEEP / BENCH_AIRFOIL /
-BENCH_SCALING_N / BENCH_SYNCED_BREAKDOWN (TPU only: "1" [default] appends
-the Pallas-vs-XLA expert-size sweep / the airfoil 10-fold parity bar / the
-N-linearity curve / the synced phase-breakdown fit to the result detail;
-any other value disables), BENCH_SCALING_SIZES (comma-separated N values
-for the linearity curve, default "30000,100000,300000,1000000"),
-BENCH_FORCE_EXTRAS ("1": a CPU run adopts the full TPU policy — async
-primary + extras — so CI can exercise those paths at tiny shapes), and
+BENCH_SCALING_N / BENCH_SYNCED_BREAKDOWN / BENCH_MFU_CURVE (TPU only: "1"
+[default] appends the Pallas-vs-XLA expert-size sweep / the airfoil
+10-fold parity bar / the N-linearity curve / the synced phase-breakdown
+fit / the MFU-vs-expert-size curve to the result detail; any other value
+disables), BENCH_MFU_SIZES (extra expert sizes for the MFU curve, default
+"256,512"), BENCH_SCALING_SIZES (comma-separated N values for the
+linearity curve, default "30000,100000,300000,1000000"), BENCH_ROOFLINE
+("1" [default]: after the worker exits — libtpu is single-process-
+exclusive — run benchmarks/roofline.py and embed it as detail.roofline;
+BENCH_ROOFLINE_TIMEOUT fences it, default 1500 s), BENCH_FORCE_EXTRAS
+("1": a CPU run adopts the full TPU policy — async primary + extras +
+roofline at tiny shapes — so CI can exercise those paths), and
 GP_SYNC_PHASES (unset [default]: TPU primaries run async with a fenced
 synced breakdown fit afterwards, CPU primaries run synced; explicit 0/1
-forces the primary's own mode and skips the extra fit).
+forces the primary's own mode and skips the extra fit).  The roofline's
+own knobs (ROOFLINE_TOTAL/SIZES/REPEATS/CHILD_TIMEOUT and
+GP_MATMUL_PRECISION) are documented in benchmarks/roofline.py.
 """
 
 from __future__ import annotations
