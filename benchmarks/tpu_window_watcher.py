@@ -121,6 +121,49 @@ def _captured_platform(envelope):
     return None
 
 
+def capture_window(note) -> bool:
+    """One full window capture: every lane in priority order, re-probing
+    the tunnel between lanes and abandoning the rest the moment it dies
+    (windows can be shorter than the full sequence; a dead tunnel would
+    otherwise burn every remaining lane's whole timeout for nothing).
+
+    Lane order is deliberate: bench first (it lands the round's headline
+    number, warms the persistent compile cache, and appends the
+    post-worker roofline; its 4500s fence = worker watchdog 2400 +
+    roofline 1500 + preflight with slack, and it prints the primary line
+    early so even a fence trip salvages the measurement), then the
+    Mosaic + on-chip-quality tests (VERDICT r4 #2), the matched-config
+    and large-m lanes (r4 #3/#4), and the Pallas sweep last.
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    tenv = dict(env)
+    tenv["GP_TEST_PLATFORM"] = "tpu"
+    lanes = [
+        ([sys.executable, "bench.py"],
+         "TPU_WINDOW_BENCH.json", 4500, env, "bench"),
+        ([sys.executable, "-m", "pytest",
+          "tests/test_pallas_linalg.py",
+          "tests/test_tpu_quality_slice.py", "-q"],
+         "TPU_WINDOW_TESTS.json", 1500, tenv,
+         "mosaic + quality-slice tests"),
+        ([sys.executable, "benchmarks/matched_config.py"],
+         "TPU_WINDOW_MATCHED.json", 1800, env, "matched-config lane"),
+        ([sys.executable, "benchmarks/large_m.py"],
+         "TPU_WINDOW_LARGE_M.json", 1800, env, "large-m lane"),
+        ([sys.executable, "benchmarks/pallas_sweep.py"],
+         "TPU_WINDOW_PALLAS.json", 1800, env, "pallas sweep"),
+    ]
+    for i, (cmd, out_path, timeout_s, lane_env, name) in enumerate(lanes):
+        _run(cmd, out_path, timeout_s, lane_env)
+        note(f"{name} done")
+        if i + 1 < len(lanes) and not _probe_tpu():
+            note("tunnel died mid-window — abandoning remaining lanes")
+            return False
+    note("window capture finished")
+    return True
+
+
 def main() -> None:
     with open(os.path.join(ROOT, "TPU_WINDOW_WATCHER.pid"), "w") as fh:
         fh.write(str(os.getpid()))
@@ -136,43 +179,12 @@ def main() -> None:
         if _probe_tpu():
             failed_probes = 0
             note("TPU REACHABLE — capturing artifacts")
-            env = dict(os.environ)
-            env.pop("JAX_PLATFORMS", None)
-            tenv = dict(env)
-            tenv["GP_TEST_PLATFORM"] = "tpu"
-            # bench first: it lands the round's headline number and warms
-            # the persistent compile cache for any subsequent run.
-            # 4500s: worker watchdog (2400) + post-worker roofline (1500)
-            # + preflight, with slack; bench prints the primary line before
-            # the roofline so even a fence trip salvages the measurement.
-            # The quality-slice/Mosaic tests (VERDICT r4 #2) and the
-            # matched-config / large-m lanes (r4 #3/#4) follow.
-            lanes = [
-                ([sys.executable, "bench.py"],
-                 "TPU_WINDOW_BENCH.json", 4500, env, "bench"),
-                ([sys.executable, "-m", "pytest",
-                  "tests/test_pallas_linalg.py",
-                  "tests/test_tpu_quality_slice.py", "-q"],
-                 "TPU_WINDOW_TESTS.json", 1500, tenv,
-                 "mosaic + quality-slice tests"),
-                ([sys.executable, "benchmarks/matched_config.py"],
-                 "TPU_WINDOW_MATCHED.json", 1800, env, "matched-config lane"),
-                ([sys.executable, "benchmarks/large_m.py"],
-                 "TPU_WINDOW_LARGE_M.json", 1800, env, "large-m lane"),
-                ([sys.executable, "benchmarks/pallas_sweep.py"],
-                 "TPU_WINDOW_PALLAS.json", 1800, env, "pallas sweep"),
-            ]
-            for i, (cmd, out_path, timeout_s, lane_env, name) in enumerate(lanes):
-                _run(cmd, out_path, timeout_s, lane_env)
-                note(f"{name} done")
-                # windows can be shorter than the full capture sequence:
-                # a dead tunnel makes every remaining lane burn its whole
-                # timeout for nothing — re-probe between lanes and bail
-                if i + 1 < len(lanes) and not _probe_tpu():
-                    note("tunnel died mid-window — abandoning remaining lanes")
-                    break
-            note("window capture finished; sleeping 15 min before re-probe")
-            time.sleep(900)
+            if capture_window(note):
+                # full capture landed: nothing new to gain for a while
+                note("sleeping 15 min before re-probe")
+                time.sleep(900)
+            # bailed mid-window: fall through to the normal 3-min probe
+            # cadence so a quickly-reopening window isn't missed
         else:
             # heartbeat every ~30 min of failed probes: a silent log reads
             # as "watcher died", not "tunnel stayed down" — post-mortems

@@ -94,3 +94,30 @@ def test_run_timeout_records_both_streams(tmp_path, monkeypatch):
     assert envelope["timed_out_after_s"] == 20
     assert "partial" in envelope["stdout_tail"]
     assert "diag" in envelope["stderr_tail"]
+
+
+def test_capture_window_bails_when_tunnel_dies(monkeypatch):
+    """A tunnel that dies mid-window must abandon the remaining lanes
+    (instead of serially burning each one's full timeout against a dead
+    device) — and a healthy tunnel must run all five lanes in priority
+    order, bench first."""
+    ran, notes = [], []
+    monkeypatch.setattr(
+        watcher, "_run", lambda cmd, out, t, env=None: ran.append(out)
+    )
+
+    # healthy: every lane runs, bench first; completed -> True (main then
+    # takes the long post-capture sleep)
+    monkeypatch.setattr(watcher, "_probe_tpu", lambda *a, **k: True)
+    assert watcher.capture_window(notes.append) is True
+    assert ran[0] == "TPU_WINDOW_BENCH.json"
+    assert len(ran) == 5
+
+    # tunnel dies after the first lane: bail with a log line
+    ran.clear()
+    notes.clear()
+    monkeypatch.setattr(watcher, "_probe_tpu", lambda *a, **k: False)
+    # bailed -> False (main then drops to the 3-min down-tunnel cadence)
+    assert watcher.capture_window(notes.append) is False
+    assert ran == ["TPU_WINDOW_BENCH.json"]
+    assert any("abandoning" in n for n in notes)
