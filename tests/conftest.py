@@ -8,8 +8,9 @@ oracles; the TPU f32 path is covered by dtype-specific tests and the bench).
 
 ``GP_TEST_PLATFORM=tpu`` switches the session to the real chip (f32) and
 runs ONLY the tests marked ``@pytest.mark.tpu`` (the Mosaic lowering parity
-checks in test_pallas_linalg.py); everything else — the f64 accuracy
-oracles, whose tolerances are meaningless at f32 — is skipped.
+checks in test_pallas_linalg.py and the asserted on-chip quality bars in
+test_tpu_quality_slice.py); everything else — the f64 accuracy oracles,
+whose tolerances are meaningless at f32 — is skipped.
 """
 
 import os
