@@ -39,11 +39,16 @@ def _bench(fn, k, iters: int = 20) -> float:
     return (time.perf_counter() - start) / iters
 
 
-def sweep(sizes=(32, 64, 100, 128, 200, 256, 512), iters: int = 20) -> list:
+def sweep(sizes=(32, 64, 100, 128, 200, 256, 512), iters: int = 20,
+          on_row=None) -> list:
     """Time the fused Pallas kernel vs XLA's batched Cholesky chain at each
     expert size; returns one dict per size (importable — bench.py embeds a
     compressed sweep in its TPU runs so the artifact is captured on real
-    hardware automatically)."""
+    hardware automatically).  ``on_row`` is called with each row as it is
+    measured — main() prints through it so a mid-sweep tunnel death still
+    leaves every completed size on stdout (r4's window died exactly here,
+    during a remote compile, and the buffered design lost the whole sweep).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -68,14 +73,17 @@ def sweep(sizes=(32, 64, 100, 128, 200, 256, 512), iters: int = 20) -> list:
         t_pallas = _bench(pallas_fn, k, iters)
         t_xla = _bench(xla_fn, k, iters)
 
-        rows.append({
+        row = {
             "n": n,
             "batch": b,
             "pallas_us_per_matrix": round(t_pallas / b * 1e6, 2),
             "xla_us_per_matrix": round(t_xla / b * 1e6, 2),
             "speedup": round(t_xla / t_pallas, 2),
             "backend": backend,
-        })
+        }
+        rows.append(row)
+        if on_row is not None:
+            on_row(row)
     return rows
 
 
@@ -85,9 +93,10 @@ def main() -> None:
     if jax.default_backend() != "tpu":
         print(json.dumps({"warning": f"backend={jax.default_backend()}: "
                           "Pallas runs in interpret mode; timings are NOT "
-                          "meaningful"}))
-    for row in sweep():
-        print(json.dumps(row))
+                          "meaningful"}), flush=True)
+    # print AS each size completes (flushed): partial sweeps survive a
+    # mid-run tunnel death in the watcher's captured stdout
+    sweep(on_row=lambda row: print(json.dumps(row), flush=True))
 
 
 if __name__ == "__main__":
