@@ -1,0 +1,112 @@
+"""Serving round trip — fit, save, boot the serve CLI, score over it.
+
+Demonstrates the online-inference subsystem end to end
+(docs/SERVING.md): a model is fitted and saved as a versioned ``.npz``,
+``python -m spark_gp_tpu.serve`` boots in a subprocess, warms every
+(model, bucket) executable before reporting ready, and this client
+streams a mixed-size batch of JSON-line requests through the
+micro-batcher, checking the answers against in-process predictions.
+
+Run: python examples/serve_client.py [--requests 40]
+"""
+
+import os as _os
+import sys as _sys
+
+# runnable as ``python examples/<name>.py`` from anywhere: put the repo
+# root (the spark_gp_tpu package home) ahead of the script's own dir
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from spark_gp_tpu.utils.platform import preflight_backend
+
+import argparse
+import json
+import subprocess
+import tempfile
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=40)
+    args = parser.parse_args()
+
+    # never wedge on a half-dead accelerator tunnel (utils/platform.py)
+    preflight_backend()
+
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2000, 3))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=2000)
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(0.5))
+        .setDatasetSizeForExpert(100)
+        .setActiveSetSize(100)
+        .setSigma2(1e-3)
+        .setSeed(13)
+        .fit(x, y)
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _os.path.join(tmp, "model.npz")
+        model.save(path)
+
+        # mixed request sizes: the server pads each to its bucket, so the
+        # whole mix runs on the executables warmed before "ready"
+        sizes = [1, 3, 8, 20, 64][: max(1, args.requests)]
+        while len(sizes) < args.requests:
+            sizes.append(sizes[len(sizes) % 5])
+        requests = []
+        for i, t in enumerate(sizes):
+            row = (i * 31) % (2000 - 64)
+            requests.append(
+                {"id": i, "model": "demo", "x": x[row : row + t].tolist()}
+            )
+        lines = (
+            "\n".join(json.dumps(r) for r in requests)
+            + "\n" + json.dumps({"cmd": "metrics"})
+            + "\n" + json.dumps({"cmd": "shutdown"}) + "\n"
+        )
+
+        env = dict(_os.environ)
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [_sys.executable, "-m", "spark_gp_tpu.serve",
+             "--model", f"demo={path}", "--max-batch", "64"],
+            input=lines, capture_output=True, text=True, timeout=600,
+            env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        events = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+
+    ready = events[0]
+    assert ready["event"] == "ready", ready
+    print(f"ready on {ready['platform']}; "
+          f"{ready['buckets_warmed']} buckets warmed at load")
+
+    by_id = {e["id"]: e for e in events if "id" in e}
+    worst = 0.0
+    for req in requests:
+        answer = by_id[req["id"]]
+        assert "error" not in answer, answer
+        local = model.predict(np.asarray(req["x"]))
+        worst = max(worst, float(np.max(np.abs(np.asarray(answer["mean"]) - local))))
+    # the CLI subprocess predicts in f32; in-process f64 — parity is approximate
+    assert worst < 1e-3, worst
+    print(f"{len(requests)} requests round-tripped; "
+          f"max |serve - local| = {worst:.2e}")
+
+    metrics = next(e for e in events if e.get("event") == "metrics")
+    lat = metrics["histograms"]["request_latency_s"]
+    occ = metrics["histograms"]["batch_occupancy"]
+    print(f"latency p50 {lat['p50'] * 1e3:.2f} ms / p99 {lat['p99'] * 1e3:.2f} ms; "
+          f"batches {metrics['counters']['batches']:.0f}; "
+          f"occupancy p50 {occ['p50']:.2f}")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,10 @@ from spark_gp_tpu.utils.platform import honor_platform_env as _honor_platform_en
 
 _honor_platform_env()
 
+from spark_gp_tpu.utils.compat import install_jax_compat as _install_jax_compat
+
+_install_jax_compat()
+
 from spark_gp_tpu.kernels import (
     ARDMatern32Kernel,
     ARDRationalQuadraticKernel,

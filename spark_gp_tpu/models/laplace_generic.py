@@ -408,6 +408,15 @@ def fit_generic_device_sharded(
         log_reparam,
     )
     from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+    from spark_gp_tpu.utils.compat import whole_loop_shard_map_supported
+
+    if not whole_loop_shard_map_supported():
+        # old-jax compat (utils/compat.py): the L-BFGS while_loop inside
+        # shard_map wedges the compile; GSPMD partitions the same stack
+        return fit_generic_device(
+            lik, kernel, tol, log_space, theta0, lower, upper, x, y, mask,
+            max_iter,
+        )
 
     @partial(
         jax.shard_map,

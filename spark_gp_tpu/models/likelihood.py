@@ -169,7 +169,16 @@ def _make_sharded_vag(kernel: Kernel, mesh, objective: str = "marginal"):
         # theta is replicated (P()): shard_map's transpose already inserts
         # the cross-device psum for its gradient, so only the value needs an
         # explicit all-reduce here (psum-ing grad too would multiply it by
-        # the device count).
+        # the device count).  EXCEPT under the old-jax compat wrapper
+        # (check_rep disabled — utils/compat.py): no replication machinery
+        # runs, the local gradient would leak through the P() out_spec
+        # unsummed, and the all-reduce must be explicit.
+        from spark_gp_tpu.utils.compat import (
+            shard_map_needs_explicit_grad_psum,
+        )
+
+        if shard_map_needs_explicit_grad_psum():
+            grad = jax.lax.psum(grad, EXPERT_AXIS)
         return jax.lax.psum(value, EXPERT_AXIS), grad
 
     return sharded
@@ -401,8 +410,18 @@ def fit_gpr_device_sharded(
         lbfgs_minimize_device,
         log_reparam,
     )
+    from spark_gp_tpu.utils.compat import whole_loop_shard_map_supported
 
     _require_shard_map_support(objective)
+
+    if not whole_loop_shard_map_supported():
+        # old-jax compat (utils/compat.py): the L-BFGS while_loop inside
+        # shard_map wedges the compile; the plain jitted fit partitions
+        # the same sharded stack via GSPMD instead
+        return fit_gpr_device(
+            kernel, log_space, theta0, lower, upper, x, y, mask,
+            max_iter, tol, (), objective=objective,
+        )
 
     @partial(
         jax.shard_map,

@@ -1,0 +1,57 @@
+"""Online inference: model registry, shape-bucketed micro-batching,
+warm compiled predict paths.
+
+The PPA predictor's cost depends only on the m-point active set
+(models/ppa.py, R&W ch. 8.3.4) — exactly the shape of a low-latency
+scorer.  What a request-driven workload adds on top of a correct
+``predict`` is *shape discipline*: XLA compiles one executable per input
+shape, so free-form request sizes would recompile on the hot path and a
+p50 of microseconds would hide a p99 of seconds.  This package keeps the
+compiled surface finite and warm:
+
+* :class:`~spark_gp_tpu.serve.registry.ModelRegistry` — ``.npz`` models
+  (utils/serialization.py) keyed by name+version, hot-swapped on reload;
+* :class:`~spark_gp_tpu.serve.batcher.BucketedPredictor` — requests are
+  padded to a small set of power-of-two batch buckets, one XLA compile
+  per (model, bucket), with an explicit recompile guard after warmup;
+* :class:`~spark_gp_tpu.serve.server.GPServeServer` — a bounded request
+  queue with micro-batch coalescing (max-wait deadline), per-request
+  timeouts, and load shedding instead of stalling;
+* :class:`~spark_gp_tpu.serve.metrics.ServingMetrics` — counters and
+  latency histograms (p50/p99, batch occupancy, queue depth) on top of
+  utils/instrumentation.py;
+* ``python -m spark_gp_tpu.serve`` — a JSON-lines (stdin or socket)
+  entrypoint that warms every bucket before reporting ready.
+
+See docs/SERVING.md for architecture and tuning.
+"""
+
+from spark_gp_tpu.serve.batcher import (
+    BucketOverflowError,
+    BucketedPredictor,
+    RecompileGuardError,
+    bucket_sizes,
+)
+from spark_gp_tpu.serve.metrics import LatencyHistogram, ServingMetrics
+from spark_gp_tpu.serve.queue import (
+    QueueFullError,
+    RequestTimeoutError,
+    ServeFuture,
+)
+from spark_gp_tpu.serve.registry import ModelRegistry, ServableModel
+from spark_gp_tpu.serve.server import GPServeServer
+
+__all__ = [
+    "BucketedPredictor",
+    "BucketOverflowError",
+    "RecompileGuardError",
+    "bucket_sizes",
+    "ServingMetrics",
+    "LatencyHistogram",
+    "QueueFullError",
+    "RequestTimeoutError",
+    "ServeFuture",
+    "ModelRegistry",
+    "ServableModel",
+    "GPServeServer",
+]

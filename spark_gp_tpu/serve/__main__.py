@@ -1,0 +1,310 @@
+"""``python -m spark_gp_tpu.serve`` — JSON-lines scoring over stdin or TCP.
+
+Startup sequence (the ready contract):
+
+1. pin the JAX platform (``utils/platform.py``: ``JAX_PLATFORMS`` is
+   re-asserted over site hooks at package import; ``--preflight`` probes
+   the backend in a throwaway subprocess so a wedged device tunnel makes
+   the server fall back to CPU instead of hanging before ready);
+2. load every ``--model name=path`` through the registry, which runs the
+   AOT warmup — each (model, bucket) pair compiles NOW;
+3. emit ``{"event": "ready", ...}`` — only after this line is the hot
+   path guaranteed compile-free.
+
+Protocol (one JSON object per line, in either direction):
+
+    {"id": 1, "model": "m", "x": [[...], ...]}      -> {"id": 1, "mean": [...], "var": [...]}
+    {"cmd": "metrics"}                               -> {"event": "metrics", ...}
+    {"cmd": "reload", "model": "m"}                  -> {"event": "reloaded", ...}
+    {"cmd": "shutdown"}  (or EOF on stdin)           -> {"event": "shutdown", ...}
+
+Responses to predicts are emitted in submission order by a writer thread,
+so the reader loop never blocks on a result and the micro-batcher sees
+concurrent requests even from a single-stream client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import queue as _queue
+import sys
+import threading
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(
+        prog="python -m spark_gp_tpu.serve",
+        description="online GP inference server (JSON lines on stdin or TCP)",
+    )
+    parser.add_argument(
+        "--model", action="append", default=[], metavar="NAME=PATH",
+        help="model to load and warm (repeatable)",
+    )
+    parser.add_argument("--max-batch", type=int, default=256,
+                        help="largest batch bucket (rows)")
+    parser.add_argument("--min-bucket", type=int, default=8,
+                        help="smallest batch bucket (rows)")
+    parser.add_argument("--mean-only", action="store_true",
+                        help="serve means only (skips the O(t m^2) variance)")
+    parser.add_argument("--capacity", type=int, default=1024,
+                        help="request queue bound (backpressure past this)")
+    parser.add_argument("--max-wait-ms", type=float, default=2.0,
+                        help="micro-batch coalescing window")
+    parser.add_argument("--request-timeout-ms", type=float, default=1000.0,
+                        help="per-request deadline (0 disables)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="serve a TCP socket on 127.0.0.1:PORT instead of stdin")
+    parser.add_argument(
+        "--preflight", action="store_true",
+        help="probe the JAX backend in a subprocess before loading "
+        "(falls back to CPU when a device tunnel is wedged)",
+    )
+    return parser.parse_args(argv)
+
+
+def _out(lock, stream, payload: dict) -> None:
+    with lock:
+        stream.write(json.dumps(payload) + "\n")
+        stream.flush()
+
+
+def _writer_loop(pending: "_queue.Queue", lock, stream, result_wait_s) -> None:
+    """Emit responses in submission order — predicts and command replies
+    share the one queue, so a ``metrics`` reply can never overtake the
+    predict submitted just before it."""
+    while True:
+        item = pending.get()
+        if item is None:
+            return
+        if isinstance(item, dict):  # pre-built command reply
+            _out(lock, stream, item)
+            continue
+        if callable(item):  # late-bound reply (metrics snapshot at emit
+            _out(lock, stream, item())  # time, after earlier predicts)
+            continue
+        req_id, future, wait_s = item
+        try:
+            # every enqueued request IS eventually completed (answered,
+            # deadline-expired, or shutdown-errored), so with deadlines
+            # disabled an unbounded wait cannot hang — while any finite
+            # cap here would spuriously error deep-queued requests and
+            # head-of-line-block every reply behind them
+            mean, var = future.result(
+                timeout=result_wait_s if wait_s is None else wait_s
+            )
+            response = {
+                "id": req_id,
+                "mean": [float(v) for v in mean],
+                "var": None if var is None else [float(v) for v in var],
+            }
+        except Exception as exc:  # noqa: BLE001 — per-request error surface
+            response = {
+                "id": req_id,
+                "error": f"{type(exc).__name__}: {exc}"[:500],
+            }
+        _out(lock, stream, response)
+
+
+def _serve_stream(server, lines, out_stream, out_lock) -> bool:
+    """One client session; returns True when a shutdown was requested."""
+    # queue deadline + batch slack; None (wait indefinitely) when
+    # per-request deadlines are disabled — see _writer_loop
+    timeout_s = server.request_timeout_s
+    result_wait_s = None if timeout_s is None else timeout_s + 30.0
+    pending: _queue.Queue = _queue.Queue()
+    writer = threading.Thread(
+        target=_writer_loop,
+        args=(pending, out_lock, out_stream, result_wait_s),
+        daemon=True,
+    )
+    writer.start()
+    shutdown = False
+    try:
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+                if not isinstance(msg, dict):
+                    raise ValueError("expected a JSON object")
+            except ValueError as exc:
+                _out(out_lock, out_stream, {"error": f"bad request line: {exc}"})
+                continue
+            cmd = msg.get("cmd")
+            if cmd == "shutdown":
+                shutdown = True
+                break
+            if cmd == "metrics":
+                pending.put(
+                    lambda: {"event": "metrics", **server.snapshot()}
+                )
+                continue
+            if cmd == "reload":
+                # on a side thread: a reload pays a full load + AOT warmup,
+                # and blocking the reader here would keep NEW requests from
+                # even reaching the (still-serving) old version.  The reply
+                # rides the pending queue, so ordering is preserved.
+                def _do_reload(m=msg):
+                    try:
+                        entry = server.registry.reload(
+                            m["model"], m.get("path")
+                        )
+                        return {
+                            "event": "reloaded",
+                            "model": entry.name,
+                            "version": entry.version,
+                        }
+                    except Exception as exc:  # noqa: BLE001
+                        return {"error": f"reload failed: {exc}"[:500]}
+
+                reload_future = concurrent.futures.Future()
+                threading.Thread(
+                    target=lambda: reload_future.set_result(_do_reload()),
+                    daemon=True,
+                ).start()
+                pending.put(lambda: reload_future.result())
+                continue
+            if cmd is not None:
+                pending.put({"error": f"unknown cmd {cmd!r}"})
+                continue
+            req_id = msg.get("id")
+            try:
+                future = server.submit(
+                    msg["model"], msg["x"],
+                    version=msg.get("version"),
+                    timeout_ms=msg.get("timeout_ms"),
+                )
+            except Exception as exc:  # noqa: BLE001 — shed/shape errors
+                # through the writer queue, not directly: error replies
+                # must not overtake earlier predicts' answers (the
+                # submission-order contract)
+                pending.put({
+                    "id": req_id,
+                    "error": f"{type(exc).__name__}: {exc}"[:500],
+                })
+                continue
+            # a per-request timeout_ms override also stretches the writer's
+            # wait — a long-deadline request must not be errored at the
+            # server-default cap while still within its own deadline
+            override = msg.get("timeout_ms")
+            pending.put((
+                req_id, future,
+                None if override is None else override / 1e3 + 30.0,
+            ))
+        if shutdown:
+            # the documented reply to {"cmd": "shutdown"}, on THIS
+            # session's stream (a TCP client would otherwise only see EOF)
+            pending.put(lambda: {
+                "event": "shutdown",
+                "requests": server.metrics.counter("requests"),
+                "batches": server.metrics.counter("batches"),
+            })
+    finally:
+        pending.put(None)
+        writer.join(timeout=120.0)
+    return shutdown
+
+
+def _serve_socket(server, port: int, out_lock) -> None:
+    import socket
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("127.0.0.1", port))
+    sock.listen(16)
+    bound = sock.getsockname()[1]
+    _out(out_lock, sys.stdout, {"event": "listening", "port": bound})
+    stop = threading.Event()
+
+    def _handle(conn):
+        with conn, conn.makefile("r") as rf, conn.makefile("w") as wf:
+            conn_lock = threading.Lock()
+            if _serve_stream(server, rf, wf, conn_lock):
+                stop.set()
+
+    try:
+        sock.settimeout(0.5)
+        while not stop.is_set():
+            try:
+                conn, _ = sock.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(
+                target=_handle, args=(conn,), daemon=True
+            ).start()
+    finally:
+        sock.close()
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    out_lock = threading.Lock()
+
+    if args.preflight:
+        from spark_gp_tpu.utils.platform import preflight_backend
+
+        preflight_backend()
+
+    # import AFTER the platform decision: spark_gp_tpu re-asserts
+    # JAX_PLATFORMS over site hooks at import (utils/platform.py)
+    from spark_gp_tpu.serve.server import GPServeServer
+
+    if not args.model:
+        print("at least one --model NAME=PATH is required", file=sys.stderr)
+        return 2
+
+    server = GPServeServer(
+        max_batch=args.max_batch,
+        min_bucket=args.min_bucket,
+        mean_only=args.mean_only,
+        capacity=args.capacity,
+        max_wait_ms=args.max_wait_ms,
+        request_timeout_ms=(
+            None if args.request_timeout_ms == 0 else args.request_timeout_ms
+        ),
+    )
+    for spec in args.model:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            print(f"--model expects NAME=PATH, got {spec!r}", file=sys.stderr)
+            return 2
+        server.register(name, path)  # loads + warms every bucket (AOT)
+    server.start()
+
+    import jax
+
+    _out(out_lock, sys.stdout, {
+        "event": "ready",
+        "platform": jax.devices()[0].platform,
+        "models": server.registry.describe(),
+        "buckets_warmed": sum(
+            len(m["compiles"]) for m in server.registry.describe()
+        ),
+    })
+
+    explicit_shutdown = False
+    try:
+        if args.port is not None:
+            _serve_socket(server, args.port, out_lock)
+        else:
+            explicit_shutdown = _serve_stream(
+                server, sys.stdin, sys.stdout, out_lock
+            )
+    finally:
+        server.stop(drain=True)
+        if not explicit_shutdown:
+            # EOF / socket-mode exit: the session stream never carried a
+            # shutdown reply, so emit the process-level event here
+            _out(out_lock, sys.stdout, {
+                "event": "shutdown",
+                "requests": server.metrics.counter("requests"),
+                "batches": server.metrics.counter("batches"),
+            })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,271 @@
+"""Shape-bucketed predict: one XLA compile per (model, bucket), ever.
+
+XLA specializes every executable to its input shapes.  A serving path fed
+raw request sizes therefore compiles on the hot path — a 3-row request
+after a lifetime of 4-row requests stalls for a full trace+compile (tens
+of ms on CPU, tens of *seconds* cold on TPU) exactly when a user is
+waiting.  The fix is the standard one (cf. "Memory Safe Computations with
+XLA", PAPERS.md): quantize request batch shapes to a small fixed set of
+power-of-two buckets, pad up to the bucket, slice the answer back.  The
+compiled surface is then finite and enumerable, which makes ahead-of-time
+warmup possible (:meth:`BucketedPredictor.warmup` runs every bucket once
+before the server reports ready) and makes "it recompiled in production"
+a detectable bug instead of a silent tail-latency cliff
+(:class:`RecompileGuardError`).
+
+Padding uses the model's own first active-set point, never zeros — the
+same benign-padding convention as models/ppa.py's chunked predict: a
+custom kernel may be non-finite at the zero point, and although padded
+rows are sliced away, a NaN there would still have burned MXU cycles and
+can trip NaN-debugging modes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
+
+
+class BucketOverflowError(ValueError):
+    """A request exceeded the largest configured bucket and chunking was
+    disabled."""
+
+
+class RecompileGuardError(RuntimeError):
+    """A compile happened on the hot path after warmup declared the
+    compiled surface complete."""
+
+
+def bucket_sizes(max_batch: int, min_bucket: int = 8) -> Tuple[int, ...]:
+    """Power-of-two bucket ladder ``(min_bucket, ..., max_batch)``.
+
+    Both ends are rounded up to powers of two; the ladder is the compile
+    budget (one executable per rung per model), so it grows log-wise in
+    ``max_batch`` — 8..1024 is 8 compiles, not 1024.
+    """
+    if max_batch < 1 or min_bucket < 1:
+        raise ValueError("max_batch and min_bucket must be >= 1")
+
+    def _pow2(n: int) -> int:
+        return 1 << (n - 1).bit_length()
+
+    lo, hi = _pow2(min_bucket), _pow2(max_batch)
+    if lo > hi:
+        raise ValueError(
+            f"min_bucket {min_bucket} exceeds max_batch {max_batch}"
+        )
+    sizes = []
+    b = lo
+    while b <= hi:
+        sizes.append(b)
+        b *= 2
+    return tuple(sizes)
+
+
+class BucketedPredictor:
+    """Compile-once predict over a fixed bucket ladder.
+
+    Wraps a :class:`ProjectedProcessRawPredictor` with device-resident
+    operands (theta/active/magic uploaded once, not per request) and a
+    per-bucket-compiled ``(mean, var)`` program.  Requests larger than the
+    top bucket are served in top-bucket chunks, so throughput callers and
+    latency callers share one compiled surface.
+
+    ``compile_counts`` maps bucket -> number of XLA traces observed — the
+    compile-counting hook the serving tests assert against.  The counter
+    increments inside the traced function body, which Python executes
+    exactly once per trace (i.e. per compile); steady-state dispatches
+    never touch it.
+    """
+
+    def __init__(
+        self,
+        raw: ProjectedProcessRawPredictor,
+        max_batch: int = 256,
+        min_bucket: int = 8,
+        buckets: Optional[Sequence[int]] = None,
+        mean_only: bool = False,
+    ):
+        self._raw = raw
+        self.mean_only = bool(mean_only) or raw.magic_matrix is None
+        self.buckets = (
+            tuple(sorted(set(int(b) for b in buckets)))
+            if buckets is not None
+            else bucket_sizes(max_batch, min_bucket)
+        )
+        if any(b < 1 for b in self.buckets):
+            raise ValueError(f"buckets must be >= 1, got {self.buckets}")
+        self.n_features = int(raw.active.shape[1])
+        # one dtype for the whole compiled surface: f64 under the x64
+        # harness, f32 in production — requests are cast on entry so a
+        # float32 payload can never force a second set of executables.
+        # canonicalize_dtype, not a probe array: the probe would log a
+        # "float64 is not available" warning per construction at x64 off
+        self._dtype = jax.dtypes.canonicalize_dtype(np.float64)
+        self._theta = jnp.asarray(raw.theta, dtype=self._dtype)
+        self._active = jnp.asarray(raw.active, dtype=self._dtype)
+        self._magic_vector = jnp.asarray(raw.magic_vector, dtype=self._dtype)
+        self._magic_matrix = (
+            None
+            if self.mean_only
+            else jnp.asarray(raw.magic_matrix, dtype=self._dtype)
+        )
+        # pad rows with the first active-set point (benign-padding
+        # convention — module docstring)
+        self._pad_row = np.asarray(raw.active[:1], dtype=self._dtype)
+        self.compile_counts: Dict[int, int] = {}
+        self._warmed: set[int] = set()
+        self._frozen = False
+        self._lock = threading.Lock()
+        # donate the request buffer on accelerators: each padded batch is a
+        # fresh upload consumed by exactly one dispatch, so XLA can reuse
+        # its HBM in place.  Not on CPU, where donation is unimplemented
+        # and every dispatch would log a donation warning.
+        donate = (4,) if jax.default_backend() != "cpu" else ()
+        self._jit = jax.jit(self._make_impl(), donate_argnums=donate)
+
+    def _make_impl(self):
+        # the math is ppa's own predict impls — one source of truth, so a
+        # fix to the PPA formulas reaches the serving path automatically
+        from spark_gp_tpu.models.ppa import _predict_impl, _predict_mean_impl
+
+        kernel = self._raw.kernel
+        mean_only = self.mean_only
+        counts = self.compile_counts
+        lock = self._lock
+
+        def impl(theta, active, magic_vector, magic_matrix, x):
+            # trace-time side effect: one execution of this Python body ==
+            # one XLA trace/compile for x.shape — THE compile counter
+            with lock:
+                b = int(x.shape[0])
+                counts[b] = counts.get(b, 0) + 1
+            if mean_only:
+                mean = _predict_mean_impl(kernel, theta, active, magic_vector, x)
+                return mean, jnp.zeros_like(mean)
+            return _predict_impl(
+                kernel, theta, active, magic_vector, magic_matrix, x
+            )
+
+        return impl
+
+    def bucket_for(self, n: int) -> Optional[int]:
+        """Smallest bucket >= n, or None when n exceeds the top bucket
+        (the caller then chunks by the top bucket)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return None
+
+    def warmup(self, block: bool = True) -> Dict[int, int]:
+        """Compile every bucket ahead of time; freezes the compiled
+        surface (any later compile raises :class:`RecompileGuardError`).
+        Returns a copy of ``compile_counts``.  Idempotent: warmed buckets
+        hit their compiled executables and the counts stay put.
+        """
+        for b in self.buckets:
+            dummy = jnp.asarray(
+                np.broadcast_to(self._pad_row, (b, self.n_features)),
+                dtype=self._dtype,
+            )
+            out = self._dispatch(b, dummy)
+            if block:
+                jax.block_until_ready(out)
+            self._warmed.add(b)
+        self._frozen = True
+        return dict(self.compile_counts)
+
+    def _dispatch(self, bucket: int, x_padded):
+        if self._frozen and bucket not in self._warmed:
+            raise RecompileGuardError(
+                f"bucket {bucket} was not warmed; compiled surface is "
+                f"frozen to {sorted(self._warmed)}"
+            )
+        before = self.compile_counts.get(bucket, 0)
+        out = self._jit(
+            self._theta,
+            self._active,
+            self._magic_vector,
+            self._magic_matrix,
+            x_padded,
+        )
+        if self._frozen and self.compile_counts.get(bucket, 0) > before:
+            # the compile already happened (this guard is a tripwire, not
+            # a prevention), but a silent one would only ever surface as
+            # an unexplained p99 cliff — fail loudly instead
+            raise RecompileGuardError(
+                f"recompile on warmed bucket {bucket} — input dtype or "
+                "operand identity drifted on the hot path"
+            )
+        return out
+
+    def _normalize(self, x_test) -> np.ndarray:
+        x = np.asarray(x_test, dtype=self._dtype)
+        if x.ndim != 2 or x.shape[1] != self.n_features:
+            raise ValueError(
+                f"x_test must be [t, {self.n_features}] (the model was "
+                f"fitted on {self.n_features} features); got shape "
+                f"{tuple(np.shape(x_test))}"
+            )
+        return x
+
+    def predict(self, x_test, chunk_oversize: bool = True):
+        """``(mean [t], var [t])`` — ``var`` is None for mean-only models.
+
+        Pads up to the smallest covering bucket (occupancy t/bucket);
+        requests past the top bucket are served in top-bucket chunks when
+        ``chunk_oversize`` (default), else raise
+        :class:`BucketOverflowError`.
+        """
+        x = self._normalize(x_test)
+        t = x.shape[0]
+        if t == 0:
+            empty = np.zeros(0, dtype=self._dtype)
+            return empty, (None if self.mean_only else empty.copy())
+        top = self.buckets[-1]
+        if t > top and not chunk_oversize:
+            raise BucketOverflowError(
+                f"request of {t} rows exceeds the largest bucket {top} "
+                "(pass chunk_oversize=True to serve it in chunks)"
+            )
+        means, vars_ = [], []
+        for start in range(0, t, top):
+            part = x[start : start + top]
+            bucket = self.bucket_for(part.shape[0])
+            pad = bucket - part.shape[0]
+            if pad:
+                part = np.concatenate(
+                    [part, np.broadcast_to(self._pad_row, (pad, x.shape[1]))]
+                )
+            mean, var = self._dispatch(bucket, jnp.asarray(part))
+            means.append(np.asarray(mean)[: bucket - pad])
+            vars_.append(np.asarray(var)[: bucket - pad])
+        mean = np.concatenate(means) if len(means) > 1 else means[0]
+        if self.mean_only:
+            return mean, None
+        return mean, (np.concatenate(vars_) if len(vars_) > 1 else vars_[0])
+
+    @property
+    def dtype(self):
+        """The one dtype of the compiled surface — callers casting their
+        payload to this up front avoid a second conversion in predict."""
+        return self._dtype
+
+    def padded_rows(self, t: int) -> int:
+        """Device rows a ``t``-row request actually occupies after bucket
+        padding and top-bucket chunking (the occupancy denominator)."""
+        if t <= 0:
+            return 0
+        top = self.buckets[-1]
+        full, rem = divmod(t, top)
+        return full * top + (self.bucket_for(rem) if rem else 0)
+
+    @property
+    def total_compiles(self) -> int:
+        return sum(self.compile_counts.values())

@@ -1,0 +1,115 @@
+"""Serving metrics: counters, gauges and latency histograms.
+
+Extends :class:`spark_gp_tpu.utils.instrumentation.Instrumentation` — the
+per-fit phase/metric recorder — with what a *request-driven* workload
+needs and a one-shot fit does not: monotonic counters (requests, batches,
+shed load, compiles), point-in-time gauges (queue depth), and bounded
+latency histograms with percentile snapshots (p50/p99).  All entry points
+are thread-safe: the submit path, the batcher thread, and a metrics
+reader (the CLI's ``{"cmd": "metrics"}``) touch one instance concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from spark_gp_tpu.utils.instrumentation import Instrumentation
+
+
+class LatencyHistogram:
+    """Bounded-memory sample reservoir with percentile snapshots.
+
+    A ring buffer of the most recent ``capacity`` observations: recency is
+    the right bias for serving dashboards (a warm-up spike should age out,
+    not poison p99 forever), and the memory bound holds under sustained
+    traffic.  ``count`` still reports every observation ever made.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._buf = np.zeros(capacity, dtype=np.float64)
+        self._n = 0  # total observations (monotonic)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._buf[self._n % self._buf.shape[0]] = float(value)
+            self._n += 1
+
+    def snapshot(self) -> dict:
+        """``{count, mean, p50, p99, max}`` over the retained window
+        (zeros/None when nothing was observed yet)."""
+        with self._lock:
+            n = self._n
+            window = self._buf[: min(n, self._buf.shape[0])].copy()
+        if n == 0:
+            return {"count": 0, "mean": None, "p50": None, "p99": None, "max": None}
+        return {
+            "count": n,
+            "mean": float(window.mean()),
+            "p50": float(np.percentile(window, 50)),
+            "p99": float(np.percentile(window, 99)),
+            "max": float(window.max()),
+        }
+
+
+class ServingMetrics(Instrumentation):
+    """Thread-safe counters + gauges + histograms for the serve path.
+
+    The inherited ``timings``/``metrics``/``phase`` keep working (the
+    warmup stage reuses ``phase``, and a raising phase records its
+    ``<phase>.failed`` marker); the additions below are the steady-state
+    signals.  Histogram keys are created on first ``observe``.
+    """
+
+    def __init__(self, name: str = "serve", histogram_capacity: int = 4096):
+        super().__init__(name=name)
+        self._lock = threading.Lock()
+        self._hist_capacity = histogram_capacity
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, LatencyHistogram] = {}
+
+    def inc(self, key: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0.0) + value
+
+    def set_gauge(self, key: str, value: float) -> None:
+        with self._lock:
+            self.gauges[key] = float(value)
+
+    def observe(self, key: str, value: float) -> None:
+        with self._lock:
+            hist = self.histograms.get(key)
+            if hist is None:
+                hist = self.histograms[key] = LatencyHistogram(
+                    self._hist_capacity
+                )
+        hist.observe(value)
+
+    def counter(self, key: str) -> float:
+        with self._lock:
+            return self.counters.get(key, 0.0)
+
+    def histogram(self, key: str) -> Optional[LatencyHistogram]:
+        with self._lock:
+            return self.histograms.get(key)
+
+    def snapshot(self) -> dict:
+        """One JSON-ready dict: counters, gauges, per-histogram percentile
+        summaries, plus the inherited phase timings/metrics."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            hists = dict(self.histograms)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {k: h.snapshot() for k, h in hists.items()},
+            "timings": dict(self.timings),
+            "metrics": dict(self.metrics),
+        }

@@ -1,0 +1,222 @@
+"""Bounded request queue with micro-batch coalescing.
+
+The latency/throughput trade at the heart of online GP scoring: a single
+request of 1 row uses a sliver of the MXU, but holding requests to build
+big batches adds queueing delay.  The standard resolution is micro-batch
+coalescing — dispatch immediately when idle, and while the device is busy
+let a short max-wait window (default 2 ms) collect whatever arrives, so
+batch size adapts to load.
+
+Failure semantics are explicit and load-shedding, never stalling:
+
+* the queue is bounded — a full queue rejects the submit with
+  :class:`QueueFullError` at the *door* (the client sees backpressure in
+  microseconds instead of a timeout after seconds);
+* every request carries a deadline — one that expires while queued is
+  completed with :class:`RequestTimeoutError` and never wastes a device
+  dispatch on an answer nobody is waiting for.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import queue as _queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the request queue is at capacity; retry with backoff
+    or add serving capacity."""
+
+
+class RequestTimeoutError(TimeoutError):
+    """The request's deadline expired before a result was produced."""
+
+
+class ServeFuture(concurrent.futures.Future):
+    """Single-request result holder: the stdlib Future (thread-safe,
+    double-set protected) with the serve error vocabulary — ``set_error``
+    and a ``result`` that times out as :class:`RequestTimeoutError`."""
+
+    def set_error(self, error: BaseException) -> None:
+        self.set_exception(error)
+
+    def result(self, timeout: Optional[float] = None):
+        try:
+            return super().result(timeout)
+        except concurrent.futures.TimeoutError:
+            raise RequestTimeoutError(
+                "no result within the wait timeout (server overloaded or "
+                "stopped?)"
+            ) from None
+
+
+@dataclass
+class PredictRequest:
+    """One enqueued predict: rows for a named model + bookkeeping."""
+
+    model_key: Tuple[str, Optional[int]]  # (name, version|None=latest)
+    x: np.ndarray
+    future: ServeFuture = field(default_factory=ServeFuture)
+    enqueued_at: float = field(default_factory=time.monotonic)
+    deadline: Optional[float] = None  # monotonic seconds, None = never
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and (
+            now if now is not None else time.monotonic()
+        ) > self.deadline
+
+
+_SENTINEL = object()
+
+
+class MicroBatchQueue:
+    """Bounded queue + coalescing worker.
+
+    ``execute(batch)`` — supplied by the server — receives a list of
+    same-model :class:`PredictRequest` and must complete every future.
+    The worker groups a coalesced window by model key, so mixed-model
+    traffic still batches per model.
+    """
+
+    def __init__(
+        self,
+        execute: Callable[[List[PredictRequest]], None],
+        capacity: int = 1024,
+        max_wait_s: float = 0.002,
+        max_batch_rows: int = 1024,
+        on_timeout: Optional[Callable[[int], None]] = None,
+    ):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._execute = execute
+        self._on_timeout = on_timeout
+        self._q: _queue.Queue = _queue.Queue(maxsize=capacity)
+        self.capacity = capacity
+        self.max_wait_s = float(max_wait_s)
+        self.max_batch_rows = int(max_batch_rows)
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    # -- producer side ----------------------------------------------------
+    def submit(self, request: PredictRequest) -> ServeFuture:
+        if self._stopping.is_set():
+            raise RuntimeError("queue is stopped")
+        try:
+            self._q.put_nowait(request)
+        except _queue.Full:
+            raise QueueFullError(
+                f"request queue at capacity ({self.capacity}); shedding "
+                "load — retry with backoff or raise --capacity"
+            ) from None
+        if self._stopping.is_set():
+            # stop() completed between the gate above and the put: the
+            # worker and stop()'s own drain sweep may both be gone, so
+            # nothing would ever complete this future — sweep the queue
+            # here rather than leave the caller blocked forever
+            self._fail_leftovers()
+        return request.future
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    # -- worker side ------------------------------------------------------
+    def start(self) -> None:
+        """Start (or restart) the worker.  ``stop``/``start`` are
+        symmetric: a stopped queue restarted here accepts and serves
+        requests again."""
+        if self._thread is not None:
+            return
+        self._stopping.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="gp-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the worker; with ``drain`` (default) queued requests are
+        still executed, without it they fail fast with shutdown errors."""
+        if self._thread is None:
+            return
+        if not drain:
+            self._stopping.set()
+        self._q.put(_SENTINEL)  # blocking put: always deliverable
+        self._thread.join(timeout)
+        self._thread = None
+        self._stopping.set()
+        # whatever is left after the join window fails explicitly
+        self._fail_leftovers()
+
+    def _fail_leftovers(self) -> None:
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            if item is not _SENTINEL:
+                item.future.set_error(RuntimeError("server shut down"))
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                first = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            if first is _SENTINEL:
+                return
+            if self._stopping.is_set():
+                first.future.set_error(RuntimeError("server shut down"))
+                continue
+            batch = [first]
+            rows = first.x.shape[0]
+            # coalescing window opens at first dequeue: an idle server
+            # dispatches a lone request after at most max_wait_s, a busy
+            # one fills toward max_batch_rows
+            deadline = time.monotonic() + self.max_wait_s
+            saw_sentinel = False
+            while rows < self.max_batch_rows:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except _queue.Empty:
+                    break
+                if nxt is _SENTINEL:
+                    saw_sentinel = True
+                    break
+                batch.append(nxt)
+                rows += nxt.x.shape[0]
+            self._run_batch(batch)
+            if saw_sentinel:
+                return
+
+    def _run_batch(self, batch: List[PredictRequest]) -> None:
+        # shed already-expired requests BEFORE spending a dispatch on them
+        now = time.monotonic()
+        live: dict = {}
+        expired = 0
+        for req in batch:
+            if req.expired(now):
+                expired += 1
+                req.future.set_error(
+                    RequestTimeoutError(
+                        "deadline expired while queued (server overloaded)"
+                    )
+                )
+                continue
+            live.setdefault(req.model_key, []).append(req)
+        if expired and self._on_timeout is not None:
+            self._on_timeout(expired)
+        for group in live.values():
+            try:
+                self._execute(group)
+            except BaseException as exc:  # noqa: BLE001 — worker must survive
+                for req in group:
+                    if not req.future.done():
+                        req.future.set_error(exc)

@@ -1,0 +1,207 @@
+"""Model registry: named, versioned, hot-swappable servable models.
+
+Loads ``.npz`` models through :mod:`spark_gp_tpu.utils.serialization`
+(which version-gates the on-disk format), wraps each in a warmed
+:class:`~spark_gp_tpu.serve.batcher.BucketedPredictor`, and keys the
+result by ``name`` + integer ``version``.  ``reload`` builds and warms
+the NEW version completely before the latest-pointer moves — in-flight
+requests keep scoring against the old compiled executables and never
+observe a half-initialized model (hot swap, no drain needed).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_gp_tpu.serve.batcher import BucketedPredictor
+from spark_gp_tpu.serve.metrics import ServingMetrics
+
+
+class ServableModel:
+    """One immutable registry entry: a loaded model + its warm predictor."""
+
+    def __init__(
+        self,
+        name: str,
+        version: int,
+        path: str,
+        model,
+        predictor: BucketedPredictor,
+        kind: str,
+    ):
+        self.name = name
+        self.version = int(version)
+        self.path = path
+        self.model = model
+        self.predictor = predictor
+        self.kind = kind
+        self.loaded_at = time.time()
+
+    def predict(self, x: np.ndarray):
+        """``(mean [t], var [t] | None)`` through the bucketed path."""
+        return self.predictor.predict(x)
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "path": self.path,
+            "kind": self.kind,
+            "n_features": self.predictor.n_features,
+            "buckets": list(self.predictor.buckets),
+            "mean_only": self.predictor.mean_only,
+            "compiles": dict(self.predictor.compile_counts),
+        }
+
+
+class ModelRegistry:
+    """name -> {version -> ServableModel}, with a latest-version pointer.
+
+    ``warmup=True`` (default) is the AOT stage: every (model, bucket)
+    pair is compiled at load, inside a metrics phase, so the server's
+    ready signal means "no compile will ever happen on the hot path".
+    """
+
+    def __init__(
+        self,
+        max_batch: int = 256,
+        min_bucket: int = 8,
+        buckets: Optional[Sequence[int]] = None,
+        mean_only: bool = False,
+        metrics: Optional[ServingMetrics] = None,
+        max_versions: int = 2,
+    ):
+        if max_versions < 1:
+            raise ValueError("max_versions must be >= 1")
+        self._max_batch = max_batch
+        self._min_bucket = min_bucket
+        self._buckets = tuple(buckets) if buckets is not None else None
+        self._mean_only = mean_only
+        # versions retained per name: each entry pins host arrays, device
+        # buffers AND a ladder of compiled executables, so unbounded
+        # retention would leak a full warmed model per reload.  The
+        # default keeps latest + one predecessor (in-flight requests
+        # pinned at the previous latest survive a single hot swap); raise
+        # it when clients pin explicit versions across longer windows.
+        self._max_versions = max_versions
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._lock = threading.Lock()
+        self._models: Dict[str, Dict[int, ServableModel]] = {}
+        self._latest: Dict[str, int] = {}
+        # highest version ever ALLOCATED per name (>= latest): auto
+        # versions are reserved here under the lock BEFORE the slow
+        # build, so two concurrent register/reload calls can never be
+        # handed the same number and silently overwrite each other
+        self._allocated: Dict[str, int] = {}
+
+    def _build(self, name: str, version: int, path: str, warmup: bool) -> ServableModel:
+        from spark_gp_tpu.utils.serialization import load_model
+
+        with self.metrics.phase(f"load.{name}"):
+            model = load_model(path)
+        kind = type(model).__name__
+        predictor = BucketedPredictor(
+            model.raw_predictor,
+            max_batch=self._max_batch,
+            min_bucket=self._min_bucket,
+            buckets=self._buckets,
+            mean_only=self._mean_only,
+        )
+        if warmup:
+            with self.metrics.phase(f"warmup.{name}"):
+                counts = predictor.warmup()
+            self.metrics.inc("compiles", sum(counts.values()))
+        return ServableModel(name, version, path, model, predictor, kind)
+
+    def register(
+        self,
+        name: str,
+        path: str,
+        version: Optional[int] = None,
+        warmup: bool = True,
+    ) -> ServableModel:
+        """Load ``path`` and publish it as ``name`` at ``version``
+        (default: one past the current latest; 1 for a new name).  The
+        entry is fully built — loaded, compiled, warmed — before it
+        becomes visible."""
+        with self._lock:
+            if version is None:
+                version = self._allocated.get(name, 0) + 1
+            elif version in self._models.get(name, {}):
+                raise ValueError(
+                    f"model {name!r} version {version} is already registered"
+                )
+            self._allocated[name] = max(self._allocated.get(name, 0), version)
+        entry = self._build(name, version, path, warmup)
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if entry.version in versions:
+                # two explicit-version registrations raced past the check
+                # above: refuse rather than replace a published entry
+                raise ValueError(
+                    f"model {name!r} version {entry.version} was registered "
+                    "concurrently"
+                )
+            versions[entry.version] = entry
+            if entry.version >= self._latest.get(name, 0):
+                self._latest[name] = entry.version
+            for old in sorted(versions)[: -self._max_versions]:
+                # never trim the entry this very call just published — an
+                # explicitly re-registered old version must stay gettable
+                if old != entry.version:
+                    del versions[old]
+        self.metrics.inc("models_loaded")
+        return entry
+
+    def reload(self, name: str, path: Optional[str] = None) -> ServableModel:
+        """Hot-swap: re-load ``name`` (from its current path unless a new
+        one is given) as the next version and move the latest pointer.
+        Prior versions stay addressable for pinned clients."""
+        with self._lock:
+            current = self._latest.get(name)
+            if current is None:
+                raise KeyError(f"no model named {name!r} to reload")
+            source = path or self._models[name][current].path
+        entry = self.register(name, source, warmup=True)
+        self.metrics.inc("models_reloaded")
+        return entry
+
+    def get(self, name: str, version: Optional[int] = None) -> ServableModel:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError(
+                    f"no model named {name!r}; registered: {sorted(self._models)}"
+                )
+            v = self._latest[name] if version is None else int(version)
+            entry = versions.get(v)
+            if entry is None:
+                raise KeyError(
+                    f"model {name!r} has no version {v}; available: "
+                    f"{sorted(versions)}"
+                )
+            return entry
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def describe(self) -> List[dict]:
+        with self._lock:
+            entries = [
+                (self._latest[name], versions)
+                for name, versions in self._models.items()
+            ]
+            return [
+                {**entry.describe(), "latest": entry.version == latest}
+                for latest, versions in entries
+                for entry in versions.values()
+            ]
+
+    def resolve(self, key: Tuple[str, Optional[int]]) -> ServableModel:
+        """(name, version|None) -> entry; the queue's model_key form."""
+        return self.get(key[0], key[1])

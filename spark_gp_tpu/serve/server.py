@@ -1,0 +1,204 @@
+"""The serving core: registry + micro-batch queue + metrics, one object.
+
+:class:`GPServeServer` owns the lifecycle the CLI (and any embedding
+application) needs: register models (each load runs the AOT warmup so
+every (model, bucket) pair is compiled before ``ready``), accept
+requests from any thread via :meth:`submit`, coalesce them into
+micro-batches on the single batcher thread, and answer through
+:class:`~spark_gp_tpu.serve.queue.ServeFuture`.  One batcher thread is
+deliberate: JAX dispatch is serialized per device anyway, and a single
+consumer makes the coalescing window race-free.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from spark_gp_tpu.serve.metrics import ServingMetrics
+from spark_gp_tpu.serve.queue import (
+    MicroBatchQueue,
+    PredictRequest,
+    ServeFuture,
+)
+from spark_gp_tpu.serve.registry import ModelRegistry, ServableModel
+
+
+class GPServeServer:
+    """Online scorer over a :class:`ModelRegistry`.
+
+    >>> server = GPServeServer(max_batch=128)
+    >>> server.register("airfoil", "model.npz")
+    >>> server.start()
+    >>> fut = server.submit("airfoil", x)      # any thread
+    >>> mean, var = fut.result(timeout=1.0)
+    >>> server.stop()
+    """
+
+    def __init__(
+        self,
+        max_batch: int = 256,
+        min_bucket: int = 8,
+        buckets: Optional[Sequence[int]] = None,
+        mean_only: bool = False,
+        capacity: int = 1024,
+        max_wait_ms: float = 2.0,
+        request_timeout_ms: Optional[float] = 1000.0,
+        metrics: Optional[ServingMetrics] = None,
+        max_versions: int = 2,
+    ):
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.registry = ModelRegistry(
+            max_batch=max_batch,
+            min_bucket=min_bucket,
+            buckets=buckets,
+            mean_only=mean_only,
+            metrics=self.metrics,
+            max_versions=max_versions,
+        )
+        self._request_timeout_s = (
+            None if request_timeout_ms is None else request_timeout_ms / 1e3
+        )
+        self._queue = MicroBatchQueue(
+            execute=self._execute,
+            capacity=capacity,
+            max_wait_s=max_wait_ms / 1e3,
+            max_batch_rows=max_batch,
+            on_timeout=lambda n: self.metrics.inc("timeouts", n),
+        )
+        self._started = False
+
+    @property
+    def request_timeout_s(self) -> Optional[float]:
+        """The default per-request deadline in seconds (None = disabled)."""
+        return self._request_timeout_s
+
+    # -- lifecycle --------------------------------------------------------
+    def register(self, name: str, path: str, **kw) -> ServableModel:
+        return self.registry.register(name, path, **kw)
+
+    def start(self) -> None:
+        self._queue.start()
+        self._started = True
+
+    def ready(self) -> bool:
+        return self._started and bool(self.registry.names())
+
+    def stop(self, drain: bool = True) -> None:
+        self._queue.stop(drain=drain)
+        self._started = False
+
+    # -- request path -----------------------------------------------------
+    def submit(
+        self,
+        name: str,
+        x,
+        version: Optional[int] = None,
+        timeout_ms: Optional[float] = None,
+    ) -> ServeFuture:
+        """Enqueue a predict; returns immediately with a future.
+
+        Shape errors and backpressure surface HERE, in the caller's
+        thread — an invalid request must never occupy queue capacity or
+        a batch slot.
+        """
+        entry = self.registry.get(name, version)  # KeyError for unknowns
+        # cast straight to the predictor's compiled dtype: one conversion
+        # on the hot path, and _normalize's later asarray is then a no-op
+        x = np.asarray(x, dtype=entry.predictor.dtype)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2 or x.shape[1] != entry.predictor.n_features:
+            raise ValueError(
+                f"model {name!r} expects [t, {entry.predictor.n_features}] "
+                f"inputs; got shape {tuple(x.shape)}"
+            )
+        timeout_s = (
+            timeout_ms / 1e3 if timeout_ms is not None
+            else self._request_timeout_s
+        )
+        request = PredictRequest(
+            # pin the CONCRETE version resolved at submit: a reload
+            # between submit and dispatch must not re-route this request
+            # to a model it was never validated against (the registry's
+            # in-flight hot-swap invariant)
+            model_key=(name, entry.version if version is None else version),
+            x=x,
+            deadline=(
+                None if timeout_s is None else time.monotonic() + timeout_s
+            ),
+        )
+        try:
+            future = self._queue.submit(request)
+        except Exception:
+            self.metrics.inc("shed")
+            raise
+        self.metrics.inc("requests")
+        self.metrics.inc("requests_rows", x.shape[0])
+        self.metrics.set_gauge("queue_depth", self._queue.depth())
+        return future
+
+    def predict(
+        self,
+        name: str,
+        x,
+        version: Optional[int] = None,
+        timeout_ms: Optional[float] = None,
+    ):
+        """Blocking convenience: submit + wait."""
+        wait_s = (
+            None
+            if (timeout_ms is None and self._request_timeout_s is None)
+            # queue deadline + one batch window of slack for the dispatch
+            else ((timeout_ms / 1e3) if timeout_ms is not None
+                  else self._request_timeout_s) + 5.0
+        )
+        return self.submit(name, x, version, timeout_ms).result(wait_s)
+
+    # -- batch execution (batcher thread) ---------------------------------
+    def _execute(self, group: List[PredictRequest]) -> None:
+        """Score one coalesced same-model group: concatenate rows, one
+        bucketed predict, split the answers back per request."""
+        entry = self.registry.resolve(group[0].model_key)
+        rows = [req.x.shape[0] for req in group]
+        total = sum(rows)
+        x = (
+            group[0].x if len(group) == 1
+            else np.concatenate([req.x for req in group], axis=0)
+        )
+        started = time.monotonic()
+        mean, var = entry.predict(x)
+        elapsed = time.monotonic() - started
+        padded = entry.predictor.padded_rows(total)
+        self.metrics.inc("batches")
+        self.metrics.inc("padded_rows", padded - total)
+        self.metrics.observe("batch_rows", total)
+        self.metrics.observe("batch_requests", len(group))
+        self.metrics.observe("batch_occupancy", total / max(padded, 1))
+        self.metrics.observe("batch_predict_s", elapsed)
+        self.metrics.set_gauge("queue_depth", self._queue.depth())
+        now = time.monotonic()
+        offset = 0
+        for req, t in zip(group, rows):
+            req.future.set_result(
+                (
+                    mean[offset : offset + t],
+                    None if var is None else var[offset : offset + t],
+                )
+            )
+            offset += t
+            self.metrics.observe("request_latency_s", now - req.enqueued_at)
+
+    # -- introspection ----------------------------------------------------
+    def snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["models"] = self.registry.describe()
+        snap["queue"] = {
+            "depth": self._queue.depth(),
+            "capacity": self._queue.capacity,
+            "max_wait_ms": self._queue.max_wait_s * 1e3,
+            "max_batch_rows": self._queue.max_batch_rows,
+        }
+        return snap

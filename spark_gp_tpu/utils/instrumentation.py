@@ -36,6 +36,13 @@ class Instrumentation:
         start = time.perf_counter()
         try:
             yield
+        except BaseException:
+            # a raising phase used to record only its timing — the metric
+            # context vanished and an emitted metrics dict looked identical
+            # to a healthy run's.  A "<phase>.failed" marker makes serve-path
+            # (and fit-path) errors visible wherever metrics are shipped.
+            self.metrics[f"{phase_name}.failed"] = 1.0
+            raise
         finally:
             elapsed = time.perf_counter() - start
             self.timings[phase_name] = self.timings.get(phase_name, 0.0) + elapsed

@@ -16,6 +16,20 @@ import numpy as np
 
 from spark_gp_tpu.models.ppa import ProjectedProcessRawPredictor
 
+# On-disk contract version.  History:
+#   (absent)  — pre-versioning files (seed .. r5): same array layout as v2,
+#               minus this marker; loaded as legacy without complaint.
+#   2         — identical layout, explicit version marker.
+# Bump this ONLY on a layout/semantics change a current loader cannot read;
+# readers accept every version <= FORMAT_VERSION and refuse newer files with
+# a versioned error instead of a KeyError deep in predictor construction
+# (the serve registry depends on this being a stable, explicit contract).
+FORMAT_VERSION = 2
+
+
+class ModelFormatError(ValueError):
+    """A saved model's format_version is not loadable by this build."""
+
 
 def _normalize(path: str) -> str:
     """np.savez appends '.npz' to bare paths; keep save/load symmetric."""
@@ -33,6 +47,7 @@ def save_model(path: str, model, kind: str) -> None:
         extras["u2"] = raw.u2
     np.savez(
         _normalize(path),
+        format_version=np.array(FORMAT_VERSION),
         kind=np.array(kind),
         theta=raw.theta,
         active=raw.active,
@@ -56,6 +71,18 @@ def load_model(path: str):
     from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
 
     with np.load(_normalize(path), allow_pickle=False) as data:
+        # version-gate FIRST: a future layout must fail here with its
+        # version named, not as an arbitrary KeyError below
+        version = (
+            int(data["format_version"]) if "format_version" in data else 1
+        )
+        if version > FORMAT_VERSION:
+            raise ModelFormatError(
+                f"{_normalize(path)} was saved with model format v{version}, "
+                f"but this build reads up to v{FORMAT_VERSION}. Load it with "
+                "the spark_gp_tpu version that wrote it, or re-save it from "
+                "there with an older format."
+            )
         kind = str(data["kind"])
         kernel = pickle.loads(data["kernel_pickle"].tobytes())
         magic_matrix = data["magic_matrix"]

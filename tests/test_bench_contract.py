@@ -72,6 +72,13 @@ def test_bench_emits_one_parseable_result_line():
     # the MXU-aligned secondary config rode along
     assert detail["mxu_config"]["expert_size"] == 64
     assert detail["mxu_config"]["fit_seconds"] > 0
+    # the serving path entered the trajectory: p50/p99 latency and
+    # throughput through the micro-batcher, with a compile-free hot path
+    serve = detail["serve_predict"]
+    assert "error" not in serve, serve
+    assert serve["points_per_sec"] > 0
+    assert 0 < serve["latency_p50_ms"] <= serve["latency_p99_ms"]
+    assert all(c == 1 for c in serve["compiles_per_bucket"].values())
 
 
 @pytest.mark.slow

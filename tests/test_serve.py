@@ -1,0 +1,308 @@
+"""Registry, server, queue and metrics behavior (spark_gp_tpu.serve),
+plus the serving-adjacent contracts in utils/: the .npz format_version
+gate and the failed-phase metric marker.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.serve import (
+    GPServeServer,
+    LatencyHistogram,
+    ModelRegistry,
+    QueueFullError,
+    RequestTimeoutError,
+    ServingMetrics,
+)
+
+
+def _fit(seed, n=160):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 3))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=n)
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(40)
+        .setActiveSetSize(40)
+        .setSigma2(1e-3)
+        .setMaxIter(8)
+        .setSeed(seed)
+        .fit(x, y)
+    )
+    return model, x
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    model, x = _fit(3)
+    path = str(tmp_path_factory.mktemp("serve") / "model.npz")
+    model.save(path)
+    return path, model, x
+
+
+# -- registry -------------------------------------------------------------
+
+
+def test_registry_register_get_and_versions(saved_model):
+    path, model, x = saved_model
+    reg = ModelRegistry(max_batch=32, min_bucket=8)
+    entry = reg.register("m", path)
+    assert (entry.name, entry.version) == ("m", 1)
+    # warmup ran at load: every bucket compiled exactly once, AOT
+    assert entry.predictor.compile_counts == {8: 1, 16: 1, 32: 1}
+    assert reg.get("m") is entry and reg.get("m", 1) is entry
+    with pytest.raises(KeyError, match="no model named"):
+        reg.get("nope")
+    with pytest.raises(KeyError, match="no version 9"):
+        reg.get("m", 9)
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("m", path, version=1)
+    mean, var = entry.predict(x[:5])
+    np.testing.assert_allclose(
+        mean, np.asarray(model.raw_predictor(x[:5])[0]), rtol=1e-10
+    )
+    assert var is not None
+
+
+def test_registry_hot_swap_on_reload(saved_model, tmp_path):
+    path, model, x = saved_model
+    other, _ = _fit(11)
+    other_path = str(tmp_path / "other.npz")
+    other.save(other_path)
+
+    reg = ModelRegistry(max_batch=16, min_bucket=8)
+    v1 = reg.register("m", path)
+    v2 = reg.reload("m", other_path)  # new source hot-swapped in
+    assert v2.version == 2
+    assert reg.get("m") is v2          # latest pointer moved...
+    assert reg.get("m", 1) is v1       # ...old version stays addressable
+    # the swap is a real model change, not a re-wrap
+    m1 = v1.predict(x[:8])[0]
+    m2 = v2.predict(x[:8])[0]
+    assert not np.allclose(m1, m2)
+    # reload without a path re-reads the latest's own source
+    v3 = reg.reload("m")
+    assert v3.version == 3 and v3.path == other_path
+    np.testing.assert_allclose(v3.predict(x[:8])[0], m2, rtol=1e-12)
+    with pytest.raises(KeyError, match="to reload"):
+        reg.reload("ghost")
+
+
+# -- server / queue -------------------------------------------------------
+
+
+def test_server_round_trip_mixed_sizes(saved_model):
+    path, model, x = saved_model
+    server = GPServeServer(max_batch=32, min_bucket=8, max_wait_ms=1.0)
+    server.register("m", path)
+    server.start()
+    assert server.ready()
+    try:
+        sizes = [1, 5, 8, 13, 2, 30, 7, 32, 9, 3]
+        futs = [
+            server.submit("m", x[i * 4 : i * 4 + t])
+            for i, t in enumerate(sizes)
+        ]
+        for (i, t), fut in zip(enumerate(sizes), futs):
+            mean, var = fut.result(timeout=10.0)
+            ref_mean, ref_var = model.raw_predictor(x[i * 4 : i * 4 + t])
+            np.testing.assert_allclose(mean, np.asarray(ref_mean), rtol=1e-10)
+            np.testing.assert_allclose(var, np.asarray(ref_var), rtol=1e-10)
+        # compile-once invariant holds THROUGH the server path
+        entry = server.registry.get("m")
+        assert entry.predictor.compile_counts == {8: 1, 16: 1, 32: 1}
+        snap = server.snapshot()
+        assert snap["counters"]["requests"] == len(sizes)
+        assert snap["histograms"]["request_latency_s"]["count"] == len(sizes)
+        assert snap["histograms"]["request_latency_s"]["p99"] > 0
+        assert 0 < snap["histograms"]["batch_occupancy"]["max"] <= 1.0
+    finally:
+        server.stop()
+
+
+def test_server_coalesces_concurrent_requests(saved_model):
+    """Requests arriving inside one max-wait window share a dispatch:
+    fewer batches than requests under a burst."""
+    path, _, x = saved_model
+    server = GPServeServer(max_batch=64, min_bucket=8, max_wait_ms=20.0)
+    server.register("m", path)
+    server.start()
+    try:
+        futs = [server.submit("m", x[i : i + 2]) for i in range(12)]
+        for fut in futs:
+            fut.result(timeout=10.0)
+        assert server.metrics.counter("batches") < 12
+        assert server.metrics.counter("requests") == 12
+    finally:
+        server.stop()
+
+
+def test_backpressure_sheds_load_with_clear_error(saved_model):
+    path, _, x = saved_model
+    # worker never started: the bounded queue must reject at the door
+    server = GPServeServer(max_batch=16, capacity=2)
+    server.register("m", path)
+    server.submit("m", x[:2])
+    server.submit("m", x[:2])
+    with pytest.raises(QueueFullError, match="at capacity"):
+        server.submit("m", x[:2])
+    assert server.metrics.counter("shed") == 1
+
+
+def test_per_request_timeout_expires_in_queue(saved_model):
+    path, _, x = saved_model
+    server = GPServeServer(max_batch=16, request_timeout_ms=10.0)
+    server.register("m", path)
+    fut = server.submit("m", x[:2])           # enqueued, nobody serving
+    time.sleep(0.05)                          # deadline passes in queue
+    server.start()                            # worker now drains it
+    with pytest.raises(RequestTimeoutError, match="deadline expired"):
+        fut.result(timeout=10.0)
+    assert server.metrics.counter("timeouts") == 1
+    server.stop()
+
+
+def test_submit_validation_fails_fast(saved_model):
+    path, _, x = saved_model
+    server = GPServeServer(max_batch=16)
+    server.register("m", path)
+    with pytest.raises(KeyError):
+        server.submit("ghost", x[:2])
+    with pytest.raises(ValueError, match=r"\[t, 3\]"):
+        server.submit("m", x[:2, :2])
+    # a 1-D row is promoted to [1, p], not rejected
+    server.start()
+    try:
+        mean, var = server.submit("m", x[0]).result(timeout=10.0)
+        assert mean.shape == (1,)
+    finally:
+        server.stop()
+
+
+def test_stop_then_start_serves_again(saved_model):
+    """stop/start are symmetric: a restarted server accepts and answers
+    requests instead of shedding with 'queue is stopped'."""
+    path, model, x = saved_model
+    server = GPServeServer(max_batch=16)
+    server.register("m", path)
+    server.start()
+    server.submit("m", x[:2]).result(timeout=10.0)
+    server.stop()
+    server.start()
+    try:
+        mean, _ = server.submit("m", x[:2]).result(timeout=10.0)
+        np.testing.assert_allclose(
+            mean, np.asarray(model.raw_predictor(x[:2])[0]), rtol=1e-10
+        )
+    finally:
+        server.stop()
+
+
+def test_stop_drains_queued_requests(saved_model):
+    path, model, x = saved_model
+    server = GPServeServer(max_batch=16)
+    server.register("m", path)
+    futs = [server.submit("m", x[i : i + 2]) for i in range(4)]
+    server.start()
+    server.stop(drain=True)
+    for fut in futs:
+        mean, _ = fut.result(timeout=1.0)  # already done post-drain
+        assert mean.shape == (2,)
+
+
+# -- metrics --------------------------------------------------------------
+
+
+def test_latency_histogram_percentiles():
+    hist = LatencyHistogram(capacity=100)
+    assert hist.snapshot()["count"] == 0
+    assert hist.snapshot()["p50"] is None
+    for v in range(1, 101):
+        hist.observe(float(v))
+    snap = hist.snapshot()
+    assert snap["count"] == 100
+    assert snap["p50"] == pytest.approx(50.5)
+    assert snap["p99"] == pytest.approx(99.01)
+    assert snap["max"] == 100.0
+    # ring buffer: old samples age out, count keeps the lifetime total
+    for _ in range(100):
+        hist.observe(7.0)
+    snap = hist.snapshot()
+    assert snap["count"] == 200 and snap["max"] == 7.0
+
+
+def test_serving_metrics_concurrent_increments():
+    metrics = ServingMetrics()
+
+    def hammer():
+        for _ in range(500):
+            metrics.inc("hits")
+            metrics.observe("lat", 0.001)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert metrics.counter("hits") == 2000
+    assert metrics.histogram("lat").snapshot()["count"] == 2000
+    metrics.set_gauge("depth", 3)
+    assert metrics.snapshot()["gauges"]["depth"] == 3.0
+
+
+# -- utils satellites -----------------------------------------------------
+
+
+def test_saved_models_carry_format_version(saved_model):
+    path, _, _ = saved_model
+    from spark_gp_tpu.utils.serialization import FORMAT_VERSION
+
+    with np.load(path, allow_pickle=False) as data:
+        assert int(data["format_version"]) == FORMAT_VERSION
+
+
+def test_future_format_version_raises_friendly_error(saved_model, tmp_path):
+    path, _, _ = saved_model
+    from spark_gp_tpu.utils.serialization import ModelFormatError, load_model
+
+    with np.load(path, allow_pickle=False) as data:
+        arrays = {k: data[k] for k in data.files}
+    arrays["format_version"] = np.array(99)
+    future_path = str(tmp_path / "future.npz")
+    np.savez(future_path, **arrays)
+    with pytest.raises(ModelFormatError, match=r"v99.*reads up to v"):
+        load_model(future_path)
+
+
+def test_legacy_file_without_format_version_loads(saved_model, tmp_path):
+    path, model, x = saved_model
+    from spark_gp_tpu.utils.serialization import load_model
+
+    with np.load(path, allow_pickle=False) as data:
+        arrays = {k: data[k] for k in data.files if k != "format_version"}
+    legacy_path = str(tmp_path / "legacy.npz")
+    np.savez(legacy_path, **arrays)
+    loaded = load_model(legacy_path)
+    np.testing.assert_allclose(
+        loaded.predict(x[:5]), model.predict(x[:5]), rtol=1e-12
+    )
+
+
+def test_failing_phase_records_failed_metric():
+    from spark_gp_tpu.utils.instrumentation import Instrumentation
+
+    instr = Instrumentation(name="t")
+    with pytest.raises(RuntimeError, match="boom"):
+        with instr.phase("serve_warmup"):
+            raise RuntimeError("boom")
+    assert instr.metrics["serve_warmup.failed"] == 1.0
+    assert instr.timings["serve_warmup"] >= 0.0  # timing still recorded
+    # a healthy phase leaves no failure marker behind
+    with instr.phase("ok_phase"):
+        pass
+    assert "ok_phase.failed" not in instr.metrics

@@ -1,0 +1,147 @@
+"""Shape-bucketed micro-batcher guarantees (serve/batcher.py).
+
+The three serving invariants ISSUE 1 names: padded rows never leak into
+returned means/variances, bucket selection lands on exact power-of-two
+boundaries, and sustained mixed-size traffic compiles each (model,
+bucket) executable exactly once — asserted through the batcher's
+trace-time compile-counting hook.
+"""
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.serve.batcher import (
+    BucketOverflowError,
+    BucketedPredictor,
+    RecompileGuardError,
+    bucket_sizes,
+)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(200, 3))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=200)
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(40)
+        .setActiveSetSize(40)
+        .setSigma2(1e-3)
+        .setMaxIter(8)
+        .setSeed(5)
+        .fit(x, y)
+    )
+    return model, x
+
+
+def test_bucket_ladder_and_boundaries():
+    assert bucket_sizes(256, 8) == (8, 16, 32, 64, 128, 256)
+    # non-powers round up on both ends
+    assert bucket_sizes(100, 3) == (4, 8, 16, 32, 64, 128)
+    assert bucket_sizes(1, 1) == (1,)
+    with pytest.raises(ValueError):
+        bucket_sizes(4, 32)
+    with pytest.raises(ValueError):
+        bucket_sizes(0)
+
+
+def test_bucket_for_exact_boundaries(fitted):
+    model, _ = fitted
+    bp = BucketedPredictor(model.raw_predictor, max_batch=64, min_bucket=8)
+    assert bp.buckets == (8, 16, 32, 64)
+    assert bp.bucket_for(1) == 8
+    assert bp.bucket_for(8) == 8      # exact fit: no promotion
+    assert bp.bucket_for(9) == 16     # one past: next rung
+    assert bp.bucket_for(16) == 16
+    assert bp.bucket_for(17) == 32
+    assert bp.bucket_for(64) == 64
+    assert bp.bucket_for(65) is None  # oversize: caller chunks
+    # occupancy accounting mirrors the dispatch plan
+    assert bp.padded_rows(1) == 8
+    assert bp.padded_rows(64) == 64
+    assert bp.padded_rows(65) == 64 + 8
+    assert bp.padded_rows(130) == 64 + 64 + 8
+    assert bp.padded_rows(0) == 0
+
+
+def test_padding_never_leaks_into_results(fitted):
+    """Across every bucket boundary (and the chunked oversize path) the
+    bucketed answers equal the unbatched predictor's exactly."""
+    model, x = fitted
+    raw = model.raw_predictor
+    bp = BucketedPredictor(raw, max_batch=64, min_bucket=8)
+    bp.warmup()
+    for t in (1, 7, 8, 9, 15, 16, 17, 31, 32, 63, 64, 65, 100, 150):
+        mean, var = bp.predict(x[:t])
+        ref_mean, ref_var = raw(x[:t])
+        assert mean.shape == (t,) and var.shape == (t,)
+        np.testing.assert_allclose(mean, np.asarray(ref_mean), rtol=1e-10)
+        np.testing.assert_allclose(var, np.asarray(ref_var), rtol=1e-10)
+
+
+def test_one_compile_per_bucket_under_mixed_traffic(fitted):
+    """Sustained mixed-size traffic: exactly one XLA trace per bucket,
+    all paid at warmup — zero compiles on the hot path."""
+    model, x = fitted
+    bp = BucketedPredictor(model.raw_predictor, max_batch=64, min_bucket=8)
+    counts = bp.warmup()
+    assert counts == {8: 1, 16: 1, 32: 1, 64: 1}
+    rng = np.random.default_rng(0)
+    for t in rng.integers(1, 65, size=50):
+        bp.predict(x[: int(t)])
+    # float32 payloads must not open a second executable family either
+    bp.predict(x[:10].astype(np.float32))
+    assert bp.compile_counts == {8: 1, 16: 1, 32: 1, 64: 1}
+    assert bp.total_compiles == len(bp.buckets)
+
+
+def test_recompile_guard_fires_on_unwarmed_bucket(fitted):
+    model, x = fitted
+    bp = BucketedPredictor(model.raw_predictor, buckets=(8, 16))
+    bp.warmup()
+    # an in-ladder request is fine...
+    bp.predict(x[:5])
+    # ...but a frozen surface refuses to grow: simulate a config drift by
+    # widening the ladder after warmup
+    bp.buckets = (8, 16, 32)
+    with pytest.raises(RecompileGuardError, match="not warmed"):
+        bp.predict(x[:20])
+
+
+def test_oversize_chunking_and_overflow_error(fitted):
+    model, x = fitted
+    bp = BucketedPredictor(model.raw_predictor, max_batch=32, min_bucket=8)
+    bp.warmup()
+    mean, var = bp.predict(x[:150])  # 32+32+32+32+16+8... chunked
+    ref_mean, _ = model.raw_predictor(x[:150])
+    np.testing.assert_allclose(mean, np.asarray(ref_mean), rtol=1e-10)
+    assert bp.compile_counts == {8: 1, 16: 1, 32: 1}
+    with pytest.raises(BucketOverflowError, match="exceeds the largest"):
+        bp.predict(x[:150], chunk_oversize=False)
+
+
+def test_mean_only_mode(fitted):
+    model, x = fitted
+    bp = BucketedPredictor(
+        model.raw_predictor, max_batch=16, min_bucket=8, mean_only=True
+    )
+    bp.warmup()
+    mean, var = bp.predict(x[:10])
+    assert var is None
+    np.testing.assert_allclose(
+        mean, np.asarray(model.raw_predictor.predict_mean(x[:10])), rtol=1e-10
+    )
+
+
+def test_input_validation(fitted):
+    model, x = fitted
+    bp = BucketedPredictor(model.raw_predictor, max_batch=8)
+    with pytest.raises(ValueError, match=r"\[t, 3\]"):
+        bp.predict(x[:4, :2])
+    with pytest.raises(ValueError, match=r"\[t, 3\]"):
+        bp.predict(np.zeros(3))
+    mean, var = bp.predict(x[:0])  # empty request: empty answer, no dispatch
+    assert mean.shape == (0,) and var.shape == (0,)

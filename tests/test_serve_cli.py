@@ -1,0 +1,131 @@
+"""Tier-1 smoke for the serve CLI: boot -> warmup-ready -> score -> clean
+shutdown, in a subprocess under JAX_PLATFORMS=cpu.
+
+The subprocess is timeout-fenced with a process-group kill on expiry
+(the utils/subproc.py hazard pattern: never let a wedged child hold the
+suite), but unlike run_captured it needs a stdin leg — the protocol IS
+stdin JSON lines.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+
+@pytest.fixture(scope="module")
+def tiny_model(tmp_path_factory):
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(120, 3))
+    y = np.sin(x.sum(axis=1))
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(30)
+        .setActiveSetSize(30)
+        .setSigma2(1e-3)
+        .setMaxIter(5)
+        .setSeed(1)
+        .fit(x, y)
+    )
+    path = str(tmp_path_factory.mktemp("cli") / "tiny.npz")
+    model.save(path)
+    return path, model, x
+
+
+def _run_cli(args, input_text, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the CLI manages a plain single-device CPU process; drop the
+    # harness's forced 8-device flag and any compile-cache override
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spark_gp_tpu.serve", *args],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(input_text, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        out, err = proc.communicate()
+        pytest.fail(f"serve CLI wedged past {timeout}s; stderr: {err[-500:]}")
+    return proc.returncode, out, err
+
+
+def test_cli_boot_score_shutdown(tiny_model):
+    path, model, x = tiny_model
+    request_rows = x[:3].tolist()
+    lines = "\n".join(
+        [
+            json.dumps({"id": 1, "model": "tiny", "x": request_rows}),
+            json.dumps({"cmd": "metrics"}),
+            json.dumps({"cmd": "shutdown"}),
+        ]
+    ) + "\n"
+    rc, out, err = _run_cli(
+        ["--model", f"tiny={path}", "--max-batch", "16", "--min-bucket", "4"],
+        lines,
+    )
+    assert rc == 0, err[-500:]
+    events = [json.loads(ln) for ln in out.strip().splitlines()]
+
+    # ready is FIRST — warmup completes before any request is answered
+    assert events[0]["event"] == "ready"
+    assert events[0]["platform"] == "cpu"
+    [desc] = events[0]["models"]
+    assert desc["name"] == "tiny" and desc["version"] == 1
+    # the AOT stage compiled the whole ladder at load
+    assert sorted(int(b) for b in desc["compiles"]) == [4, 8, 16]
+    assert all(c == 1 for c in desc["compiles"].values())
+
+    by_id = {e["id"]: e for e in events if "id" in e}
+    answer = by_id[1]
+    assert "error" not in answer, answer
+    # the CLI subprocess runs f32 (no x64 harness): parity is approximate
+    np.testing.assert_allclose(
+        answer["mean"], model.predict(x[:3]), rtol=1e-4, atol=1e-5
+    )
+    assert len(answer["var"]) == 3
+
+    metrics = next(e for e in events if e.get("event") == "metrics")
+    assert metrics["counters"]["requests"] >= 1
+    assert "request_latency_s" in metrics["histograms"]
+
+    assert events[-1]["event"] == "shutdown"
+    assert events[-1]["requests"] >= 1
+
+
+def test_cli_rejects_bad_request_and_survives(tiny_model):
+    path, _, x = tiny_model
+    lines = "\n".join(
+        [
+            "this is not json",
+            json.dumps({"id": 7, "model": "ghost", "x": x[:2].tolist()}),
+            json.dumps({"id": 8, "model": "tiny", "x": x[:2].tolist()}),
+            json.dumps({"cmd": "shutdown"}),
+        ]
+    ) + "\n"
+    rc, out, err = _run_cli(["--model", f"tiny={path}"], lines)
+    assert rc == 0, err[-500:]
+    events = [json.loads(ln) for ln in out.strip().splitlines()]
+    assert any("bad request line" in e.get("error", "") for e in events)
+    by_id = {e["id"]: e for e in events if "id" in e}
+    assert "KeyError" in by_id[7]["error"]  # unknown model: error response
+    assert "mean" in by_id[8]            # ...and the next request still works
+
+
+def test_cli_requires_a_model():
+    rc, out, err = _run_cli([], "")
+    assert rc == 2
+    assert "--model" in err
