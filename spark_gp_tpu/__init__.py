@@ -84,6 +84,14 @@ from spark_gp_tpu.models.active_set import (
     RandomActiveSetProvider,
 )
 from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+from spark_gp_tpu.resilience.quarantine import (
+    ExpertQuarantineError,
+    NonFiniteFitError,
+)
+from spark_gp_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointMismatchError,
+)
 
 __version__ = "0.4.0"
 
@@ -124,4 +132,8 @@ __all__ = [
     "KMeansActiveSetProvider",
     "GreedilyOptimizingActiveSetProvider",
     "NotPositiveDefiniteException",
+    "ExpertQuarantineError",
+    "NonFiniteFitError",
+    "CheckpointCorruptError",
+    "CheckpointMismatchError",
 ]

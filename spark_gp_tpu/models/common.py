@@ -48,6 +48,8 @@ class GaussianProcessParams:
         self._predictive_variance: bool = True
         self._num_restarts: int = 1
         self._restart_scale: float = 0.5
+        self._expert_quarantine: bool = True
+        self._fit_retries: int = 2
 
     # --- reference setter names (GaussianProcessParams.scala:32-53) -------
     def setKernel(self, value: Union[Kernel, Callable[[], Kernel]]):
@@ -166,6 +168,30 @@ class GaussianProcessParams:
         self._checkpoint_interval = int(iters)
         return self
 
+    def setExpertQuarantine(self, value: bool):
+        """``True`` (default): experts whose NLL or gradient is non-finite
+        — NaN data rows, Gram matrices past the edge of positive
+        definiteness — are repaired (adaptive jitter escalation over the
+        ``ops.linalg.JITTER_SCHEDULE`` ladder) or, failing that, dropped
+        from the BCM sum with renormalization (``resilience/quarantine.py``)
+        instead of poisoning the global objective.  A failure affecting
+        EVERY expert still raises (that is a configuration problem — the
+        classic remedy is increasing sigma2 — not a per-expert fault).
+        ``False``: the pre-quarantine behavior — any non-finite expert
+        fails the fit."""
+        self._expert_quarantine = bool(value)
+        return self
+
+    def setFitRetries(self, value: int):
+        """Recovery budget: how many times a failed fit attempt is retried
+        (with backoff) after quarantine/jitter repair — and how many times
+        a transiently-failing device fit is re-dispatched.  Default 2;
+        0 disables retries (first failure is final)."""
+        if int(value) < 0:
+            raise ValueError("fit retries must be >= 0")
+        self._fit_retries = int(value)
+        return self
+
     def setOptimizer(self, value: str):
         """``"host"`` — SciPy L-BFGS-B driving the jitted objective (one
         device dispatch per evaluation; bitwise closest to the reference's
@@ -234,6 +260,8 @@ class GaussianProcessParams:
     set_optimizer = setOptimizer
     set_hyper_space = setHyperSpace
     set_num_restarts = setNumRestarts
+    set_expert_quarantine = setExpertQuarantine
+    set_fit_retries = setFitRetries
 
     def get_params(self) -> dict:
         return {
@@ -301,7 +329,9 @@ class GaussianProcessCommons(GaussianProcessParams):
 
         kernel = self._get_kernel()
         if self._num_restarts <= 1:
-            return fit_once(kernel, outer_instr)
+            model = fit_once(kernel, outer_instr)
+            self._log_renormalized_nll(model.instr)
+            return model
         if self._checkpoint_dir is not None:
             raise ValueError(
                 "setNumRestarts(>1) is not combinable with "
@@ -328,13 +358,26 @@ class GaussianProcessCommons(GaussianProcessParams):
                 instr_r.metrics.update(base_metrics)
                 instr_r.timings.update(base_timings)
             kernel_r = ThetaOverrideKernel(kernel, theta_batch[r])
-            model = fit_once(kernel_r, instr_r)
+            from spark_gp_tpu.resilience.quarantine import NonFiniteFitError
+
+            try:
+                model = fit_once(kernel_r, instr_r)
+            except NonFiniteFitError:
+                # one diverged restart (NaN objective from a wild starting
+                # point) is the multi-start driver's NORMAL business — it
+                # scores inf and the best finite restart wins, exactly the
+                # pre-detection behavior.  Only every-restart failure
+                # escalates (below) to the fit-level recovery driver.
+                nlls.append(np.inf)
+                continue
             nll = float(model.instr.metrics.get("final_nll", np.inf))
             nlls.append(nll if np.isfinite(nll) else np.inf)
             if nlls[-1] < best_nll:
                 best_model, best_nll, best_r = model, nlls[-1], r
         if best_model is None:
-            raise RuntimeError(
+            from spark_gp_tpu.resilience.quarantine import NonFiniteFitError
+
+            raise NonFiniteFitError(
                 "every restart produced a non-finite final NLL — the model "
                 "configuration is numerically unusable at these settings"
             )
@@ -342,6 +385,7 @@ class GaussianProcessCommons(GaussianProcessParams):
             best_model.instr.log_metric(f"restart_{r}_nll", nll)
         best_model.instr.log_metric("num_restarts", self._num_restarts)
         best_model.instr.log_metric("best_restart", best_r)
+        self._log_renormalized_nll(best_model.instr)
         return best_model
 
     def _group(self, x: np.ndarray, y: np.ndarray) -> ExpertData:
@@ -349,6 +393,227 @@ class GaussianProcessCommons(GaussianProcessParams):
         if self._mesh is not None:
             data = shard_experts(data, self._mesh)
         return data
+
+    def _group_screened(self, instr: Instrumentation, x, y) -> ExpertData:
+        """Group + the pre-fit data screen: experts carrying non-finite
+        rows (the NaN-from-a-bad-shard fault class) are quarantined HERE,
+        before the optimizer ever sees an ``inf`` objective.  Every
+        estimator family's ``fit`` routes through this.
+
+        The screen runs in pure numpy on the raw rows — round-robin
+        grouping assigns row ``i`` to expert ``i % E``
+        (``parallel/experts.py``), so the bad-expert set follows from the
+        bad-row set with zero device work and zero per-shape compiles on
+        the clean path.  (The distributed entry point, where no host holds
+        the rows, uses the jitted ``nonfinite_expert_mask`` instead.)"""
+        bad_experts = None
+        if self._expert_quarantine:
+            x_np = np.asarray(x)
+            finite = self._finite_row_mask(x_np, y)
+            if finite is not None:
+                from spark_gp_tpu.parallel.experts import num_experts_for
+
+                e = num_experts_for(
+                    x_np.shape[0], self._dataset_size_for_expert
+                )
+                bad_experts = np.zeros(e, dtype=bool)
+                bad_experts[np.flatnonzero(~finite) % e] = True
+        data = self._group(x, y)
+        if bad_experts is not None:
+            if bad_experts.shape[0] < data.x.shape[0]:
+                # a mesh shard pads the expert axis; padded experts are
+                # inert and never bad — extend the mask to the padded
+                # length or the quarantine broadcast fails
+                bad_experts = np.pad(
+                    bad_experts, (0, data.x.shape[0] - bad_experts.shape[0])
+                )
+            data = self._apply_quarantine(
+                instr, data, bad_experts, "data screen"
+            )
+        return data
+
+    @staticmethod
+    def _finite_row_mask(x, y=None):
+        """bool [N] mask of rows whose features (and labels, when given)
+        are all finite — or ``None`` when every row passes (the common
+        case; callers skip all filtering work).  The ONE implementation
+        behind the pre-fit expert screen and the provider-row filters, so
+        the three consumers cannot drift."""
+        finite = np.all(np.isfinite(x), axis=1)
+        if y is not None:
+            y2d = np.asarray(y).reshape(x.shape[0], -1)
+            finite &= np.all(np.isfinite(y2d), axis=1)
+        return None if finite.all() else finite
+
+    def _screen_rows(self, x: np.ndarray, y: np.ndarray):
+        """Row-level companion of the expert screen: the active-set
+        providers sample from the RAW host rows (not the quarantined
+        stack), so poisoned rows must never be offered to them — an active
+        set with one NaN row re-poisons the PPA statistics the quarantine
+        just cleaned.  Returns filtered ``(x, y)`` views (the originals
+        when everything is finite)."""
+        if not self._expert_quarantine:
+            return x, y
+        finite = self._finite_row_mask(x, y)
+        if finite is None:
+            return x, y
+        return x[finite], np.asarray(y)[finite]
+
+    def _log_renormalized_nll(self, instr) -> None:
+        """When experts were quarantined, publish the full-stack-comparable
+        objective: ``final_nll_renormalized = final_nll * bcm_renorm``
+        (``E_active / E_kept`` — resilience/quarantine.py).  ``final_nll``
+        itself stays the optimizer's literal reduced-sum objective; tooling
+        comparing fits across configurations should read the renormalized
+        metric when it is present.  Idempotent (pure recomputation)."""
+        if instr is None:
+            return
+        renorm = instr.metrics.get("bcm_renorm")
+        if renorm is not None and "final_nll" in instr.metrics:
+            instr.log_metric(
+                "final_nll_renormalized", instr.metrics["final_nll"] * renorm
+            )
+
+    def _provider_rows_filter(self, x):
+        """``(x_filtered, n_orig, row_filter)`` for the latent-target
+        estimator families: their providers sample raw host rows while
+        their targets are ungrouped per-point latents of the ORIGINAL
+        length — so both sides must be filtered by the same finite-row
+        mask, or a poisoned row re-enters through the active set while
+        the targets misalign.  ``row_filter`` applies that mask to an
+        ungrouped [n_orig] target vector."""
+        n_orig = x.shape[0]
+        if not self._expert_quarantine:
+            return x, n_orig, (lambda t: t)
+        finite = self._finite_row_mask(x)
+        if finite is None:
+            return x, n_orig, (lambda t: t)
+        return x[finite], n_orig, (lambda t: np.asarray(t)[finite])
+
+    def _apply_quarantine(self, instr, data, bad, source: str) -> ExpertData:
+        """Drop ``bad`` experts from the stack; account for renormalization.
+
+        ``experts_active_initial`` is pinned at the first drop so repeated
+        recovery rounds accumulate against the original denominator;
+        ``bcm_renorm`` is the factor that maps the reduced BCM sum back to
+        a full-stack-comparable NLL (``resilience/quarantine.py``)."""
+        from spark_gp_tpu.resilience.quarantine import (
+            GLOBAL_FAILURE_ADVICE,
+            ExpertQuarantineError,
+            quarantine_experts,
+            renorm_factor,
+        )
+
+        bad = np.asarray(bad, dtype=bool)
+        n_bad = int(bad.sum())
+        if n_bad == 0:
+            return data
+        active = int((np.asarray(data.mask).sum(axis=1) > 0).sum())
+        if n_bad >= active:
+            # count against ACTIVE experts, not the stack length: a
+            # mesh-padded stack carries inert all-masked experts that are
+            # never flagged bad, and masking every real expert would let
+            # the fit "converge" on zero data
+            raise ExpertQuarantineError(
+                f"{source}: all {active} active expert(s) are non-finite — "
+                + GLOBAL_FAILURE_ADVICE
+            )
+        data = quarantine_experts(data, bad)  # raises when all experts bad
+        base = instr.metrics.get("experts_active_initial")
+        if base is None:
+            base = float(active)
+            instr.log_metric("experts_active_initial", base)
+        dropped = instr.metrics.get("experts_quarantined", 0.0) + n_bad
+        instr.log_metric("experts_quarantined", dropped)
+        renorm = renorm_factor(base, dropped)
+        instr.log_metric("bcm_renorm", renorm)
+        instr.log_warning(
+            f"{source}: quarantined {n_bad} non-finite expert(s) "
+            f"({int(dropped)}/{int(base)} total dropped); BCM objective "
+            f"renormalized by {renorm:.4f}"
+        )
+        return data
+
+    def _run_with_expert_resilience(self, instr, data, run_fit):
+        """Bounded recovery driver around one COMPLETE fit attempt.
+
+        ``run_fit(data, resilience_extra) -> model`` is the whole
+        optimize→PPA pipeline; on a non-finite failure
+        (``NotPositiveDefiniteException`` from any factorization,
+        ``NonFiniteFitError`` from a device fit) the per-expert health
+        probe runs at theta0, unhealthy experts walk the adaptive jitter
+        ladder, irreparable ones are quarantined, and the fit is retried
+        with backoff (``resilience/retry.py``) — recovery lives out here
+        on the host, never inside the compiled programs.  A failure the
+        diagnosis cannot attribute to specific experts (every expert
+        healthy in isolation) is re-raised untouched.
+        """
+        if not self._expert_quarantine or self._fit_retries < 1:
+            return run_fit(data, ())
+        from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+        from spark_gp_tpu.resilience.quarantine import (
+            NonFiniteFitError,
+            diagnose_experts,
+        )
+        from spark_gp_tpu.resilience.retry import (
+            RetryBudgetExceededError,
+            retry_with_backoff,
+        )
+
+        state = {"data": data, "extra": ()}
+        objective = getattr(self, "_objective", "marginal")
+
+        def attempt():
+            return run_fit(state["data"], state["extra"])
+
+        # the health probe needs a per-expert-DECOMPOSABLE objective; the
+        # ELBO is a nonlinear function of global sums, so its faults are
+        # diagnosed through the marginal per-expert NLL as a proxy (data
+        # and conditioning faults are objective-independent)
+        probe_objective = objective if objective in ("marginal", "loo") else "marginal"
+
+        def recover(attempt_idx, exc):
+            kernel = self._get_kernel()
+            report = diagnose_experts(
+                kernel, kernel.init_theta(), state["data"],
+                objective=probe_objective,
+                # the sharded objectives cannot carry the jitter operand
+                # (shard_map signature), and only the marginal objective
+                # threads it — other paths go straight from the probe to
+                # quarantine
+                allow_jitter=(objective == "marginal" and self._mesh is None),
+            )
+            if report.clean:
+                raise exc  # not a per-expert fault; surface the original
+            if report.num_jittered:
+                import jax.numpy as jnp
+
+                instr.log_metric("experts_jittered", report.num_jittered)
+                instr.log_warning(
+                    f"fit recovery: {report.num_jittered} expert(s) "
+                    "repaired by adaptive jitter escalation "
+                    f"(max relative jitter {report.jitter.max():.1e})"
+                )
+                state["extra"] = (
+                    jnp.asarray(report.jitter, dtype=state["data"].x.dtype),
+                )
+            if report.num_dropped:
+                state["data"] = self._apply_quarantine(
+                    instr, state["data"], report.bad, "fit recovery"
+                )
+            instr.log_metric("fit_retries", float(attempt_idx + 1))
+
+        try:
+            return retry_with_backoff(
+                attempt,
+                attempts=self._fit_retries + 1,
+                base_delay_s=0.05,
+                retry_on=(NotPositiveDefiniteException, NonFiniteFitError),
+                on_retry=recover,
+                describe=f"{type(self).__name__} fit",
+            )
+        except RetryBudgetExceededError as err:
+            raise err.__cause__ from err  # the underlying failure is the story
 
     def _checkpoint_tag(self) -> str:
         """Checkpoint file tag: class name, plus the objective when it is
@@ -373,7 +638,8 @@ class GaussianProcessCommons(GaussianProcessParams):
         from spark_gp_tpu.utils.checkpoint import LbfgsCheckpointer
 
         return LbfgsCheckpointer(
-            self._checkpoint_dir, kernel, tag=self._checkpoint_tag()
+            self._checkpoint_dir, kernel, tag=self._checkpoint_tag(),
+            seed=self._seed,
         )
 
     def _optimize_hypers(
@@ -387,35 +653,75 @@ class GaussianProcessCommons(GaussianProcessParams):
         (GaussianProcessCommons.scala:66-92)."""
         instr.log_info("Optimising the kernel hyperparameters")
         theta0 = kernel.init_theta()
+        done_iters = 0
         if self._checkpoint_dir is not None:
-            # resume the host optimizer from the last persisted iterate
+            # resume the host optimizer from the last persisted iterate,
+            # with the REMAINING iteration budget (a preempted 100-iter fit
+            # killed at 60 runs 40 more, not another 100)
             from spark_gp_tpu.utils.checkpoint import (
+                CheckpointMismatchError,
                 kernel_signature,
                 load_checkpoint,
             )
 
             ck = load_checkpoint(self._checkpoint_dir, tag=self._checkpoint_tag())
-            if (
-                ck is not None
-                and np.asarray(ck[1]).shape == theta0.shape
-                and ck[2] == kernel_signature(kernel, theta0.shape[0])
-            ):
+            if ck is not None:
+                expected = kernel_signature(kernel, theta0.shape[0])
+                if np.asarray(ck[1]).shape != theta0.shape or (
+                    ck[2] is not None and ck[2] != expected
+                ):
+                    raise CheckpointMismatchError(
+                        f"checkpoint in {self._checkpoint_dir!r} (tag "
+                        f"{self._checkpoint_tag()!r}) was written under a "
+                        f"different kernel configuration "
+                        f"({ck[2]!r} != {expected!r}) — clear the directory "
+                        "or use a distinct one per configuration"
+                    )
                 instr.log_info(
                     f"Resuming from checkpoint (iteration {ck[0]})"
                 )
                 theta0 = np.asarray(ck[1])
+                done_iters = int(ck[0])
+                instr.log_metric("resumed_from_iteration", done_iters)
+                if callback is not None and hasattr(callback, "iteration"):
+                    # the checkpointer keeps counting from where the
+                    # preempted run stopped, so the persisted iteration
+                    # number stays the fit-global budget marker
+                    callback.iteration = done_iters
         lower, upper = kernel.bounds()
         with instr.phase("optimize_hypers"):
-            res = minimize_lbfgsb(
-                value_and_grad,
-                theta0,
-                lower,
-                upper,
-                max_iter=self._max_iter,
-                tol=self._tol,
-                callback=callback,
-                log_space=self._use_log_space(kernel),
-            )
+            if done_iters >= self._max_iter:
+                # the checkpoint already sits AT the iteration budget (a
+                # preemption right after the final save): running even one
+                # more iteration would walk theta past the uninterrupted
+                # fit's result, and every crash/resume cycle would drift it
+                # further.  Evaluate once for the final NLL and return the
+                # persisted iterate untouched.
+                from spark_gp_tpu.optimize.lbfgsb import OptimizeResult
+
+                value, _ = value_and_grad(theta0)
+                res = OptimizeResult(
+                    theta=np.asarray(theta0, dtype=np.float64),
+                    fun=float(np.asarray(value)),
+                    nit=0,
+                    nfev=1,
+                    success=True,
+                    message=(
+                        "checkpoint already at the iteration budget; "
+                        "returning the persisted iterate"
+                    ),
+                )
+            else:
+                res = minimize_lbfgsb(
+                    value_and_grad,
+                    theta0,
+                    lower,
+                    upper,
+                    max_iter=self._max_iter - done_iters,
+                    tol=self._tol,
+                    callback=callback,
+                    log_space=self._use_log_space(kernel),
+                )
         instr.log_metric("lbfgs_iters", res.nit)
         instr.log_metric("lbfgs_nfev", res.nfev)
         instr.log_metric("final_nll", res.fun)
@@ -462,13 +768,16 @@ class GaussianProcessCommons(GaussianProcessParams):
         (``best_restart`` is a scalar pending entry logged by the fetch)."""
         nlls = np.asarray(fetched["restart_nlls"], dtype=np.float64)
         if not np.any(np.isfinite(nlls)):
-            raise RuntimeError(
+            from spark_gp_tpu.resilience.quarantine import NonFiniteFitError
+
+            raise NonFiniteFitError(
                 "every restart produced a non-finite final NLL — the model "
                 "configuration is numerically unusable at these settings"
             )
         for r, nll in enumerate(nlls):
             instr.log_metric(f"restart_{r}_nll", float(nll))
         instr.log_metric("num_restarts", self._num_restarts)
+        self._log_renormalized_nll(instr)
 
     def _use_batched_multistart(self) -> bool:
         """The batched one-dispatch multi-start applies on the plain
@@ -483,20 +792,47 @@ class GaussianProcessCommons(GaussianProcessParams):
 
     def _run_fit_distributed(self, name: str, data, active_set, prepare):
         """Shared shell of every estimator's ``fit_distributed``: resolve
-        the mesh from the stack, log the stack shape, normalize an explicit
-        active set to f64, then run ``prepare(instr, active64) ->
-        fit_once(kernel, instr_r)`` through the multi-start driver.
-        Estimator-specific validation/target preparation lives in
-        ``prepare`` (label-domain checks, one-hot construction, ...)."""
+        the mesh from the stack, log the stack shape, run the pre-fit data
+        screen, normalize an explicit active set to f64, then run
+        ``prepare(instr, active64, data) -> fit_once(kernel, instr_r)``
+        through the multi-start driver.  ``prepare`` MUST use the ``data``
+        it is handed (the screened stack — quarantined experts masked
+        out), never the caller's own closure capture, or the quarantine
+        is silently discarded.  Estimator-specific validation/target
+        preparation lives in ``prepare`` (label-domain checks, one-hot
+        construction, ...)."""
+        import jax
+
         instr = Instrumentation(name=name)
         with self._stack_mesh(data):
             instr.log_metric("num_experts", int(data.x.shape[0]))
             instr.log_metric("expert_size", int(data.x.shape[1]))
+            if self._expert_quarantine and jax.process_count() == 1:
+                # same pre-fit data screen as the in-process fit paths: a
+                # bad shard's NaN rows must not poison the mesh-wide psum
+                from spark_gp_tpu.resilience.quarantine import (
+                    nonfinite_expert_mask,
+                )
+
+                bad = nonfinite_expert_mask(data)
+                if bad.any():
+                    data = self._apply_quarantine(
+                        instr, data, bad, "data screen"
+                    )
+            elif self._expert_quarantine:
+                # the screen (and with_experts_masked) host-fetch the
+                # stack, which a cross-process sharding cannot satisfy —
+                # skip rather than crash every clean multihost fit
+                instr.log_warning(
+                    "expert quarantine screen skipped: the stack spans "
+                    f"{jax.process_count()} processes and cannot be "
+                    "host-fetched for diagnosis"
+                )
             active64 = (
                 None if active_set is None
                 else np.asarray(active_set, dtype=np.float64)
             )
-            fit_once = prepare(instr, active64)
+            fit_once = prepare(instr, active64, data)
             return self._fit_with_restarts(instr, fit_once)
 
     def _optimize_latent_host(self, instr, kernel, objective, f0):

@@ -66,7 +66,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
             raise ValueError("targets must be non-negative integer counts")
 
         with instr.phase("group_experts"):
-            data = self._group(x, y_f)
+            data = self._group_screened(instr, x, y_f)
         instr.log_metric("num_experts", data.num_experts)
 
         if self._use_batched_multistart():
@@ -128,14 +128,18 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
             latent_y = f_final * data.mask
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
 
+            x_prov, n_orig, row_filter = self._provider_rows_filter(x)
+
             def targets_fn():
                 e_real = num_experts_for(
-                    x.shape[0], self._dataset_size_for_expert
+                    n_orig, self._dataset_size_for_expert
                 )
-                return ungroup(np.asarray(latent_y)[:e_real], x.shape[0])
+                return row_filter(
+                    ungroup(np.asarray(latent_y)[:e_real], n_orig)
+                )
 
             raw = self._projected_process(
-                instr, kernel, theta_host, x, targets_fn, latent_data
+                instr, kernel, theta_host, x_prov, targets_fn, latent_data
             )
         instr.log_success()
         model = GaussianProcessPoissonModel(raw)
@@ -152,7 +156,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         generic-Laplace objective keeps the latent stacks device-resident,
         and the provider selects over the latent log-rates from the stack.
         """
-        def prepare(instr, active64):
+        def prepare(instr, active64, data):
             if not bool(_counts_valid(data.y, data.mask)):
                 raise ValueError(
                     "targets must be non-negative integer counts"
@@ -194,12 +198,15 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                 # distributed: provider selects from the sharded stack
                 targets_fn = None
             else:
+                x, n_orig, row_filter = self._provider_rows_filter(x)
 
                 def targets_fn():
                     e_real = num_experts_for(
-                        x.shape[0], self._dataset_size_for_expert
+                        n_orig, self._dataset_size_for_expert
                     )
-                    return ungroup(np.asarray(latent_y)[:e_real], x.shape[0])
+                    return row_filter(
+                        ungroup(np.asarray(latent_y)[:e_real], n_orig)
+                    )
 
             # targets stay a callable: materializing the latent stack is a
             # device sync the random/kmeans providers never need

@@ -63,7 +63,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             raise ValueError("Only 0 and 1 labels are supported.")
 
         with instr.phase("group_experts"):
-            data = self._group(x, y)
+            data = self._group_screened(instr, x, y)
         instr.log_metric("num_experts", data.num_experts)
 
         # PPA runs over the latent modes as targets (GPClf.scala:62-65), and
@@ -73,10 +73,17 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         # provider actually reads the targets.
         from spark_gp_tpu.parallel.experts import num_experts_for, ungroup
 
+        # providers sample raw host rows; hand them only finite ones, and
+        # filter the ungrouped latent targets by the SAME mask so rows and
+        # targets stay aligned (common._provider_rows_filter)
+        x, n_orig, row_filter = self._provider_rows_filter(x)
+
         def make_targets_fn(latent_y):
             def targets_fn():
-                e_real = num_experts_for(x.shape[0], self._dataset_size_for_expert)
-                return ungroup(np.asarray(latent_y)[:e_real], x.shape[0])
+                e_real = num_experts_for(n_orig, self._dataset_size_for_expert)
+                return row_filter(
+                    ungroup(np.asarray(latent_y)[:e_real], n_orig)
+                )
 
             return targets_fn
 
@@ -185,7 +192,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         sharded stack (``ActiveSetProvider.from_stack``) — GPClf.scala:62-65
         substitutes f for y before produceModel, so providers must see f.
         """
-        def prepare(instr, active64):
+        def prepare(instr, active64, data):
             # Label-domain check on the sharded stack (GPClf.scala:68-72):
             # one reduction on device, no host gather of the labels.
             if not bool(_labels_are_01(data.y, data.mask)):

@@ -103,7 +103,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
             raise ValueError("need at least 2 classes")
 
         with instr.phase("group_experts"):
-            data = self._group(x, y_int.astype(np.float64))
+            data = self._group_screened(instr, x, y_int.astype(np.float64))
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("num_classes", n_classes)
 
@@ -184,7 +184,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         not contain every class); by default it is computed with one
         device reduction over the global labels.
         """
-        def prepare(instr, active64):
+        def prepare(instr, active64, data):
             n_cls = n_classes
             if n_cls is None:
                 n_cls = int(np.asarray(_max_label(data.y, data.mask))) + 1
@@ -334,16 +334,19 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                     self._mesh,
                 )
             elif getattr(provider, "uses_fit_outputs", True):
-                e_real = num_experts_for(x.shape[0], self._dataset_size_for_expert)
+                x_prov, n_orig, row_filter = self._provider_rows_filter(x)
+                e_real = num_experts_for(n_orig, self._dataset_size_for_expert)
                 margin = np.asarray(jnp.max(latents, axis=-1))[:e_real]
-                targets = ungroup(margin, x.shape[0])
+                targets = row_filter(ungroup(margin, n_orig))
                 active = provider(
-                    self._active_set_size, x, targets, kernel,
+                    self._active_set_size, x_prov, targets, kernel,
                     np.asarray(theta_opt, dtype=np.float64), self._seed,
                 )
             else:
+                x_prov, _, _ = self._provider_rows_filter(x)
                 active = provider(
-                    self._active_set_size, x, None, kernel, None, self._seed
+                    self._active_set_size, x_prov, None, kernel, None,
+                    self._seed,
                 )
         active64 = np.asarray(active, dtype=np.float64)
 

@@ -138,36 +138,57 @@ class GaussianProcessRegression(GaussianProcessCommons):
             raise ValueError(f"y must be [N], got shape {y.shape}")
 
         with instr.phase("group_experts"):
-            data = self._group(x, y)
+            data = self._group_screened(instr, x, y)
         instr.log_metric("num_experts", data.num_experts)
         instr.log_metric("expert_size", data.expert_size)
+        # providers sample raw host rows — hand them only finite ones
+        x, y = self._screen_rows(x, y)
 
-        if self._use_batched_multistart():
-            # ALL restarts as one vmapped device program; the PPA model is
-            # built once, for the winner (vs the sequential driver's
-            # full-fit-per-restart)
-            return self._fit_device_multistart(instr, data, x, y)
-
-        # ELBO: ONE inducing set, selected up front at the base kernel's
-        # init theta and shared by every sequential restart — matching the
-        # batched path's semantics (each restart's ThetaOverrideKernel has
-        # a different init theta, so per-restart selection would both
-        # repeat the work and, for theta-dependent providers, optimize
-        # each restart over a different surface)
-        active_shared = None
-        if self._objective == "elbo":
-            base_kernel = self._get_kernel()
-            with instr.phase("active_set"):
-                active_shared = self._select_active(
-                    base_kernel, base_kernel.init_theta(), x, lambda: y, data
+        def run_fit(data_r, rextra):
+            x_r, y_r = x, y
+            if data_r is not data:
+                # fit recovery rebuilt the stack: provider rows must come
+                # from the QUARANTINED stack, not the raw inputs — a
+                # poisoned row can be finite (catastrophic scaling) and a
+                # single one in the active set re-poisons the PPA
+                # statistics the quarantine just cleaned
+                keep = np.asarray(data_r.mask) > 0
+                x_r = np.asarray(data_r.x)[keep]
+                y_r = np.asarray(data_r.y)[keep]
+            if self._use_batched_multistart():
+                # ALL restarts as one vmapped device program; the PPA
+                # model is built once, for the winner (vs the sequential
+                # driver's full-fit-per-restart)
+                return self._fit_device_multistart(
+                    instr, data_r, x_r, y_r, rextra
                 )
 
-        def fit_once(kernel, instr_r):
-            return self._fit_from_stack(
-                instr_r, kernel, data, x, lambda: y, active_shared
-            )
+            # ELBO: ONE inducing set, selected at the base kernel's init
+            # theta and shared by every sequential restart — matching the
+            # batched path's semantics (each restart's ThetaOverrideKernel
+            # has a different init theta, so per-restart selection would
+            # both repeat the work and, for theta-dependent providers,
+            # optimize each restart over a different surface).  Selected
+            # INSIDE the attempt: a recovery retry must re-select from the
+            # repaired rows, not reuse a poisoned inducing set.
+            active_shared = None
+            if self._objective == "elbo":
+                base_kernel = self._get_kernel()
+                with instr.phase("active_set"):
+                    active_shared = self._select_active(
+                        base_kernel, base_kernel.init_theta(), x_r,
+                        lambda: y_r, data_r,
+                    )
 
-        return self._fit_with_restarts(instr, fit_once)
+            def fit_once(kernel, instr_r):
+                return self._fit_from_stack(
+                    instr_r, kernel, data_r, x_r, lambda: y_r, active_shared,
+                    resilience_extra=rextra,
+                )
+
+            return self._fit_with_restarts(instr, fit_once)
+
+        return self._run_with_expert_resilience(instr, data, run_fit)
 
     def loo(
         self,
@@ -231,7 +252,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
         )
 
     def _fit_device_multistart(
-        self, instr, data, x, y
+        self, instr, data, x, y, resilience_extra=()
     ) -> "GaussianProcessRegressionModel":
         """Batched on-device multi-start (single chip): R starting points
         run in one vmapped L-BFGS dispatch
@@ -250,7 +271,9 @@ class GaussianProcessRegression(GaussianProcessCommons):
             )
             lower, upper = kernel.bounds()
             log_space = self._use_log_space(kernel)
-            extra = ()
+            # the marginal objective's trailing operands are the resilience
+            # layer's jitter escalation (empty on clean fits)
+            extra = resilience_extra if self._objective == "marginal" else ()
             active_override = None
             if self._objective == "elbo":
                 # one inducing set, shared by every restart lane and the
@@ -298,14 +321,15 @@ class GaussianProcessRegression(GaussianProcessCommons):
         return model
 
     def _fit_from_stack(
-        self, instr, kernel, data, x, targets_fn, active_override
+        self, instr, kernel, data, x, targets_fn, active_override,
+        resilience_extra=(),
     ) -> "GaussianProcessRegressionModel":
         """Shared optimize → active set → PPA tail of ``fit`` and
         ``fit_distributed``."""
         from spark_gp_tpu.utils.instrumentation import maybe_profile
 
         with maybe_profile(self._profile_dir):
-            extra = ()
+            extra = resilience_extra if self._objective == "marginal" else ()
             if self._objective == "elbo":
                 # selected once up front, reused for the PPA build below
                 active_override, extra = self._elbo_setup(
@@ -318,10 +342,23 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 theta_dev, pending = self._fit_device(
                     instr, kernel, data, extra
                 )
-                raw, _ = self._finalize_device_fit(
+                raw, fetched = self._finalize_device_fit(
                     instr, kernel, theta_dev, pending, x, targets_fn, data,
                     active_override=active_override,
                 )
+                if self._expert_quarantine and not np.isfinite(
+                    float(np.asarray(fetched.get("final_nll", 0.0)))
+                ):
+                    # the one-dispatch device loop cannot raise mid-flight;
+                    # surface the poisoned objective HERE so the resilience
+                    # driver can diagnose/quarantine and re-dispatch
+                    from spark_gp_tpu.resilience.quarantine import (
+                        NonFiniteFitError,
+                    )
+
+                    raise NonFiniteFitError(
+                        "device fit converged to a non-finite objective"
+                    )
             else:
                 if self._mesh is not None and self._objective != "elbo":
                     vag = make_sharded_value_and_grad(
@@ -365,7 +402,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
         Single-process it is equivalent to ``fit`` with a pre-grouped stack.
         """
-        def prepare(instr, active64):
+        def prepare(instr, active64, data):
             if active64 is None and self._objective == "elbo":
                 # same shared-inducing-set semantics as fit(): select once
                 # from the sharded stack at the base kernel's init theta,

@@ -40,7 +40,7 @@ from spark_gp_tpu.parallel.experts import ExpertData
 from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 
 
-def batched_nll(kernel: Kernel, theta, data: ExpertData):
+def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None):
     """Sum of per-expert NLLs over the local ``[E, s, ...]`` stack.
 
     On TPU the factor/solve/invert chain for the whole Gram stack runs as
@@ -54,12 +54,25 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData):
     Cholesky, one vector solve, logdet from the diagonal — is cheaper than
     materializing inverses, so the two paths split here rather than inside
     ``spd_inv_logdet``.
+
+    ``jitter`` (scalar or per-expert [E], trace-relative) is the adaptive
+    escalation operand (``resilience/quarantine.py``): a *traced* value,
+    so recovery retries reuse the compiled program, and the default
+    ``None`` path — the clean hot loop — carries zero extra work.
     """
     from spark_gp_tpu.ops.pallas_linalg import _use_pallas, spd_inv_logdet
 
     kmat = jax.vmap(
         lambda x, m: masked_kernel_matrix(kernel.gram(theta, x), m)
     )(data.x, data.mask)
+    if jitter is not None:
+        s = kmat.shape[-1]
+        trace = jnp.trace(kmat, axis1=-2, axis2=-1)
+        scale = jnp.where(jnp.isfinite(trace) & (trace > 0), trace / s, 1.0)
+        boost = jnp.broadcast_to(jnp.asarray(jitter, kmat.dtype), trace.shape)
+        kmat = kmat + (boost * scale)[..., None, None] * jnp.eye(
+            s, dtype=kmat.dtype
+        )
     ym = data.y * data.mask
     if _use_pallas(kmat):
         kinv, logdet = spd_inv_logdet(kmat)
@@ -100,8 +113,10 @@ def objective_fn(objective: str):
     every fit entry point swaps them via one static argument plus one
     traced operand tuple."""
     if objective == "marginal":
+        # extra, when present, is the (jitter,) escalation operand of the
+        # resilience layer — absent on every clean fit
         return lambda kernel, theta, data, *extra: batched_nll(
-            kernel, theta, data
+            kernel, theta, data, *extra
         )
     if objective == "loo":
         from spark_gp_tpu.models.loo import batched_loo_nll

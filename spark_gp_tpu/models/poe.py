@@ -40,8 +40,9 @@ import numpy as np
 
 from spark_gp_tpu.kernels.base import Kernel
 from spark_gp_tpu.ops.linalg import (
-    check_pd_status,
+    JITTER_SCHEDULE,
     cholesky,
+    cholesky_escalated,
     is_pd,
     masked_kernel_matrix,
 )
@@ -51,15 +52,25 @@ _MODES = ("poe", "gpoe", "bcm", "rbcm")
 
 
 @partial(jax.jit, static_argnums=0)
-def _factor_experts(kernel: Kernel, theta, x, y, mask):
-    """One-time batched factorization: L [E,s,s], alpha [E,s]."""
-    kmat = jax.vmap(
+def _expert_grams(kernel: Kernel, theta, x, mask):
+    """Masked per-expert Gram stack [E, s, s]."""
+    return jax.vmap(
         lambda xe, me: masked_kernel_matrix(kernel.gram(theta, xe), me)
     )(x, mask)
-    chol_l = cholesky(kmat)
+
+
+@jax.jit
+def _alpha_from_chol(chol_l, y, mask):
     ym = y * mask
-    alpha = jax.scipy.linalg.cho_solve((chol_l, True), ym[..., None])[..., 0]
-    return chol_l, alpha
+    return jax.scipy.linalg.cho_solve((chol_l, True), ym[..., None])[..., 0]
+
+
+@partial(jax.jit, static_argnums=0)
+def _factor_experts(kernel: Kernel, theta, x, y, mask):
+    """One-time batched factorization: L [E,s,s], alpha [E,s]."""
+    kmat = _expert_grams(kernel, theta, x, mask)
+    chol_l = cholesky(kmat)
+    return chol_l, _alpha_from_chol(chol_l, y, mask)
 
 
 def _local_moments(kernel: Kernel, mode, theta, x, mask, chol_l, alpha,
@@ -187,10 +198,21 @@ class PoEPredictor:
         self._chol, self._alpha = _factor_experts(
             kernel, self.theta, data.x, data.y, data.mask
         )
-        # surface a non-PD expert gram here, like every other factorization
-        # path (NotPositiveDefiniteException + advice) — not as NaN
-        # predictions later
-        check_pd_status(jnp.all(is_pd(self._chol)))
+        if not bool(is_pd(self._chol)):
+            # a borderline expert Gram gets the shared adaptive jitter
+            # ladder (ops/linalg.py) before we give up: the unjittered
+            # clean path above stays untouched, the escalation re-runs the
+            # factorization host-driven, and only a stack the whole ladder
+            # cannot repair raises NotPositiveDefiniteException (with the
+            # reference's advice) — never NaN predictions later.
+            kmat = _expert_grams(kernel, self.theta, data.x, data.mask)
+            # full ladder (rung 0 included): the escalation is per matrix,
+            # so healthy experts keep their unjittered factors bit-exact
+            # and only the borderline Grams climb rungs
+            self._chol, _tau = cholesky_escalated(
+                kmat, "per-expert Gram (PoE)", schedule=JITTER_SCHEDULE
+            )
+            self._alpha = _alpha_from_chol(self._chol, data.y, data.mask)
 
     # per-chunk element budget for the [E*s, t_chunk] cross-kernel /
     # solve intermediates — bounds device memory at ANY test-set size

@@ -246,19 +246,15 @@ def _sharded_kmn_stats_x64_from32_impl(
 
 
 # One escalating-jitter policy for every magic-solve dispatch branch (host
-# numpy, replicated device, mesh-sharded): relative-to-(trace/m) diagonal
-# boosts, unjittered first, then the f32 noise-floor scale escalating x10.
-# A matrix that exhausts the schedule raises NotPositiveDefiniteException
-# with the reference's advice identically on all branches (PGPH.scala:9-11).
-_JITTER_SCHEDULE = (0.0, 1.2e-7, 1.2e-6, 1.2e-5, 1.2e-4)
-
-
-def _jittered(mat: np.ndarray, tau: float, scale: float) -> np.ndarray:
-    """``mat + (tau * scale) I`` with a no-copy fast path at tau=0 (the
-    common first-try-succeeds route skips the O(m^2) identity add)."""
-    if tau == 0.0:
-        return mat
-    return mat + (tau * scale) * np.eye(mat.shape[0])
+# numpy, replicated device, mesh-sharded) — the framework-wide adaptive
+# ladder of ops/linalg.py (trace-relative diagonal boosts, unjittered
+# first, escalating to 1.2e-4).  A matrix that exhausts the schedule
+# raises NotPositiveDefiniteException with the reference's advice
+# identically on all branches (PGPH.scala:9-11).
+from spark_gp_tpu.ops.linalg import (  # noqa: E402 — policy import
+    JITTER_SCHEDULE as _JITTER_SCHEDULE,
+    jittered_np as _jittered,
+)
 
 # Above this active-set size the O(m^3) magic solve moves off the host
 # single-thread numpy path onto the device (XLA f64): at m=1000 the host
@@ -405,33 +401,19 @@ def _gram_f64_on_host(kernel: Kernel, theta64, active64):
 
 
 def _psd_safe_cholesky(mat, name):
-    """Cholesky with the shared escalating trace-relative jitter schedule.
+    """Cholesky under the shared adaptive jitter ladder (ops/linalg.py).
 
     The distributed U1 = sum K_mn K_nm accumulates on-device in float32; its
     smallest eigenvalues carry O(eps_f32 * lambda_max) noise which can push a
     mathematically-PSD matrix slightly indefinite.  Repairing with jitter
-    proportional to trace/m (starting at f32 epsilon scale, escalating x10)
-    perturbs the solution far less than the PPA approximation error itself.
-    Raises NotPositiveDefiniteException (with the reference's "increase
-    sigma2" advice, PGPH.scala:9-11) only once jitter 1e3x the float32 noise
-    floor still fails — at that point the matrix is genuinely bad.
+    proportional to trace/m perturbs the solution far less than the PPA
+    approximation error itself; a matrix the whole ladder cannot repair is
+    genuinely bad and raises NotPositiveDefiniteException (with the
+    reference's "increase sigma2" advice, PGPH.scala:9-11).
     """
-    mat = 0.5 * (mat + mat.T)
-    scale = np.trace(mat) / mat.shape[0] if mat.shape[0] else 1.0
-    for tau in _JITTER_SCHEDULE:
-        try:
-            chol = np.linalg.cholesky(_jittered(mat, tau, scale))
-        except np.linalg.LinAlgError:
-            continue
-        if tau:
-            import logging
+    from spark_gp_tpu.ops.linalg import psd_safe_cholesky_np
 
-            logging.getLogger("spark_gp_tpu").warning(
-                "%s required jitter %.3e for positive definiteness "
-                "(float32 accumulation noise)", name, tau * scale,
-            )
-        return chol
-    raise NotPositiveDefiniteException()
+    return psd_safe_cholesky_np(mat, name)
 
 
 def _solve_magic_np(pd_mat, kmm, u2, sn2, with_variance: bool = True):

@@ -96,6 +96,129 @@ def check_pd_status(ok) -> None:
         raise NotPositiveDefiniteException()
 
 
+# --- adaptive jitter escalation -------------------------------------------
+#
+# One bounded ladder for every factorization that may meet a borderline
+# matrix: trace-relative diagonal boosts, unjittered first, then escalating
+# from well below the f64 noise floor (1e-10) through the f32 accumulation
+# noise scale up to 1e-4.  The ladder is driven from the HOST, around the
+# compiled factorization ("Memory Safe Computations with XLA", PAPERS.md:
+# recovery logic stays out of the hot path) — the clean first attempt is the
+# plain Cholesky the fit paths already run, and only a failure pays for
+# retries.  A matrix that exhausts the ladder raises
+# :class:`NotPositiveDefiniteException` with the reference's advice
+# (PGPH.scala:9-11) identically on every branch.
+JITTER_SCHEDULE = (0.0, 1e-10, 1e-8, 1.2e-7, 1.2e-6, 1.2e-5, 1.2e-4)
+
+
+def jittered_np(mat, tau: float, scale: float):
+    """``mat + (tau * scale) I`` (host numpy) with a no-copy fast path at
+    tau=0 — the common first-try-succeeds route skips the O(n^2) add."""
+    import numpy as np
+
+    if tau == 0.0:
+        return mat
+    return mat + (tau * scale) * np.eye(mat.shape[0])
+
+
+def psd_safe_cholesky_np(mat, name: str, schedule=JITTER_SCHEDULE):
+    """Host numpy Cholesky with the escalating trace-relative ladder.
+
+    Device-accumulated Gram statistics carry O(eps * lambda_max) entry
+    noise which can push a mathematically-PSD matrix slightly indefinite;
+    repairing with jitter proportional to trace/n perturbs the solution
+    far less than the approximation error already present.  Returns the
+    lower factor; raises :class:`NotPositiveDefiniteException` once the
+    whole ladder fails — at that point the matrix is genuinely bad.
+    """
+    import logging
+
+    import numpy as np
+
+    mat = 0.5 * (mat + mat.T)
+    scale = float(np.trace(mat)) / mat.shape[0] if mat.shape[0] else 1.0
+    if not np.isfinite(scale) or scale <= 0.0:
+        scale = 1.0
+    for tau in schedule:
+        try:
+            chol = np.linalg.cholesky(jittered_np(mat, tau, scale))
+        except np.linalg.LinAlgError:
+            continue
+        if not np.all(np.isfinite(chol)):
+            # LAPACK can hand back a NaN factor with info == 0 when the
+            # INPUT carries NaN/inf — that must walk the ladder (and
+            # ultimately raise) exactly like an indefinite matrix, not
+            # escape as NaN solves downstream
+            continue
+        if tau:
+            logging.getLogger("spark_gp_tpu").warning(
+                "%s required jitter %.3e for positive definiteness",
+                name, tau * scale,
+            )
+        return chol
+    raise NotPositiveDefiniteException()
+
+
+@jax.jit
+def _jittered_cholesky_impl(mat: jax.Array, tau: jax.Array) -> jax.Array:
+    """One (possibly batched) factorization attempt at trace-relative
+    jitter ``tau`` — a traced scalar, so every ladder rung reuses the same
+    compiled executable."""
+    n = mat.shape[-1]
+    sym = 0.5 * (mat + jnp.swapaxes(mat, -1, -2))
+    trace = jnp.trace(sym, axis1=-2, axis2=-1)
+    scale = jnp.where(
+        jnp.isfinite(trace) & (trace > 0.0), trace / n, 1.0
+    )
+    eye = jnp.eye(n, dtype=mat.dtype)
+    return jnp.linalg.cholesky(sym + tau * scale[..., None, None] * eye)
+
+
+def cholesky_escalated(
+    mat: jax.Array, name: str = "matrix", schedule=JITTER_SCHEDULE
+):
+    """Device Cholesky (batched or single) under the shared jitter ladder.
+
+    Host-driven retry around the compiled factorization: each rung
+    re-dispatches :func:`_jittered_cholesky_impl` with a bigger traced
+    tau, and each MATRIX keeps the factor from the first rung that made
+    it finite — matrices already factored stay untouched (the per-expert
+    principle of the resilience layer: a healthy expert's math never
+    pays for its neighbor's repair).  Returns ``(chol, tau_max)`` with
+    ``tau_max`` the largest rung any matrix needed; raises
+    :class:`NotPositiveDefiniteException` after the ladder is exhausted.
+    For the fit hot loops prefer the plain :func:`cholesky` plus
+    quarantine (``resilience/quarantine.py``) — this is for one-time
+    factor builds (POE predictors, posterior sampling).
+    """
+    import logging
+
+    out = None
+    done = None
+    tau_max = 0.0
+    for tau in schedule:
+        chol_l = _jittered_cholesky_impl(mat, jnp.asarray(tau, mat.dtype))
+        ok = jnp.all(jnp.isfinite(chol_l), axis=(-2, -1))
+        if out is None:
+            out, done = chol_l, ok
+            if bool(jnp.any(ok)):
+                tau_max = tau
+        else:
+            newly = ok & ~done
+            if bool(jnp.any(newly)):
+                out = jnp.where(newly[..., None, None], chol_l, out)
+                done = done | newly
+                tau_max = tau
+        if bool(jnp.all(done)):
+            if tau_max:
+                logging.getLogger("spark_gp_tpu").warning(
+                    "%s required relative jitter up to %.3e for positive "
+                    "definiteness", name, tau_max,
+                )
+            return out, tau_max
+    raise NotPositiveDefiniteException()
+
+
 def masked_kernel_matrix(kmat: jax.Array, mask: jax.Array) -> jax.Array:
     """Embed a masked Gram matrix into an identity so padded rows are inert.
 

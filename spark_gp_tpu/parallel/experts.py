@@ -47,6 +47,36 @@ class ExpertData:
     def expert_size(self) -> int:
         return self.x.shape[1]
 
+    def with_experts_masked(self, drop, benign_row=None) -> "ExpertData":
+        """Stack with the ``drop``-flagged experts made inert (the
+        quarantine primitive, ``resilience/quarantine.py``).
+
+        Mask and labels zeroed — the masked Gram embedding
+        (``ops.linalg.masked_kernel_matrix``) then turns each dropped
+        expert into an identity block contributing exactly 0 to every
+        reduction — and features replaced by ``benign_row`` (default:
+        the first kept expert's first point), because a fully-masked
+        expert still flows through ``kernel.gram`` and ``0 * NaN`` would
+        re-poison the sum.  Shapes are unchanged, so compiled
+        executables and sharding are reused."""
+        drop = np.asarray(drop, dtype=bool)
+        if not drop.any():
+            return self
+        drop_dev = jnp.asarray(drop)
+        keep = jnp.asarray(~drop, dtype=self.mask.dtype)
+        if benign_row is None:
+            benign_row = self.x[int(np.argmax(~drop)), :1]  # [1, p]
+        x = jnp.where(drop_dev[:, None, None], benign_row[None], self.x)
+        # zero by SELECTION, never by multiplication: a dropped expert's
+        # labels may be NaN/inf (the fault being quarantined), and
+        # IEEE 0 * NaN = NaN would re-poison the very sum this masks
+        y = jnp.where(drop_dev[:, None], jnp.zeros((), self.y.dtype), self.y)
+        return ExpertData(
+            x=x,
+            y=y,
+            mask=self.mask * keep[:, None],
+        )
+
     def pad_experts(self, multiple: int) -> "ExpertData":
         """Pad the expert axis up to a multiple (for even sharding across
         devices).  Padded experts are fully masked and contribute nothing."""

@@ -1,0 +1,55 @@
+"""Fault tolerance for fit and serve.
+
+The BCM objective is a *sum* of per-expert NLLs (PAPER.md; Deisenroth &
+Ng, Distributed GPs), so one poisoned expert chunk — a NaN feature row
+from a bad host, an ill-conditioned Gram — makes the whole objective
+non-finite; one preempted host loses the optimizer state; one broken
+model can wedge a serving process.  This package is the recovery layer,
+and it deliberately lives OUTSIDE the compiled hot paths ("Memory Safe
+Computations with XLA", PAPERS.md): clean fits and clean requests never
+pay for it, failures re-dispatch the same compiled programs with repaired
+operands.
+
+* :mod:`~spark_gp_tpu.resilience.quarantine` — per-expert health probes,
+  adaptive jitter escalation over the shared ladder
+  (``ops.linalg.JITTER_SCHEDULE``), and BCM quarantine-with-
+  renormalization for experts the ladder cannot repair.
+* :mod:`~spark_gp_tpu.resilience.retry` — bounded retry-with-backoff for
+  whole fit attempts and other host-side operations.
+* :mod:`~spark_gp_tpu.resilience.breaker` — a circuit breaker
+  (closed/open/half-open) isolating a faulting model on the serve path.
+* :mod:`~spark_gp_tpu.resilience.chaos` — the deterministic fault-
+  injection harness that proves all of the above end to end
+  (``pytest -m chaos``).
+
+See docs/RESILIENCE.md for the failure model and semantics.
+"""
+
+from spark_gp_tpu.resilience.breaker import (
+    BreakerOpenError,
+    CircuitBreaker,
+)
+from spark_gp_tpu.resilience.quarantine import (
+    ExpertQuarantineError,
+    NonFiniteFitError,
+    QuarantineReport,
+    diagnose_experts,
+    expert_health,
+    nonfinite_expert_mask,
+    quarantine_experts,
+)
+from spark_gp_tpu.resilience.retry import RetryBudgetExceededError, retry_with_backoff
+
+__all__ = [
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "ExpertQuarantineError",
+    "NonFiniteFitError",
+    "QuarantineReport",
+    "RetryBudgetExceededError",
+    "diagnose_experts",
+    "expert_health",
+    "nonfinite_expert_mask",
+    "quarantine_experts",
+    "retry_with_backoff",
+]

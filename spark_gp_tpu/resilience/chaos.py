@@ -1,0 +1,199 @@
+"""Deterministic fault injection: the proof harness for the resilience layer.
+
+Every fault here is seed- or count-driven — no wall-clock races, no
+randomized kill timers — so the chaos tests (``pytest -m chaos``) are
+ordinary fast deterministic tier-1 tests, not flaky integration theater.
+
+Fault classes:
+
+* :func:`poison_expert` — corrupt the raw rows round-robin-assigned to
+  one expert (NaN / inf / huge values), the data-fault that used to turn
+  the whole BCM objective to ``inf``;
+* :func:`failing_cholesky` — make the host Cholesky raise for the first
+  N calls, driving the adaptive jitter ladder and the
+  ``NotPositiveDefiniteException`` path;
+* :class:`PreemptingCheckpointer` — hard-kills the process
+  (``os._exit``, the SIGKILL analogue: no cleanup, no atexit) right
+  after the k-th checkpoint save — a deterministic preemption for
+  kill-and-resume tests;
+* :class:`FlakyPredictor` — a predict path that fails and/or stalls on
+  schedule, for circuit-breaker and poisoned-batch isolation tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def poison_expert(
+    x: np.ndarray,
+    y: np.ndarray,
+    expert: int,
+    num_experts: int,
+    kind: str = "nan",
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Corrupt every row that round-robin grouping assigns to ``expert``.
+
+    ``parallel/experts.py``: expert ``j`` receives points ``j, j+E,
+    j+2E, ...`` — so poisoning those rows poisons exactly one expert of
+    the fitted stack.  ``kind``: ``"nan"`` (a NaN feature per row),
+    ``"inf"`` (an infinite label), ``"huge"`` (1e300-scale features: the
+    finite-but-catastrophic conditioning fault), ``"dup"`` (every row
+    identical: an exactly singular expert Gram — the fault class the
+    adaptive jitter ladder repairs without quarantine).  Returns
+    corrupted copies; the inputs are untouched.
+    """
+    if not 0 <= expert < num_experts:
+        raise ValueError(f"expert {expert} out of range [0, {num_experts})")
+    x = np.array(x, dtype=np.float64, copy=True)
+    y = np.array(y, dtype=np.float64, copy=True)
+    rows = np.arange(expert, x.shape[0], num_experts)
+    rng = np.random.default_rng(seed)
+    cols = rng.integers(0, x.shape[1], size=rows.shape[0])
+    if kind == "nan":
+        x[rows, cols] = np.nan
+    elif kind == "inf":
+        y[rows] = np.inf
+    elif kind == "huge":
+        x[rows] *= 1e300
+    elif kind == "dup":
+        x[rows] = x[rows[0]]
+        y[rows] = y[rows[0]]
+    else:
+        raise ValueError(f"unknown poison kind {kind!r}")
+    return x, y
+
+
+@contextlib.contextmanager
+def failing_cholesky(times: int = 1):
+    """Patch ``np.linalg.cholesky`` to raise ``LinAlgError`` for the first
+    ``times`` calls (then behave normally).  Yields a one-element list
+    holding the injected-failure count, so tests can assert the fault
+    actually fired.  Drives the host jitter ladder
+    (``ops.linalg.psd_safe_cholesky_np``) and, with a large ``times``,
+    the ladder-exhausted ``NotPositiveDefiniteException`` path.
+    """
+    original = np.linalg.cholesky
+    fired = [0]
+
+    def chaotic(a, *args, **kwargs):
+        if fired[0] < times:
+            fired[0] += 1
+            raise np.linalg.LinAlgError("chaos: injected Cholesky failure")
+        return original(a, *args, **kwargs)
+
+    np.linalg.cholesky = chaotic
+    try:
+        yield fired
+    finally:
+        np.linalg.cholesky = original
+
+
+#: conventional exit status of a SIGKILLed process (128 + 9) — what a
+#: cluster preemption looks like to the supervisor
+PREEMPTION_EXIT_CODE = 137
+
+
+class SimulatedPreemption(BaseException):
+    """In-process preemption marker (``BaseException``: ordinary
+    ``except Exception`` recovery code must not swallow a kill)."""
+
+
+class PreemptingCheckpointer:
+    """Device-checkpointer wrapper that dies right after the k-th save.
+
+    Two kill modes: ``exit_process=True`` calls ``os._exit`` — no
+    exception unwinding, no atexit, no buffered-file flushing, the
+    closest in-process analogue of a SIGKILL preemption (subprocess
+    tests); the default raises :class:`SimulatedPreemption`, which aborts
+    the fit mid-segment without tearing down the interpreter — the fast
+    deterministic variant for tier-1.  Because the wrapped saver's write
+    is atomic (tmp + fsync + ``os.replace`` + checksum), the checkpoint
+    on disk is the complete k-th state either way, and a restarted fit
+    resumes from exactly there.
+    """
+
+    def __init__(self, inner, kill_after_saves: int,
+                 exit_process: bool = False,
+                 exit_code: int = PREEMPTION_EXIT_CODE) -> None:
+        if kill_after_saves < 1:
+            raise ValueError("kill_after_saves must be >= 1")
+        self.inner = inner
+        self.kill_after_saves = int(kill_after_saves)
+        self.exit_process = bool(exit_process)
+        self.exit_code = int(exit_code)
+        self.saves = 0
+
+    def save(self, state, meta: dict) -> None:
+        self.inner.save(state, meta)
+        self.saves += 1
+        if self.saves >= self.kill_after_saves:
+            if self.exit_process:
+                os._exit(self.exit_code)
+            raise SimulatedPreemption(
+                f"preempted after checkpoint save #{self.saves}"
+            )
+
+    def load(self, template_state, meta: dict):
+        return self.inner.load(template_state, meta)
+
+    @property
+    def path(self):
+        return self.inner.path
+
+
+class FlakyPredictor:
+    """Predict path that fails / stalls on a deterministic schedule.
+
+    Duck-types enough of :class:`~spark_gp_tpu.serve.batcher.
+    BucketedPredictor` for the serving stack (everything else delegates
+    to the wrapped predictor).  ``fail_first`` predicts raise
+    ``exc_type``; with ``fail_forever`` every call raises; ``latency_s``
+    sleeps before answering (slow-predict fault).
+    """
+
+    def __init__(
+        self,
+        inner,
+        fail_first: int = 0,
+        fail_forever: bool = False,
+        latency_s: float = 0.0,
+        exc_type: type = RuntimeError,
+    ) -> None:
+        self._inner = inner
+        self.fail_first = int(fail_first)
+        self.fail_forever = bool(fail_forever)
+        self.latency_s = float(latency_s)
+        self.exc_type = exc_type
+        self.calls = 0
+
+    def predict(self, x, *args, **kwargs):
+        self.calls += 1
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        if self.fail_forever or self.calls <= self.fail_first:
+            raise self.exc_type(
+                f"chaos: injected predict failure (call {self.calls})"
+            )
+        return self._inner.predict(x, *args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def break_model(server, name: str, version: Optional[int] = None, **flaky_kw):
+    """Swap a registered model's predictor for a :class:`FlakyPredictor`.
+
+    Returns the wrapper (its ``calls`` counter is the test's evidence the
+    fault fired).  Chaos-only: mutates the live registry entry in place.
+    """
+    entry = server.registry.get(name, version)
+    flaky = FlakyPredictor(entry.predictor, **flaky_kw)
+    entry.predictor = flaky
+    return flaky

@@ -1,0 +1,230 @@
+"""Expert quarantine: adaptive repair and removal of poisoned BCM experts.
+
+The training objective is ``sum_e NLL_e`` over the expert stack
+(``models/likelihood.py``).  A single expert whose NLL or gradient is
+non-finite — NaN rows from a failed preprocessing shard, a Gram matrix
+past the edge of positive definiteness — previously poisoned the global
+objective: the host optimizer raised ``NotPositiveDefiniteException`` at
+the first evaluation and the device optimizer silently converged to NaN.
+
+Recovery ladder (host-driven, outside the compiled hot path — clean fits
+never pay for any of this):
+
+1. **health probe** — one vmapped program evaluates every expert's NLL
+   and gradient-magnitude independently at the initial hyperparameters;
+2. **adaptive jitter escalation** — unhealthy experts retry with
+   per-expert trace-relative diagonal boosts walked up the shared ladder
+   (``ops.linalg.JITTER_SCHEDULE``), re-dispatching the same compiled
+   probe with a traced jitter operand;
+3. **quarantine** — experts still non-finite after the ladder are dropped
+   from the BCM sum: their mask rows are zeroed (the masked Gram embedding
+   turns them into inert identity blocks), their features replaced with a
+   benign copy of a healthy expert's first point (so ``0 * NaN`` can never
+   leak back in).  ``final_nll`` stays the optimizer's literal reduced
+   sum; the full-stack-comparable figure is published alongside as
+   ``final_nll_renormalized = final_nll * E_active / E_kept``
+   (``models/common._log_renormalized_nll``).
+
+The shapes of the stack never change, so every retry reuses the already
+compiled fit executables, and sharded stacks keep their sharding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_gp_tpu.ops.linalg import JITTER_SCHEDULE
+from spark_gp_tpu.parallel.experts import ExpertData
+
+
+class NonFiniteFitError(RuntimeError):
+    """A fit attempt produced a non-finite objective (detected on host)."""
+
+
+class ExpertQuarantineError(RuntimeError):
+    """Quarantine would drop every expert (or too many to trust the fit) —
+    the failure is global, not a per-expert fault; the model configuration
+    itself is numerically unusable (the classic remedy: increase sigma2)."""
+
+
+#: shared tail of every "quarantine refused" message — the advice is the
+#: same whichever guard fires
+GLOBAL_FAILURE_ADVICE = (
+    "the failure is global (increase sigma2 / check the data), not a "
+    "quarantinable per-expert fault"
+)
+
+
+def renorm_factor(active: float, dropped: float) -> float:
+    """``E_active / E_kept`` — the factor mapping the reduced BCM sum back
+    to a full-stack-comparable NLL.  Exactly 1.0 when nothing is dropped;
+    raises :class:`ExpertQuarantineError` when nothing would be kept.
+    The single implementation behind ``QuarantineReport.renorm`` and the
+    fit drivers' ``bcm_renorm`` metric."""
+    kept = active - dropped
+    if kept <= 0:
+        raise ExpertQuarantineError(
+            f"all {int(active)} active expert(s) are non-finite — "
+            + GLOBAL_FAILURE_ADVICE
+        )
+    return active / kept
+
+
+@dataclass(frozen=True)
+class QuarantineReport:
+    """Outcome of one diagnosis pass over the expert stack."""
+
+    bad: np.ndarray      # bool [E] — non-finite after the whole ladder
+    jitter: np.ndarray   # f64 [E] — per-expert relative jitter that fixed it
+    num_active: int      # experts with any unmasked points before diagnosis
+
+    @property
+    def num_dropped(self) -> int:
+        return int(self.bad.sum())
+
+    @property
+    def num_jittered(self) -> int:
+        return int((self.jitter > 0).sum())
+
+    @property
+    def renorm(self) -> float:
+        """``E_active / E_kept`` — multiply the reduced BCM sum by this to
+        keep the reported NLL comparable to the full-expert objective
+        (published as the ``final_nll_renormalized`` metric by the fit
+        drivers).  Exactly 1.0 when nothing is dropped."""
+        return renorm_factor(self.num_active, self.num_dropped)
+
+    @property
+    def clean(self) -> bool:
+        return self.num_dropped == 0 and self.num_jittered == 0
+
+
+@jax.jit
+def _nonfinite_expert_impl(x, y, mask):
+    real = mask > 0
+    bad_x = jnp.any(~jnp.isfinite(x) & real[..., None], axis=(1, 2))
+    bad_y = jnp.any(~jnp.isfinite(y) & real, axis=1)
+    return bad_x | bad_y
+
+
+def nonfinite_expert_mask(data: ExpertData) -> np.ndarray:
+    """bool [E]: experts carrying any non-finite unmasked feature/label.
+
+    The cheap pre-fit screen (one reduction over the stack, ~free next to
+    a single objective evaluation): data-level NaN/inf faults are caught
+    before the optimizer ever sees an ``inf`` objective."""
+    return np.asarray(_nonfinite_expert_impl(data.x, data.y, data.mask))
+
+
+@partial(jax.jit, static_argnums=0, static_argnames=("objective",))
+def _expert_health_impl(kernel, theta, x, y, mask, jitter, *, objective):
+    from spark_gp_tpu.models.likelihood import objective_fn
+
+    obj = objective_fn(objective)
+
+    def one(xe, ye, me, je):
+        local = ExpertData(x=xe[None], y=ye[None], mask=me[None])
+        extra = (je,) if objective == "marginal" else ()
+        value, grad = jax.value_and_grad(
+            lambda t: obj(kernel, t, local, *extra)
+        )(theta)
+        return value, jnp.sum(jnp.abs(grad))
+
+    return jax.vmap(one)(x, y, mask, jitter)
+
+
+def expert_health(
+    kernel, theta, data: ExpertData, objective: str = "marginal",
+    jitter=None,
+):
+    """``(nll [E], grad_l1 [E])`` — every expert probed independently.
+
+    The per-expert decomposition of the exact training objective: one
+    vmapped value-and-grad, so a single dispatch diagnoses the whole
+    stack.  ``jitter`` (scalar or [E], trace-relative) feeds the marginal
+    objective's escalation operand."""
+    e = data.x.shape[0]
+    dtype = data.x.dtype
+    if jitter is None:
+        jit_vec = jnp.zeros((e,), dtype=dtype)
+    else:
+        jit_vec = jnp.broadcast_to(
+            jnp.asarray(jitter, dtype=dtype), (e,)
+        )
+    theta = jnp.asarray(theta, dtype=dtype)
+    nll, gnorm = _expert_health_impl(
+        kernel, theta, data.x, data.y, data.mask, jit_vec,
+        objective=objective,
+    )
+    return np.asarray(nll), np.asarray(gnorm)
+
+
+def _healthy(nll: np.ndarray, gnorm: np.ndarray) -> np.ndarray:
+    return np.isfinite(nll) & np.isfinite(gnorm)
+
+
+def diagnose_experts(
+    kernel,
+    theta,
+    data: ExpertData,
+    objective: str = "marginal",
+    schedule=JITTER_SCHEDULE,
+    allow_jitter: bool = True,
+) -> QuarantineReport:
+    """Probe every expert, escalate jitter for the unhealthy, report.
+
+    Experts already healthy keep jitter 0 (their math is untouched);
+    unhealthy experts walk the ladder rung by rung — each rung is one
+    re-dispatch of the same compiled probe — and keep the first rung that
+    makes them finite.  Experts the whole ladder cannot repair are marked
+    ``bad``.  ``allow_jitter=False`` (the sharded fit paths, whose
+    objective cannot carry the jitter operand) skips straight from the
+    unjittered probe to quarantine.
+    """
+    e = data.x.shape[0]
+    active = np.asarray(data.mask).sum(axis=1) > 0
+    nll, gnorm = expert_health(kernel, theta, data, objective)
+    healthy = _healthy(nll, gnorm) | ~active  # inert experts are fine
+    jitter = np.zeros(e, dtype=np.float64)
+    if allow_jitter and objective == "marginal" and not healthy.all():
+        for tau in schedule[1:]:
+            candidate = np.where(healthy, jitter, tau)
+            nll_t, gnorm_t = expert_health(
+                kernel, theta, data, objective, jitter=candidate
+            )
+            fixed = _healthy(nll_t, gnorm_t) & ~healthy
+            jitter[fixed] = tau
+            healthy |= fixed
+            if healthy.all():
+                break
+    return QuarantineReport(
+        bad=(~healthy) & active,
+        jitter=jitter,
+        num_active=int(active.sum()),
+    )
+
+
+def quarantine_experts(data: ExpertData, bad) -> ExpertData:
+    """Return a stack with the ``bad`` experts made inert.
+
+    Mask rows zeroed (the masked Gram embedding then contributes an exact
+    0 to the likelihood), labels zeroed, and features replaced by a benign
+    copy of the first healthy expert's first point — a fully-masked expert
+    still flows through ``kernel.gram``, and ``0 * NaN`` would re-poison
+    the sum.  Shapes (and therefore sharding and compiled executables) are
+    unchanged.
+    """
+    bad = np.asarray(bad, dtype=bool)
+    if not bad.any():
+        return data
+    if bad.all():
+        raise ExpertQuarantineError(
+            "every expert is non-finite — " + GLOBAL_FAILURE_ADVICE
+        )
+    return data.with_experts_masked(bad)

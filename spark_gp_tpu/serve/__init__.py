@@ -23,9 +23,14 @@ compiled surface finite and warm:
 * ``python -m spark_gp_tpu.serve`` — a JSON-lines (stdin or socket)
   entrypoint that warms every bucket before reporting ready.
 
+Failures degrade per model / per request, never the process: per-model
+circuit breakers, poisoned-request isolation, classed shed metrics and
+a health verb (``resilience/``, docs/RESILIENCE.md).
+
 See docs/SERVING.md for architecture and tuning.
 """
 
+from spark_gp_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
 from spark_gp_tpu.serve.batcher import (
     BucketOverflowError,
     BucketedPredictor,
@@ -34,6 +39,7 @@ from spark_gp_tpu.serve.batcher import (
 )
 from spark_gp_tpu.serve.metrics import LatencyHistogram, ServingMetrics
 from spark_gp_tpu.serve.queue import (
+    DeadlineExpiredError,
     QueueFullError,
     RequestTimeoutError,
     ServeFuture,
@@ -42,8 +48,11 @@ from spark_gp_tpu.serve.registry import ModelRegistry, ServableModel
 from spark_gp_tpu.serve.server import GPServeServer
 
 __all__ = [
+    "BreakerOpenError",
     "BucketedPredictor",
     "BucketOverflowError",
+    "CircuitBreaker",
+    "DeadlineExpiredError",
     "RecompileGuardError",
     "bucket_sizes",
     "ServingMetrics",

@@ -15,8 +15,16 @@ Protocol (one JSON object per line, in either direction):
 
     {"id": 1, "model": "m", "x": [[...], ...]}      -> {"id": 1, "mean": [...], "var": [...]}
     {"cmd": "metrics"}                               -> {"event": "metrics", ...}
+    {"cmd": "health"}   (alias: {"op": "health"})    -> {"event": "health", "status": "ok"|"degraded"|"unready", ...}
     {"cmd": "reload", "model": "m"}                  -> {"event": "reloaded", ...}
     {"cmd": "shutdown"}  (or EOF on stdin)           -> {"event": "shutdown", ...}
+
+``health`` answers immediately (it does not ride the ordered writer
+queue): an orchestrator's liveness probe must not block behind a stalled
+predict backlog — that is exactly when it needs an answer.  Error
+replies carry a machine-readable ``code`` when the failure has one
+(``queue.shed.deadline``, ``queue.shed.backpressure``), so clients can
+tell shed classes apart (docs/RESILIENCE.md).
 
 Responses to predicts are emitted in submission order by a writer thread,
 so the reader loop never blocks on a result and the micro-batcher sees
@@ -28,6 +36,7 @@ from __future__ import annotations
 import argparse
 import concurrent.futures
 import json
+import os
 import queue as _queue
 import sys
 import threading
@@ -54,6 +63,11 @@ def _parse_args(argv):
                         help="micro-batch coalescing window")
     parser.add_argument("--request-timeout-ms", type=float, default=1000.0,
                         help="per-request deadline (0 disables)")
+    parser.add_argument("--breaker-threshold", type=int, default=3,
+                        help="consecutive predict failures that trip a "
+                        "model's circuit breaker open")
+    parser.add_argument("--breaker-reset-s", type=float, default=5.0,
+                        help="breaker cooldown before a half-open probe")
     parser.add_argument("--port", type=int, default=None,
                         help="serve a TCP socket on 127.0.0.1:PORT instead of stdin")
     parser.add_argument(
@@ -104,6 +118,9 @@ def _writer_loop(pending: "_queue.Queue", lock, stream, result_wait_s) -> None:
                 "id": req_id,
                 "error": f"{type(exc).__name__}: {exc}"[:500],
             }
+            code = getattr(exc, "code", None)
+            if code is not None:
+                response["code"] = code
         _out(lock, stream, response)
 
 
@@ -133,7 +150,7 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
             except ValueError as exc:
                 _out(out_lock, out_stream, {"error": f"bad request line: {exc}"})
                 continue
-            cmd = msg.get("cmd")
+            cmd = msg.get("cmd", msg.get("op"))
             if cmd == "shutdown":
                 shutdown = True
                 break
@@ -141,6 +158,14 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                 pending.put(
                     lambda: {"event": "metrics", **server.snapshot()}
                 )
+                continue
+            if cmd == "health":
+                # straight to the stream, NOT the ordered writer queue: a
+                # liveness probe must answer even when the writer is
+                # blocked behind a stalled predict backlog
+                _out(out_lock, out_stream, {
+                    "event": "health", **server.health()
+                })
                 continue
             if cmd == "reload":
                 # on a side thread: a reload pays a full load + AOT warmup,
@@ -181,10 +206,14 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                 # through the writer queue, not directly: error replies
                 # must not overtake earlier predicts' answers (the
                 # submission-order contract)
-                pending.put({
+                reply = {
                     "id": req_id,
                     "error": f"{type(exc).__name__}: {exc}"[:500],
-                })
+                }
+                code = getattr(exc, "code", None)
+                if code is not None:
+                    reply["code"] = code
+                pending.put(reply)
                 continue
             # a per-request timeout_ms override also stretches the writer's
             # wait — a long-deadline request must not be errored at the
@@ -265,6 +294,8 @@ def main(argv=None) -> int:
         request_timeout_ms=(
             None if args.request_timeout_ms == 0 else args.request_timeout_ms
         ),
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
     )
     for spec in args.model:
         name, sep, path = spec.partition("=")
@@ -273,6 +304,16 @@ def main(argv=None) -> int:
             return 2
         server.register(name, path)  # loads + warms every bucket (AOT)
     server.start()
+
+    chaos_target = os.environ.get("GP_CHAOS_BREAK_MODEL")
+    if chaos_target:
+        # chaos-harness hook (resilience/chaos.py): make the named model's
+        # predict raise so the fault-injection suite can drive the circuit
+        # breaker through the REAL CLI process.  Inert unless the env var
+        # is set; never set it in production.
+        from spark_gp_tpu.resilience.chaos import break_model
+
+        break_model(server, chaos_target, fail_forever=True)
 
     import jax
 

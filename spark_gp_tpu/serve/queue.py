@@ -13,8 +13,12 @@ Failure semantics are explicit and load-shedding, never stalling:
   :class:`QueueFullError` at the *door* (the client sees backpressure in
   microseconds instead of a timeout after seconds);
 * every request carries a deadline — one that expires while queued is
-  completed with :class:`RequestTimeoutError` and never wastes a device
-  dispatch on an answer nobody is waiting for.
+  completed with :class:`DeadlineExpiredError` (counted separately from
+  backpressure: the ``queue.shed.deadline`` metric) and never wastes a
+  device dispatch on an answer nobody is waiting for;
+* a batch whose execution raises is re-run one request at a time, so a
+  single poisoned request fails alone instead of taking its coalesced
+  batchmates down with it.
 """
 
 from __future__ import annotations
@@ -33,9 +37,22 @@ class QueueFullError(RuntimeError):
     """Backpressure: the request queue is at capacity; retry with backoff
     or add serving capacity."""
 
+    #: machine-readable shed class, surfaced by the CLI error payloads
+    code = "queue.shed.backpressure"
+
 
 class RequestTimeoutError(TimeoutError):
     """The request's deadline expired before a result was produced."""
+
+
+class DeadlineExpiredError(RequestTimeoutError):
+    """The request's deadline expired while it sat in the QUEUE — shed
+    load under overload.  Structurally distinct from a client-side wait
+    timeout (:class:`RequestTimeoutError` from ``ServeFuture.result``) so
+    dashboards can tell "the server is saturated" (this error + the
+    ``queue.shed.deadline`` counter) from "the client gave up"."""
+
+    code = "queue.shed.deadline"
 
 
 class ServeFuture(concurrent.futures.Future):
@@ -65,6 +82,12 @@ class PredictRequest:
     future: ServeFuture = field(default_factory=ServeFuture)
     enqueued_at: float = field(default_factory=time.monotonic)
     deadline: Optional[float] = None  # monotonic seconds, None = never
+    # set by the worker when this request is re-executed singly to isolate
+    # a poisoned batch: the executor must treat the run as a PAYLOAD probe
+    # (skip model-level circuit-breaker gating/accounting), or one poisoned
+    # episode would multi-count failures and trip the breaker mid-loop,
+    # erroring the innocent batchmates still waiting their turn
+    isolation_retry: bool = False
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and (
@@ -91,11 +114,13 @@ class MicroBatchQueue:
         max_wait_s: float = 0.002,
         max_batch_rows: int = 1024,
         on_timeout: Optional[Callable[[int], None]] = None,
+        on_poison: Optional[Callable[[int], None]] = None,
     ):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self._execute = execute
         self._on_timeout = on_timeout
+        self._on_poison = on_poison
         self._q: _queue.Queue = _queue.Queue(maxsize=capacity)
         self.capacity = capacity
         self.max_wait_s = float(max_wait_s)
@@ -205,7 +230,7 @@ class MicroBatchQueue:
             if req.expired(now):
                 expired += 1
                 req.future.set_error(
-                    RequestTimeoutError(
+                    DeadlineExpiredError(
                         "deadline expired while queued (server overloaded)"
                     )
                 )
@@ -217,6 +242,50 @@ class MicroBatchQueue:
             try:
                 self._execute(group)
             except BaseException as exc:  # noqa: BLE001 — worker must survive
+                from spark_gp_tpu.resilience.breaker import BreakerOpenError
+
+                if len(group) == 1 or isinstance(exc, BreakerOpenError):
+                    # a breaker rejection is a BATCH-level verdict: every
+                    # request in the group would be rejected identically,
+                    # so per-request isolation would only burn N futile
+                    # execute calls and mislabel the episode as poison
+                    for req in group:
+                        if not req.future.done():
+                            req.future.set_error(exc)
+                    continue
+                # poisoned-request isolation: ONE bad request (a payload
+                # the compiled predict chokes on) must not fail its
+                # innocent batchmates.  Re-execute each request singly —
+                # failure-path-only cost — so exactly the offender(s)
+                # receive the error and everyone else an answer.
+                poisoned = 0
+                late = 0
                 for req in group:
-                    if not req.future.done():
-                        req.future.set_error(exc)
+                    if req.future.done():
+                        continue
+                    if req.expired():
+                        # the serial re-execution takes time of its own: a
+                        # request whose deadline lapsed mid-isolation gets
+                        # the same deadline shed as the normal dispatch
+                        # path, not a dispatch nobody is waiting for
+                        late += 1
+                        req.future.set_error(
+                            DeadlineExpiredError(
+                                "deadline expired while queued "
+                                "(server overloaded)"
+                            )
+                        )
+                        continue
+                    req.isolation_retry = True
+                    try:
+                        self._execute([req])
+                    except BaseException as exc_one:  # noqa: BLE001
+                        poisoned += 1
+                        if not req.future.done():
+                            req.future.set_error(exc_one)
+                    finally:
+                        req.isolation_retry = False
+                if late and self._on_timeout is not None:
+                    self._on_timeout(late)
+                if poisoned and self._on_poison is not None:
+                    self._on_poison(poisoned)

@@ -17,10 +17,12 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from spark_gp_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
 from spark_gp_tpu.serve.metrics import ServingMetrics
 from spark_gp_tpu.serve.queue import (
     MicroBatchQueue,
     PredictRequest,
+    QueueFullError,
     ServeFuture,
 )
 from spark_gp_tpu.serve.registry import ModelRegistry, ServableModel
@@ -48,8 +50,15 @@ class GPServeServer:
         request_timeout_ms: Optional[float] = 1000.0,
         metrics: Optional[ServingMetrics] = None,
         max_versions: int = 2,
+        breaker_threshold: int = 3,
+        breaker_reset_s: float = 5.0,
     ):
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # one circuit breaker per model NAME (not version: a reload that
+        # fixes the model closes the breaker through its half-open probe)
+        self._breakers: dict = {}
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_reset_s = float(breaker_reset_s)
         self.registry = ModelRegistry(
             max_batch=max_batch,
             min_bucket=min_bucket,
@@ -66,9 +75,29 @@ class GPServeServer:
             capacity=capacity,
             max_wait_s=max_wait_ms / 1e3,
             max_batch_rows=max_batch,
-            on_timeout=lambda n: self.metrics.inc("timeouts", n),
+            # "timeouts" is the long-standing aggregate; queue.shed.deadline
+            # is the shed-class counter dashboards can tell apart from
+            # backpressure (ISSUE: deadline shedding was indistinguishable
+            # from overload in metrics)
+            on_timeout=lambda n: (
+                self.metrics.inc("timeouts", n),
+                self.metrics.inc("queue.shed.deadline", n),
+            ),
+            on_poison=lambda n: self.metrics.inc("queue.poisoned", n),
         )
         self._started = False
+
+    def _breaker_for(self, name: str) -> CircuitBreaker:
+        breaker = self._breakers.get(name)
+        if breaker is None:
+            # registry access is already lock-protected; breaker creation
+            # races are benign (last write wins before any failure counts)
+            breaker = self._breakers[name] = CircuitBreaker(
+                name=name,
+                failure_threshold=self._breaker_threshold,
+                reset_timeout_s=self._breaker_reset_s,
+            )
+        return breaker
 
     @property
     def request_timeout_s(self) -> Optional[float]:
@@ -105,6 +134,13 @@ class GPServeServer:
         a batch slot.
         """
         entry = self.registry.get(name, version)  # KeyError for unknowns
+        breaker = self._breaker_for(name)
+        if breaker.state == CircuitBreaker.OPEN:
+            # fail fast at the door while the breaker cools: no queue
+            # slot, no batch dispatch, microsecond latency.  Half-open
+            # probes are admitted (and accounted) in _execute.
+            self.metrics.inc("shed.breaker")
+            raise BreakerOpenError(name, breaker.reset_timeout_s)
         # cast straight to the predictor's compiled dtype: one conversion
         # on the hot path, and _normalize's later asarray is then a no-op
         x = np.asarray(x, dtype=entry.predictor.dtype)
@@ -114,6 +150,13 @@ class GPServeServer:
             raise ValueError(
                 f"model {name!r} expects [t, {entry.predictor.n_features}] "
                 f"inputs; got shape {tuple(x.shape)}"
+            )
+        if not np.isfinite(x).all():
+            # poisoned payload rejected at the door: it must never occupy
+            # queue capacity or share a coalesced batch with healthy rows
+            self.metrics.inc("shed.poison")
+            raise ValueError(
+                f"request for model {name!r} contains non-finite values"
             )
         timeout_s = (
             timeout_ms / 1e3 if timeout_ms is not None
@@ -132,8 +175,10 @@ class GPServeServer:
         )
         try:
             future = self._queue.submit(request)
-        except Exception:
+        except Exception as exc:
             self.metrics.inc("shed")
+            if isinstance(exc, QueueFullError):
+                self.metrics.inc("queue.shed.backpressure")
             raise
         self.metrics.inc("requests")
         self.metrics.inc("requests_rows", x.shape[0])
@@ -160,16 +205,54 @@ class GPServeServer:
     # -- batch execution (batcher thread) ---------------------------------
     def _execute(self, group: List[PredictRequest]) -> None:
         """Score one coalesced same-model group: concatenate rows, one
-        bucketed predict, split the answers back per request."""
-        entry = self.registry.resolve(group[0].model_key)
-        rows = [req.x.shape[0] for req in group]
-        total = sum(rows)
-        x = (
-            group[0].x if len(group) == 1
-            else np.concatenate([req.x for req in group], axis=0)
-        )
+        bucketed predict, split the answers back per request.
+
+        The model's circuit breaker brackets the predict: an open breaker
+        rejects the group instantly (half-open admits one probe), a
+        raising predict counts toward tripping it, and a success closes
+        it — so a model whose compiled predict is broken stops consuming
+        batcher dispatches after ``breaker_threshold`` failures while
+        every other model keeps serving."""
+        name = group[0].model_key[0]
+        breaker = self._breaker_for(name)
+        # isolation re-runs are PAYLOAD probes of an already-counted batch
+        # failure: gating/accounting them would multi-count one poisoned
+        # episode, trip the breaker mid-loop, and error the innocent
+        # batchmates still waiting their turn (queue.py isolation_retry)
+        guarded = not group[0].isolation_retry
+        if guarded:
+            breaker.before_call()  # raises BreakerOpenError while open
+        try:
+            entry = self.registry.resolve(group[0].model_key)
+            rows = [req.x.shape[0] for req in group]
+            total = sum(rows)
+            x = (
+                group[0].x if len(group) == 1
+                else np.concatenate([req.x for req in group], axis=0)
+            )
+        except BaseException:
+            # pre-dispatch failure (e.g. the pinned version was evicted):
+            # not the model's predict misbehaving — release the admission
+            # (a half-open probe permit would otherwise leak and reject
+            # the model forever) without counting a breaker outcome
+            if guarded:
+                breaker.abort_call()
+            raise
         started = time.monotonic()
-        mean, var = entry.predict(x)
+        try:
+            mean, var = entry.predict(x)
+        except BaseException:
+            self.metrics.inc("predict.failures")
+            if guarded:
+                trips_before = breaker.trip_count
+                breaker.record_failure()
+                if breaker.trip_count > trips_before:
+                    self.metrics.inc("breaker.trips")
+                    self.metrics.set_gauge(f"breaker.open.{name}", 1.0)
+            raise
+        if guarded:
+            breaker.record_success()
+            self.metrics.set_gauge(f"breaker.open.{name}", 0.0)
         elapsed = time.monotonic() - started
         padded = entry.predictor.padded_rows(total)
         self.metrics.inc("batches")
@@ -201,4 +284,56 @@ class GPServeServer:
             "max_wait_ms": self._queue.max_wait_s * 1e3,
             "max_batch_rows": self._queue.max_batch_rows,
         }
+        snap["breakers"] = {
+            # copy first: reader threads insert breakers concurrently
+            name: b.snapshot() for name, b in sorted(dict(self._breakers).items())
+        }
         return snap
+
+    def health(self) -> dict:
+        """The ``/healthz`` answer: liveness, readiness, and per-component
+        degradation — cheap enough for an orchestrator to poll.
+
+        ``status``: ``"ok"`` (ready, all breakers closed),
+        ``"degraded"`` (serving, but at least one model's breaker is
+        open/half-open or the queue is above 90% capacity) or
+        ``"unready"`` (not started / no models).  A degraded server still
+        answers requests for its healthy models — that is the point.
+        """
+        breakers = {
+            # copy first: reader threads insert breakers concurrently
+            name: b.snapshot() for name, b in sorted(dict(self._breakers).items())
+        }
+        depth = self._queue.depth()
+        queue_pressure = depth / max(self._queue.capacity, 1)
+        broken = sorted(
+            name for name, b in breakers.items()
+            if b["state"] != CircuitBreaker.CLOSED
+        )
+        if not self.ready():
+            status = "unready"
+        elif broken or queue_pressure > 0.9:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "ready": self.ready(),
+            "models": self.registry.names(),
+            "broken_models": broken,
+            "breakers": breakers,
+            "queue": {
+                "depth": depth,
+                "capacity": self._queue.capacity,
+                "pressure": queue_pressure,
+            },
+            "counters": {
+                key: self.metrics.counter(key)
+                for key in (
+                    "requests", "batches", "shed", "timeouts",
+                    "queue.shed.deadline", "queue.shed.backpressure",
+                    "queue.poisoned", "shed.breaker", "shed.poison",
+                    "predict.failures", "breaker.trips",
+                )
+            },
+        }

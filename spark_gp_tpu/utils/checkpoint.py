@@ -1,19 +1,86 @@
-"""L-BFGS training-state checkpointing.
+"""Preemption-safe L-BFGS training-state checkpointing.
 
 JAX has no Spark-style lineage recomputation: if a long multi-host fit dies,
-the optimizer state is gone (SURVEY.md §5, failure detection).  This hook
-persists the current hyperparameter iterate each L-BFGS iteration so a
-restarted fit can resume from the best theta via
-``GaussianProcessRegression.setKernel(restored-kernel-with-theta0)`` or by
-passing ``theta0`` directly to the optimizer.
+the optimizer state is gone (SURVEY.md §5, failure detection).  This module
+persists optimizer state so a restarted fit resumes mid-run:
+
+* **host optimizer** — :class:`LbfgsCheckpointer` saves theta, the iterate
+  history window, the iteration count and the estimator seed each L-BFGS
+  iteration; ``models/common._optimize_hypers`` resumes from the persisted
+  iterate with the remaining iteration budget.
+* **device optimizer** — :class:`DeviceOptimizerCheckpointer` round-trips
+  the FULL ``_LbfgsState`` pytree between segments, so a killed fit
+  resumes bit-exactly (``tests/test_checkpoint.py``, chaos kill-and-resume).
+
+Durability contract (both writers): serialize to ``<path>.tmp``, fsync,
+``os.replace`` — a preemption at ANY instant leaves either the previous
+complete checkpoint or the new complete checkpoint, never a torn file.
+Every payload carries a content checksum; a checkpoint that fails it (disk
+corruption — atomicity rules out torn writes) raises
+:class:`CheckpointCorruptError`, and one written under a different kernel
+configuration raises :class:`CheckpointMismatchError` instead of silently
+seeding (or being clobbered by) the wrong fit.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 
 import numpy as np
+
+
+class CheckpointMismatchError(RuntimeError):
+    """The checkpoint on disk belongs to a different configuration
+    (kernel signature / theta shape) than the fit trying to resume from
+    it.  Clear the checkpoint directory or use a distinct one per
+    configuration."""
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint failed its content checksum — disk-level corruption
+    (the atomic write protocol rules out torn writes).  Delete the file to
+    restart the fit from scratch."""
+
+
+def _fsync_replace(tmp: str, path: str) -> None:
+    """The preemption-safe publish: flush ``tmp`` to stable storage, then
+    atomically rename over ``path`` and fsync the directory entry.  A kill
+    at any instant leaves a complete old or complete new checkpoint."""
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    dir_fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; rename is still atomic
+    finally:
+        os.close(dir_fd)
+
+
+def _payload_checksum(payload: dict) -> str:
+    """sha256 over the canonical JSON of everything except the checksum
+    field itself."""
+    body = {k: v for k, v in payload.items() if k != "checksum"}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def _npz_digest(arrays) -> str:
+    """sha256 over sorted ``name -> ndarray`` entries (the ``checksum``
+    entry excluded) — the one digest both the device writer and reader
+    must agree on byte-for-byte."""
+    digest = hashlib.sha256()
+    for key in sorted(k for k in arrays if k != "checksum"):
+        digest.update(key.encode())
+        digest.update(np.ascontiguousarray(arrays[key]).tobytes())
+    return digest.hexdigest()
 
 
 def kernel_signature(kernel, theta_dim: int) -> str:
@@ -24,40 +91,75 @@ def kernel_signature(kernel, theta_dim: int) -> str:
 
 
 class LbfgsCheckpointer:
-    """Callback for ``scipy.optimize.minimize``: saves theta every iteration.
+    """Callback for ``scipy.optimize.minimize``: saves the optimizer's
+    host-visible state every iteration.
 
     ``tag`` (the estimator class name) keys the file so GPR and GPC fits
-    sharing a directory cannot cross-contaminate.
+    sharing a directory cannot cross-contaminate.  Beyond theta the
+    payload carries the iteration count (the resume budget), a bounded
+    window of recent iterates (the L-BFGS history scipy walks — recorded
+    for diagnosis and for external warm-starting; scipy's own internal
+    curvature pairs are not injectable) and the estimator ``seed`` (the
+    fit's only RNG input — restart perturbations and active-set sampling
+    derive from it deterministically).
     """
 
-    def __init__(self, directory: str, kernel, tag: str = "gp") -> None:
+    HISTORY_WINDOW = 8
+
+    def __init__(
+        self, directory: str, kernel, tag: str = "gp",
+        seed: int | None = None,
+    ) -> None:
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"lbfgs_state_{tag}.json")
         self.kernel = kernel
+        self.seed = seed
         self.iteration = 0
+        self._history: list[list[float]] = []
 
     def __call__(self, theta) -> None:
         theta = np.asarray(theta, dtype=np.float64)
         self.iteration += 1
+        self._history.append(theta.tolist())
+        del self._history[: -self.HISTORY_WINDOW]
         payload = {
+            "format_version": 2,
             "iteration": self.iteration,
             "theta": theta.tolist(),
+            "history": list(self._history),
+            "seed": self.seed,
             "kernel": self.kernel.describe(theta),
             "kernel_sig": kernel_signature(self.kernel, theta.shape[0]),
         }
+        payload["checksum"] = _payload_checksum(payload)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(payload, fh)
-        os.replace(tmp, self.path)
+            fh.flush()
+        _fsync_replace(tmp, self.path)
 
 
 def load_checkpoint(directory: str, tag: str = "gp"):
-    """Returns ``(iteration, theta, kernel_sig)`` or ``None`` if absent."""
+    """Returns ``(iteration, theta, kernel_sig)`` or ``None`` if absent.
+
+    Raises :class:`CheckpointCorruptError` on a checksum failure (v2
+    payloads; v1 files predate checksums and load as-is)."""
     path = os.path.join(directory, f"lbfgs_state_{tag}.json")
     if not os.path.exists(path):
         return None
     with open(path) as fh:
-        payload = json.load(fh)
+        try:
+            payload = json.load(fh)
+        except ValueError as exc:
+            raise CheckpointCorruptError(
+                f"checkpoint {path} is not valid JSON: {exc}"
+            ) from exc
+    stored = payload.get("checksum")
+    if stored is not None and stored != _payload_checksum(payload):
+        raise CheckpointCorruptError(
+            f"checkpoint {path} failed its content checksum — delete it to "
+            "restart the fit from scratch"
+        )
     return (
         payload["iteration"],
         np.asarray(payload["theta"], dtype=np.float64),
@@ -89,9 +191,12 @@ class DeviceOptimizerCheckpointer:
         arrays["meta_json"] = np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8
         )
+        arrays["checksum"] = np.frombuffer(
+            _npz_digest(arrays).encode(), dtype=np.uint8
+        )
         tmp = self.path + ".tmp.npz"
         np.savez(tmp, **arrays)
-        os.replace(tmp, self.path)
+        _fsync_replace(tmp, self.path)
 
     def load(self, template_state, meta: dict):
         """Rebuild a state pytree from disk, or ``None`` when absent/stale.
@@ -107,6 +212,13 @@ class DeviceOptimizerCheckpointer:
         if not os.path.exists(self.path):
             return None
         with np.load(self.path) as npz:
+            if "checksum" in npz:
+                stored = bytes(npz["checksum"]).decode()
+                if stored != _npz_digest({k: npz[k] for k in npz.files}):
+                    raise CheckpointCorruptError(
+                        f"device checkpoint {self.path} failed its content "
+                        "checksum — delete it to restart the fit from scratch"
+                    )
             stored_meta = json.loads(bytes(npz["meta_json"]))
             template_leaves, treedef = jax.tree.flatten(template_state)
             if stored_meta != meta:

@@ -104,6 +104,11 @@ def pytest_configure(config):
         "slow: multi-subprocess artifact-contract guards (~30s each); "
         "deselect with -m 'not slow' for a quick loop",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (resilience/chaos.py) — "
+        "fast and tier-1; select with -m chaos for the resilience-only loop",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

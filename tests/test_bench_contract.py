@@ -11,6 +11,7 @@ import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -79,6 +80,13 @@ def test_bench_emits_one_parseable_result_line():
     assert serve["points_per_sec"] > 0
     assert 0 < serve["latency_p50_ms"] <= serve["latency_p99_ms"]
     assert all(c == 1 for c in serve["compiles_per_bucket"].values())
+    # the resilience section rode along: one NaN-poisoned expert is
+    # quarantined and the faulted fit completes at a sane overhead
+    res = detail["resilience"]
+    assert "error" not in res, res
+    assert res["experts_quarantined"] == 1
+    assert res["faulted_fit_seconds"] > 0
+    assert np.isfinite(res["faulted_final_nll_renormalized"])
 
 
 @pytest.mark.slow

@@ -153,8 +153,11 @@ def test_matmul_precision_knob(monkeypatch):
 
     monkeypatch.setenv("GP_MATMUL_PRECISION", "high")
     k = _spd_batch(2, 36, seed=9)
-    with jax.disable_jit():  # fresh trace so the knob is actually read
-        kinv, ld = _pallas_inv_logdet(jnp.asarray(k), interpret=True)
+    # fresh trace so the knob is actually read.  clear_caches, NOT
+    # disable_jit: pallas_call's interpret-mode impl re-enters itself
+    # unjitted on this jax version (0.4.37) and recurses to death.
+    jax.clear_caches()
+    kinv, ld = _pallas_inv_logdet(jnp.asarray(k), interpret=True)
     want_inv = np.linalg.inv(k.astype(np.float64))
     np.testing.assert_allclose(np.asarray(kinv), want_inv, atol=5e-4)
     np.testing.assert_allclose(

@@ -158,16 +158,23 @@ def test_estimator_poe_predictor_competitive_with_ppa(rng):
     assert var.shape == y_te.shape and np.all(var > 0)
 
 
-def test_poe_surfaces_non_pd_gram(rng):
-    """A non-PD expert gram must raise at build time with the advice every
-    other factorization path gives — never NaN predictions later."""
+def test_poe_singular_gram_repaired_or_surfaced(rng):
+    """A singular-but-PSD expert gram is repaired by the shared adaptive
+    jitter ladder (ops/linalg.py) at build time — finite predictions, not
+    NaN; a gram the ladder cannot repair (NaN input) still raises the
+    advice-bearing error every other factorization path gives."""
     from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
 
     x = np.zeros((12, 2))  # duplicate rows, zero-noise kernel: singular gram
     y = np.zeros(12)
     kernel = 1.0 * RBFKernel(0.7, 1e-6, 10)
+    poe = make_poe_predictor(kernel, kernel.init_theta(), x, y, 12)
+    mean, var = poe.predict_with_var(np.zeros((3, 2)))
+    assert np.isfinite(mean).all() and np.isfinite(var).all()
+
+    x_bad = np.full((12, 2), np.nan)  # irreparable: ladder exhausts
     with pytest.raises(NotPositiveDefiniteException):
-        make_poe_predictor(kernel, kernel.init_theta(), x, y, 12)
+        make_poe_predictor(kernel, kernel.init_theta(), x_bad, y, 12)
 
 
 def test_poe_validates(rng):

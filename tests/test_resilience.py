@@ -1,0 +1,433 @@
+"""Unit behavior of the resilience layer (spark_gp_tpu/resilience/):
+jitter-ladder boundaries, quarantine semantics + renormalization, the
+retry driver, the circuit breaker state machine, checkpoint integrity
+errors, and the serve-path shed/poison accounting.
+
+The end-to-end proofs (fit survives a poisoned expert, kill-and-resume,
+breaker under live traffic) live in tests/test_chaos.py.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.ops.linalg import (
+    JITTER_SCHEDULE,
+    NotPositiveDefiniteException,
+    cholesky_escalated,
+    psd_safe_cholesky_np,
+)
+from spark_gp_tpu.parallel.experts import group_for_experts
+from spark_gp_tpu.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    RetryBudgetExceededError,
+    retry_with_backoff,
+)
+from spark_gp_tpu.resilience.quarantine import (
+    ExpertQuarantineError,
+    diagnose_experts,
+    expert_health,
+    nonfinite_expert_mask,
+    quarantine_experts,
+)
+
+
+def _spd(n, rng, floor=1.0):
+    a = rng.normal(size=(n, n))
+    return a @ a.T + floor * np.eye(n)
+
+
+# -- jitter ladder boundaries (ISSUE satellite) ----------------------------
+
+
+def test_jitter_ladder_psd_needs_none(rng):
+    """A healthy SPD matrix factors at rung 0 — zero extra work."""
+    mat = _spd(12, rng)
+    chol, tau = cholesky_escalated(jnp.asarray(mat))
+    assert tau == 0.0
+    np.testing.assert_allclose(
+        np.asarray(chol), np.linalg.cholesky(mat), rtol=1e-10
+    )
+    # host path likewise: untouched factorization, no warning rung
+    np.testing.assert_allclose(
+        psd_safe_cholesky_np(mat, "t"), np.linalg.cholesky(mat), rtol=1e-10
+    )
+
+
+def test_jitter_ladder_fixed_at_rung_k(rng):
+    """A rank-deficient (PSD, singular) matrix is repaired at a finite
+    rung, and the factor reproduces the matrix to the jitter scale."""
+    a = rng.normal(size=(10, 4))
+    low = a @ a.T  # rank 4
+    chol, tau = cholesky_escalated(jnp.asarray(low))
+    assert 0.0 < tau <= JITTER_SCHEDULE[-1]
+    assert np.all(np.isfinite(np.asarray(chol)))
+    rebuilt = np.asarray(chol) @ np.asarray(chol).T
+    scale = np.trace(low) / low.shape[0]
+    np.testing.assert_allclose(rebuilt, low, atol=10 * tau * scale + 1e-12)
+    # host ladder repairs the same matrix
+    assert np.all(np.isfinite(psd_safe_cholesky_np(low, "t")))
+
+
+def test_jitter_ladder_exhausts(rng):
+    """A matrix no bounded diagonal boost can repair raises the advice-
+    bearing error on both the device and host paths — including the NaN
+    case, where LAPACK can hand back a NaN factor without erroring."""
+    indefinite = np.diag([1.0, -1e6])
+    for bad in (indefinite, np.full((3, 3), np.nan)):
+        with pytest.raises(NotPositiveDefiniteException):
+            cholesky_escalated(jnp.asarray(bad))
+        with pytest.raises(NotPositiveDefiniteException):
+            psd_safe_cholesky_np(bad, "t")
+
+
+def test_jitter_ladder_batched(rng):
+    """One bad matrix in a batch escalates the whole stack's rung; the
+    healthy matrices stay numerically intact (trace-relative boost)."""
+    good = _spd(6, rng)
+    a = rng.normal(size=(6, 2))
+    batch = np.stack([good, a @ a.T])
+    chol, tau = cholesky_escalated(jnp.asarray(batch))
+    assert tau > 0.0 and np.all(np.isfinite(np.asarray(chol)))
+    np.testing.assert_allclose(
+        np.asarray(chol[0]) @ np.asarray(chol[0]).T, good, rtol=1e-6
+    )
+
+
+# -- quarantine -----------------------------------------------------------
+
+
+def _stack(rng, n=120, s=30, poison=None, poison_labels=False):
+    x = rng.normal(size=(n, 3))
+    y = np.sin(x.sum(axis=1))
+    if poison is not None:
+        e = 4  # n=120, s=30 -> 4 experts
+        rows = np.arange(poison, n, e)
+        if poison_labels:
+            y[rows[::2]] = np.inf
+            y[rows[1::2]] = np.nan
+        else:
+            x[rows, 0] = np.nan
+    return group_for_experts(x, y, s)
+
+
+def test_nonfinite_expert_mask(rng):
+    data = _stack(rng, poison=2)
+    bad = nonfinite_expert_mask(data)
+    assert bad.tolist() == [False, False, True, False]
+    assert not nonfinite_expert_mask(_stack(rng)).any()
+
+
+def test_quarantine_renormalization_noop_matches_full_nll(rng):
+    """ISSUE satellite: with nothing dropped the quarantined objective IS
+    the full-expert objective — renorm factor exactly 1, identical NLL."""
+    from spark_gp_tpu.models.likelihood import batched_nll
+
+    data = _stack(rng)
+    kernel = (
+        GaussianProcessRegression().setKernel(lambda: RBFKernel(1.0))
+        ._get_kernel()
+    )
+    theta = kernel.init_theta()
+    report = diagnose_experts(kernel, theta, data)
+    assert report.clean and report.renorm == 1.0
+    same = quarantine_experts(data, report.bad)
+    assert same is data  # no copy, no graph change
+    nll_full = float(batched_nll(kernel, jnp.asarray(theta), data))
+    nll_q = float(batched_nll(kernel, jnp.asarray(theta), same))
+    assert nll_full == nll_q
+
+
+def test_quarantine_drops_only_the_poisoned_expert(rng):
+    from spark_gp_tpu.models.likelihood import batched_nll
+
+    data = _stack(rng, poison=1)
+    kernel = (
+        GaussianProcessRegression().setKernel(lambda: RBFKernel(1.0))
+        ._get_kernel()
+    )
+    theta = kernel.init_theta()
+    nll_e, grad_e = expert_health(kernel, theta, data)
+    assert not np.isfinite(nll_e[1])
+    assert np.isfinite(np.delete(nll_e, 1)).all()
+    assert np.isfinite(np.delete(grad_e, 1)).all()
+
+    report = diagnose_experts(kernel, theta, data)
+    assert report.bad.tolist() == [False, True, False, False]
+    assert report.renorm == pytest.approx(4 / 3)
+
+    clean = quarantine_experts(data, report.bad)
+    assert np.asarray(clean.mask)[1].sum() == 0  # inert
+    assert np.isfinite(np.asarray(clean.x)).all()  # benign replacement
+    total = float(batched_nll(kernel, jnp.asarray(theta), clean))
+    assert np.isfinite(total)
+    # the reduced sum is exactly the healthy experts' sum
+    assert total == pytest.approx(float(np.delete(nll_e, 1).sum()), rel=1e-12)
+
+
+def test_quarantine_sanitizes_nonfinite_labels(rng):
+    """Regression: labels must be zeroed by SELECTION, not multiplication —
+    IEEE NaN*0=NaN and inf*0=NaN, so ``y * keep`` let a label-poisoned
+    expert re-poison the very BCM sum quarantine had masked it out of."""
+    from spark_gp_tpu.models.likelihood import batched_nll
+
+    data = _stack(rng, poison=1, poison_labels=True)
+    kernel = (
+        GaussianProcessRegression().setKernel(lambda: RBFKernel(1.0))
+        ._get_kernel()
+    )
+    theta = kernel.init_theta()
+    report = diagnose_experts(kernel, theta, data)
+    assert report.bad.tolist() == [False, True, False, False]
+
+    clean = quarantine_experts(data, report.bad)
+    assert np.isfinite(np.asarray(clean.y)).all()  # NaN/inf labels gone
+    assert np.isfinite(np.asarray(clean.x)).all()
+    nll_e, _ = expert_health(kernel, theta, data)
+    total = float(batched_nll(kernel, jnp.asarray(theta), clean))
+    assert np.isfinite(total)
+    assert total == pytest.approx(float(np.delete(nll_e, 1).sum()), rel=1e-12)
+
+
+def test_quarantine_all_bad_raises(rng):
+    data = _stack(rng)
+    with pytest.raises(ExpertQuarantineError, match="every expert"):
+        quarantine_experts(data, np.ones(data.num_experts, dtype=bool))
+
+
+def test_diagnose_escalates_jitter_before_quarantine(rng):
+    """An exactly singular expert is repaired by a ladder rung, not
+    dropped (quarantine is the last resort, after jitter escalation)."""
+    x = rng.normal(size=(120, 3))
+    y = np.sin(x.sum(axis=1))
+    rows = np.arange(1, 120, 4)
+    x[rows] = x[rows[0]]  # expert 1: all points identical -> singular Gram
+    y[rows] = y[rows[0]]
+    data = group_for_experts(x, y, 30)
+    gp = (
+        GaussianProcessRegression().setKernel(lambda: RBFKernel(1.0))
+        .setSigma2(0.0)
+    )
+    kernel = gp._get_kernel()
+    report = diagnose_experts(kernel, kernel.init_theta(), data)
+    assert report.num_dropped == 0
+    assert report.num_jittered == 1 and report.jitter[1] > 0
+    assert report.renorm == 1.0
+
+
+# -- retry ----------------------------------------------------------------
+
+
+def test_retry_with_backoff_recovers_and_repairs():
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("boom")
+        return "done"
+
+    repaired = []
+    out = retry_with_backoff(
+        flaky, attempts=3, base_delay_s=0.01, retry_on=(ValueError,),
+        on_retry=lambda i, exc: repaired.append((i, str(exc))),
+        sleep=delays.append,
+    )
+    assert out == "done" and len(calls) == 3
+    assert repaired == [(0, "boom"), (1, "boom")]
+    assert delays == [0.01, 0.02]  # deterministic exponential backoff
+
+
+def test_retry_budget_exhausts_with_cause():
+    def always():
+        raise ValueError("persistent")
+
+    with pytest.raises(RetryBudgetExceededError) as err:
+        retry_with_backoff(
+            always, attempts=2, retry_on=(ValueError,), sleep=lambda _: None
+        )
+    assert isinstance(err.value.__cause__, ValueError)
+
+
+def test_retry_does_not_catch_foreign_errors():
+    def wrong():
+        raise KeyError("not retryable")
+
+    with pytest.raises(KeyError):
+        retry_with_backoff(wrong, attempts=3, retry_on=(ValueError,))
+
+
+# -- circuit breaker ------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    clock = [0.0]
+    b = CircuitBreaker("m", failure_threshold=2, reset_timeout_s=10.0,
+                       clock=lambda: clock[0])
+    assert b.state == CircuitBreaker.CLOSED
+    b.before_call(); b.record_failure()
+    b.before_call(); b.record_failure()       # second consecutive: trips
+    assert b.state == CircuitBreaker.OPEN and b.trip_count == 1
+    with pytest.raises(BreakerOpenError) as err:
+        b.before_call()
+    assert err.value.retry_after_s <= 10.0
+
+    clock[0] = 10.5                            # cooldown elapsed
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.before_call()                            # the single probe is admitted
+    with pytest.raises(BreakerOpenError):
+        b.before_call()                        # ...but only one
+    b.record_failure()                         # probe failed: re-open
+    assert b.state == CircuitBreaker.OPEN and b.trip_count == 2
+
+    clock[0] = 21.0
+    b.before_call()
+    b.record_success()                         # probe succeeded: closed
+    assert b.state == CircuitBreaker.CLOSED
+    b.before_call()                            # normal service resumes
+    snap = b.snapshot()
+    assert snap["state"] == "closed" and snap["trips"] == 2
+
+
+def test_breaker_success_resets_failure_count():
+    b = CircuitBreaker("m", failure_threshold=3, reset_timeout_s=1.0)
+    for _ in range(2):
+        b.record_failure()
+    b.record_success()
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED  # never 3 consecutive
+
+
+# -- checkpoint integrity -------------------------------------------------
+
+
+def test_host_checkpoint_checksum_and_history(tmp_path):
+    from spark_gp_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        LbfgsCheckpointer,
+        load_checkpoint,
+    )
+
+    kernel = RBFKernel(1.0)
+    ck = LbfgsCheckpointer(str(tmp_path), kernel, tag="t", seed=7)
+    for k in range(1, 4):
+        ck(np.array([float(k)]))
+    it, theta, _sig = load_checkpoint(str(tmp_path), tag="t")
+    assert it == 3 and theta[0] == 3.0
+    payload = json.loads((tmp_path / "lbfgs_state_t.json").read_text())
+    assert payload["seed"] == 7
+    assert payload["history"] == [[1.0], [2.0], [3.0]]
+    assert payload["format_version"] == 2
+
+    payload["theta"] = [999.0]  # tamper without updating the checksum
+    (tmp_path / "lbfgs_state_t.json").write_text(json.dumps(payload))
+    with pytest.raises(CheckpointCorruptError, match="checksum"):
+        load_checkpoint(str(tmp_path), tag="t")
+
+
+def test_host_checkpoint_mismatch_is_a_named_error(tmp_path):
+    """ISSUE satellite: resuming under a different kernel config raises
+    CheckpointMismatchError instead of silently proceeding."""
+    from spark_gp_tpu.utils.checkpoint import CheckpointMismatchError
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(120, 3))
+    y = np.sin(x.sum(axis=1))
+
+    def gp(kf):
+        return (
+            GaussianProcessRegression().setKernel(kf)
+            .setDatasetSizeForExpert(40).setActiveSetSize(30)
+            .setMaxIter(5).setOptimizer("host")
+            .setCheckpointDir(str(tmp_path))
+        )
+
+    gp(lambda: RBFKernel(1.0)).fit(x, y)
+    with pytest.raises(CheckpointMismatchError, match="different kernel"):
+        gp(lambda: 1.0 * RBFKernel(1.0, 1e-6, 10.0)).fit(x, y)
+
+
+def test_device_checkpoint_corruption_detected(tmp_path):
+    from spark_gp_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        DeviceOptimizerCheckpointer,
+    )
+
+    saver = DeviceOptimizerCheckpointer(str(tmp_path), "t")
+    state = {"a": np.arange(4.0), "b": np.ones((2, 2))}
+    saver.save(state, {"kind": "t"})
+    assert saver.load(state, {"kind": "t"}) is not None
+
+    # flip bytes in one stored leaf, keeping the archive loadable
+    with np.load(saver.path) as npz:
+        arrays = {k: npz[k].copy() for k in npz.files}
+    arrays["leaf_0"][0] = 12345.0
+    np.savez(saver.path.replace(".npz", ""), **arrays)
+    with pytest.raises(CheckpointCorruptError, match="checksum"):
+        saver.load(state, {"kind": "t"})
+
+
+# -- serve-path shed accounting ------------------------------------------
+
+
+def test_deadline_shed_metric_and_structured_error():
+    from spark_gp_tpu.serve.queue import (
+        DeadlineExpiredError,
+        MicroBatchQueue,
+        PredictRequest,
+    )
+
+    sheds = []
+    q = MicroBatchQueue(
+        execute=lambda group: None, capacity=8,
+        on_timeout=lambda n: sheds.append(n),
+    )
+    req = PredictRequest(("m", 1), np.zeros((1, 2)), deadline=-1.0)
+    q.start()
+    try:
+        fut = q.submit(req)
+        with pytest.raises(DeadlineExpiredError) as err:
+            fut.result(timeout=5.0)
+        assert err.value.code == "queue.shed.deadline"
+        assert sheds == [1]
+    finally:
+        q.stop()
+
+
+def test_poisoned_request_isolated_not_the_batch():
+    from spark_gp_tpu.serve.queue import MicroBatchQueue, PredictRequest
+
+    poisoned_counts = []
+
+    def execute(group):
+        for req in group:
+            if np.isnan(req.x).any():
+                raise RuntimeError("poisoned payload")
+        for req in group:
+            req.future.set_result(req.x.sum())
+
+    q = MicroBatchQueue(
+        execute=execute, capacity=16, max_wait_s=0.05, max_batch_rows=64,
+        on_poison=poisoned_counts.append,
+    )
+    good1 = PredictRequest(("m", 1), np.ones((2, 2)))
+    bad = PredictRequest(("m", 1), np.full((2, 2), np.nan))
+    good2 = PredictRequest(("m", 1), np.full((2, 2), 2.0))
+    # enqueue BEFORE starting the worker so all three coalesce into one batch
+    for req in (good1, bad, good2):
+        q.submit(req)
+    q.start()
+    try:
+        assert good1.future.result(timeout=5.0) == 4.0
+        assert good2.future.result(timeout=5.0) == 8.0
+        with pytest.raises(RuntimeError, match="poisoned"):
+            bad.future.result(timeout=5.0)
+        assert poisoned_counts == [1]
+    finally:
+        q.stop()
