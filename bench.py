@@ -38,7 +38,11 @@ BENCH_SERVE_REQUESTS (200) / BENCH_SERVE_MIX ("1,4,16,100": the
 serve_predict section's closed-burst request sizes through the
 spark_gp_tpu.serve micro-batcher — p50/p99 latency and points/sec),
 BENCH_PREFLIGHT_TIMEOUT (150 s), BENCH_PREFLIGHT_ATTEMPTS (4),
-BENCH_WORKER_TIMEOUT (2400 s), BENCH_PALLAS_SWEEP / BENCH_AIRFOIL /
+BENCH_WORKER_TIMEOUT (2400 s), BENCH_PRECISION_LANES ("1" [default]:
+the strict/mixed/fast mixed-precision lane section — gram-build GFLOP/s,
+end-to-end fit rate and the fit-time guard deltas per lane; any other
+value skips it) / BENCH_GRAM_N (gram-probe rows, default min(2048, N)),
+BENCH_PALLAS_SWEEP / BENCH_AIRFOIL /
 BENCH_SCALING_N / BENCH_SYNCED_BREAKDOWN / BENCH_MFU_CURVE (TPU only: "1"
 [default] appends the Pallas-vs-XLA expert-size sweep / the airfoil
 10-fold parity bar / the N-linearity curve / the synced phase-breakdown
@@ -318,6 +322,8 @@ def worker() -> None:
 
     throughput = n / fit_seconds
 
+    from spark_gp_tpu.ops.precision import active_lane
+
     # ONE definition of the primary payload, shared by the immediate
     # partial emit below and the full result dict later — the supervisor
     # treats whichever line is last as THE measurement, so the two must
@@ -330,6 +336,9 @@ def worker() -> None:
         "fit_seconds": fit_seconds,
         "lbfgs_evals": nfev,
         "platform": platform,
+        # the precision lane the primary fit ran on (ops/precision.py);
+        # per-lane numbers live in detail.precision_lanes
+        "precision_lane": active_lane(),
     }
 
     # Emit the primary metric NOW, before any secondary work: the
@@ -516,6 +525,123 @@ def worker() -> None:
     except Exception as exc:  # noqa: BLE001 — secondary metric only
         resilience = {"error": f"{type(exc).__name__}: {exc}"[:200]}
 
+    # Mixed-precision lanes (the ISSUE 3 MXU lane): the SAME workload at
+    # strict / mixed / fast (ops/precision.py), reporting the gram-build
+    # rate (the contraction the lanes actually change), the end-to-end fit
+    # rate, and the fit-time guard deltas.  The acceptance bar — mixed
+    # gram-build >= 1.5x strict — is asserted on TPU rounds only; CPU
+    # rounds record the numbers (the compensated path is EXTRA work for a
+    # CPU, which emulates nothing — expect < 1x there) so the contract
+    # test can pin the artifact's shape.
+    def _precision_lanes_section():
+        import jax as _jax
+        from functools import partial as _partial
+
+        from spark_gp_tpu.ops.distance import sq_dist
+        from spark_gp_tpu.ops.precision import (
+            precision_lane_scope,
+            set_precision_lane,
+        )
+
+        # clamped to the rows that exist: x[:n_g] would silently truncate
+        # a larger request and the FLOP count would overstate the rate
+        n_g = min(int(os.environ.get("BENCH_GRAM_N", min(2048, n))), n)
+        xg = np.asarray(x[:n_g], dtype=np.float32)
+        gram_flops = 2.0 * n_g * n_g * xg.shape[1]
+
+        @_partial(_jax.jit, static_argnames=("lane",))
+        def gram_probe(xs, *, lane):
+            with precision_lane_scope(lane):
+                return sq_dist(xs, xs)
+
+        def time_gram(lane_name):
+            xs = _jax.numpy.asarray(xg)
+            _jax.block_until_ready(gram_probe(xs, lane=lane_name))  # compile
+            reps = 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = gram_probe(xs, lane=lane_name)
+            _jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / reps
+
+        lanes = {}
+        # capture the ambient lane BEFORE clearing the process override —
+        # the primary fit ran at it, and it names the 'primary
+        # measurement' row below
+        ambient = active_lane()
+        prev = set_precision_lane(None)
+        try:
+            for lane_name in ("strict", "mixed", "fast"):
+                set_precision_lane(lane_name)
+                row = {}
+                gram_s = time_gram(lane_name)
+                row["gram_build_gflops_per_sec"] = gram_flops / gram_s / 1e9
+                if lane_name == ambient:
+                    # the primary fit IS this lane's end-to-end number
+                    row.update({
+                        "fit_seconds": fit_seconds,
+                        "train_points_per_sec": round(throughput, 1),
+                        "lbfgs_evals": nfev,
+                        "source": "primary measurement",
+                    })
+                else:
+                    # a lane's fit may legitimately die on real hardware
+                    # (the fast 1-pass gram can NaN the L-BFGS line
+                    # search -> NonFiniteFitError, PR 2); record it in
+                    # THIS row instead of voiding the other lanes' numbers
+                    try:
+                        make_gp(1).fit(x, y)  # warm-up/compile at this lane
+                        t0 = time.perf_counter()
+                        m_l = make_gp(max_iter).fit(x, y)
+                        dt = time.perf_counter() - t0
+                        row.update({
+                            "fit_seconds": dt,
+                            "train_points_per_sec": round(n / dt, 1),
+                            "lbfgs_evals": int(
+                                m_l.instr.metrics.get("lbfgs_nfev", 1)
+                            ),
+                        })
+                        guard = {
+                            k.split(".", 1)[1]: v
+                            for k, v in m_l.instr.metrics.items()
+                            if k.startswith("mixed_precision_guard.")
+                        }
+                        if guard:
+                            row["guard"] = guard
+                    except Exception as exc:  # noqa: BLE001
+                        row["fit_error"] = (
+                            f"{type(exc).__name__}: {exc}"[:200]
+                        )
+                lanes[lane_name] = row
+        finally:
+            set_precision_lane(prev)
+        strict_rate = lanes["strict"]["gram_build_gflops_per_sec"]
+        for lane_name in ("mixed", "fast"):
+            lanes[lane_name]["gram_speedup_vs_strict"] = (
+                lanes[lane_name]["gram_build_gflops_per_sec"] / strict_rate
+            )
+        return {
+            "gram_probe": {"n": n_g, "p": int(xg.shape[1]),
+                           "flops_per_call": gram_flops},
+            "lanes": lanes,
+            "note": (
+                "gram build = f32 sq-dist contraction at each lane "
+                "(strict: 6-pass HIGHEST; mixed: ~3-pass compensated "
+                "split-bf16; fast: 1-pass).  Speedup is only expected on "
+                "MXU hardware — on CPU the compensated path is strictly "
+                "extra work.  guard = fit-time mixed_precision_guard "
+                "relative deltas vs the strict lane (models/common.py)."
+            ),
+        }
+
+    if os.environ.get("BENCH_PRECISION_LANES", "1") == "1":
+        try:
+            precision_lanes = _precision_lanes_section()
+        except Exception as exc:  # noqa: BLE001 — secondary metric only
+            precision_lanes = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    else:
+        precision_lanes = {"skipped": "BENCH_PRECISION_LANES != 1"}
+
     def _classifier_fit_seconds(estimator_cls, labels):
         """Warm-up + timed fit of a classifier at the same shape/config as
         the primary metric (one definition, so the binary and multiclass
@@ -623,6 +749,7 @@ def worker() -> None:
             **({"predict_error": predict_error} if predict_error else {}),
             "serve_predict": serve_predict,
             "resilience": resilience,
+            "precision_lanes": precision_lanes,
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,
             "cpu_proxy_host_cores": host_cores,

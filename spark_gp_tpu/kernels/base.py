@@ -12,6 +12,10 @@ under JAX tracing and would forfeit autodiff.  Here a kernel is an immutable
   ScalarTimesKernel.scala:78-84);
 * ``gram`` / ``cross`` / ``diag`` / ``self_diag`` are pure functions of
   ``(theta, X)``, safe under ``jit``, ``vmap``, ``shard_map`` and ``grad``;
+  their heavy contractions route through :mod:`spark_gp_tpu.ops.distance`,
+  which selects the MXU precision from the framework-wide lane policy
+  (:mod:`spark_gp_tpu.ops.precision`) — kernel code never pins a raw
+  ``lax.Precision`` (enforced by ``tools/check_precision_pins.py``);
 * derivatives w.r.t. ``theta`` come from autodiff — there is no analogue of
   ``trainingKernelAndDerivative``'s hand algebra to maintain (the reference's
   finite-difference kernel tests are kept as oracles in ``tests/``).

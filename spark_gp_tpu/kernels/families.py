@@ -19,8 +19,11 @@ specs compatible with the composition DSL, jit-static hashing, and autodiff
 
 All members ride the MXU: RationalQuadratic through
 :func:`spark_gp_tpu.ops.distance.sq_dist`, Periodic through a cos/sin
-feature-map matmul, the dot-product members through one ``dot_general`` at
-HIGHEST precision.  None of them takes a distance ``sqrt``, so Matérn's
+feature-map matmul, the dot-product members through one contraction — all
+via :func:`spark_gp_tpu.ops.distance.mxu_inner`, so every family sits on
+the precision policy's gram lane (``ops/precision.py``: HIGHEST on
+``strict``, the compensated split-bf16 path on ``mixed``) with zero
+per-kernel plumbing.  None of them takes a distance ``sqrt``, so Matérn's
 coincident-point guard (:data:`spark_gp_tpu.kernels.matern._R2_FLOOR`) has
 no analogue here — every formula is smooth in ``theta`` at r = 0.
 """
@@ -33,7 +36,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_gp_tpu.kernels.base import ARDHypers, Kernel, StationaryKernel
-from spark_gp_tpu.ops.distance import mxu_inner, sq_dist, weighted_sq_dist
+from spark_gp_tpu.ops.distance import (
+    mxu_inner,
+    sq_dist,
+    sq_dist_self,
+    weighted_sq_dist,
+)
 
 
 def _pair(value, default: float) -> tuple:
@@ -93,7 +101,7 @@ class RationalQuadraticKernel(_TwoHyperStationary):
         return jnp.exp(-alpha * jnp.log(base))
 
     def gram(self, theta, x):
-        return self._k(theta, sq_dist(x, x))
+        return self._k(theta, sq_dist_self(x))
 
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))

@@ -32,7 +32,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_gp_tpu.kernels.base import ARDHypers, ScalarLengthscaleHypers
-from spark_gp_tpu.ops.distance import sq_dist, weighted_sq_dist
+from spark_gp_tpu.ops.distance import (
+    sq_dist,
+    sq_dist_self,
+    weighted_sq_dist,
+    weighted_sq_dist_self,
+)
 
 _R2_FLOOR = 1e-24  # sqrt grad guard; sqrt(floor) = 1e-12 off the true diag
 
@@ -64,7 +69,7 @@ class _MaternIso(ScalarLengthscaleHypers):
         return _matern_of_a(self._nu2, a)
 
     def gram(self, theta, x):
-        return self._k(theta, sq_dist(x, x))
+        return self._k(theta, sq_dist_self(x))
 
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))
@@ -100,15 +105,15 @@ class _MaternARD(ARDHypers):
 
     _nu2: int
 
-    def _k(self, theta, x_a, x_b):
-        a = math.sqrt(self._nu2) * _safe_r(weighted_sq_dist(x_a, x_b, theta))
+    def _of_sqd(self, theta, sqd):
+        a = math.sqrt(self._nu2) * _safe_r(sqd)
         return _matern_of_a(self._nu2, a)
 
     def gram(self, theta, x):
-        return self._k(theta, x, x)
+        return self._of_sqd(theta, weighted_sq_dist_self(x, theta))
 
     def cross(self, theta, x_test, x_train):
-        return self._k(theta, x_test, x_train)
+        return self._of_sqd(theta, weighted_sq_dist(x_test, x_train, theta))
 
     def describe(self, theta) -> str:
         vals = ", ".join(f"{v:.1e}" for v in np.asarray(theta))

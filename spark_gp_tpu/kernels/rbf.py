@@ -17,7 +17,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_gp_tpu.kernels.base import ARDHypers, ScalarLengthscaleHypers
-from spark_gp_tpu.ops.distance import sq_dist, weighted_sq_dist
+from spark_gp_tpu.ops.distance import (
+    sq_dist,
+    sq_dist_self,
+    weighted_sq_dist,
+    weighted_sq_dist_self,
+)
 
 
 class RBFKernel(ScalarLengthscaleHypers):
@@ -30,7 +35,7 @@ class RBFKernel(ScalarLengthscaleHypers):
         return jnp.exp(sqd / (-2.0 * sigma * sigma))
 
     def gram(self, theta, x):
-        return self._k(theta, sq_dist(x, x))
+        return self._k(theta, sq_dist_self(x))
 
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))
@@ -49,7 +54,7 @@ class ARDRBFKernel(ARDHypers):
     """
 
     def gram(self, theta, x):
-        return jnp.exp(-weighted_sq_dist(x, x, theta))
+        return jnp.exp(-weighted_sq_dist_self(x, theta))
 
     def cross(self, theta, x_test, x_train):
         return jnp.exp(-weighted_sq_dist(x_test, x_train, theta))

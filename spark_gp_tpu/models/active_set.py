@@ -193,9 +193,14 @@ def _lloyd(x, centroids, max_iter, mask=None, psum=None):
         if mask is not None:
             onehot = onehot * mask[:, None]
         counts = jnp.sum(onehot, axis=0)  # [k]
+        # linalg-stage precision from the policy (ops/precision.py), not a
+        # raw HIGHEST pin: a one-hot scatter-sum has no cancellation, so
+        # it rides the same lane as the other non-gram matmuls
+        from spark_gp_tpu.ops.precision import matmul_precision
+
         sums = jax.lax.dot_general(
             onehot, x, (((0,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=matmul_precision(),
         )  # [k, p]
         if psum is not None:
             # one fused all-reduce per Lloyd step (latency over ICI)

@@ -192,6 +192,28 @@ class GaussianProcessParams:
         self._fit_retries = int(value)
         return self
 
+    def setPrecisionLane(self, value: str):
+        """Mixed-precision lane for the MXU contractions
+        (:mod:`spark_gp_tpu.ops.precision`): ``"strict"`` (default —
+        HIGHEST everywhere, today's exact numerics), ``"mixed"``
+        (compensated split-bf16 gram/cross builds + 3-pass bf16x3 linalg
+        matmuls — ~2x the matmul-rate ceiling with accuracy recovered
+        structurally), or ``"fast"`` (1-pass bf16 gram builds —
+        experiments only).  Cholesky, triangular solves and the f64 PPA
+        statistics are lane-immune.  The setter is a fluent veneer over
+        the PROCESS-wide knob (``set_precision_lane`` /
+        ``GP_PRECISION_LANE``): lanes are resolved into the fit/predict
+        programs' jit keys, so the setting takes effect from the next
+        fit on.  Every fit at a non-default lane emits a
+        ``mixed_precision_guard`` artifact (max relative |delta NLL| /
+        |delta grad| / |delta predict| vs the strict lane on a probe
+        expert) into its Instrumentation metrics, with a loud warning
+        when the lane's accuracy bar is breached."""
+        from spark_gp_tpu.ops.precision import set_precision_lane
+
+        set_precision_lane(value)
+        return self
+
     def setOptimizer(self, value: str):
         """``"host"`` — SciPy L-BFGS-B driving the jitted objective (one
         device dispatch per evaluation; bitwise closest to the reference's
@@ -258,6 +280,7 @@ class GaussianProcessParams:
     set_checkpoint_dir = setCheckpointDir
     set_checkpoint_interval = setCheckpointInterval
     set_optimizer = setOptimizer
+    set_precision_lane = setPrecisionLane
     set_hyper_space = setHyperSpace
     set_num_restarts = setNumRestarts
     set_expert_quarantine = setExpertQuarantine
@@ -965,19 +988,27 @@ class GaussianProcessCommons(GaussianProcessParams):
             u1 = np.asarray(u1)
             u2 = np.asarray(u2)
 
-        return self._build_predictor(instr, kernel, theta_opt, active, u1, u2)
+        return self._build_predictor(
+            instr, kernel, theta_opt, active, u1, u2, data=data
+        )
 
     def _build_predictor(
-        self, instr: Instrumentation, kernel: Kernel, theta, active, u1, u2
+        self, instr: Instrumentation, kernel: Kernel, theta, active, u1, u2,
+        data: Optional[ExpertData] = None,
     ) -> ppa.ProjectedProcessRawPredictor:
         """Shared tail of both fit paths: the host f64 magic solve
-        (PGPH.scala:49-60) and the serializable raw predictor."""
+        (PGPH.scala:49-60) and the serializable raw predictor.  ``data``
+        (the fitted expert stack, when the caller has it) feeds the
+        fit-time mixed-precision guard below."""
         active64 = np.asarray(active, dtype=np.float64)
         with instr.phase("magic_solve"):
             magic_vector, magic_matrix = ppa.magic_solve(
                 kernel, theta, active64, u1, u2, mesh=self._mesh,
                 with_variance=self._predictive_variance,
             )
+        self._emit_precision_guard(
+            instr, kernel, theta, active64, magic_vector, data
+        )
         keep_stats = self._keeps_update_statistics
         return ppa.ProjectedProcessRawPredictor(
             kernel=kernel,
@@ -994,6 +1025,114 @@ class GaussianProcessCommons(GaussianProcessParams):
             u1=np.asarray(u1, dtype=np.float64) if keep_stats else None,
             u2=np.asarray(u2, dtype=np.float64) if keep_stats else None,
         )
+
+    def _emit_precision_guard(
+        self, instr, kernel, theta, active64, magic_vector, data
+    ) -> None:
+        """The fit-time accuracy tripwire of the mixed-precision lanes.
+
+        At any non-``strict`` lane (ops/precision.py), re-evaluate the
+        objective, its gradient, and the posterior mean on ONE probe
+        expert under both the fitted lane and ``strict``, and publish the
+        relative deltas as ``mixed_precision_guard.*`` metrics — so a bad
+        lane choice (a kernel/data combination whose cancellation the
+        compensated path cannot carry) is detected AT FIT TIME with a
+        loud warning, not discovered as drift in production predictions.
+        The probe is one expert and <= 32 predict rows: O(s^2) work, noise
+        next to the fit itself.  bench.py forwards the deltas into its
+        ``precision_lanes`` artifact."""
+        from spark_gp_tpu.ops.precision import GUARD_BARS, active_lane
+
+        lane = active_lane()
+        instr.metrics["precision_lane"] = lane
+        if lane == "strict" or data is None:
+            return
+        import jax
+
+        if jax.process_count() > 1:
+            # probing needs the first expert's rows on this host, which a
+            # cross-process sharding cannot satisfy (same restriction as
+            # the quarantine data screen) — skip rather than crash
+            instr.log_warning(
+                "mixed_precision_guard skipped: the stack spans "
+                f"{jax.process_count()} processes and cannot be "
+                "host-probed"
+            )
+            return
+        import jax.numpy as jnp
+
+        from spark_gp_tpu.models.likelihood import guard_probe_value_and_grad
+        from spark_gp_tpu.models.ppa import guard_probe_predict_mean
+
+        dtype = data.x.dtype
+        x_p = data.x[:1]
+        # multi-head latent targets ([E, s, C], the multiclass stacks)
+        # probe head 0 — this is a numeric delta probe, not a statistic
+        y_p = data.y[:1] if data.y.ndim == 2 else data.y[:1, :, 0]
+        mask_p = data.mask[:1]
+        theta_p = jnp.asarray(np.asarray(theta), dtype=dtype)
+        active_p = jnp.asarray(active64, dtype=dtype)
+        mv = np.asarray(magic_vector)
+        mv_p = jnp.asarray(mv if mv.ndim == 1 else mv[:, 0], dtype=dtype)
+        x_rows = data.x[0][: min(32, data.x.shape[1])]
+
+        def probes(lane_name):
+            nll, grad = guard_probe_value_and_grad(
+                kernel, theta_p, x_p, y_p, mask_p, lane=lane_name
+            )
+            mean = guard_probe_predict_mean(
+                kernel, theta_p, active_p, mv_p, x_rows, lane=lane_name
+            )
+            return (
+                float(np.asarray(nll)),
+                np.asarray(grad, dtype=np.float64),
+                np.asarray(mean, dtype=np.float64),
+            )
+
+        nll_s, grad_s, mean_s = probes("strict")
+        nll_l, grad_l, mean_l = probes(lane)
+
+        def rel(delta, scale):
+            return float(delta / max(scale, 1e-30))
+
+        # Each leg's denominator is floored at a problem-scale quantity,
+        # not just 1e-30: |nll_strict| crosses zero when log|K| cancels
+        # the quadratic term, max|grad_strict| is near zero when the
+        # probe expert sits at a stationary point of ITS own NLL, and a
+        # zero-mean posterior makes max|mean_strict| tiny — any of these
+        # would blow a healthy O(eps) absolute delta into a spurious
+        # breach.  The per-point NLL contribution is O(1), so the probe's
+        # row count floors the NLL and gradient legs; the probe labels'
+        # RMS floors the predict leg.
+        nll_scale = max(
+            abs(nll_s), float(np.asarray(mask_p, dtype=np.float64).sum()), 1.0
+        )
+        y_scale = float(
+            np.sqrt(np.mean(np.square(np.asarray(y_p, dtype=np.float64))))
+        )
+        d_nll = rel(abs(nll_l - nll_s), nll_scale)
+        d_grad = rel(
+            float(np.max(np.abs(grad_l - grad_s), initial=0.0)),
+            max(float(np.max(np.abs(grad_s), initial=0.0)), nll_scale),
+        )
+        d_pred = rel(
+            float(np.max(np.abs(mean_l - mean_s), initial=0.0)),
+            max(float(np.max(np.abs(mean_s), initial=0.0)), y_scale),
+        )
+        instr.log_metric("mixed_precision_guard.delta_nll_rel", d_nll)
+        instr.log_metric("mixed_precision_guard.delta_grad_rel", d_grad)
+        instr.log_metric("mixed_precision_guard.delta_predict_rel", d_pred)
+        bar = GUARD_BARS.get(lane, 1e-3)
+        worst = max(d_nll, d_grad, d_pred)
+        breach = float(not np.isfinite(worst) or worst > bar)
+        instr.log_metric("mixed_precision_guard.breach", breach)
+        if breach:
+            instr.log_warning(
+                f"mixed_precision_guard: lane {lane!r} deviates from the "
+                f"strict lane beyond its bar ({worst:.3e} > {bar:.1e}) on "
+                "the probe expert — this kernel/data combination should "
+                "run on the strict lane (setPrecisionLane('strict'))"
+            )
 
     def _finalize_device_fit(
         self,
@@ -1091,5 +1230,7 @@ class GaussianProcessCommons(GaussianProcessParams):
             )
         instr.log_info("Optimal kernel: " + kernel.describe(theta64))
 
-        raw = self._build_predictor(instr, kernel, theta64, active64, u1, u2)
+        raw = self._build_predictor(
+            instr, kernel, theta64, active64, u1, u2, data=data
+        )
         return raw, fetched

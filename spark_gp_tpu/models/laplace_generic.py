@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from spark_gp_tpu.kernels.base import Kernel
 from spark_gp_tpu.ops.linalg import masked_kernel_matrix
+from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 
 
 class Likelihood:
@@ -488,7 +489,12 @@ def generic_device_segment_init(
     return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+# the L-BFGS state carry is donated — consumed once per segment and
+# replaced by the return value (optimize/lbfgs_device.lbfgs_state_donation)
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3, 4),
+    donate_argnums=lbfgs_state_donation(5),
+)
 def generic_device_segment_run(
     lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
     state, lower, upper, x, y, mask, iter_limit,

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 
 from spark_gp_tpu.kernels.base import Kernel
 from spark_gp_tpu.ops.linalg import masked_kernel_matrix
+from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 
 
 def _batched_spd_inv_logdet(mats):
@@ -422,7 +423,12 @@ def gpc_mc_device_segment_init(
     return lbfgs_init_state(vag, t0, jnp.zeros_like(y1h))
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+# the L-BFGS state carry is donated — consumed once per segment and
+# replaced by the return value (optimize/lbfgs_device.lbfgs_state_donation)
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3),
+    donate_argnums=lbfgs_state_donation(4),
+)
 def gpc_mc_device_segment_run(
     kernel: Kernel, tol, mesh, log_space, state, lower, upper, x, y1h, mask,
     iter_limit,

@@ -36,8 +36,18 @@ from spark_gp_tpu.ops.linalg import (
     cholesky,
     masked_kernel_matrix,
 )
+from spark_gp_tpu.ops.precision import active_lane, precision_lane_scope
+from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 from spark_gp_tpu.parallel.experts import ExpertData
 from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+
+# Every jitted fit entry point below carries the resolved precision lane
+# (ops/precision.py) as a STATIC argument and re-pins it with
+# precision_lane_scope during its trace: the lane is thereby part of the
+# jit cache key, so set_precision_lane / GP_PRECISION_LANE switches
+# between fits compile fresh executables instead of silently reusing the
+# old lane's programs.  Public wrappers resolve lane=None to the ambient
+# lane at CALL time.
 
 
 def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None):
@@ -134,13 +144,15 @@ def objective_fn(objective: str):
     )
 
 
-@partial(jax.jit, static_argnums=0, static_argnames=("objective",))
+@partial(jax.jit, static_argnums=0, static_argnames=("objective", "lane"))
 def _vag_impl(
-    kernel: Kernel, theta, x, y, mask, extra=(), *, objective="marginal"
+    kernel: Kernel, theta, x, y, mask, extra=(), *, objective="marginal",
+    lane=None,
 ):
-    data = ExpertData(x=x, y=y, mask=mask)
-    obj = objective_fn(objective)
-    return jax.value_and_grad(lambda t: obj(kernel, t, data, *extra))(theta)
+    with precision_lane_scope(lane):
+        data = ExpertData(x=x, y=y, mask=mask)
+        obj = objective_fn(objective)
+        return jax.value_and_grad(lambda t: obj(kernel, t, data, *extra))(theta)
 
 
 def make_value_and_grad(
@@ -157,10 +169,23 @@ def make_value_and_grad(
         theta = jnp.asarray(theta, dtype=data.x.dtype)
         return _vag_impl(
             kernel, theta, data.x, data.y, data.mask, extra,
-            objective=objective,
+            objective=objective, lane=active_lane(),
         )
 
     return vag
+
+
+@partial(jax.jit, static_argnums=0, static_argnames=("lane",))
+def guard_probe_value_and_grad(kernel: Kernel, theta, x, y, mask, *, lane):
+    """(NLL, grad) of one probe expert stack at an EXPLICIT lane — the
+    fit-time mixed_precision_guard's objective probe (models/common.py).
+    ``lane`` is static, so the strict and non-strict evaluations compile
+    as separate executables and can be compared within one process."""
+    with precision_lane_scope(lane):
+        data = ExpertData(x=x, y=y, mask=mask)
+        return jax.value_and_grad(
+            lambda t: batched_nll(kernel, t, data)
+        )(theta)
 
 
 def _make_sharded_vag(kernel: Kernel, mesh, objective: str = "marginal"):
@@ -199,11 +224,13 @@ def _make_sharded_vag(kernel: Kernel, mesh, objective: str = "marginal"):
     return sharded
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective",))
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective", "lane"))
 def _sharded_vag_impl(
-    kernel: Kernel, mesh, theta, x, y, mask, *, objective="marginal"
+    kernel: Kernel, mesh, theta, x, y, mask, *, objective="marginal",
+    lane=None,
 ):
-    return _make_sharded_vag(kernel, mesh, objective)(theta, x, y, mask)
+    with precision_lane_scope(lane):
+        return _make_sharded_vag(kernel, mesh, objective)(theta, x, y, mask)
 
 
 def make_sharded_value_and_grad(
@@ -222,7 +249,7 @@ def make_sharded_value_and_grad(
         theta = jnp.asarray(theta, dtype=data.x.dtype)
         return _sharded_vag_impl(
             kernel, mesh, theta, data.x, data.y, data.mask,
-            objective=objective,
+            objective=objective, lane=active_lane(),
         )
 
     return vag
@@ -231,64 +258,99 @@ def make_sharded_value_and_grad(
 # --- fully on-device fits: the entire L-BFGS loop is ONE dispatch ---------
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective",))
-def fit_gpr_device(
+@partial(
+    jax.jit, static_argnums=(0, 1), static_argnames=("objective", "lane")
+)
+def _fit_gpr_device_impl(
     kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter,
-    tol, extra=(), *, objective="marginal",
+    tol, extra=(), *, objective="marginal", lane=None,
 ):
-    """Single-chip on-device fit: objective + projected L-BFGS in one XLA
-    program.  Returns (theta_opt, final_nll, n_iter, n_fev, stalled)."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
     )
 
-    data = ExpertData(x=x, y=y, mask=mask)
-    obj = objective_fn(objective)
+    with precision_lane_scope(lane):
+        data = ExpertData(x=x, y=y, mask=mask)
+        obj = objective_fn(objective)
 
-    def vag(theta, aux):
-        value, grad = jax.value_and_grad(
-            lambda t: obj(kernel, t, data, *extra)
-        )(theta)
-        return value, grad, aux
+        def vag(theta, aux):
+            value, grad = jax.value_and_grad(
+                lambda t: obj(kernel, t, data, *extra)
+            )(theta)
+            return value, grad, aux
 
-    if log_space:
-        vag, theta0, lower, upper, from_u = log_reparam(vag, theta0, lower, upper)
-    else:
-        from_u = lambda t: t
+        if log_space:
+            vag, theta0, lower, upper, from_u = log_reparam(
+                vag, theta0, lower, upper
+            )
+        else:
+            from_u = lambda t: t
 
-    theta, f, _, n_iter, n_fev, stalled = lbfgs_minimize_device(
-        vag, theta0, lower, upper, jnp.zeros(()), max_iter=max_iter, tol=tol
+        theta, f, _, n_iter, n_fev, stalled = lbfgs_minimize_device(
+            vag, theta0, lower, upper, jnp.zeros(()), max_iter=max_iter,
+            tol=tol,
+        )
+        return from_u(theta), f, n_iter, n_fev, stalled
+
+
+def fit_gpr_device(
+    kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter,
+    tol, extra=(), *, objective="marginal", lane=None,
+):
+    """Single-chip on-device fit: objective + projected L-BFGS in one XLA
+    program.  Returns (theta_opt, final_nll, n_iter, n_fev, stalled).
+    ``lane=None`` resolves the ambient precision lane at call time into
+    the jit key (module note above)."""
+    return _fit_gpr_device_impl(
+        kernel, log_space, theta0, lower, upper, x, y, mask, max_iter, tol,
+        extra, objective=objective,
+        lane=active_lane() if lane is None else lane,
     )
-    return from_u(theta), f, n_iter, n_fev, stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective",))
+@partial(
+    jax.jit, static_argnums=(0, 1), static_argnames=("objective", "lane")
+)
+def _fit_gpr_device_multistart_impl(
+    kernel: Kernel, log_space, theta0_batch, lower, upper, x, y, mask,
+    max_iter, tol, extra=(), *, objective="marginal", lane=None,
+):
+    from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
+
+    with precision_lane_scope(lane):
+        data = ExpertData(x=x, y=y, mask=mask)
+        obj = objective_fn(objective)
+
+        def vag(theta, aux):
+            value, grad = jax.value_and_grad(
+                lambda t: obj(kernel, t, data, *extra)
+            )(theta)
+            return value, grad, aux
+
+        theta, _, f, n_iter, n_fev, stalled, f_all, best = (
+            multistart_minimize(
+                vag, log_space, theta0_batch, lower, upper, jnp.zeros(()),
+                max_iter, tol,
+            )
+        )
+        return theta, f, n_iter, n_fev, stalled, f_all, best
+
+
 def fit_gpr_device_multistart(
     kernel: Kernel, log_space, theta0_batch, lower, upper, x, y, mask,
-    max_iter, tol, extra=(), *, objective="marginal",
+    max_iter, tol, extra=(), *, objective="marginal", lane=None,
 ):
     """Multi-start single-chip fit: the R restarts run as ONE vmapped
     on-device L-BFGS program (optimize/lbfgs_device.py multistart docs) and
     only the winning iterate is returned — the PPA model is then built
     once, for the winner.  Returns ``(theta_best, f_best, n_iter, n_fev,
     stalled, f_all [R], best)``."""
-    from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
-
-    data = ExpertData(x=x, y=y, mask=mask)
-    obj = objective_fn(objective)
-
-    def vag(theta, aux):
-        value, grad = jax.value_and_grad(
-            lambda t: obj(kernel, t, data, *extra)
-        )(theta)
-        return value, grad, aux
-
-    theta, _, f, n_iter, n_fev, stalled, f_all, best = multistart_minimize(
-        vag, log_space, theta0_batch, lower, upper, jnp.zeros(()),
-        max_iter, tol,
+    return _fit_gpr_device_multistart_impl(
+        kernel, log_space, theta0_batch, lower, upper, x, y, mask,
+        max_iter, tol, extra, objective=objective,
+        lane=active_lane() if lane is None else lane,
     )
-    return theta, f, n_iter, n_fev, stalled, f_all, best
 
 
 # --- segmented device fit: checkpoint/resume for long runs ----------------
@@ -323,39 +385,54 @@ def _gpr_segment_vag(
     return log_transform_vag(base) if log_space else base
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective",))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective", "lane")
+)
 def gpr_device_segment_init(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    extra=(), *, objective="marginal",
+    extra=(), *, objective="marginal", lane=None,
 ):
     """One objective evaluation -> the optimizer's carried state (the
     checkpoint unit)."""
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
-    data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective, extra)
-    t0 = jnp.log(theta0) if log_space else theta0
-    return lbfgs_init_state(vag, t0, jnp.zeros((), theta0.dtype))
+    with precision_lane_scope(lane):
+        data = ExpertData(x=x, y=y, mask=mask)
+        vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective, extra)
+        t0 = jnp.log(theta0) if log_space else theta0
+        return lbfgs_init_state(vag, t0, jnp.zeros((), theta0.dtype))
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective",))
-def gpr_device_segment_run(
+def _gpr_segment_run_impl(
     kernel: Kernel, mesh, log_space, state, lower, upper, x, y, mask,
-    iter_limit, tol, extra=(), *, objective="marginal",
+    iter_limit, tol, extra=(), *, objective="marginal", lane=None,
 ):
-    """Advance the device L-BFGS to ``iter_limit`` total iterations (one
-    compiled program, reused for every segment — iter_limit is traced)."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
         log_transform_bounds,
     )
 
-    data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective, extra)
-    lo, hi = (
-        log_transform_bounds(lower, upper) if log_space else (lower, upper)
-    )
-    return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
+    with precision_lane_scope(lane):
+        data = ExpertData(x=x, y=y, mask=mask)
+        vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective, extra)
+        lo, hi = (
+            log_transform_bounds(lower, upper) if log_space else (lower, upper)
+        )
+        return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
+
+
+# The state carry (iterate + [m_hist, h] curvature history + aux) is
+# consumed exactly once per segment and replaced by the returned state:
+# donating it lets XLA write the new state into the old buffers instead
+# of double-buffering the carry in HBM every chunk.  run_segmented
+# (utils/checkpoint.py) persists the RETURNED state before the next
+# dispatch, so the donated input is never read again.
+gpr_device_segment_run = jax.jit(
+    _gpr_segment_run_impl,
+    static_argnums=(0, 1, 2),
+    static_argnames=("objective", "lane"),
+    donate_argnums=lbfgs_state_donation(3),
+)
 
 
 def fit_gpr_device_checkpointed(
@@ -390,10 +467,12 @@ def fit_gpr_device_checkpointed(
         family, kernel, tol, log_space, theta0, data.x, data.y, data.mask,
         **extra_meta,
     )
+    lane = active_lane()
+
     def init(theta0_, lower_, upper_, x_, y_, mask_):
         return gpr_device_segment_init(
             kernel, mesh, log_space, theta0_, lower_, upper_, x_, y_, mask_,
-            extra, objective=objective,
+            extra, objective=objective, lane=lane,
         )
 
     tol_arr = jnp.asarray(tol, theta0.dtype)
@@ -402,7 +481,7 @@ def fit_gpr_device_checkpointed(
         return gpr_device_segment_run(
             kernel, mesh, log_space, state, lower, upper,
             data.x, data.y, data.mask, limit, tol_arr, extra,
-            objective=objective,
+            objective=objective, lane=lane,
         )
 
     theta, state = run_segmented(
@@ -413,14 +492,24 @@ def fit_gpr_device_checkpointed(
     return theta, state.f, state.n_iter, state.n_fev, state.stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective",))
-def fit_gpr_device_sharded(
+@partial(
+    jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective", "lane")
+)
+def _fit_gpr_device_sharded_impl(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, tol, *, objective="marginal",
+    max_iter, tol, *, objective="marginal", lane=None,
 ):
-    """Multi-chip on-device fit: the WHOLE optimizer runs inside shard_map —
-    per-iteration communication is exactly one psum of the scalar NLL plus
-    the implicit gradient all-reduce, all over ICI, with zero host syncs."""
+    with precision_lane_scope(lane):
+        return _fit_gpr_device_sharded_body(
+            kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
+            max_iter, tol, objective, lane,
+        )
+
+
+def _fit_gpr_device_sharded_body(
+    kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
+    max_iter, tol, objective, lane,
+):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
@@ -435,7 +524,7 @@ def fit_gpr_device_sharded(
         # the same sharded stack via GSPMD instead
         return fit_gpr_device(
             kernel, log_space, theta0, lower, upper, x, y, mask,
-            max_iter, tol, (), objective=objective,
+            max_iter, tol, (), objective=objective, lane=lane,
         )
 
     @partial(
@@ -472,3 +561,19 @@ def fit_gpr_device_sharded(
         return from_u(theta), f, n_iter, n_fev, stalled
 
     return run(theta0, lower, upper, x, y, mask, max_iter, tol)
+
+
+def fit_gpr_device_sharded(
+    kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
+    max_iter, tol, *, objective="marginal", lane=None,
+):
+    """Multi-chip on-device fit: the WHOLE optimizer runs inside shard_map —
+    per-iteration communication is exactly one psum of the scalar NLL plus
+    the implicit gradient all-reduce, all over ICI, with zero host syncs.
+    ``lane=None`` resolves the ambient precision lane at call time into
+    the jit key (module note above)."""
+    return _fit_gpr_device_sharded_impl(
+        kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
+        max_iter, tol, objective=objective,
+        lane=active_lane() if lane is None else lane,
+    )

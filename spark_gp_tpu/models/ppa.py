@@ -49,6 +49,7 @@ from jax.sharding import PartitionSpec as P
 
 from spark_gp_tpu.kernels.base import Kernel
 from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+from spark_gp_tpu.ops.precision import active_lane, precision_lane_scope
 from spark_gp_tpu.parallel.experts import ExpertData
 from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 
@@ -62,10 +63,11 @@ def _flat_stats(kernel: Kernel, theta, active, xf, yf, maskf):
     from spark_gp_tpu.ops.distance import mxu_inner
 
     kmn = kernel.cross(theta, active, xf) * maskf[None, :]  # [m, c]
-    # NOT on the GP_MATMUL_PRECISION knob: every caller runs the (U1, u2)
+    # Lane-immune by construction: every caller runs the (U1, u2)
     # accumulation in f64 (models/common.py casts under jax.enable_x64 —
     # the one-time stats feed a condition-squared normal-equations solve),
-    # and lax.Precision only selects bf16 pass counts for f32 inputs
+    # and mxu_inner routes f64 inputs to the plain HIGHEST contraction
+    # regardless of the precision lane (ops/distance.py)
     u1 = mxu_inner(kmn, kmn)
     ym = yf * (maskf if yf.ndim == 1 else maskf[:, None])
     u2 = kmn @ ym
@@ -690,6 +692,7 @@ class ProjectedProcessRawPredictor:
             jnp.asarray(self.magic_vector, dtype=dtype),
             jnp.asarray(self.magic_matrix, dtype=dtype),
             x_test,
+            lane=active_lane(),
         )
 
     def __call__(self, x_test):
@@ -706,11 +709,12 @@ class ProjectedProcessRawPredictor:
             jnp.asarray(self.magic_vector, dtype=dtype),
         ) + (() if mean_only else (jnp.asarray(self.magic_matrix, dtype=dtype),))
         predict = _predict_mean_jit if mean_only else _predict_jit
+        lane = active_lane()
         t = x_test.shape[0]
         m = max(1, self.active.shape[0])
         chunk = max(1, self._PREDICT_CHUNK_ELEMS // m)
         if t <= chunk:
-            out = predict(*args, jnp.asarray(x_test, dtype=dtype))
+            out = predict(*args, jnp.asarray(x_test, dtype=dtype), lane=lane)
             return (out, None) if mean_only else out
         # fixed chunk shape (last chunk padded) -> one compiled executable
         means, vars_ = [], []
@@ -721,7 +725,7 @@ class ProjectedProcessRawPredictor:
                 part = jnp.concatenate(
                     [part, jnp.broadcast_to(part[:1], (pad, part.shape[1]))]
                 )
-            out = predict(*args, jnp.asarray(part, dtype=dtype))
+            out = predict(*args, jnp.asarray(part, dtype=dtype), lane=lane)
             mean, var = (out, None) if mean_only else out
             means.append(mean[: chunk - pad] if pad else mean)
             if var is not None:
@@ -761,15 +765,36 @@ def _predict_cov_impl(kernel, theta, active, magic_vector, magic_matrix, x_test)
     return mean, 0.5 * (cov + cov.T)
 
 
-_predict_cov_jit = jax.jit(_predict_cov_impl, static_argnums=0)
-
-
-_predict_jit = jax.jit(_predict_impl, static_argnums=0)
-
-
 def _predict_mean_impl(kernel, theta, active, magic_vector, x_test):
     """Mean-only prediction: ``cross . magicVector`` (no [m, m] operator)."""
     return kernel.cross(theta, x_test, active) @ magic_vector
 
 
-_predict_mean_jit = jax.jit(_predict_mean_impl, static_argnums=0)
+# The chunked-predict programs carry the precision lane (ops/precision.py)
+# in their jit keys, like the fit entry points in models/likelihood.py:
+# the cross-kernel build inside rides the gram lane, and switching lanes
+# between predictions must recompile rather than silently reuse the old
+# lane's executables.
+def _lane_jit(impl):
+    def with_lane(kernel, *operands, lane=None):
+        with precision_lane_scope(lane):
+            return impl(kernel, *operands)
+
+    return jax.jit(with_lane, static_argnums=0, static_argnames=("lane",))
+
+
+_predict_cov_jit = _lane_jit(_predict_cov_impl)
+_predict_jit = _lane_jit(_predict_impl)
+_predict_mean_jit = _lane_jit(_predict_mean_impl)
+
+
+@partial(jax.jit, static_argnums=0, static_argnames=("lane",))
+def guard_probe_predict_mean(
+    kernel: Kernel, theta, active, magic_vector, x_test, *, lane
+):
+    """Posterior-mean probe at an EXPLICIT lane — the predict leg of the
+    fit-time mixed_precision_guard (models/common.py).  ``lane`` is
+    static so the strict and non-strict evaluations compile separately
+    and can be compared within one process."""
+    with precision_lane_scope(lane):
+        return _predict_mean_impl(kernel, theta, active, magic_vector, x_test)

@@ -1,13 +1,30 @@
-"""Pairwise squared distances, MXU-friendly.
+"""Pairwise squared distances, MXU-friendly, on the precision-policy lanes.
 
 The reference computes squared distances with O(n^2) scalar loops on the JVM
 (RBFKernel.scala:37-48, ARDRBFKernel.scala:43-46).  On TPU the right shape is
 one big matmul: ``|x - y|^2 = |x|^2 + |y|^2 - 2<x, y>``, so the O(n^2 p) work
 rides the 128x128 systolic array instead of scalar units.
 
-``precision=HIGHEST`` keeps the dominant -2<x,y> term in full float32 (six
-bf16 passes on TPU); without it, cancellation between the three terms destroys
-small distances and, downstream, Cholesky stability.
+The dominant ``-2<x,y>`` term is a cancellation against the norm terms:
+at 1-pass bf16 it destroys small distances and, downstream, Cholesky
+stability.  The gram stage of :mod:`ops.precision` therefore selects one
+of three contractions here (trace-time read; docs/ROOFLINE.md):
+
+* ``highest`` (the ``strict`` lane): ``Precision.HIGHEST`` — XLA's 6-pass
+  bf16 emulation of true f32, the hard 16.7% bf16-MFU ceiling.
+* ``compensated`` (the ``mixed`` lane): the bf16x3/Ozaki-style split
+  ``x = hi + lo`` with ``hi`` exactly bf16-representable, so
+  ``<x1, x2> = <hi1, hi2> + (<hi1, lo2> + <lo1, hi2>)`` needs ~3 MXU
+  passes and drops only the ``<lo1, lo2>`` term — O(2^-16) relative, the
+  same order as f32 rounding itself.  ~2x the strict matmul ceiling with
+  accuracy recovered structurally, not hoped for.
+* ``default``/``high`` (the ``fast`` lane and experiments): a plain
+  contraction at the named ``lax.Precision``.
+
+float64 inputs always take the plain HIGHEST path: ``lax.Precision`` is
+inert on f64 and the split would triple the cost of the one-time PPA
+statistics for nothing — so the f64 stats/magic paths are lane-immune by
+construction, exactly as docs/ROOFLINE.md promises.
 """
 
 from __future__ import annotations
@@ -15,19 +32,66 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from spark_gp_tpu.ops.precision import gram_mode
 
-def mxu_inner(x1: jax.Array, x2: jax.Array) -> jax.Array:
-    """``[n1, p], [n2, p] -> [n1, n2]`` pairwise inner products as one MXU
-    matmul at HIGHEST precision — the single home of the "contract feature
-    dim, full-f32 accumulation" convention every kernel rides.  (The f64
-    PPA statistics path also routes through here; lax.Precision is inert
-    on f64 inputs, so the pin costs those callers nothing.)"""
+_PLAIN_PRECISION = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
+
+
+def _inner(x1, x2, precision):
+    """``[n1, p], [n2, p] -> [n1, n2]`` contraction of the feature dim."""
     return jax.lax.dot_general(
         x1,
         x2,
         dimension_numbers=(((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
+        precision=precision,
     )
+
+
+def _bf16_split(x):
+    """``x = hi + lo`` with ``hi`` exactly representable in bf16 (the
+    round-trip cast) and ``lo`` the f32 residual, |lo| <~ 2^-9 |x|.
+    Differentiable: the round-trip cast's gradient is the identity, so
+    autodiff through a compensated kernel matches the plain path."""
+    hi = x.astype(jnp.bfloat16).astype(x.dtype)
+    return hi, x - hi
+
+
+def _inner_compensated(x1, x2):
+    """Split-bf16 compensated inner products: three 1-pass contractions
+    instead of HIGHEST's six.  The middle operand is the FULL ``x1``, not
+    ``hi1``: since ``x1 = hi1 + lo1`` exactly, ``hi1.hi2 + x1.lo2 +
+    lo1.hi2`` telescopes to the exact product in f32 arithmetic — on a
+    backend whose MXU rounds f32 operands to bf16, ``x1`` rounds to
+    ``hi1`` and only the O(2^-16 |x1||x2|) ``lo1.lo2`` term is dropped,
+    the same order as bf16x3's 3-pass (``Precision.HIGH``) residual."""
+    hi1, lo1 = _bf16_split(x1)
+    hi2, lo2 = _bf16_split(x2)
+    default = jax.lax.Precision.DEFAULT
+    # bracket the two correction terms together: they are the same
+    # magnitude (~2^-9 of the main term), so summing them first loses
+    # nothing and lets XLA fuse the adds
+    return _inner(hi1, hi2, default) + (
+        _inner(x1, lo2, default) + _inner(lo1, hi2, default)
+    )
+
+
+def mxu_inner(x1: jax.Array, x2: jax.Array) -> jax.Array:
+    """``[n1, p], [n2, p] -> [n1, n2]`` pairwise inner products as one MXU
+    contraction on the precision policy's gram lane — the single home of
+    the "contract feature dim, accuracy-governed accumulation" convention
+    every kernel rides.  f64 inputs (the PPA statistics path) always take
+    the plain HIGHEST contraction: lax.Precision is inert there and the
+    compensated split would only triple the cost."""
+    mode = gram_mode()
+    if mode == "highest" or x1.dtype != jnp.float32:
+        return _inner(x1, x2, jax.lax.Precision.HIGHEST)
+    if mode == "compensated":
+        return _inner_compensated(x1, x2)
+    return _inner(x1, x2, _PLAIN_PRECISION[mode])
 
 
 def sq_dist(x1: jax.Array, x2: jax.Array) -> jax.Array:
@@ -49,3 +113,28 @@ def weighted_sq_dist(x1: jax.Array, x2: jax.Array, w: jax.Array) -> jax.Array:
     computed by pre-scaling rows so the heavy lifting is still one matmul.
     """
     return sq_dist(x1 * w, x2 * w)
+
+
+def _zero_diag(d: jax.Array) -> jax.Array:
+    n = d.shape[-1]
+    eye = jnp.eye(n, dtype=bool)
+    return jnp.where(eye, jnp.zeros((), dtype=d.dtype), d)
+
+
+def sq_dist_self(x: jax.Array) -> jax.Array:
+    """``sq_dist(x, x)`` with the diagonal pinned to its analytic value, 0.
+
+    The three-term identity leaves O(eps)·|x|² cancellation noise on the
+    self-distance diagonal in every lane — and a different noise per lane,
+    since each contraction rounds differently.  Kernels that take a
+    distance ``sqrt`` (the Matérn family) amplify that to O(√eps), which
+    is both a real accuracy loss (exp(-√noise) ≠ 1 at f32) and a
+    lane-parity breaker.  Every self-gram goes through here so the
+    diagonal is exact by construction, lane-invariantly.
+    """
+    return _zero_diag(sq_dist(x, x))
+
+
+def weighted_sq_dist_self(x: jax.Array, w: jax.Array) -> jax.Array:
+    """ARD twin of :func:`sq_dist_self` (same analytic-zero diagonal)."""
+    return _zero_diag(weighted_sq_dist(x, x, w))

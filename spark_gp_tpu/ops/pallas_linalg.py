@@ -66,8 +66,11 @@ _N_MAX = 512  # largest matrix the Pallas path handles (VMEM at T=1)
 _HI = jax.lax.Precision.HIGHEST
 
 
-# the GP_MATMUL_PRECISION knob lives in ops/precision.py (it also governs
-# the PPA statistics matmul); re-exported here for the kernel's callers
+# the linalg-stage precision (the lane's default, or an explicit
+# GP_MATMUL_PRECISION pin) lives in ops/precision.py; re-exported here
+# for the kernel's callers.  This governs the blocked-inverse panels and
+# the SPD VJP below — the non-cancellation matmuls; the sq-dist/gram
+# contraction rides the separate gram stage (ops/distance.py).
 from spark_gp_tpu.ops.precision import matmul_precision as _matmul_precision
 
 

@@ -1,34 +1,75 @@
-"""Matmul-precision policy and public chip-spec tables.
+"""Matmul-precision policy (named lanes) and public chip-spec tables.
 
-ONE home for two things several modules were starting to duplicate:
+ONE home for three things several modules were starting to duplicate:
 
-* :func:`matmul_precision` — the ``GP_MATMUL_PRECISION`` knob governing
-  the hot-loop f32 matmuls that are NOT a cancellation: the Pallas
-  blocked-inverse panels and the SPD VJP (together the dominant matmul
-  work of every L-BFGS eval).  The sq-dist contraction in
-  :mod:`ops.distance` deliberately does NOT ride it, and the one-time PPA
-  statistics run in f64 where ``lax.Precision`` is inert.
+* :class:`PrecisionPolicy` / :func:`active_lane` — the framework-wide
+  mixed-precision lane (``strict`` / ``mixed`` / ``fast``) with per-stage
+  resolution: the *gram* stage (the cancellation-sensitive sq-dist /
+  cross-kernel contractions of :mod:`ops.distance`) and the *linalg*
+  stage (the Pallas blocked-inverse panels and the SPD VJP — the dominant
+  matmul work of every L-BFGS eval).  Cholesky factorizations, triangular
+  solves and the one-time f64 PPA statistics are NOT on any lane: they
+  keep today's f32/f64 semantics in every lane (``lax.Precision`` is
+  inert on f64 inputs, and the solves are not matmuls).
+* :func:`matmul_precision` — the linalg-stage resolution, still
+  overridable by the pre-lane ``GP_MATMUL_PRECISION`` knob (an explicit
+  pin wins over the lane default).
 * ``PEAK_TFLOPS`` / ``PEAK_GBPS`` — nominal per-chip bf16-matmul and HBM
   peaks (public figures), keyed by ``device_kind`` substring, consumed by
   ``bench.py`` and ``benchmarks/roofline.py`` so their MFU/bandwidth
   fractions can never disagree about what a chip's peak is.
+
+Lane semantics (docs/ROOFLINE.md has the full table):
+
+========  ==================================  =========================
+lane      gram stage                          linalg stage
+========  ==================================  =========================
+strict    HIGHEST (6-pass bf16 = true f32)    HIGHEST
+mixed     compensated split-bf16 (~3 passes,  HIGH (3-pass bf16x3,
+          error recovered structurally —      ~1e-6 rel)
+          ops/distance.py)
+fast      DEFAULT (1-pass bf16, ~1e-3 rel —   HIGH (1-pass linalg is
+          experiments only)                   measured fatal for the
+                                              L-BFGS line search)
+========  ==================================  =========================
+
+Reads happen at TRACE time.  The GPR fit/predict entry points
+(``models/likelihood.py``, ``models/ppa.py``) carry the resolved lane in
+their jit cache keys, so switching lanes between fits recompiles and
+takes effect; other consumers (the Laplace families' jitted programs)
+read the ambient lane at their first trace — set the lane before the
+first fit in a process, exactly like the pre-lane
+``GP_MATMUL_PRECISION`` contract.  Every fit at a non-default lane emits
+a ``mixed_precision_guard`` artifact (models/common.py) so a bad lane
+choice is detected at fit time, not in production predictions.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
+from typing import NamedTuple
 
 import jax
 
 # nominal bf16 MXU peak TFLOP/s by device-kind substring (public figures);
-# f32 emulation runs at peak/passes — see PRECISION_PASSES
+# f32 emulation runs at peak/passes — see PRECISION_PASSES.  The "cpu"
+# entry is a nominal host-proxy figure (an 8-core AVX2/FMA server at f32)
+# so CPU-fallback bench rounds exercise the whole MFU-reporting pipeline
+# with a non-null est_mfu_vs_bf16_peak — it is a PLUMBING proxy, never
+# comparable to the TPU rows (bench.py marks CPU rounds as fallback).
 PEAK_TFLOPS = {"v4": 275.0, "v5 lite": 197.0, "v5e": 197.0,
-               "v5p": 459.0, "v6e": 918.0, "v6 lite": 918.0}
-# nominal HBM bandwidth GB/s by device-kind substring (public figures)
+               "v5p": 459.0, "v6e": 918.0, "v6 lite": 918.0,
+               "cpu": 0.5}
+# nominal HBM bandwidth GB/s by device-kind substring (public figures);
+# "cpu" is a nominal dual-channel DDR4 host figure (same proxy caveat)
 PEAK_GBPS = {"v4": 1228.0, "v5 lite": 819.0, "v5e": 819.0,
-             "v5p": 2765.0, "v6e": 1640.0, "v6 lite": 1640.0}
-# f32-emulation cost of each precision mode, in bf16 MXU passes
-PRECISION_PASSES = {"highest": 6, "high": 3, "default": 1}
+             "v5p": 2765.0, "v6e": 1640.0, "v6 lite": 1640.0,
+             "cpu": 40.0}
+# f32-emulation cost of each precision mode, in bf16 MXU passes; the
+# compensated gram path of ops/distance.py costs ~3 ("compensated")
+PRECISION_PASSES = {"highest": 6, "high": 3, "default": 1, "compensated": 3}
 
 
 def chip_peaks(device_kind: str):
@@ -40,28 +81,142 @@ def chip_peaks(device_kind: str):
     return tf, bw
 
 
-def matmul_precision():
-    """MXU precision for non-cancellation f32 matmuls.
+class PrecisionPolicy(NamedTuple):
+    """Resolved per-stage precision of one lane (see module docstring)."""
 
-    ``GP_MATMUL_PRECISION``: ``highest`` (default; 6-pass bf16 = true f32,
-    matmul-rate ceiling ~peak/6), ``high`` (3-pass bf16x3, ~2x the rate at
-    ~1e-6 relative error — the measured-trade candidate, quality-gated in
-    ``benchmarks/roofline.py``), or ``default`` (1-pass bf16, ~1e-3 error
-    — measured fatal for L-BFGS line-search consistency; exposed for
-    experiments only).  Read at TRACE time: set the env var before the
-    first fit in a process; benchmarks vary it via subprocesses.
+    lane: str    # "strict" | "mixed" | "fast"
+    gram: str    # "highest" | "compensated" | "high" | "default"
+    linalg: str  # "highest" | "high" | "default"
+
+
+# the three named lanes; per-stage env overrides below refine them
+LANES = {
+    "strict": PrecisionPolicy("strict", gram="highest", linalg="highest"),
+    "mixed": PrecisionPolicy("mixed", gram="compensated", linalg="high"),
+    "fast": PrecisionPolicy("fast", gram="default", linalg="high"),
+}
+
+# guard bars (relative deltas vs the strict lane on the fit-time probe,
+# models/common.py _emit_precision_guard): a lane whose probe deltas
+# exceed its bar gets a loud warning + mixed_precision_guard.breach=1.
+# Calibration: the probe's NLL/grad legs amplify the gram-stage error by
+# the experts' K^-1 conditioning (sigma2 ~ 1e-3 => ~1e3x), so a healthy
+# compensated fit sits around 1e-4..2e-3 — the mixed bar flags an order
+# of magnitude beyond that; the fast lane's 1-pass gram is ~500x noisier
+# and gets a correspondingly looser tripwire.
+GUARD_BARS = {"mixed": 1e-2, "fast": 0.5}
+
+# process-wide lane override (set_precision_lane); None = env/default
+_LANE_OVERRIDE = None
+# trace-local lane scope (precision_lane_scope) — thread-local because
+# serving-path predictors may trace concurrently from reader threads
+_SCOPE = threading.local()
+
+
+def _validate_lane(lane: str, source: str) -> str:
+    lane = str(lane).strip().lower()
+    if lane not in LANES:
+        # fail loud and NAMED — a bare KeyError from inside a jit trace
+        # never mentions where the lane came from
+        raise ValueError(
+            f"{source}={lane!r} is not a precision lane; use one of "
+            f"{sorted(LANES)}"
+        )
+    return lane
+
+
+def active_lane() -> str:
+    """The lane in effect: innermost ``precision_lane_scope``, else the
+    ``set_precision_lane`` process override, else ``GP_PRECISION_LANE``,
+    else ``strict`` (today's exact behavior)."""
+    scoped = getattr(_SCOPE, "lane", None)
+    if scoped is not None:
+        return scoped
+    if _LANE_OVERRIDE is not None:
+        return _LANE_OVERRIDE
+    env = os.environ.get("GP_PRECISION_LANE")
+    if env is None or not env.strip():
+        return "strict"
+    return _validate_lane(env, "GP_PRECISION_LANE")
+
+
+def set_precision_lane(lane):
+    """Process-wide lane setter (the programmatic twin of
+    ``GP_PRECISION_LANE``).  ``None`` clears the override.  Returns the
+    previously-set override so callers can restore it.  Takes effect on
+    programs whose jit keys carry the lane (the GPR fit/predict paths)
+    immediately; elsewhere on the next first-trace."""
+    global _LANE_OVERRIDE
+    previous = _LANE_OVERRIDE
+    _LANE_OVERRIDE = (
+        None if lane is None else _validate_lane(lane, "set_precision_lane")
+    )
+    return previous
+
+
+@contextlib.contextmanager
+def precision_lane_scope(lane):
+    """Pin the lane for the duration of a trace (used inside jitted
+    programs whose cache key carries the lane as a static argument, so
+    each lane compiles its own executable).  ``None`` is a no-op — the
+    ambient lane applies."""
+    if lane is None:
+        yield
+        return
+    lane = _validate_lane(lane, "precision_lane_scope")
+    prev = getattr(_SCOPE, "lane", None)
+    _SCOPE.lane = lane
+    try:
+        yield
+    finally:
+        _SCOPE.lane = prev
+
+
+def get_policy() -> PrecisionPolicy:
+    """The active lane's per-stage resolution with env refinements applied:
+    ``GP_MATMUL_PRECISION`` pins the linalg stage, ``GP_PRECISION_GRAM``
+    pins the gram stage (both optional; explicit pins win over the lane)."""
+    policy = LANES[active_lane()]
+    gram = os.environ.get("GP_PRECISION_GRAM", "").strip().lower()
+    if gram:
+        if gram not in ("highest", "compensated", "high", "default"):
+            raise ValueError(
+                f"GP_PRECISION_GRAM={gram!r} is not supported; use one of "
+                "['compensated', 'default', 'high', 'highest']"
+            )
+        policy = policy._replace(gram=gram)
+    linalg = os.environ.get("GP_MATMUL_PRECISION", "").strip().lower()
+    if linalg:
+        if linalg not in ("highest", "high", "default"):
+            raise ValueError(
+                f"GP_MATMUL_PRECISION={linalg!r} is not supported; use one "
+                "of ['default', 'high', 'highest']"
+            )
+        policy = policy._replace(linalg=linalg)
+    return policy
+
+
+def gram_mode() -> str:
+    """Gram-stage mode for :mod:`ops.distance` (trace-time read):
+    ``compensated`` selects the split-bf16 path; the other names map to
+    ``lax.Precision`` for a plain contraction."""
+    return get_policy().gram
+
+
+def matmul_precision():
+    """MXU precision for the linalg-stage f32 matmuls (Pallas
+    blocked-inverse panels + the SPD VJP): the lane's linalg default,
+    overridable by ``GP_MATMUL_PRECISION`` — ``highest`` (6-pass bf16 =
+    true f32, matmul-rate ceiling ~peak/6), ``high`` (3-pass bf16x3, ~2x
+    the rate at ~1e-6 relative error — the ``mixed``/``fast`` lanes'
+    default), or ``default`` (1-pass bf16, ~1e-3 error — measured fatal
+    for L-BFGS line-search consistency; exposed for experiments only).
+    Read at TRACE time (see module docstring for the recompile contract).
     """
-    name = os.environ.get("GP_MATMUL_PRECISION", "highest").strip().lower()
+    name = get_policy().linalg
     table = {
         "highest": jax.lax.Precision.HIGHEST,
         "high": jax.lax.Precision.HIGH,
         "default": jax.lax.Precision.DEFAULT,
     }
-    if name not in table:
-        # fail loud and NAMED — a bare KeyError from inside a jit trace
-        # never mentions the env var
-        raise ValueError(
-            f"GP_MATMUL_PRECISION={name!r} is not supported; use one of "
-            f"{sorted(table)}"
-        )
     return table[name]

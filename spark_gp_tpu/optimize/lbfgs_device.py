@@ -74,6 +74,25 @@ def log_reparam(value_and_grad_aux, theta0, lower, upper):
     )
 
 
+def lbfgs_state_donation(state_argnum: int) -> tuple:
+    """``donate_argnums`` for a jitted segment-advance whose
+    :class:`_LbfgsState` carry sits at positional ``state_argnum``.
+
+    The segmented checkpoint drivers round-trip the full optimizer state
+    — iterate, gradient, the [m_hist, h] curvature history pair, aux —
+    through one compiled program per chunk.  The input state is consumed
+    exactly once and replaced by the returned state (every family's
+    ``run_segmented`` loop rebinds and persists the RETURN value before
+    the next dispatch), so donating it lets XLA alias the output into the
+    input's HBM instead of double-buffering the carry.  ONE home for the
+    argnum-tuple so every family's segment runner declares donation the
+    same way and tests can assert the contract (test_precision_policy.py
+    asserts the lowered programs carry the donor/aliasing annotations and
+    that the live-buffer count stays flat across segments).
+    """
+    return (int(state_argnum),)
+
+
 class _LbfgsState(NamedTuple):
     theta: jax.Array  # [h]
     f: jax.Array  # scalar

@@ -123,22 +123,47 @@ class BucketedPredictor:
         self._warmed: set[int] = set()
         self._frozen = False
         self._lock = threading.Lock()
-        # donate the request buffer on accelerators: each padded batch is a
-        # fresh upload consumed by exactly one dispatch, so XLA can reuse
-        # its HBM in place.  Not on CPU, where donation is unimplemented
-        # and every dispatch would log a donation warning.
-        donate = (4,) if jax.default_backend() != "cpu" else ()
-        self._jit = jax.jit(self._make_impl(), donate_argnums=donate)
+        # The compiled surface is built once (warmup) and frozen, so the
+        # precision lane (ops/precision.py) is captured HERE and pinned
+        # into every bucket's trace — a process-level lane switch after
+        # construction must not split the surface into mixed-lane
+        # executables.  Exposed as .precision_lane for ops introspection.
+        from spark_gp_tpu.ops.precision import active_lane
+
+        self.precision_lane = active_lane()
+        # donate the request buffer: each padded batch is a fresh upload
+        # consumed by exactly one dispatch, so its HBM can be reused
+        # instead of double-buffered.  A donated buffer is only usable if
+        # some output aliases it, and the natural outputs (mean/var [b])
+        # are the wrong shape — so the impl echoes the request buffer as a
+        # third output for XLA to alias into (the echo is dropped in
+        # _dispatch; it costs nothing, it IS the input buffer).  This is
+        # the predict-side half of the hot-loop donation contract
+        # (optimize/lbfgs_device.lbfgs_state_donation is the fit side;
+        # test_precision_policy.py asserts both lowerings carry the
+        # donor/aliasing annotations).
+        self._jit = self._make_jit(donate=True)
+
+    def _make_jit(self, donate: bool):
+        """jit the bucket impl, optionally donating the padded request
+        buffer (arg 4).  Split out so tests can lower the donating variant
+        and assert the donor annotations regardless of backend."""
+        return jax.jit(
+            self._make_impl(), donate_argnums=(4,) if donate else ()
+        )
 
     def _make_impl(self):
         # the math is ppa's own predict impls — one source of truth, so a
         # fix to the PPA formulas reaches the serving path automatically
         from spark_gp_tpu.models.ppa import _predict_impl, _predict_mean_impl
 
+        from spark_gp_tpu.ops.precision import precision_lane_scope
+
         kernel = self._raw.kernel
         mean_only = self.mean_only
         counts = self.compile_counts
         lock = self._lock
+        lane = self.precision_lane
 
         def impl(theta, active, magic_vector, magic_matrix, x):
             # trace-time side effect: one execution of this Python body ==
@@ -146,12 +171,20 @@ class BucketedPredictor:
             with lock:
                 b = int(x.shape[0])
                 counts[b] = counts.get(b, 0) + 1
-            if mean_only:
-                mean = _predict_mean_impl(kernel, theta, active, magic_vector, x)
-                return mean, jnp.zeros_like(mean)
-            return _predict_impl(
-                kernel, theta, active, magic_vector, magic_matrix, x
-            )
+            # pin the construction-time lane for this trace (see __init__)
+            with precision_lane_scope(lane):
+                if mean_only:
+                    mean = _predict_mean_impl(
+                        kernel, theta, active, magic_vector, x
+                    )
+                    var = jnp.zeros_like(mean)
+                else:
+                    mean, var = _predict_impl(
+                        kernel, theta, active, magic_vector, magic_matrix, x
+                    )
+            # echo the request buffer so the donation is usable: a same-
+            # shaped output for XLA to alias the donated arg into (__init__)
+            return mean, var, x
 
         return impl
 
@@ -188,7 +221,7 @@ class BucketedPredictor:
                 f"frozen to {sorted(self._warmed)}"
             )
         before = self.compile_counts.get(bucket, 0)
-        out = self._jit(
+        mean, var, _echo = self._jit(
             self._theta,
             self._active,
             self._magic_vector,
@@ -203,7 +236,7 @@ class BucketedPredictor:
                 f"recompile on warmed bucket {bucket} — input dtype or "
                 "operand identity drifted on the hot path"
             )
-        return out
+        return mean, var
 
     def _normalize(self, x_test) -> np.ndarray:
         x = np.asarray(x_test, dtype=self._dtype)

@@ -29,6 +29,11 @@ def _run(script, env_extra, args=(), timeout=900):
     # branch (CPU primaries run synced) is what the assertion exercises.
     env.pop("XLA_FLAGS", None)
     env.pop("GP_SYNC_PHASES", None)
+    # an exported lane/precision pin would fail the strict-lane and
+    # guard-shape assertions on a healthy bench.py
+    env.pop("GP_PRECISION_LANE", None)
+    env.pop("GP_MATMUL_PRECISION", None)
+    env.pop("GP_PRECISION_GRAM", None)
     for var in list(env):
         if var.startswith("BENCH_") or var.startswith("QUALITY_"):
             env.pop(var)
@@ -87,6 +92,34 @@ def test_bench_emits_one_parseable_result_line():
     assert res["experts_quarantined"] == 1
     assert res["faulted_fit_seconds"] > 0
     assert np.isfinite(res["faulted_final_nll_renormalized"])
+    # the mixed-precision lane contract: the lane the primary fit ran at
+    # is recorded, the MFU estimate is non-null (the peak table carries a
+    # CPU-proxy entry precisely so this plumbing is exercised off-TPU),
+    # and the precision_lanes section has all three lanes with gram rates,
+    # end-to-end fits, and fit-time guard deltas on the non-strict lanes.
+    # The >= 1.5x mixed-vs-strict gram bar is TPU-only (on CPU the
+    # compensated path is strictly extra work) — here only the shape.
+    assert detail["precision_lane"] == "strict"
+    assert detail["est_mfu_vs_bf16_peak"] is not None
+    assert detail["mxu_config"]["est_mfu_vs_bf16_peak"] is not None
+    lanes_section = detail["precision_lanes"]
+    assert "error" not in lanes_section, lanes_section
+    assert lanes_section["gram_probe"]["flops_per_call"] > 0
+    lanes = lanes_section["lanes"]
+    assert set(lanes) == {"strict", "mixed", "fast"}
+    for row in lanes.values():
+        assert row["gram_build_gflops_per_sec"] > 0
+        assert row["fit_seconds"] > 0
+        assert row["train_points_per_sec"] > 0
+    assert lanes["strict"]["source"] == "primary measurement"
+    for lane_name in ("mixed", "fast"):
+        assert lanes[lane_name]["gram_speedup_vs_strict"] > 0
+        guard = lanes[lane_name]["guard"]
+        for leg in ("delta_nll_rel", "delta_grad_rel", "delta_predict_rel"):
+            assert np.isfinite(guard[leg])
+    # no-breach is only pinned for the production-intended mixed lane
+    # (fast is a documented loose tripwire, not an accuracy contract)
+    assert lanes["mixed"]["guard"]["breach"] == 0.0, lanes["mixed"]["guard"]
 
 
 @pytest.mark.slow

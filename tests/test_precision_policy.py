@@ -1,0 +1,412 @@
+"""Mixed-precision lane guarantees (ops/precision.py, ops/distance.py).
+
+ISSUE 3's acceptance bars as tier-1 assertions: the compensated split-bf16
+gram path agrees with the strict (HIGHEST) lane to rtol <= 1e-5 on f32
+inputs across every kernel family; mixed-lane grams stay Cholesky-factorable
+under the shared JITTER_SCHEDULE; the lane knob round-trips through env,
+setter, scope, and the fluent estimator param; the L-BFGS segment carry and
+the serve batcher's request buffer are actually donated; and no module
+outside ``ops/`` pins a raw ``lax.Precision`` literal
+(tools/check_precision_pins.py).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu import (
+    ARDRBFKernel,
+    DotProductKernel,
+    GaussianProcessRegression,
+    Matern12Kernel,
+    Matern32Kernel,
+    Matern52Kernel,
+    PeriodicKernel,
+    PolynomialKernel,
+    RationalQuadraticKernel,
+    RBFKernel,
+    SpectralMixtureKernel,
+    WhiteNoiseKernel,
+)
+from spark_gp_tpu.ops import precision
+from spark_gp_tpu.ops.distance import mxu_inner, sq_dist, weighted_sq_dist
+from spark_gp_tpu.ops.precision import (
+    GUARD_BARS,
+    LANES,
+    active_lane,
+    get_policy,
+    precision_lane_scope,
+    set_precision_lane,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_lane(monkeypatch):
+    """Every test starts and ends on the default (strict) lane with no
+    env refinements — the knob is process-global state."""
+    monkeypatch.delenv("GP_PRECISION_LANE", raising=False)
+    monkeypatch.delenv("GP_PRECISION_GRAM", raising=False)
+    monkeypatch.delenv("GP_MATMUL_PRECISION", raising=False)
+    set_precision_lane(None)
+    yield
+    set_precision_lane(None)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+# every kernel family with a gram contraction (the sq-dist members, the
+# feature-map Periodic, the dot-product members, and the SM mixture);
+# p=3 matches each ctor below
+_FAMILIES = {
+    "rbf": lambda: RBFKernel(0.4),
+    "ard_rbf": lambda: ARDRBFKernel(np.array([0.3, 0.6, 1.1])),
+    "matern12": lambda: Matern12Kernel(0.8),
+    "matern32": lambda: Matern32Kernel(0.8),
+    "matern52": lambda: Matern52Kernel(0.8),
+    "rq": lambda: RationalQuadraticKernel(0.8, 1.7),
+    "periodic": lambda: PeriodicKernel(1.3, 0.6),
+    "dot": lambda: DotProductKernel(0.7),
+    "poly": lambda: PolynomialKernel(3, 1.2),
+    "spectral_mixture": lambda: SpectralMixtureKernel(3, q=2),
+    "composite": lambda: 1.0 * RBFKernel(0.4) + WhiteNoiseKernel(0.5, 0, 1),
+}
+
+# per-lane accuracy ladder, relative to max|gram| at strict: the
+# compensated split drops only the lo.lo term (~2^-18 relative — same
+# order as f32 rounding), the 1-pass fast lane keeps bf16's ~2^-8
+_LANE_RTOL = {"mixed": 1e-5, "fast": 5e-2}
+
+
+def _gram_at(kernel, theta, x, lane):
+    set_precision_lane(lane)
+    try:
+        return np.asarray(kernel.gram(theta, x), dtype=np.float64)
+    finally:
+        set_precision_lane(None)
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES), ids=sorted(_FAMILIES))
+@pytest.mark.parametrize("lane", ["mixed", "fast"])
+def test_gram_parity_vs_strict_all_families(family, lane, rng):
+    """ISSUE 3 acceptance: the compensated (mixed-lane) gram agrees with
+    the strict lane to rtol <= 1e-5 on f32 inputs for EVERY kernel
+    family; the fast lane holds its own (much looser) bar."""
+    kernel = _FAMILIES[family]()
+    theta = jnp.asarray(kernel.init_theta(), dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(48, 3)), dtype=jnp.float32)
+
+    k_strict = _gram_at(kernel, theta, x, "strict")
+    k_lane = _gram_at(kernel, theta, x, lane)
+
+    scale = np.max(np.abs(k_strict))
+    assert scale > 0
+    err = np.max(np.abs(k_lane - k_strict)) / scale
+    assert err <= _LANE_RTOL[lane], (
+        f"{family} gram at lane {lane!r}: rel err {err:.3e} exceeds "
+        f"{_LANE_RTOL[lane]:.0e} vs strict"
+    )
+
+
+def test_compensated_sq_dist_small_distances(rng):
+    """The cancellation case HIGHEST exists for: near-coincident points.
+    The compensated path must keep tiny squared distances accurate
+    relative to the matrix scale — at 1-pass bf16 they collapse to 0 or
+    go wild, which is exactly what the fast lane's looser bar admits."""
+    base = rng.normal(size=(32, 4)).astype(np.float32)
+    # pairs at ~1e-3 separation on O(1) coordinates: |x|^2 terms ~10,
+    # distances ~1e-5 — the three-term identity cancels ~6 digits
+    x = jnp.asarray(
+        np.concatenate([base, base + 1e-3 * rng.normal(size=base.shape)]),
+        dtype=jnp.float32,
+    )
+    set_precision_lane("strict")
+    d_strict = np.asarray(sq_dist(x, x), dtype=np.float64)
+    set_precision_lane("mixed")
+    d_mixed = np.asarray(sq_dist(x, x), dtype=np.float64)
+    w = jnp.asarray(np.array([1.0, 0.5, 2.0, 1.5]), dtype=jnp.float32)
+    d_strict_w = np.asarray(weighted_sq_dist(x, x, w), dtype=np.float64)
+    d_mixed_w = np.asarray(weighted_sq_dist(x, x, w), dtype=np.float64)
+    set_precision_lane(None)
+
+    scale = np.max(d_strict)
+    assert np.max(np.abs(d_mixed - d_strict)) / scale < 1e-5
+    assert np.max(np.abs(d_mixed_w - d_strict_w)) / np.max(d_strict_w) < 1e-5
+    # distances stay clamped nonnegative on every lane
+    assert np.min(d_mixed) >= 0.0
+
+
+def test_f64_inputs_are_lane_immune(rng):
+    """The PPA statistics path: f64 contractions bypass the lane entirely
+    (lax.Precision is inert there), so the magic-equation statistics are
+    bitwise identical on every lane."""
+    x64 = jnp.asarray(rng.normal(size=(24, 3)), dtype=jnp.float64)
+    if x64.dtype != jnp.float64:
+        pytest.skip("x64 disabled in this harness")
+    outs = {}
+    for lane in LANES:
+        set_precision_lane(lane)
+        outs[lane] = np.asarray(mxu_inner(x64, x64))
+    set_precision_lane(None)
+    np.testing.assert_array_equal(outs["strict"], outs["mixed"])
+    np.testing.assert_array_equal(outs["strict"], outs["fast"])
+
+
+def test_mixed_gram_cholesky_stable_under_jitter_schedule(rng):
+    """Downstream stability: a mixed-lane RBF gram (with near-duplicate
+    rows — the worst cancellation case) plus the usual sigma2 diagonal
+    must factor under the shared JITTER_SCHEDULE without exhausting the
+    ladder, and reconstruct to gram accuracy."""
+    from spark_gp_tpu.ops.linalg import JITTER_SCHEDULE, cholesky_escalated
+
+    base = rng.normal(size=(40, 3)).astype(np.float32)
+    x = jnp.asarray(
+        np.concatenate([base, base + 1e-4 * rng.normal(size=base.shape)]),
+        dtype=jnp.float32,
+    )
+    kernel = RBFKernel(0.7)
+    theta = jnp.asarray(kernel.init_theta(), dtype=jnp.float32)
+    set_precision_lane("mixed")
+    k = kernel.gram(theta, x)
+    set_precision_lane(None)
+    kmat = k + 1e-3 * jnp.eye(k.shape[0], dtype=k.dtype)
+    chol, tau_max = cholesky_escalated(kmat, "mixed-lane gram")
+    chol = np.asarray(chol, dtype=np.float64)
+    assert np.all(np.isfinite(chol))
+    assert tau_max <= JITTER_SCHEDULE[-1]
+    recon = chol @ chol.T
+    rel = np.max(np.abs(recon - np.asarray(kmat, dtype=np.float64)))
+    assert rel / np.max(np.abs(np.asarray(kmat))) < 1e-4
+
+
+def test_lane_plumbing_env_setter_scope_roundtrip(monkeypatch):
+    """Resolution order: scope > setter > env > strict default; invalid
+    names fail loud and NAMED at every entry point."""
+    assert active_lane() == "strict"
+    assert get_policy() == LANES["strict"]
+
+    monkeypatch.setenv("GP_PRECISION_LANE", "mixed")
+    assert active_lane() == "mixed"
+    assert get_policy().gram == "compensated"
+
+    # the setter wins over env and returns the previous override
+    assert set_precision_lane("fast") is None
+    assert active_lane() == "fast"
+    assert set_precision_lane("strict") == "fast"
+    # a scope wins over both and restores on exit (even nested)
+    with precision_lane_scope("mixed"):
+        assert active_lane() == "mixed"
+        with precision_lane_scope("fast"):
+            assert active_lane() == "fast"
+        assert active_lane() == "mixed"
+    assert active_lane() == "strict"
+    # None-scope is a no-op passthrough
+    with precision_lane_scope(None):
+        assert active_lane() == "strict"
+    # clearing the setter falls back to env
+    set_precision_lane(None)
+    assert active_lane() == "mixed"
+
+    with pytest.raises(ValueError, match="GP_PRECISION_LANE"):
+        monkeypatch.setenv("GP_PRECISION_LANE", "bf16")
+        active_lane()
+    monkeypatch.delenv("GP_PRECISION_LANE")
+    with pytest.raises(ValueError, match="set_precision_lane"):
+        set_precision_lane("fastest")
+    with pytest.raises(ValueError, match="precision_lane_scope"):
+        with precision_lane_scope("loose"):
+            pass
+
+    # per-stage env refinements override the lane's defaults
+    monkeypatch.setenv("GP_PRECISION_GRAM", "high")
+    monkeypatch.setenv("GP_MATMUL_PRECISION", "default")
+    policy = get_policy()
+    assert policy.gram == "high"
+    assert policy.linalg == "default"
+    monkeypatch.setenv("GP_PRECISION_GRAM", "six-pass")
+    with pytest.raises(ValueError, match="GP_PRECISION_GRAM"):
+        get_policy()
+
+
+def test_estimator_setter_is_fluent_and_process_wide():
+    """setPrecisionLane is a veneer over the process knob — the fluent
+    call returns the estimator and flips the ambient lane."""
+    gp = GaussianProcessRegression()
+    assert gp.setPrecisionLane("mixed") is gp
+    assert active_lane() == "mixed"
+    # snake_case alias rides along like the other params
+    gp.set_precision_lane("strict")
+    assert active_lane() == "strict"
+    with pytest.raises(ValueError):
+        gp.setPrecisionLane("turbo")
+
+
+def _tiny_expert_stack(rng, e=2, s=16, p=2):
+    x = jnp.asarray(rng.normal(size=(e, s, p)), dtype=jnp.float32)
+    y = jnp.asarray(
+        np.sin(np.asarray(x).sum(axis=-1)), dtype=jnp.float32
+    )
+    mask = jnp.ones((e, s), dtype=jnp.float32)
+    return x, y, mask
+
+
+def test_lbfgs_segment_carry_is_donated(rng):
+    """The fit-side donation contract: the segment-advance program aliases
+    the L-BFGS state carry into its output (HLO carries the aliasing
+    annotation), and executing it consumes the input state's buffers —
+    so run_segmented's carry never double-buffers in HBM."""
+    from spark_gp_tpu.models.likelihood import (
+        gpr_device_segment_init,
+        gpr_device_segment_run,
+    )
+
+    kernel = RBFKernel(0.5, 1e-3, 10.0)
+    theta0 = jnp.asarray(kernel.init_theta(), dtype=jnp.float32)
+    lower, upper = (
+        jnp.asarray(b, dtype=jnp.float32) for b in kernel.bounds()
+    )
+    x, y, mask = _tiny_expert_stack(rng)
+    state = gpr_device_segment_init(
+        kernel, None, True, theta0, lower, upper, x, y, mask
+    )
+    limit = jnp.asarray(3, jnp.int32)
+    tol = jnp.asarray(1e-6, jnp.float32)
+
+    lowered = gpr_device_segment_run.lower(
+        kernel, None, True, state, lower, upper, x, y, mask, limit, tol
+    )
+    assert "tf.aliasing_output" in lowered.as_text()
+
+    new_state = gpr_device_segment_run(
+        kernel, None, True, state, lower, upper, x, y, mask, limit, tol
+    )
+    # the donated carry is consumed: its buffers are gone, the returned
+    # state is alive and well — live-buffer count stays flat per segment
+    assert state.theta.is_deleted()
+    assert state.s_hist.is_deleted()
+    assert state.y_hist.is_deleted()
+    assert not new_state.theta.is_deleted()
+    assert np.isfinite(float(new_state.f))
+    # ... and the next segment chains off the returned state
+    final = gpr_device_segment_run(
+        kernel, None, True, new_state, lower, upper, x, y, mask,
+        jnp.asarray(6, jnp.int32), tol,
+    )
+    assert new_state.theta.is_deleted()
+    assert np.all(np.isfinite(np.asarray(final.theta)))
+
+
+def test_batcher_request_buffer_donation_annotations():
+    """The predict-side donation contract: the batcher's donating jit
+    variant aliases the padded request buffer (arg 4) into its output.
+    Lowered explicitly (CPU backends construct the non-donating variant),
+    so the annotation is asserted regardless of harness hardware."""
+    from spark_gp_tpu.serve.batcher import BucketedPredictor
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(120, 2))
+    y = np.sin(x.sum(axis=1))
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(30)
+        .setActiveSetSize(20)
+        .setSigma2(1e-3)
+        .setMaxIter(3)
+        .setSeed(5)
+        .fit(x, y)
+    )
+    bp = BucketedPredictor(model.raw_predictor, max_batch=16, min_bucket=8)
+    raw = model.raw_predictor
+    dtype = jnp.float32
+    args = (
+        jnp.asarray(raw.theta, dtype=dtype),
+        jnp.asarray(raw.active, dtype=dtype),
+        jnp.asarray(raw.magic_vector, dtype=dtype),
+        jnp.asarray(raw.magic_matrix, dtype=dtype),
+        jnp.zeros((8, 2), dtype=dtype),
+    )
+    donating = bp._make_jit(donate=True)
+    assert "tf.aliasing_output" in donating.lower(*args).as_text()
+    # the construction-time lane is captured and pinned on the surface
+    assert bp.precision_lane == active_lane()
+
+
+def test_mixed_fit_emits_precision_guard(rng):
+    """Every fit at a non-default lane carries the mixed_precision_guard
+    artifact: the three relative deltas vs the strict lane plus the
+    breach flag, under the lane's bar on this healthy synthetic; a
+    strict fit records its lane and no guard deltas."""
+    x = rng.normal(size=(300, 2))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=300)
+
+    def fit():
+        return (
+            GaussianProcessRegression()
+            .setKernel(lambda: RBFKernel(1.0))
+            .setDatasetSizeForExpert(50)
+            .setActiveSetSize(30)
+            .setSigma2(1e-3)
+            .setMaxIter(5)
+            .setSeed(7)
+            .fit(x, y)
+        )
+
+    set_precision_lane("mixed")
+    model = fit()
+    set_precision_lane(None)
+    metrics = model.instr.metrics
+    assert metrics["precision_lane"] == "mixed"
+    for leg in ("delta_nll_rel", "delta_grad_rel", "delta_predict_rel"):
+        val = metrics[f"mixed_precision_guard.{leg}"]
+        assert np.isfinite(val) and val >= 0.0
+    assert metrics["mixed_precision_guard.breach"] == 0.0
+    worst = max(
+        metrics["mixed_precision_guard.delta_nll_rel"],
+        metrics["mixed_precision_guard.delta_grad_rel"],
+        metrics["mixed_precision_guard.delta_predict_rel"],
+    )
+    assert worst <= GUARD_BARS["mixed"]
+
+    strict_model = fit()
+    strict_metrics = strict_model.instr.metrics
+    assert strict_metrics["precision_lane"] == "strict"
+    assert not any(
+        k.startswith("mixed_precision_guard.") for k in strict_metrics
+    )
+    # the two lanes' models agree on predictions (the guard's promise,
+    # checked end-to-end on the full posterior mean)
+    mean_m = model.predict(x)
+    mean_s = strict_model.predict(x)
+    scale = float(np.max(np.abs(mean_s)))
+    assert float(np.max(np.abs(mean_m - mean_s))) / scale < 1e-3
+
+
+def test_no_raw_precision_pins_outside_ops():
+    """tools/check_precision_pins.py as a tier-1 gate: all MXU precision
+    choices route through the policy — a new raw ``lax.Precision`` pin
+    outside ops/ fails here before it ever lands."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_precision_pins
+    finally:
+        sys.path.pop(0)
+
+    violations = check_precision_pins.find_pins(
+        os.path.join(ROOT, "spark_gp_tpu")
+    )
+    assert violations == [], (
+        "raw lax.Precision pins outside ops/ (route through "
+        "ops/precision.py or mark '# precision-pin-ok'):\n"
+        + "\n".join(f"{p}:{n}: {l}" for p, n, l in violations)
+    )
+    # the tool's CLI contract: exit 0 on a clean tree
+    assert check_precision_pins.main([os.path.join(ROOT, "spark_gp_tpu")]) == 0

@@ -540,16 +540,27 @@ def test_cross_validate_param_grid_nan_cell_never_wins():
 
 def test_chip_peaks_and_precision_passes():
     """The shared chip-spec table (ops/precision.py): known generations
-    resolve both peaks, unknown kinds resolve to None (consumers then
-    report MFU as null rather than guessing), and the pass-count table
-    covers exactly the knob's vocabulary."""
+    resolve both peaks, CPU hosts resolve to the nominal host-proxy
+    figures (so CPU-fallback bench rounds report a non-null
+    est_mfu_vs_bf16_peak through the same pipeline — ISSUE 3), truly
+    unknown kinds resolve to None (consumers then report MFU as null
+    rather than guessing), and the pass-count table covers exactly the
+    policy's gram/linalg mode vocabulary."""
     from spark_gp_tpu.ops.precision import PRECISION_PASSES, chip_peaks
 
     tf, bw = chip_peaks("TPU v5 lite")
     assert (tf, bw) == (197.0, 819.0)
     tf, bw = chip_peaks("TPU v4")
     assert (tf, bw) == (275.0, 1228.0)
-    assert chip_peaks("TFRT_CPU_0 whatever") == (None, None)
-    # the knob's vocabulary (its HIGHEST default is pinned by
+    # v5p/v6e rows exist so est_mfu_vs_bf16_peak is non-null there too
+    assert chip_peaks("TPU v5p")[0] == 459.0
+    assert chip_peaks("TPU v6e")[0] == 918.0
+    # the CPU host-proxy row (a PLUMBING proxy — bench.py marks such
+    # rounds as fallback; never comparable to the TPU rows)
+    assert chip_peaks("TFRT_CPU_0 whatever") == (0.5, 40.0)
+    assert chip_peaks("some fpga thing") == (None, None)
+    # the mode vocabulary (the lanes' HIGHEST/strict default is pinned by
     # test_matmul_precision_knob in test_pallas_linalg.py)
-    assert set(PRECISION_PASSES) == {"highest", "high", "default"}
+    assert set(PRECISION_PASSES) == {
+        "highest", "high", "default", "compensated"
+    }

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Pre-commit-style guard: no raw ``lax.Precision`` pins outside ``ops/``.
+
+The mixed-precision lanes (``spark_gp_tpu/ops/precision.py``) only work if
+every MXU contraction actually consults the policy: one module that pins
+``precision=jax.lax.Precision.HIGHEST`` directly is invisible to the lane
+switch and silently drags its matmuls back to the 6-pass ceiling (or, worse,
+pins a gram build at 1-pass with no guard watching).  This checker greps the
+package for raw ``Precision.<MODE>`` literals anywhere outside
+``spark_gp_tpu/ops/`` — the two sanctioned homes are ``ops/precision.py``
+(the name -> enum tables) and ``ops/distance.py`` / ``ops/pallas_linalg.py``
+(the policy's consumers of those tables).
+
+Run standalone (``python tools/check_precision_pins.py``; exit 1 on
+violations) or through its tier-1 wrapper
+(``tests/test_precision_policy.py::test_no_raw_precision_pins_outside_ops``),
+so a new pin fails CI before it ever reaches a review.
+
+A line that genuinely must pin (e.g. a deliberately lane-immune reference
+oracle) can opt out with a trailing ``# precision-pin-ok`` comment — the
+escape is greppable, so every exemption stays auditable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# the enum literal in any spelling the package uses (jax.lax.Precision.X,
+# lax.Precision.X, Precision.X); doc prose mentioning the name inside a
+# string/docstring still matches — keeping the rule dumb and unforgeable
+# beats parsing, and prose can use the lowercase mode names instead
+_PIN = re.compile(r"\bPrecision\s*\.\s*(HIGHEST|HIGH|DEFAULT)\b")
+_ALLOW = "precision-pin-ok"
+
+# directory (relative to the package root) whose files own the enum tables
+_SANCTIONED_DIR = "ops"
+
+
+def find_pins(package_root: str) -> list[tuple[str, int, str]]:
+    """``(relative_path, lineno, stripped_line)`` for every raw
+    ``Precision.<MODE>`` literal in a ``.py`` file outside ``ops/``."""
+    violations = []
+    package_root = os.path.abspath(package_root)
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        rel_dir = os.path.relpath(dirpath, package_root)
+        parts = [] if rel_dir == "." else rel_dir.split(os.sep)
+        if parts and parts[0] == _SANCTIONED_DIR:
+            dirnames[:] = []
+            continue
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    if _PIN.search(line) and _ALLOW not in line:
+                        rel = os.path.relpath(path, os.path.dirname(package_root))
+                        violations.append((rel, lineno, line.strip()))
+    return violations
+
+
+def main(argv: list[str] | None = None) -> int:
+    root = (argv or sys.argv[1:]) or [
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "spark_gp_tpu")
+    ]
+    violations = find_pins(root[0])
+    if violations:
+        print(
+            "raw lax.Precision pins outside spark_gp_tpu/ops/ — route these "
+            "through the precision policy (ops/precision.py: matmul_precision"
+            "() for linalg-stage matmuls, ops/distance.mxu_inner for gram "
+            "contractions), or mark a deliberate exemption with "
+            f"'# {_ALLOW}':",
+            file=sys.stderr,
+        )
+        for rel, lineno, line in violations:
+            print(f"  {rel}:{lineno}: {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
