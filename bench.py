@@ -642,6 +642,214 @@ def worker() -> None:
     else:
         precision_lanes = {"skipped": "BENCH_PRECISION_LANES != 1"}
 
+    # Observability overhead (the ISSUE 4 tracing layer): the SAME fit and
+    # serve burst with the tracer on vs off (obs/trace.py set_tracing), at
+    # a capped size so the section stays cheap.  The contract bar — <2%
+    # overhead on both paths, asserted in test_bench_contract — is what
+    # keeps the span layer provably out of the hot path.  Interleaved
+    # repeats with a min-of-reps estimate, because the true overhead
+    # (a handful of spans per fit, one per micro-batch) is far below
+    # run-to-run wall-clock noise and the MIN is the low-noise statistic.
+    def _observability_section():
+        import tempfile
+
+        from spark_gp_tpu.obs import trace as obs_trace
+        from spark_gp_tpu.serve import GPServeServer
+
+        # independent workload size: at tiny BENCH_N a fit is ~50ms and
+        # wall-clock noise alone is >2% — the comparison needs fits long
+        # enough that the bar is resolvable, so the section generates its
+        # own rows when the primary's are too few
+        n_obs = int(os.environ.get("BENCH_OBS_N", 20_000))
+        obs_iters = min(max_iter, int(os.environ.get("BENCH_OBS_MAXITER", 10)))
+        if n_obs > n:
+            xo, yo = make_benchmark_data(n_obs)
+        else:
+            xo, yo = x[:n_obs], y[:n_obs]
+
+        def fit_once():
+            t0 = time.perf_counter()
+            model_o = make_gp(obs_iters).fit(xo, yo)
+            return time.perf_counter() - t0, model_o
+
+        make_gp(1).fit(xo, yo)  # warm-up/compile at the section's shape
+        t_cal, _ = fit_once()  # calibration: how many pairs noise needs
+        # shorter fits need more pairs (scheduler noise is ~10ms quanta)
+        reps = max(1, int(os.environ.get("BENCH_OBS_REPEATS", "0") or 0) or (
+            10 if t_cal < 0.5 else 5 if t_cal < 2.0 else 3
+        ))
+        fit_on, fit_off = [], []
+        spans_per_fit = 0
+        try:
+            for _ in range(reps):
+                obs_trace.set_tracing(False)
+                fit_off.append(fit_once()[0])
+                obs_trace.set_tracing(True)
+                dt, model_o = fit_once()
+                fit_on.append(dt)
+                spans_per_fit = model_o.run_journal["span_count"]
+        finally:
+            obs_trace.set_tracing(None)  # back to the env default
+
+        def serve_burst(server_, n_requests):
+            futs = []
+            total_rows = 0
+            t0 = time.perf_counter()
+            for i in range(n_requests):
+                sz = (1, 4, 16)[i % 3]
+                row = (i * 37) % max(1, n_obs - 64)
+                futs.append(server_.submit("obs", xo[row : row + sz]))
+                total_rows += sz
+            for f in futs:
+                f.result(timeout=300.0)
+            return total_rows / (time.perf_counter() - t0)
+
+        n_requests = int(os.environ.get("BENCH_OBS_SERVE_REQUESTS", 200))
+        server = GPServeServer(
+            max_batch=64, min_bucket=8, max_wait_ms=1.0,
+            capacity=max(4096, n_requests), request_timeout_ms=None,
+        )
+        with tempfile.TemporaryDirectory() as tmp:
+            mpath = os.path.join(tmp, "obs_model.npz")
+            model_o.save(mpath)
+            server.register("obs", mpath)  # AOT warmup before any burst
+        server.start()
+        serve_on, serve_off = [], []
+        serve_reps = max(reps, 5)  # bursts are short; the max needs samples
+        batches_before = batches_after = 0.0
+        try:
+            serve_burst(server, n_requests)  # warm the whole request path
+            for _ in range(serve_reps):
+                obs_trace.set_tracing(False)
+                serve_off.append(serve_burst(server, n_requests))
+                obs_trace.set_tracing(True)
+                batches_before = server.metrics.counter("batches")
+                serve_on.append(serve_burst(server, n_requests))
+                batches_after = server.metrics.counter("batches")
+        finally:
+            obs_trace.set_tracing(None)
+            server.stop()
+
+        import statistics
+
+        from spark_gp_tpu.obs import runtime as obs_runtime
+
+        # Two estimators, different jobs.  measured_delta_pct is the
+        # honest differential (median of per-pair relative deltas over
+        # interleaved repeats) — informative, but on a shared host its
+        # noise floor is several % of one fit, far above the true cost.
+        # overhead_pct — the ASSERTED number — is a direct measurement:
+        # replay exactly the layer's per-fit host work (capture, the
+        # fit's span count, phase-boundary samples, journal build over
+        # the fit's real instr) many times, and divide by the fit's
+        # wall-clock.  The layer's work is strictly additive host-side
+        # code, so timing it directly resolves far below the 2% bar
+        # where wall-clock differencing cannot.
+        fit_delta = statistics.median(
+            (t_on - t_off) / t_off * 100.0
+            for t_off, t_on in zip(fit_off, fit_on)
+        )
+        serve_delta = statistics.median(
+            (pps_off - pps_on) / pps_off * 100.0
+            for pps_off, pps_on in zip(serve_off, serve_on)
+        )
+
+        def fit_layer_seconds():
+            replay = 50
+            instr_real = model_o.instr
+            # force the layer ON for the replay (GP_TRACING=0 in the env
+            # would otherwise time no-ops and report a false-clean 0%),
+            # and suppress the journal-dir env fallback — the replay
+            # measures the journal BUILD; 50 fsync'd junk files into an
+            # operator's GP_RUN_JOURNAL_DIR is neither the default-config
+            # cost nor acceptable litter
+            prev_dir = os.environ.pop("GP_RUN_JOURNAL_DIR", None)
+            obs_trace.set_tracing(True)
+            try:
+                t0 = time.perf_counter()
+                for _ in range(replay):
+                    with obs_runtime.fit_capture("bench.obs.replay") as cap:
+                        with obs_trace.span("fit.replay") as root:
+                            for _ in range(max(1, spans_per_fit - 1)):
+                                with obs_trace.span("phase.replay"):
+                                    pass
+                                obs_runtime.on_phase_boundary(
+                                    "replay", "phase.replay"
+                                )
+                    obs_runtime.write_run_journal(instr_real, root, cap)
+                return (time.perf_counter() - t0) / replay
+            finally:
+                obs_trace.set_tracing(None)
+                if prev_dir is not None:
+                    os.environ["GP_RUN_JOURNAL_DIR"] = prev_dir
+
+        fit_layer_s = fit_layer_seconds()
+        fit_wall = min(fit_on)
+        fit_overhead = fit_layer_s / fit_wall * 100.0
+
+        # serve: the layer's per-batch work is one serve.batch + one
+        # serve.predict span (events are failure-path only); forced ON
+        # like the fit replay — a no-op pair measures nothing
+        span_reps = 2000
+        obs_trace.set_tracing(True)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(span_reps):
+                with obs_trace.span("serve.batch.replay"):
+                    with obs_trace.span("serve.predict.replay"):
+                        pass
+            span_pair_s = (time.perf_counter() - t0) / span_reps
+        finally:
+            obs_trace.set_tracing(None)
+        batches_per_burst = max(1.0, batches_after - batches_before)
+        total_rows = sum((1, 4, 16)[i % 3] for i in range(n_requests))
+        burst_wall_s = total_rows / max(serve_on)
+        serve_overhead = (
+            batches_per_burst * span_pair_s / burst_wall_s * 100.0
+        )
+
+        return {
+            "n_points": n_obs,
+            "max_iter": obs_iters,
+            "repeats": reps,
+            "fit": {
+                "tracer_on_seconds_min": min(fit_on),
+                "tracer_off_seconds_min": min(fit_off),
+                "measured_delta_pct": fit_delta,
+                "layer_cost_seconds": fit_layer_s,
+                "overhead_pct": fit_overhead,
+                "spans_per_fit": spans_per_fit,
+            },
+            "serve_predict": {
+                "requests": n_requests,
+                "repeats": serve_reps,
+                "tracer_on_points_per_sec_max": max(serve_on),
+                "tracer_off_points_per_sec_max": max(serve_off),
+                "measured_delta_pct": serve_delta,
+                "batches_per_burst": batches_per_burst,
+                "span_pair_seconds": span_pair_s,
+                "overhead_pct": serve_overhead,
+            },
+            "note": (
+                "tracer on = span tracing + run-journal capture + "
+                "compile/memory telemetry (GP_TRACING default); off = "
+                "obs/trace.set_tracing(False).  overhead_pct (asserted "
+                "<2% in test_bench_contract) divides the directly-"
+                "measured layer work (replayed capture/spans/journal per "
+                "fit; span pairs per serve batch) by the measured path "
+                "wall-clock; measured_delta_pct is the raw interleaved "
+                "differential, noise-dominated on shared hosts"
+            ),
+        }
+
+    if os.environ.get("BENCH_OBSERVABILITY", "1") == "1":
+        try:
+            observability = _observability_section()
+        except Exception as exc:  # noqa: BLE001 — secondary metric only
+            observability = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    else:
+        observability = {"skipped": "BENCH_OBSERVABILITY != 1"}
+
     def _classifier_fit_seconds(estimator_cls, labels):
         """Warm-up + timed fit of a classifier at the same shape/config as
         the primary metric (one definition, so the binary and multiclass
@@ -750,6 +958,7 @@ def worker() -> None:
             "serve_predict": serve_predict,
             "resilience": resilience,
             "precision_lanes": precision_lanes,
+            "observability": observability,
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,
             "cpu_proxy_host_cores": host_cores,

@@ -338,6 +338,35 @@ class GaussianProcessCommons(GaussianProcessParams):
         (GaussianProcessCommons.scala:18)."""
         return self._kernel_factory() + Const(self._sigma2) * EyeKernel()
 
+    def _observed_fit(self, instr: Instrumentation, run):
+        """Observability shell around one COMPLETE public fit: opens the
+        root span every phase span nests under, activates the runtime
+        capture (compile counting + phase-boundary memory sampling), and
+        stamps the returned model with its ``run_journal``
+        (obs/runtime.py) — persisted next to the checkpoints when a
+        checkpoint dir (or ``GP_RUN_JOURNAL_DIR``) is configured.
+
+        ``run()`` is the whole fit (restarts, recovery, everything); with
+        tracing off (``GP_TRACING=0``) this is a straight call — the
+        bench's observability section measures exactly that difference.
+        """
+        from spark_gp_tpu.obs import runtime as obs_runtime
+        from spark_gp_tpu.obs import trace as obs_trace
+
+        if not obs_trace.tracing_enabled():
+            return run()
+        with obs_runtime.fit_capture(instr.name) as cap:
+            with obs_trace.span(
+                f"fit.{instr.name}", family=type(self).__name__
+            ) as root:
+                model = run()
+        journal_instr = getattr(model, "instr", None) or instr
+        model.run_journal = obs_runtime.write_run_journal(
+            journal_instr, root, cap,
+            mesh=self._mesh, journal_dir=self._checkpoint_dir,
+        )
+        return model
+
     def _fit_with_restarts(self, outer_instr: Instrumentation, fit_once):
         """Multi-start driver (setNumRestarts): ``fit_once(kernel, instr)``
         must return a fitted model carrying
@@ -555,6 +584,14 @@ class GaussianProcessCommons(GaussianProcessParams):
             f"({int(dropped)}/{int(base)} total dropped); BCM objective "
             f"renormalized by {renorm:.4f}"
         )
+        # the quarantine transition as a span event: the run journal (and
+        # any trace view) shows WHEN in the fit the drop happened
+        from spark_gp_tpu.obs import trace as obs_trace
+
+        obs_trace.add_event(
+            "experts.quarantined",
+            count=n_bad, source=source, total_dropped=int(dropped),
+        )
         return data
 
     def _run_with_expert_resilience(self, instr, data, run_fit):
@@ -596,6 +633,12 @@ class GaussianProcessCommons(GaussianProcessParams):
         probe_objective = objective if objective in ("marginal", "loo") else "marginal"
 
         def recover(attempt_idx, exc):
+            from spark_gp_tpu.obs import trace as obs_trace
+
+            obs_trace.add_event(
+                "fit.retry", attempt=attempt_idx + 1,
+                error=type(exc).__name__,
+            )
             kernel = self._get_kernel()
             report = diagnose_experts(
                 kernel, kernel.init_theta(), state["data"],
@@ -612,6 +655,9 @@ class GaussianProcessCommons(GaussianProcessParams):
                 import jax.numpy as jnp
 
                 instr.log_metric("experts_jittered", report.num_jittered)
+                obs_trace.add_event(
+                    "experts.jittered", count=report.num_jittered
+                )
                 instr.log_warning(
                     f"fit recovery: {report.num_jittered} expert(s) "
                     "repaired by adaptive jitter escalation "
@@ -824,39 +870,50 @@ class GaussianProcessCommons(GaussianProcessParams):
         is silently discarded.  Estimator-specific validation/target
         preparation lives in ``prepare`` (label-domain checks, one-hot
         construction, ...)."""
-        import jax
-
         instr = Instrumentation(name=name)
         with self._stack_mesh(data):
-            instr.log_metric("num_experts", int(data.x.shape[0]))
-            instr.log_metric("expert_size", int(data.x.shape[1]))
-            if self._expert_quarantine and jax.process_count() == 1:
-                # same pre-fit data screen as the in-process fit paths: a
-                # bad shard's NaN rows must not poison the mesh-wide psum
-                from spark_gp_tpu.resilience.quarantine import (
-                    nonfinite_expert_mask,
-                )
-
-                bad = nonfinite_expert_mask(data)
-                if bad.any():
-                    data = self._apply_quarantine(
-                        instr, data, bad, "data screen"
-                    )
-            elif self._expert_quarantine:
-                # the screen (and with_experts_masked) host-fetch the
-                # stack, which a cross-process sharding cannot satisfy —
-                # skip rather than crash every clean multihost fit
-                instr.log_warning(
-                    "expert quarantine screen skipped: the stack spans "
-                    f"{jax.process_count()} processes and cannot be "
-                    "host-fetched for diagnosis"
-                )
-            active64 = (
-                None if active_set is None
-                else np.asarray(active_set, dtype=np.float64)
+            # observation shell INSIDE the mesh context but around the
+            # whole body: the data screen's quarantine events and the
+            # restart driver land in one root span (the gpr.py convention)
+            return self._observed_fit(
+                instr,
+                lambda: self._fit_distributed_body(
+                    instr, data, active_set, prepare
+                ),
             )
-            fit_once = prepare(instr, active64, data)
-            return self._fit_with_restarts(instr, fit_once)
+
+    def _fit_distributed_body(self, instr, data, active_set, prepare):
+        import jax
+
+        instr.log_metric("num_experts", int(data.x.shape[0]))
+        instr.log_metric("expert_size", int(data.x.shape[1]))
+        if self._expert_quarantine and jax.process_count() == 1:
+            # same pre-fit data screen as the in-process fit paths: a
+            # bad shard's NaN rows must not poison the mesh-wide psum
+            from spark_gp_tpu.resilience.quarantine import (
+                nonfinite_expert_mask,
+            )
+
+            bad = nonfinite_expert_mask(data)
+            if bad.any():
+                data = self._apply_quarantine(
+                    instr, data, bad, "data screen"
+                )
+        elif self._expert_quarantine:
+            # the screen (and with_experts_masked) host-fetch the
+            # stack, which a cross-process sharding cannot satisfy —
+            # skip rather than crash every clean multihost fit
+            instr.log_warning(
+                "expert quarantine screen skipped: the stack spans "
+                f"{jax.process_count()} processes and cannot be "
+                "host-fetched for diagnosis"
+            )
+        active64 = (
+            None if active_set is None
+            else np.asarray(active_set, dtype=np.float64)
+        )
+        fit_once = prepare(instr, active64, data)
+        return self._fit_with_restarts(instr, fit_once)
 
     def _optimize_latent_host(self, instr, kernel, objective, f0):
         """Host-driven L-BFGS-B over a latent-carrying jitted objective

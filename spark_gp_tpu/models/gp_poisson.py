@@ -64,7 +64,14 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         y_f = np.asarray(y, dtype=np.float64)
         if np.any(y_f < 0) or not np.all(y_f == np.floor(y_f)):
             raise ValueError("targets must be non-negative integer counts")
+        # the observation shell wraps the WHOLE post-validation body (the
+        # gpr.py convention): grouping/screen phases — and any screen-time
+        # quarantine events — land inside the fit's root span
+        return self._observed_fit(
+            instr, lambda: self._fit_body(instr, x, y_f)
+        )
 
+    def _fit_body(self, instr, x, y_f) -> "GaussianProcessPoissonModel":
         with instr.phase("group_experts"):
             data = self._group_screened(instr, x, y_f)
         instr.log_metric("num_experts", data.num_experts)

@@ -61,7 +61,14 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         if not np.all(np.isin(y, (0.0, 1.0))):
             # GPClf.scala:68-72
             raise ValueError("Only 0 and 1 labels are supported.")
+        # the observation shell wraps the WHOLE post-validation body (the
+        # gpr.py convention): grouping/screen phases — and any screen-time
+        # quarantine events — land inside the fit's root span
+        return self._observed_fit(
+            instr, lambda: self._fit_body(instr, x, y)
+        )
 
+    def _fit_body(self, instr, x, y) -> "GaussianProcessClassificationModel":
         with instr.phase("group_experts"):
             data = self._group_screened(instr, x, y)
         instr.log_metric("num_experts", data.num_experts)

@@ -145,12 +145,14 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
         model = super().fit(x, y)
         ep_model = GaussianProcessEPClassificationModel(model.raw_predictor)
         ep_model.instr = model.instr
+        ep_model.run_journal = getattr(model, "run_journal", None)
         return ep_model
 
     def fit_distributed(self, data, active_set=None):
         model = super().fit_distributed(data, active_set)
         ep_model = GaussianProcessEPClassificationModel(model.raw_predictor)
         ep_model.instr = model.instr
+        ep_model.run_journal = getattr(model, "run_journal", None)
         return ep_model
 
 

@@ -101,7 +101,14 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         n_classes = int(y_int.max()) + 1
         if n_classes < 2:
             raise ValueError("need at least 2 classes")
+        # the observation shell wraps the WHOLE post-validation body (the
+        # gpr.py convention): grouping/screen phases — and any screen-time
+        # quarantine events — land inside the fit's root span
+        return self._observed_fit(
+            instr, lambda: self._fit_body(instr, x, y_int, n_classes)
+        )
 
+    def _fit_body(self, instr, x, y_int, n_classes) -> "GaussianProcessMulticlassModel":
         with instr.phase("group_experts"):
             data = self._group_screened(instr, x, y_int.astype(np.float64))
         instr.log_metric("num_experts", data.num_experts)

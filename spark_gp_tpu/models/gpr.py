@@ -136,7 +136,13 @@ class GaussianProcessRegression(GaussianProcessCommons):
             raise ValueError(f"x must be [N, p], got shape {x.shape}")
         if y.shape != (x.shape[0],):
             raise ValueError(f"y must be [N], got shape {y.shape}")
+        # the observation shell wraps the WHOLE post-validation body, so
+        # the grouping/screen phases land inside the fit's root span
+        return self._observed_fit(
+            instr, lambda: self._fit_body(instr, x, y)
+        )
 
+    def _fit_body(self, instr, x, y) -> "GaussianProcessRegressionModel":
         with instr.phase("group_experts"):
             data = self._group_screened(instr, x, y)
         instr.log_metric("num_experts", data.num_experts)

@@ -1,0 +1,33 @@
+"""Unified observability layer: span tracing, metric exposition, runtime
+telemetry.
+
+Three modules, one system (docs/OBSERVABILITY.md):
+
+* :mod:`spark_gp_tpu.obs.trace` — context-var span tracer.  Nested,
+  attributed spans with a process-global ring buffer; a fit or a serve
+  request renders as one tree.  ``Instrumentation.phase`` and the serve
+  batch path emit into it automatically.  Exports: JSONL and
+  Chrome/Perfetto ``trace_event``.
+* :mod:`spark_gp_tpu.obs.expo` — OpenMetrics/Prometheus text exposition
+  of any :class:`~spark_gp_tpu.serve.metrics.ServingMetrics` /
+  :class:`~spark_gp_tpu.utils.instrumentation.Instrumentation` instance,
+  plus a minimal plain-text TCP scrape listener.
+* :mod:`spark_gp_tpu.obs.runtime` — the JAX runtime bridge:
+  ``jax.monitoring`` compile/retrace counting per entry point,
+  ``device.memory_stats()`` gauges sampled on phase boundaries, and the
+  per-fit ``run_journal`` artifact.
+
+Every metric key any of this emits is registered in
+:mod:`spark_gp_tpu.obs.names` — the one catalog
+``tools/check_metric_names.py`` lints the package against.
+"""
+
+from spark_gp_tpu.obs.trace import (  # noqa: F401
+    add_event,
+    current_span,
+    set_tracing,
+    span,
+    tracing_enabled,
+)
+from spark_gp_tpu.obs.expo import render_openmetrics  # noqa: F401
+from spark_gp_tpu.obs.runtime import telemetry, write_run_journal  # noqa: F401

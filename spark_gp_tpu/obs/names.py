@@ -1,0 +1,174 @@
+"""THE metric-name catalog: every key the package emits, in one place.
+
+Dashboards die by rename: a counter that silently becomes
+``queue.shed.deadline_v2`` leaves its panel flatlining at zero while the
+alert it fed goes quiet.  The contract here is mechanical: every
+metric/counter/gauge/histogram/phase key emitted anywhere in
+``spark_gp_tpu`` must (a) be dot-separated lowercase
+(``[a-z0-9_]+(\\.[a-z0-9_]+)*``) and (b) appear in :data:`CATALOG` —
+``tools/check_metric_names.py`` walks the package AST and fails CI on
+any emission that breaks either rule (tier-1 wrapper:
+``tests/test_observability.py``).
+
+Dynamic keys register as ``*`` patterns (``restart_*_nll``,
+``breaker.open.*``); the wildcard part is runtime data (a restart index,
+a model name) and exempt from the lowercase grammar.  A pattern may name
+the Prometheus ``label`` the wildcard maps to, which is how
+:mod:`spark_gp_tpu.obs.expo` renders ``breaker.open.mymodel`` as
+``gp_breaker_open{model="mymodel"}`` instead of minting one metric
+family per model.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+#: concrete-key grammar: lowercase [a-z0-9_] components, dot-separated
+KEY_GRAMMAR = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+#: pattern grammar: same, plus ``*`` wildcards for runtime-data parts
+PATTERN_GRAMMAR = re.compile(r"^[a-z0-9_*]+(\.[a-z0-9_*]+)*$")
+
+
+@dataclass(frozen=True)
+class MetricName:
+    """One registered key (or ``*`` pattern) and how to expose it."""
+
+    key: str
+    #: counter | gauge | histogram | metric (fit scalar) | phase (timing)
+    kind: str
+    help: str
+    #: for patterns: the exposition label the wildcard part becomes
+    label: Optional[str] = None
+    #: histogram bucket upper bounds override (expo picks a default ladder)
+    buckets: Optional[Tuple[float, ...]] = None
+
+
+CATALOG: Tuple[MetricName, ...] = (
+    # -- serve counters (ServingMetrics.inc) ------------------------------
+    MetricName("requests", "counter", "predict requests admitted at submit"),
+    MetricName("requests_rows", "counter", "input rows across admitted requests"),
+    MetricName("batches", "counter", "micro-batches dispatched"),
+    MetricName("padded_rows", "counter", "bucket-padding rows dispatched beyond request rows"),
+    MetricName("timeouts", "counter", "requests shed for any deadline reason (aggregate)"),
+    MetricName("shed", "counter", "submits rejected at the door (aggregate)"),
+    MetricName("queue.shed.deadline", "counter", "requests whose deadline expired while queued"),
+    MetricName("queue.shed.backpressure", "counter", "submits rejected on a full queue"),
+    MetricName("queue.poisoned", "counter", "requests isolated as poisoned after a batch failure"),
+    MetricName("shed.breaker", "counter", "submits rejected while a model's breaker was open"),
+    MetricName("shed.poison", "counter", "submits rejected for non-finite payloads"),
+    MetricName("predict.failures", "counter", "raising compiled predicts"),
+    MetricName("breaker.trips", "counter", "circuit-breaker open transitions"),
+    MetricName("compiles", "counter", "XLA bucket compiles paid at registry warmup"),
+    MetricName("models_loaded", "counter", "registry loads"),
+    MetricName("models_reloaded", "counter", "registry hot-swap reloads"),
+    # -- serve gauges ------------------------------------------------------
+    MetricName("queue_depth", "gauge", "requests currently queued"),
+    MetricName("breaker.open.*", "gauge", "1 while the model's breaker is open", label="model"),
+    # -- serve histograms (ServingMetrics.observe) -------------------------
+    MetricName("batch_rows", "histogram", "rows per dispatched micro-batch"),
+    MetricName("batch_requests", "histogram", "requests coalesced per micro-batch"),
+    MetricName("batch_occupancy", "histogram", "request rows / padded bucket rows"),
+    MetricName("batch_predict_s", "histogram", "device predict seconds per batch"),
+    MetricName("request_latency_s", "histogram", "submit-to-answer seconds per request"),
+    # -- fit metrics (Instrumentation.log_metric) --------------------------
+    MetricName("num_experts", "metric", "experts in the grouped stack"),
+    MetricName("expert_size", "metric", "rows per expert"),
+    MetricName("num_classes", "metric", "classes inferred from training labels"),
+    MetricName("final_nll", "metric", "optimizer's final objective value"),
+    MetricName("final_nll_renormalized", "metric", "final_nll * bcm_renorm (full-stack comparable)"),
+    MetricName("lbfgs_iters", "metric", "L-BFGS iterations"),
+    MetricName("lbfgs_nfev", "metric", "objective evaluations"),
+    MetricName("lbfgs_stalled", "metric", "1 when the line search exhausted before convergence"),
+    MetricName("num_restarts", "metric", "multi-start restarts configured"),
+    MetricName("best_restart", "metric", "winning restart index"),
+    MetricName("restart_*_nll", "metric", "per-restart final NLL", label="restart"),
+    MetricName("resumed_from_iteration", "metric", "checkpoint resume point"),
+    MetricName("experts_active_initial", "metric", "active experts before any quarantine"),
+    MetricName("experts_quarantined", "metric", "experts dropped by screen/recovery"),
+    MetricName("experts_jittered", "metric", "experts repaired by adaptive jitter"),
+    MetricName("fit_retries", "metric", "recovery re-dispatches of the fit"),
+    MetricName("bcm_renorm", "metric", "E_active / E_kept BCM renormalization factor"),
+    MetricName("precision_lane", "metric", "precision lane the fit ran at (strict/mixed/fast)"),
+    MetricName("mixed_precision_guard.delta_nll_rel", "metric", "guard: relative NLL delta vs strict"),
+    MetricName("mixed_precision_guard.delta_grad_rel", "metric", "guard: relative gradient delta vs strict"),
+    MetricName("mixed_precision_guard.delta_predict_rel", "metric", "guard: relative predict delta vs strict"),
+    MetricName("mixed_precision_guard.breach", "metric", "guard: 1 when a delta exceeded the lane bar"),
+    MetricName("*.failed", "metric", "a phase of this name raised", label="phase"),
+    # -- phases (Instrumentation.phase -> timings) -------------------------
+    MetricName("group_experts", "phase", "host grouping + pre-fit data screen"),
+    MetricName("optimize_hypers", "phase", "hyperparameter optimization"),
+    MetricName("active_set", "phase", "active-set provider selection"),
+    MetricName("kmn_stats", "phase", "distributed (U1, u2) accumulation"),
+    MetricName("magic_solve", "phase", "host f64 PPA magic solve"),
+    MetricName("sync_fetch", "phase", "deferred device fetch draining the async pipeline"),
+    MetricName("load.*", "phase", "registry model load", label="model"),
+    MetricName("warmup.*", "phase", "registry AOT bucket warmup", label="model"),
+    # -- runtime telemetry (obs/runtime.py) --------------------------------
+    MetricName("compile.traces", "counter", "jaxpr traces observed (each implies a compile dispatch)"),
+    MetricName("compile.backend", "counter", "XLA backend compiles (persistent-cache misses)"),
+    MetricName("compile.cache_hits", "counter", "persistent compilation cache hits"),
+    MetricName("compile.bucket_traces", "counter", "serve bucket executable traces (batcher guard)"),
+    MetricName("compile.recompile_guard_trips", "counter", "recompiles caught on a frozen serve surface"),
+    MetricName("memory.bytes_in_use", "gauge", "device HBM bytes in use at the last sample"),
+    MetricName("memory.peak_bytes_in_use", "gauge", "peak device HBM bytes in use"),
+    MetricName("memory.host_peak_rss_bytes", "gauge", "host process peak RSS (CPU fallback proxy)"),
+)
+
+_EXACT = {spec.key: spec for spec in CATALOG if "*" not in spec.key}
+_PATTERNS = tuple(spec for spec in CATALOG if "*" in spec.key)
+
+#: default cumulative-bucket upper bounds by key shape (histograms pick
+#: their ladder at CREATION so the bucket counters can be true monotonic
+#: counters — see LatencyHistogram and obs/expo.py)
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0)
+RATIO_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+
+
+def buckets_for(key: str) -> Tuple[float, ...]:
+    """Bucket upper bounds for a histogram key: the catalog override when
+    registered, else a ladder picked by the key's shape."""
+    spec = lookup(key)
+    if spec is not None and spec.buckets:
+        return spec.buckets
+    if key.endswith("_s"):
+        return LATENCY_BUCKETS
+    if "occupancy" in key or "ratio" in key:
+        return RATIO_BUCKETS
+    return SIZE_BUCKETS
+
+
+def lookup(key: str) -> Optional[MetricName]:
+    """Catalog entry for a CONCRETE emitted key (exact match first, then
+    ``*`` patterns), or None when unregistered."""
+    spec = _EXACT.get(key)
+    if spec is not None:
+        return spec
+    for spec in _PATTERNS:
+        if fnmatch.fnmatchcase(key, spec.key):
+            return spec
+    return None
+
+
+def is_registered(key_or_pattern: str) -> bool:
+    """True when an emission is covered by the catalog.  A concrete key
+    may match a pattern; an emitted PATTERN (an f-string whose dynamic
+    parts the linter wildcards) must equal a registered pattern verbatim
+    — fuzzy pattern-to-pattern matching would let near-miss renames
+    slip through."""
+    if "*" in key_or_pattern:
+        return any(spec.key == key_or_pattern for spec in _PATTERNS)
+    return lookup(key_or_pattern) is not None
+
+
+def grammar_ok(key_or_pattern: str) -> bool:
+    """The naming grammar: dot-separated lowercase components, ``*``
+    allowed only in patterns (runtime-data parts)."""
+    grammar = PATTERN_GRAMMAR if "*" in key_or_pattern else KEY_GRAMMAR
+    return bool(grammar.match(key_or_pattern))

@@ -1,0 +1,356 @@
+"""JAX runtime telemetry bridge: compiles, memory, and the run journal.
+
+The two signals that actually dominate TPU cost are invisible to wall-clock
+instrumentation: an XLA recompile on a supposedly-warm path (tens of
+seconds cold on a chip) and HBM pressure creeping toward an OOM.  This
+module surfaces both:
+
+* **compile counting** — a ``jax.monitoring`` listener counts every jaxpr
+  trace (``compile.traces`` — each implies a compile-path dispatch, even
+  when the persistent cache then satisfies the backend compile), every
+  real backend compile (``compile.backend``) and every persistent-cache
+  hit (``compile.cache_hits``), attributed to the ACTIVE TRACE ROOT
+  (``fit.GaussianProcessRegression``, ``serve.batch``, ...) so "what
+  recompiled in production" has a per-entry-point answer — the batcher's
+  trace-counting guard (``serve/batcher.py``) feeds the same counters;
+* **memory gauges** — ``device.memory_stats()`` sampled at phase
+  boundaries into ``memory.bytes_in_use`` / ``memory.peak_bytes_in_use``
+  (host peak RSS as the CPU-backend fallback, so the signal exists on
+  every harness);
+* **run journal** — every fit is stamped with a ``run_journal`` dict
+  (span tree, lane, mesh, quarantine events, compile counts, memory
+  peaks), persisted next to the checkpoints when a checkpoint directory
+  (or ``GP_RUN_JOURNAL_DIR``) is configured.
+
+All keys are registered in :mod:`spark_gp_tpu.obs.names`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_gp_tpu.obs import trace as _trace
+
+# the event names jax 0.4.x emits (jax/_src/dispatch.py, compiler.py)
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+_UNTRACED = "untraced"
+
+
+class RuntimeTelemetry:
+    """Process-global counters/gauges fed by the runtime hooks.
+
+    Thread-safe; listeners are registered once (jax.monitoring offers no
+    deregistration, so installation is idempotent and the callbacks stay
+    O(dict op) forever)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        # counter key -> {entry point -> count}; entry = active trace root
+        self.per_entry: Dict[str, Dict[str, float]] = {}
+        self.gauges: Dict[str, float] = {}
+        self._installed = False
+        # host-RSS fallback throttle: getrusage costs ~10-50us a call and
+        # fires on every phase boundary — cache it for a short interval so
+        # tiny fits (many boundaries per 100ms) don't pay it repeatedly
+        self._rss_at = 0.0
+        self._rss = None
+
+    # -- emission ----------------------------------------------------------
+    def inc(self, key: str, entry: Optional[str] = None, n: float = 1.0) -> None:
+        if entry is None:
+            entry = _trace.current_root_name() or _UNTRACED
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0.0) + n
+            by = self.per_entry.setdefault(key, {})
+            by[entry] = by.get(entry, 0.0) + n
+
+    # -- jax.monitoring hooks ----------------------------------------------
+    def install(self) -> None:
+        with self._lock:
+            if self._installed:
+                return
+            # flip first even though registration may fail below: retrying
+            # (and re-warning) on every subsequent fit would be spam, and
+            # a half-registered listener pair must not be re-registered
+            self._installed = True
+        try:
+            import jax.monitoring as monitoring
+
+            monitoring.register_event_listener(self._on_event)
+            monitoring.register_event_duration_secs_listener(self._on_duration)
+        except Exception:  # noqa: BLE001 — telemetry must never fail a fit
+            # (the listener API is jax-internal-adjacent and may move);
+            # one loud warning, then compile telemetry stays dark
+            import logging
+
+            logging.getLogger("spark_gp_tpu").warning(
+                "jax.monitoring listener registration failed — compile "
+                "telemetry disabled for this process", exc_info=True,
+            )
+
+    def _on_event(self, event: str, **kwargs) -> None:
+        if event == _CACHE_HIT_EVENT:
+            self.inc("compile.cache_hits")
+
+    def _on_duration(self, event: str, duration: float, **kwargs) -> None:
+        if event == _TRACE_EVENT:
+            self.inc("compile.traces")
+            _trace.add_event("compile.trace", duration_s=float(duration))
+        elif event == _BACKEND_EVENT:
+            self.inc("compile.backend")
+            _trace.add_event("compile.backend", duration_s=float(duration))
+
+    # -- memory ------------------------------------------------------------
+    def sample_memory(self) -> Dict[str, float]:
+        """One sample of device HBM (host RSS as the CPU fallback).
+
+        Returns the RAW sample — a :class:`FitCapture` computes ITS
+        fit's peak from the samples taken within the fit, so one big
+        fit's high-water mark never bleeds into a later fit's journal.
+        Only the process-global exposition gauges apply max-retention to
+        ``*peak*`` keys (a scrape between fits should still see the
+        high-water mark).  The underlying sources are what they are:
+        device ``peak_bytes_in_use`` and host ``ru_maxrss`` are
+        process-lifetime peaks at the source."""
+        sample: Dict[str, float] = {}
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+            if stats:
+                if "bytes_in_use" in stats:
+                    sample["memory.bytes_in_use"] = float(stats["bytes_in_use"])
+                if "peak_bytes_in_use" in stats:
+                    sample["memory.peak_bytes_in_use"] = float(
+                        stats["peak_bytes_in_use"]
+                    )
+        except Exception:  # noqa: BLE001 — telemetry must never fail a fit
+            pass
+        try:
+            now = time.monotonic()
+            if self._rss is None or now - self._rss_at > 0.02:
+                import resource
+
+                # ru_maxrss is KiB on Linux — the only harness platform
+                self._rss = float(
+                    resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+                )
+                self._rss_at = now
+            sample["memory.host_peak_rss_bytes"] = self._rss
+        except Exception:  # noqa: BLE001
+            pass
+        with self._lock:
+            for key, value in sample.items():
+                if "peak" in key:
+                    value = max(value, self.gauges.get(key, 0.0))
+                self.gauges[key] = value
+        return sample
+
+    # -- introspection -----------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "per_entry": {k: dict(v) for k, v in self.per_entry.items()},
+                "gauges": dict(self.gauges),
+            }
+
+
+#: THE process singleton every hook feeds
+telemetry = RuntimeTelemetry()
+
+
+# -- per-fit capture --------------------------------------------------------
+
+_active_capture: contextvars.ContextVar[Optional["FitCapture"]] = (
+    contextvars.ContextVar("gp_obs_fit_capture", default=None)
+)
+
+
+class FitCapture:
+    """Deltas + samples bracketing one fit (the run journal's inputs).
+
+    Compile deltas are process-global counter differences: two fits
+    racing in separate threads may cross-attribute each other's compiles
+    in the TOTALS, while the per-entry table stays exact (attribution
+    follows the trace root of the compiling thread)."""
+
+    _COMPILE_KEYS = ("compile.traces", "compile.backend", "compile.cache_hits")
+
+    def __init__(self, name: str):
+        self.name = name
+        snap = telemetry.snapshot()
+        self._base = {k: snap["counters"].get(k, 0.0) for k in self._COMPILE_KEYS}
+        self._base_entry = {
+            k: dict(snap["per_entry"].get(k, {})) for k in self._COMPILE_KEYS
+        }
+        self.memory_samples: List[dict] = []
+        self.compiles: Dict[str, float] = {}
+        self.compiles_by_entry: Dict[str, Dict[str, float]] = {}
+
+    def add_memory_sample(self, tag: str) -> None:
+        sample = telemetry.sample_memory()
+        if sample:
+            self.memory_samples.append({"phase": tag, **sample})
+
+    def finish(self) -> None:
+        self.add_memory_sample("end")
+        snap = telemetry.snapshot()
+        self.compiles = {
+            k: snap["counters"].get(k, 0.0) - self._base[k]
+            for k in self._COMPILE_KEYS
+        }
+        self.compiles_by_entry = {}
+        for key in self._COMPILE_KEYS:
+            now = snap["per_entry"].get(key, {})
+            base = self._base_entry[key]
+            delta = {
+                entry: n - base.get(entry, 0.0)
+                for entry, n in now.items()
+                if n - base.get(entry, 0.0) > 0
+            }
+            if delta:
+                self.compiles_by_entry[key] = delta
+
+    @property
+    def peak_memory(self) -> Dict[str, float]:
+        peaks: Dict[str, float] = {}
+        for sample in self.memory_samples:
+            for key, value in sample.items():
+                if key == "phase":
+                    continue
+                peaks[key] = max(peaks.get(key, 0.0), value)
+        return peaks
+
+
+@contextlib.contextmanager
+def fit_capture(name: str):
+    """Activate compile attribution + phase-boundary memory sampling for
+    one fit; yields the :class:`FitCapture` (None when tracing is off)."""
+    if not _trace.tracing_enabled():
+        yield None
+        return
+    telemetry.install()
+    cap = FitCapture(name)
+    token = _active_capture.set(cap)
+    cap.add_memory_sample("start")
+    try:
+        yield cap
+    finally:
+        _active_capture.reset(token)
+        cap.finish()
+
+
+def on_phase_boundary(instr_name: str, phase_name: str) -> None:
+    """Called by ``Instrumentation.phase`` on every phase exit: samples
+    memory into the active capture.  A cheap contextvar read when no
+    capture is active — the serve hot path never pays for it."""
+    cap = _active_capture.get()
+    if cap is not None:
+        cap.add_memory_sample(phase_name)
+
+
+# -- run journal ------------------------------------------------------------
+
+JOURNAL_FORMAT = "spark_gp_tpu.run_journal/v1"
+
+
+def write_run_journal(
+    instr,
+    root,
+    capture: Optional[FitCapture],
+    mesh=None,
+    journal_dir: Optional[str] = None,
+) -> dict:
+    """Assemble (and optionally persist) one fit's run journal.
+
+    ``root`` is the fit's closed root span; the journal's ``spans`` is the
+    reassembled tree for its trace.  Persisted as
+    ``run_journal_<name>-<unix_ms>-p<pid>-t<trace_id>.json`` (tmp +
+    atomic rename, the checkpoint writers' convention) into
+    ``journal_dir`` when given — callers pass the checkpoint directory,
+    falling back to ``GP_RUN_JOURNAL_DIR``.  The unique tag keeps
+    concurrent or repeated fits of one estimator family from clobbering
+    each other's journal (retention in a long-lived dir is the operator's
+    to manage — journals are small).  Schema: docs/OBSERVABILITY.md."""
+    from spark_gp_tpu.ops.precision import active_lane
+
+    spans = _trace.spans_of_root(root) if getattr(root, "trace_id", 0) else []
+    quarantine_events = [
+        {**event, "span": s.name}
+        for s in spans
+        for event in s.events
+        if event["name"].startswith(("experts.", "fit.retry", "breaker."))
+    ]
+    journal = {
+        "format": JOURNAL_FORMAT,
+        "name": getattr(instr, "name", "gp"),
+        "created_unix": time.time(),
+        "precision_lane": active_lane(),
+        "mesh": (
+            None if mesh is None
+            else {"axes": {str(k): int(v) for k, v in dict(mesh.shape).items()}}
+        ),
+        "timings": dict(getattr(instr, "timings", {})),
+        "metrics": dict(getattr(instr, "metrics", {})),
+        "quarantine": {
+            "experts_quarantined": getattr(instr, "metrics", {}).get(
+                "experts_quarantined", 0.0
+            ),
+            "experts_jittered": getattr(instr, "metrics", {}).get(
+                "experts_jittered", 0.0
+            ),
+            "fit_retries": getattr(instr, "metrics", {}).get("fit_retries", 0.0),
+            "events": quarantine_events,
+        },
+        "compiles": dict(capture.compiles) if capture is not None else {},
+        "compiles_by_entry": (
+            dict(capture.compiles_by_entry) if capture is not None else {}
+        ),
+        "memory": {
+            "samples": list(capture.memory_samples),
+            "peak": capture.peak_memory,
+        } if capture is not None else {"samples": [], "peak": {}},
+        "span_count": len(spans),
+        "spans": _trace.span_tree(spans),
+        "path": None,
+    }
+    if journal_dir is None:
+        journal_dir = os.environ.get("GP_RUN_JOURNAL_DIR", "").strip() or None
+    if journal_dir is not None:
+        try:
+            os.makedirs(journal_dir, exist_ok=True)
+            # ms timestamp + pid disambiguate across processes, the trace
+            # id within one (two fits can share a millisecond)
+            tag = (
+                f"{int(journal['created_unix'] * 1000):d}"
+                f"-p{os.getpid()}-t{getattr(root, 'trace_id', 0)}"
+            )
+            path = os.path.join(
+                journal_dir, f"run_journal_{journal['name']}-{tag}.json"
+            )
+            from spark_gp_tpu.utils.checkpoint import _fsync_replace
+
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(journal, fh, default=str)
+            _fsync_replace(tmp, path)
+            journal["path"] = path
+        except OSError as exc:
+            # the journal is telemetry, never a fit failure — but say so
+            import logging
+
+            logging.getLogger("spark_gp_tpu").warning(
+                "run journal not persisted to %r: %s", journal_dir, exc
+            )
+    return journal

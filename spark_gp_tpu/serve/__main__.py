@@ -71,6 +71,11 @@ def _parse_args(argv):
     parser.add_argument("--port", type=int, default=None,
                         help="serve a TCP socket on 127.0.0.1:PORT instead of stdin")
     parser.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="expose a plain-text OpenMetrics scrape endpoint on "
+        "127.0.0.1:PORT (0 picks a free port; reported in the ready line)",
+    )
+    parser.add_argument(
         "--preflight", action="store_true",
         help="probe the JAX backend in a subprocess before loading "
         "(falls back to CPU when a device tunnel is wedged)",
@@ -96,7 +101,13 @@ def _writer_loop(pending: "_queue.Queue", lock, stream, result_wait_s) -> None:
             _out(lock, stream, item)
             continue
         if callable(item):  # late-bound reply (metrics snapshot at emit
-            _out(lock, stream, item())  # time, after earlier predicts)
+            try:                     # time, after earlier predicts)
+                reply = item()
+            except Exception as exc:  # noqa: BLE001 — a raising render must
+                # not kill the writer: every reply queued behind it would be
+                # silently dropped and clients would block forever
+                reply = {"error": f"{type(exc).__name__}: {exc}"[:500]}
+            _out(lock, stream, reply)
             continue
         req_id, future, wait_s = item
         try:
@@ -155,9 +166,25 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                 shutdown = True
                 break
             if cmd == "metrics":
-                pending.put(
-                    lambda: {"event": "metrics", **server.snapshot()}
-                )
+                fmt = msg.get("format", "json")
+                if fmt == "openmetrics":
+                    # the Prometheus exposition page as one JSON field —
+                    # the line protocol cannot carry raw multi-line text;
+                    # scrapers wanting the bare page use --metrics-port
+                    pending.put(lambda: {
+                        "event": "metrics",
+                        "format": "openmetrics",
+                        "body": server.openmetrics(),
+                    })
+                elif fmt == "json":
+                    pending.put(
+                        lambda: {"event": "metrics", **server.snapshot()}
+                    )
+                else:
+                    pending.put(
+                        {"error": f"unknown metrics format {fmt!r}; "
+                         "expected 'json' or 'openmetrics'"}
+                    )
                 continue
             if cmd == "health":
                 # straight to the stream, NOT the ordered writer queue: a
@@ -280,6 +307,13 @@ def main(argv=None) -> int:
     # import AFTER the platform decision: spark_gp_tpu re-asserts
     # JAX_PLATFORMS over site hooks at import (utils/platform.py)
     from spark_gp_tpu.serve.server import GPServeServer
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    # install BEFORE model load/warmup and unconditionally (not gated on
+    # --metrics-port): the AOT warmup compiles are the baseline the
+    # openmetrics verb's compile counters advertise, and install is
+    # idempotent with O(dict op) listeners
+    telemetry.install()
 
     if not args.model:
         print("at least one --model NAME=PATH is required", file=sys.stderr)
@@ -315,6 +349,12 @@ def main(argv=None) -> int:
 
         break_model(server, chaos_target, fail_forever=True)
 
+    scrape = None
+    if args.metrics_port is not None:
+        from spark_gp_tpu.obs.expo import ScrapeListener
+
+        scrape = ScrapeListener(server.openmetrics, port=args.metrics_port)
+
     import jax
 
     _out(out_lock, sys.stdout, {
@@ -324,6 +364,7 @@ def main(argv=None) -> int:
         "buckets_warmed": sum(
             len(m["compiles"]) for m in server.registry.describe()
         ),
+        "metrics_port": None if scrape is None else scrape.port,
     })
 
     explicit_shutdown = False
@@ -335,6 +376,8 @@ def main(argv=None) -> int:
                 server, sys.stdin, sys.stdout, out_lock
             )
     finally:
+        if scrape is not None:
+            scrape.stop()
         server.stop(drain=True)
         if not explicit_shutdown:
             # EOF / socket-mode exit: the session stream never carried a

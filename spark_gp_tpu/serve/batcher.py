@@ -171,6 +171,13 @@ class BucketedPredictor:
             with lock:
                 b = int(x.shape[0])
                 counts[b] = counts.get(b, 0) + 1
+            # surface the guard's count as a real metric: the same event
+            # lands in the process-global runtime telemetry (obs/runtime),
+            # so the OpenMetrics page and the run journal see serve-side
+            # (re)compiles without asking the predictor object
+            from spark_gp_tpu.obs.runtime import telemetry
+
+            telemetry.inc("compile.bucket_traces", entry=f"bucket_{b}")
             # pin the construction-time lane for this trace (see __init__)
             with precision_lane_scope(lane):
                 if mean_only:
@@ -232,6 +239,11 @@ class BucketedPredictor:
             # the compile already happened (this guard is a tripwire, not
             # a prevention), but a silent one would only ever surface as
             # an unexplained p99 cliff — fail loudly instead
+            from spark_gp_tpu.obs.runtime import telemetry
+
+            telemetry.inc(
+                "compile.recompile_guard_trips", entry=f"bucket_{bucket}"
+            )
             raise RecompileGuardError(
                 f"recompile on warmed bucket {bucket} — input dtype or "
                 "operand identity drifted on the hot path"

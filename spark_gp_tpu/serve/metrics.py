@@ -26,19 +26,59 @@ class LatencyHistogram:
     the right bias for serving dashboards (a warm-up spike should age out,
     not poison p99 forever), and the memory bound holds under sustained
     traffic.  ``count`` still reports every observation ever made.
+
+    Alongside the window, fixed-``bounds`` bucket counters accumulate
+    monotonically over the histogram's whole lifetime: Prometheus
+    histogram ingestion (``rate()`` over ``_count``, ``histogram_quantile``
+    over ``_bucket``) assumes cumulative-counter semantics, which a
+    sliding window cannot provide — counts would freeze at ``capacity``
+    and buckets could DECREASE, reading as counter resets.  The window
+    feeds the p50/p99 snapshots; the bucket counters feed
+    :mod:`spark_gp_tpu.obs.expo`.
     """
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096, bounds: tuple = ()):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self._buf = np.zeros(capacity, dtype=np.float64)
         self._n = 0  # total observations (monotonic)
         self._lock = threading.Lock()
+        self._bounds = np.asarray(sorted(bounds), dtype=np.float64)
+        # per-interval counts; index len(bounds) is the +Inf overflow
+        self._bucket_counts = np.zeros(self._bounds.shape[0] + 1, dtype=np.int64)
+        self._sum = 0.0  # monotonic (latencies/sizes are non-negative)
 
     def observe(self, value: float) -> None:
+        value = float(value)
         with self._lock:
-            self._buf[self._n % self._buf.shape[0]] = float(value)
+            self._buf[self._n % self._buf.shape[0]] = value
             self._n += 1
+            # first bound >= value ("le" semantics); past-the-end -> +Inf
+            self._bucket_counts[
+                int(np.searchsorted(self._bounds, value, side="left"))
+            ] += 1
+            self._sum += value
+
+    def window(self) -> np.ndarray:
+        """Copy of the retained sample window (the raw observations the
+        percentile snapshot is computed over)."""
+        with self._lock:
+            return self._buf[: min(self._n, self._buf.shape[0])].copy()
+
+    def cumulative(self):
+        """``(bounds, cumulative_counts, count, sum)`` with true monotonic
+        counter semantics over the histogram's lifetime — the OpenMetrics
+        ``_bucket``/``_count``/``_sum`` series (``obs/expo.py``).
+        ``cumulative_counts[i]`` is observations ``<= bounds[i]``; the
+        implicit +Inf bucket equals ``count``."""
+        with self._lock:
+            running = np.cumsum(self._bucket_counts)
+            return (
+                tuple(float(b) for b in self._bounds),
+                [int(c) for c in running[:-1]],
+                self._n,
+                float(self._sum),
+            )
 
     def snapshot(self) -> dict:
         """``{count, mean, p50, p99, max}`` over the retained window
@@ -86,8 +126,10 @@ class ServingMetrics(Instrumentation):
         with self._lock:
             hist = self.histograms.get(key)
             if hist is None:
+                from spark_gp_tpu.obs.names import buckets_for
+
                 hist = self.histograms[key] = LatencyHistogram(
-                    self._hist_capacity
+                    self._hist_capacity, bounds=buckets_for(key)
                 )
         hist.observe(value)
 
@@ -106,10 +148,14 @@ class ServingMetrics(Instrumentation):
             counters = dict(self.counters)
             gauges = dict(self.gauges)
             hists = dict(self.histograms)
+            # inherited dicts share this instance's lock too (phase /
+            # log_metric write under it from other threads)
+            timings = dict(self.timings)
+            metrics = dict(self.metrics)
         return {
             "counters": counters,
             "gauges": gauges,
             "histograms": {k: h.snapshot() for k, h in hists.items()},
-            "timings": dict(self.timings),
-            "metrics": dict(self.metrics),
+            "timings": timings,
+            "metrics": metrics,
         }

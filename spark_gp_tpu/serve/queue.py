@@ -32,6 +32,8 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from spark_gp_tpu.obs import trace as obs_trace
+
 
 class QueueFullError(RuntimeError):
     """Backpressure: the request queue is at capacity; retry with backoff
@@ -222,6 +224,13 @@ class MicroBatchQueue:
                 return
 
     def _run_batch(self, batch: List[PredictRequest]) -> None:
+        # one span per coalesced window: the batcher thread's trace root,
+        # under which the executor's serve.predict span (and any breaker /
+        # isolation events) nest — a request's server-side story is one tree
+        with obs_trace.span("serve.batch", requests=len(batch)):
+            self._run_batch_inner(batch)
+
+    def _run_batch_inner(self, batch: List[PredictRequest]) -> None:
         # shed already-expired requests BEFORE spending a dispatch on them
         now = time.monotonic()
         live: dict = {}
@@ -236,8 +245,12 @@ class MicroBatchQueue:
                 )
                 continue
             live.setdefault(req.model_key, []).append(req)
-        if expired and self._on_timeout is not None:
-            self._on_timeout(expired)
+        if expired:
+            # the trace event records the shed whether or not a metrics
+            # callback is wired — the timeline must not depend on it
+            obs_trace.add_event("queue.shed.deadline", count=expired)
+            if self._on_timeout is not None:
+                self._on_timeout(expired)
         for group in live.values():
             try:
                 self._execute(group)
@@ -287,5 +300,11 @@ class MicroBatchQueue:
                         req.isolation_retry = False
                 if late and self._on_timeout is not None:
                     self._on_timeout(late)
-                if poisoned and self._on_poison is not None:
-                    self._on_poison(poisoned)
+                if poisoned:
+                    obs_trace.add_event(
+                        "queue.isolation",
+                        poisoned=poisoned,
+                        model=group[0].model_key[0],
+                    )
+                    if self._on_poison is not None:
+                        self._on_poison(poisoned)

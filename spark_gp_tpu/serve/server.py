@@ -17,6 +17,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from spark_gp_tpu.obs import trace as obs_trace
 from spark_gp_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
 from spark_gp_tpu.serve.metrics import ServingMetrics
 from spark_gp_tpu.serve.queue import (
@@ -221,7 +222,11 @@ class GPServeServer:
         # batchmates still waiting their turn (queue.py isolation_retry)
         guarded = not group[0].isolation_retry
         if guarded:
-            breaker.before_call()  # raises BreakerOpenError while open
+            try:
+                breaker.before_call()  # raises BreakerOpenError while open
+            except BreakerOpenError:
+                obs_trace.add_event("breaker.reject", model=name)
+                raise
         try:
             entry = self.registry.resolve(group[0].model_key)
             rows = [req.x.shape[0] for req in group]
@@ -240,7 +245,12 @@ class GPServeServer:
             raise
         started = time.monotonic()
         try:
-            mean, var = entry.predict(x)
+            with obs_trace.span(
+                "serve.predict", model=name, version=group[0].model_key[1],
+                rows=total, requests=len(group),
+                isolation_retry=not guarded,
+            ):
+                mean, var = entry.predict(x)
         except BaseException:
             self.metrics.inc("predict.failures")
             if guarded:
@@ -249,10 +259,14 @@ class GPServeServer:
                 if breaker.trip_count > trips_before:
                     self.metrics.inc("breaker.trips")
                     self.metrics.set_gauge(f"breaker.open.{name}", 1.0)
+                    obs_trace.add_event("breaker.open", model=name)
             raise
         if guarded:
+            was_broken = breaker.state != CircuitBreaker.CLOSED
             breaker.record_success()
             self.metrics.set_gauge(f"breaker.open.{name}", 0.0)
+            if was_broken:
+                obs_trace.add_event("breaker.close", model=name)
         elapsed = time.monotonic() - started
         padded = entry.predictor.padded_rows(total)
         self.metrics.inc("batches")
@@ -289,6 +303,25 @@ class GPServeServer:
             name: b.snapshot() for name, b in sorted(dict(self._breakers).items())
         }
         return snap
+
+    def openmetrics(self) -> str:
+        """The OpenMetrics/Prometheus exposition page for this server
+        (obs/expo.py), with runtime compile/memory telemetry merged in.
+        Point-in-time series are refreshed first so a scrape always
+        carries the queue gauge and one breaker gauge per model — even
+        before the first trip."""
+        from spark_gp_tpu.obs.expo import render_openmetrics
+        from spark_gp_tpu.obs.runtime import telemetry
+
+        self.metrics.set_gauge("queue_depth", self._queue.depth())
+        for name in self.registry.names():
+            breaker = self._breaker_for(name)
+            self.metrics.set_gauge(
+                f"breaker.open.{name}",
+                0.0 if breaker.state == CircuitBreaker.CLOSED else 1.0,
+            )
+        telemetry.sample_memory()
+        return render_openmetrics(self.metrics, telemetry.snapshot())
 
     def health(self) -> dict:
         """The ``/healthz`` answer: liveness, readiness, and per-component

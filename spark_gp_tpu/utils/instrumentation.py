@@ -4,15 +4,31 @@ Upgrade over the reference's Spark ``Instrumentation`` usage
 (GaussianProcessCommons.scala:69,89,108 — three log lines): named, timed
 phases with a metrics dict, standard :mod:`logging` output, and an optional
 ``jax.profiler`` trace context for TPU timeline capture.
+
+Every phase also emits a span into the unified tracer
+(:mod:`spark_gp_tpu.obs.trace`) and triggers a runtime-telemetry sample at
+its boundary (:mod:`spark_gp_tpu.obs.runtime`) — one instrumentation call
+site, three backends (log line, timing dict, trace tree).
+
+Thread-safety: serve shares one instance across the submit thread, the
+batcher thread, and metrics readers, so ``phase``/``log_metric``'s
+read-modify-writes hold the same lock discipline ``ServingMetrics`` uses
+(``ServingMetrics`` re-binds ``_lock`` in its ``__init__``; parent and
+subclass state share ONE lock per instance).
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
+
+from spark_gp_tpu.obs import runtime as _obs_runtime
+from spark_gp_tpu.obs import trace as _obs_trace
 
 logger = logging.getLogger("spark_gp_tpu")
 
@@ -24,6 +40,9 @@ class Instrumentation:
     name: str = "gp"
     timings: Dict[str, float] = field(default_factory=dict)
     metrics: Dict[str, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def log_info(self, msg: str) -> None:
         logger.info("[%s] %s", self.name, msg)
@@ -35,21 +54,32 @@ class Instrumentation:
     def phase(self, phase_name: str):
         start = time.perf_counter()
         try:
-            yield
+            # the phase IS a span: a fit's phases render as one tree under
+            # the fit's root span, a serve load/warmup under the batch's
+            with _obs_trace.span(phase_name, instr=self.name):
+                yield
         except BaseException:
             # a raising phase used to record only its timing — the metric
             # context vanished and an emitted metrics dict looked identical
             # to a healthy run's.  A "<phase>.failed" marker makes serve-path
             # (and fit-path) errors visible wherever metrics are shipped.
-            self.metrics[f"{phase_name}.failed"] = 1.0
+            with self._lock:
+                self.metrics[f"{phase_name}.failed"] = 1.0
             raise
         finally:
             elapsed = time.perf_counter() - start
-            self.timings[phase_name] = self.timings.get(phase_name, 0.0) + elapsed
+            with self._lock:
+                self.timings[phase_name] = (
+                    self.timings.get(phase_name, 0.0) + elapsed
+                )
             logger.info("[%s] phase %s: %.3fs", self.name, phase_name, elapsed)
+            # memory gauge sample on the phase boundary (no-op unless a
+            # fit capture is active — obs/runtime.py)
+            _obs_runtime.on_phase_boundary(self.name, phase_name)
 
     def log_metric(self, key: str, value: float) -> None:
-        self.metrics[key] = value
+        with self._lock:
+            self.metrics[key] = value
         logger.info("[%s] %s = %s", self.name, key, value)
 
     def log_success(self) -> None:
@@ -80,14 +110,18 @@ def sync_enabled() -> bool:
     """ONE definition of the ``GP_SYNC_PHASES`` gate, read at call time
     (bench.py toggles the variable between fits and reports the mode a fit
     actually ran in — both must agree with ``phase_sync`` above)."""
-    import os
-
     return os.environ.get("GP_SYNC_PHASES", "").strip() not in ("", "0")
 
 
 @contextlib.contextmanager
 def maybe_profile(trace_dir: Optional[str]):
-    """``jax.profiler`` trace context when a directory is given, no-op else."""
+    """``jax.profiler`` trace context when a directory is given, no-op else.
+
+    With no explicit directory, ``GP_TRACE_DIR`` (read at call time, like
+    ``GP_SYNC_PHASES``) activates capture — TPU timeline capture on any
+    existing entry point with zero code change (docs/ROOFLINE.md)."""
+    if trace_dir is None:
+        trace_dir = os.environ.get("GP_TRACE_DIR", "").strip() or None
     if trace_dir is None:
         yield
         return

@@ -1,0 +1,600 @@
+"""The unified observability layer (spark_gp_tpu/obs/): span tracing,
+OpenMetrics exposition, runtime telemetry, run journal, metric-name lint.
+
+Grammar-checks the exposition page with a strict line parser (not a
+substring sniff — a malformed page fails the real scrapers silently),
+exercises span nesting/attribution across threads, forces a recompile to
+prove the compile counters move, and drives one end-to-end fit whose run
+journal must carry the optimizer phases, a compile event and a memory
+gauge (the ISSUE 4 acceptance proof).
+"""
+
+import json
+import os
+import re
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.obs import expo, names, runtime, trace
+from spark_gp_tpu.serve.metrics import ServingMetrics
+from spark_gp_tpu.utils.instrumentation import Instrumentation, maybe_profile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def fitted(tmp_path_factory):
+    """One tiny fitted model + its run journal, shared by the e2e tests."""
+    journal_dir = str(tmp_path_factory.mktemp("journal"))
+    prev = os.environ.get("GP_RUN_JOURNAL_DIR")
+    os.environ["GP_RUN_JOURNAL_DIR"] = journal_dir
+    try:
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(120, 3))
+        y = np.sin(x.sum(axis=1))
+        model = (
+            GaussianProcessRegression()
+            .setKernel(lambda: RBFKernel(1.0))
+            .setDatasetSizeForExpert(30)
+            .setActiveSetSize(30)
+            .setSigma2(1e-3)
+            .setMaxIter(4)
+            .setSeed(3)
+            .setOptimizer("host")
+            .fit(x, y)
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("GP_RUN_JOURNAL_DIR", None)
+        else:
+            os.environ["GP_RUN_JOURNAL_DIR"] = prev
+    path = str(tmp_path_factory.mktemp("model") / "obs_tiny.npz")
+    model.save(path)
+    return model, path, journal_dir, x
+
+
+# -- span tracer ------------------------------------------------------------
+
+
+def test_span_nesting_and_attribution():
+    with trace.span("outer", kind="test") as outer:
+        with trace.span("inner") as inner:
+            assert trace.current_span() is inner
+            assert trace.add_event("tick", n=1)
+        assert trace.current_span() is outer
+    assert inner.parent_id == outer.span_id
+    assert inner.trace_id == outer.trace_id
+    assert inner.root == "outer"
+    assert outer.parent_id is None
+    assert outer.attrs == {"kind": "test"}
+    assert inner.events[0]["name"] == "tick"
+    spans = trace.spans_for_trace(outer.trace_id)
+    assert [s.name for s in spans] == ["outer", "inner"]
+    tree = trace.span_tree(spans)
+    assert len(tree) == 1 and tree[0]["name"] == "outer"
+    assert [c["name"] for c in tree[0]["children"]] == ["inner"]
+
+
+def test_span_contexts_are_thread_isolated():
+    """Two threads nesting concurrently must never adopt each other's
+    parents: the contextvar stack is per-thread."""
+    results = {}
+    barrier = threading.Barrier(2, timeout=10)
+
+    def worker(tag):
+        with trace.span(f"root_{tag}") as root:
+            barrier.wait()  # both roots open simultaneously
+            with trace.span(f"child_{tag}") as child:
+                barrier.wait()
+            results[tag] = (root, child)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in ("a", "b")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for tag in ("a", "b"):
+        root, child = results[tag]
+        assert child.parent_id == root.span_id
+        assert child.trace_id == root.trace_id
+        assert child.root == f"root_{tag}"
+    assert results["a"][0].trace_id != results["b"][0].trace_id
+
+
+def test_tracing_disabled_is_noop():
+    trace.set_tracing(False)
+    try:
+        before = len(trace.RING.snapshot())
+        with trace.span("ghost") as s:
+            assert s is trace.NOOP_SPAN
+            assert trace.current_span() is None
+            assert not trace.add_event("dropped")
+        assert len(trace.RING.snapshot()) == before
+    finally:
+        trace.set_tracing(None)
+
+
+def test_span_error_status_and_exports(tmp_path):
+    with pytest.raises(ValueError):
+        with trace.span("doomed") as s:
+            raise ValueError("boom")
+    assert s.status == "error"
+    assert s.events[0] == pytest.approx(s.events[0])  # events recorded
+    assert s.events[0]["type"] == "ValueError"
+
+    jsonl = tmp_path / "spans.jsonl"
+    n = trace.export_jsonl(str(jsonl), trace.spans_for_trace(s.trace_id))
+    assert n == 1
+    row = json.loads(jsonl.read_text().splitlines()[0])
+    assert row["name"] == "doomed" and row["status"] == "error"
+
+    doc = trace.chrome_trace(trace.spans_for_trace(s.trace_id))
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert complete[0]["name"] == "doomed" and complete[0]["dur"] >= 0
+    assert instants and instants[0]["name"] == "error"
+
+
+def test_instrumentation_phase_emits_span():
+    instr = Instrumentation(name="spantest")
+    with trace.span("fit.spantest") as root:
+        with instr.phase("optimize_hypers"):
+            pass
+    spans = trace.spans_for_trace(root.trace_id)
+    phase_spans = [s for s in spans if s.name == "optimize_hypers"]
+    assert phase_spans and phase_spans[0].parent_id == root.span_id
+    assert phase_spans[0].attrs["instr"] == "spantest"
+    assert instr.timings["optimize_hypers"] > 0
+
+
+def test_instrumentation_thread_safety():
+    """The satellite fix: phase/log_metric are read-modify-writes shared
+    across serve threads — hammer one instance and check nothing is lost."""
+    instr = Instrumentation(name="hammer")
+    n_threads, n_iters = 8, 200
+
+    def worker(idx):
+        for i in range(n_iters):
+            with instr.phase("contended"):
+                pass
+            instr.log_metric(f"restart_{idx}_nll", float(i))
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # every thread's last write survived, and no timing increment vanished
+    for idx in range(n_threads):
+        assert instr.metrics[f"restart_{idx}_nll"] == float(n_iters - 1)
+    assert instr.timings["contended"] > 0
+
+
+# -- OpenMetrics exposition -------------------------------------------------
+
+_FAMILY = r"[a-z_:][a-z0-9_:]*"
+_META_RE = re.compile(rf"^# (TYPE|HELP|UNIT) ({_FAMILY})( .+)?$")
+_VALUE = r"(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|NaN|\+Inf|-Inf)"
+_SAMPLE_RE = re.compile(rf"^({_FAMILY})(\{{([^{{}}]*)\}})? {_VALUE}$")
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def _parse_openmetrics(page: str) -> dict:
+    """Strict line-grammar parse; returns {family: {type, samples}} where
+    samples is [(sample_name, labels_text, value)].  Raises AssertionError
+    on any spec violation this page could exhibit."""
+    lines = page.splitlines()
+    assert lines, "empty page"
+    assert lines[-1] == "# EOF", f"page must end with # EOF, got {lines[-1]!r}"
+    assert page.endswith("\n"), "page must end with a newline"
+    families: dict = {}
+    current = None
+    for line in lines[:-1]:
+        meta = _META_RE.match(line)
+        if meta:
+            kind, family = meta.group(1), meta.group(2)
+            if kind == "TYPE":
+                assert family not in families, f"duplicate TYPE for {family}"
+                families[family] = {"type": meta.group(3).strip(), "samples": []}
+                current = family
+            else:
+                assert current == family, f"{kind} outside its family block"
+            continue
+        sample = _SAMPLE_RE.match(line)
+        assert sample, f"line matches neither metadata nor sample: {line!r}"
+        name, labels_text = sample.group(1), sample.group(3)
+        assert current is not None and name.startswith(current), (
+            f"sample {name} before its TYPE line (current family {current})"
+        )
+        if labels_text:
+            for part in labels_text.split(","):
+                assert _LABEL_RE.match(part), f"bad label {part!r}"
+        families[current]["samples"].append(
+            (name, labels_text or "", float(sample.group(4).replace("Inf", "inf")))
+        )
+    # per-type sample-name rules
+    for family, info in families.items():
+        suffixes = {name[len(family):] for name, _, _ in info["samples"]}
+        if info["type"] == "counter":
+            assert suffixes == {"_total"}, (family, suffixes)
+        elif info["type"] == "gauge":
+            assert suffixes == {""}, (family, suffixes)
+        elif info["type"] == "histogram":
+            assert suffixes <= {"_bucket", "_count", "_sum"}, (family, suffixes)
+    return families
+
+
+def _exercised_metrics() -> ServingMetrics:
+    m = ServingMetrics(name="expotest")
+    m.inc("requests", 5)
+    m.inc("queue.shed.deadline", 2)
+    m.set_gauge("queue_depth", 3)
+    m.set_gauge("breaker.open.modelx", 1.0)
+    for v in (0.001, 0.004, 0.2, 1.5):
+        m.observe("request_latency_s", v)
+    with m.phase("load.modelx"):
+        pass
+    m.log_metric("final_nll", -12.5)
+    m.metrics["precision_lane"] = "strict"  # string-valued diagnostic
+    return m
+
+
+def test_openmetrics_grammar_and_semantics():
+    page = expo.render_openmetrics(_exercised_metrics())
+    families = _parse_openmetrics(page)
+    assert families["gp_requests"]["type"] == "counter"
+    assert families["gp_requests"]["samples"] == [("gp_requests_total", "", 5.0)]
+    assert families["gp_queue_shed_deadline"]["samples"][0][2] == 2.0
+    assert families["gp_queue_depth"]["type"] == "gauge"
+    # the histogram: cumulative buckets, monotone, +Inf == count, sum right
+    hist = families["gp_request_latency_seconds"]
+    assert hist["type"] == "histogram"
+    buckets = [
+        (lbl, v) for name, lbl, v in hist["samples"]
+        if name.endswith("_bucket")
+    ]
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert buckets[-1][0].endswith('le="+Inf"')
+    count = [v for n, _, v in hist["samples"] if n.endswith("_count")][0]
+    total = [v for n, _, v in hist["samples"] if n.endswith("_sum")][0]
+    assert buckets[-1][1] == count == 4
+    assert total == pytest.approx(0.001 + 0.004 + 0.2 + 1.5)
+    # phase timings ride as one labeled counter family
+    phases = families["gp_phase_seconds"]
+    assert any('phase="load.modelx"' in lbl for _, lbl, _ in phases["samples"])
+    # numeric fit metrics under gp_fit_metric, strings under gp_fit_info
+    assert any(
+        'key="final_nll"' in lbl for _, lbl, _ in
+        families["gp_fit_metric"]["samples"]
+    )
+    assert any(
+        'value="strict"' in lbl for _, lbl, _ in
+        families["gp_fit_info"]["samples"]
+    )
+
+
+def test_histogram_series_stay_cumulative_past_window():
+    """The _bucket/_count/_sum series must be MONOTONIC counters over the
+    histogram's lifetime, not the recency window: Prometheus rate() and
+    histogram_quantile() read a decreasing count as a counter reset."""
+    m = ServingMetrics(name="cumtest", histogram_capacity=8)
+    for _ in range(20):
+        m.observe("request_latency_s", 0.002)  # 20 obs >> capacity 8
+    bounds, counts, count, total = m.histogram("request_latency_s").cumulative()
+    assert count == 20, "count must not freeze at the window capacity"
+    assert total == pytest.approx(20 * 0.002)
+    # distribution shift: past observations never leave their buckets
+    le_0005 = counts[bounds.index(0.005)]
+    assert le_0005 == 20
+    for _ in range(5):
+        m.observe("request_latency_s", 0.9)
+    bounds2, counts2, count2, _ = m.histogram("request_latency_s").cumulative()
+    assert count2 == 25
+    assert counts2[bounds2.index(0.005)] == le_0005, (
+        "bucket counts must never decrease"
+    )
+    page = expo.render_openmetrics(m)
+    families = _parse_openmetrics(page)
+    hist = families["gp_request_latency_seconds"]
+    count_sample = [v for n, _, v in hist["samples"] if n.endswith("_count")]
+    assert count_sample == [25.0]
+
+
+def test_run_journals_do_not_clobber_across_fits(tmp_path):
+    from spark_gp_tpu.obs.runtime import write_run_journal
+
+    instr = Instrumentation(name="ClobberProbe")
+    paths = set()
+    for _ in range(2):
+        with trace.span("fit.ClobberProbe") as root:
+            pass
+        journal = write_run_journal(
+            instr, root, None, journal_dir=str(tmp_path)
+        )
+        assert journal["path"] is not None
+        paths.add(journal["path"])
+    assert len(paths) == 2, "two fits must persist two distinct journals"
+    assert all(os.path.exists(p) for p in paths)
+
+
+def test_openmetrics_pattern_collapses_to_label():
+    page = expo.render_openmetrics(_exercised_metrics())
+    families = _parse_openmetrics(page)
+    # breaker.open.modelx -> ONE family with a model label, not a family
+    # per model name (obs/names.py pattern labels)
+    breaker = families["gp_breaker_open"]
+    assert breaker["samples"] == [("gp_breaker_open", 'model="modelx"', 1.0)]
+
+
+def test_scrape_listener_answers_http():
+    metrics = _exercised_metrics()
+    listener = expo.ScrapeListener(
+        lambda: expo.render_openmetrics(metrics), port=0
+    )
+    try:
+        with socket.create_connection(("127.0.0.1", listener.port), 5) as conn:
+            conn.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+            conn.settimeout(5)
+            blob = b""
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                blob += chunk
+    finally:
+        listener.stop()
+    head, _, body = blob.partition(b"\r\n\r\n")
+    assert b"200 OK" in head.splitlines()[0]
+    assert expo.CONTENT_TYPE.encode() in head
+    _parse_openmetrics(body.decode("utf-8"))
+
+
+# -- runtime telemetry ------------------------------------------------------
+
+
+def test_compile_counter_increments_on_forced_recompile():
+    import jax
+    import jax.numpy as jnp
+
+    runtime.telemetry.install()
+
+    @jax.jit
+    def probe(a):
+        return (a * 2.0).sum()
+
+    def traces():
+        return runtime.telemetry.snapshot()["counters"].get(
+            "compile.traces", 0.0
+        )
+
+    base = traces()
+    with trace.span("recompile.test"):
+        probe(jnp.ones((23,)))  # first shape: one trace
+        after_first = traces()
+        probe(jnp.ones((23,)))  # warm dispatch: no trace
+        assert traces() == after_first
+        probe(jnp.ones((29,)))  # forced recompile: a NEW shape retraces
+    after_second = traces()
+    assert after_first >= base + 1
+    assert after_second >= after_first + 1
+    # attribution followed the active trace root
+    by_entry = runtime.telemetry.snapshot()["per_entry"]["compile.traces"]
+    assert by_entry.get("recompile.test", 0.0) >= 2
+
+
+def test_memory_sampling_always_produces_a_gauge():
+    sample = runtime.telemetry.sample_memory()
+    # device HBM stats on TPU/GPU, host RSS fallback everywhere — some
+    # memory gauge must exist on every backend
+    assert sample, "no memory gauge from any source"
+    assert all(k.startswith("memory.") for k in sample)
+    assert any(v > 0 for v in sample.values())
+
+
+# -- run journal (the fit-side acceptance proof) ----------------------------
+
+
+def _tree_nodes(nodes):
+    for node in nodes:
+        yield node
+        yield from _tree_nodes(node["children"])
+
+
+def test_run_journal_end_to_end(fitted):
+    model, _, journal_dir, _ = fitted
+    journal = model.run_journal
+    assert journal["format"] == runtime.JOURNAL_FORMAT
+    # persisted next to the checkpoints (GP_RUN_JOURNAL_DIR here) under a
+    # per-fit unique name: repeated fits sharing a dir must not clobber
+    path = journal["path"]
+    assert path is not None and os.path.exists(path)
+    assert os.path.dirname(path) == journal_dir
+    assert os.path.basename(path).startswith(
+        "run_journal_GaussianProcessRegression-"
+    )
+    with open(path) as fh:
+        on_disk = json.load(fh)
+    assert on_disk["format"] == journal["format"]
+
+    # the span tree contains the optimizer phases under the fit root
+    all_nodes = list(_tree_nodes(journal["spans"]))
+    by_name = {node["name"] for node in all_nodes}
+    assert "fit.GaussianProcessRegression" in by_name
+    assert {"group_experts", "optimize_hypers", "magic_solve"} <= by_name
+
+    # >= 1 compile event: counted in the deltas AND visible as span events
+    assert journal["compiles"]["compile.traces"] >= 1
+    compile_events = [
+        e for node in all_nodes for e in node["events"]
+        if e["name"].startswith("compile.")
+    ]
+    assert compile_events, "no compile span events in the tree"
+
+    # a memory gauge was sampled on phase boundaries
+    assert journal["memory"]["peak"], journal["memory"]
+    assert journal["memory"]["samples"]
+    assert {s["phase"] for s in journal["memory"]["samples"]} >= {
+        "start", "optimize_hypers", "end",
+    }
+    assert journal["precision_lane"] in ("strict", "mixed", "fast")
+
+
+def test_laplace_family_journal_captures_screen_quarantine(tmp_path, monkeypatch):
+    """The observation shell must wrap the WHOLE post-validation fit body
+    on every family (not just GPR): the group_experts phase — and any
+    data-screen quarantine fired inside it — belongs to the fit's root
+    span, so the journal's quarantine.events carries the transition."""
+    from spark_gp_tpu import GaussianProcessClassifier
+    from spark_gp_tpu.parallel.experts import num_experts_for
+    from spark_gp_tpu.resilience.chaos import poison_expert
+
+    monkeypatch.setenv("GP_RUN_JOURNAL_DIR", str(tmp_path))
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(120, 2))
+    y = (x.sum(axis=1) > 0).astype(np.float64)
+    n_e = num_experts_for(len(x), 30)
+    xp, yp = poison_expert(x, y, expert=1, num_experts=n_e, kind="nan")
+    model = (
+        GaussianProcessClassifier()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(30)
+        .setActiveSetSize(20)
+        .setSigma2(1e-2)
+        .setMaxIter(3)
+        .setSeed(3)
+        .fit(xp, yp)
+    )
+    journal = model.run_journal
+    by_name = {node["name"] for node in _tree_nodes(journal["spans"])}
+    assert "fit.GaussianProcessClassifier" in by_name
+    assert "group_experts" in by_name, "grouping phase outside the root span"
+    events = journal["quarantine"]["events"]
+    assert any(e["name"] == "experts.quarantined" for e in events), events
+    assert journal["quarantine"]["experts_quarantined"] >= 1
+
+
+# -- serve CLI: openmetrics verb (the serve-side acceptance proof) ----------
+
+
+def test_serve_stream_openmetrics_verb(fitted):
+    import io
+
+    from spark_gp_tpu.serve.__main__ import _serve_stream
+    from spark_gp_tpu.serve.server import GPServeServer
+
+    _, path, _, x = fitted
+    server = GPServeServer(max_batch=8, min_bucket=4, request_timeout_ms=None)
+    server.register("tiny", path)
+    server.start()
+    try:
+        out = io.StringIO()
+        lines = [
+            json.dumps({"id": 1, "model": "tiny", "x": x[:3].tolist()}),
+            json.dumps({"cmd": "metrics", "format": "openmetrics"}),
+            json.dumps({"cmd": "metrics", "format": "nope"}),
+            json.dumps({"cmd": "shutdown"}),
+        ]
+        assert _serve_stream(server, lines, out, threading.Lock())
+    finally:
+        server.stop()
+    replies = [json.loads(line) for line in out.getvalue().splitlines()]
+    assert replies[0]["id"] == 1 and len(replies[0]["mean"]) == 3
+    page_reply = replies[1]
+    assert page_reply["event"] == "metrics"
+    assert page_reply["format"] == "openmetrics"
+    families = _parse_openmetrics(page_reply["body"])
+    # the acceptance series: queue, breaker, latency histogram
+    assert "gp_queue_depth" in families
+    assert families["gp_breaker_open"]["samples"] == [
+        ("gp_breaker_open", 'model="tiny"', 0.0)
+    ]
+    assert families["gp_request_latency_seconds"]["type"] == "histogram"
+    assert families["gp_requests"]["samples"][0][2] >= 1
+    # runtime telemetry rode along (serve bucket traces from the warmup)
+    assert families["gp_compile_bucket_traces"]["samples"][0][2] >= 1
+    assert "unknown metrics format" in replies[2]["error"]
+
+
+# -- GP_TRACE_DIR (satellite: profiler capture without code change) ---------
+
+
+def test_maybe_profile_honors_gp_trace_dir(tmp_path, monkeypatch):
+    import jax.numpy as jnp
+
+    target = tmp_path / "profile"
+    monkeypatch.setenv("GP_TRACE_DIR", str(target))
+    with maybe_profile(None):
+        jnp.arange(8).sum().block_until_ready()
+    produced = [
+        os.path.join(dirpath, name)
+        for dirpath, _, filenames in os.walk(target)
+        for name in filenames
+    ]
+    assert produced, "GP_TRACE_DIR set but no profiler artifacts captured"
+    # and the env must be read at CALL time, not cached at import
+    monkeypatch.delenv("GP_TRACE_DIR")
+    with maybe_profile(None):
+        pass  # no jax.profiler context — would raise on nested traces
+
+
+# -- metric-name catalog + lint ---------------------------------------------
+
+
+def test_catalog_is_self_consistent():
+    seen = set()
+    for spec in names.CATALOG:
+        assert names.grammar_ok(spec.key), spec.key
+        assert spec.kind in ("counter", "gauge", "histogram", "metric", "phase")
+        assert spec.key not in seen, f"duplicate catalog entry {spec.key}"
+        seen.add(spec.key)
+    assert names.lookup("breaker.open.anything").label == "model"
+    assert names.lookup("restart_3_nll").kind == "metric"
+    assert names.lookup("no.such.key") is None
+    assert names.is_registered("restart_*_nll")
+    assert not names.is_registered("restart_*")
+
+
+def test_metric_names_lint_is_clean():
+    import sys
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_metric_names
+    finally:
+        sys.path.pop(0)
+    violations = check_metric_names.find_violations(
+        os.path.join(ROOT, "spark_gp_tpu")
+    )
+    assert violations == [], "\n".join(
+        f"{p}:{n}: {k}: {why}" for p, n, k, why in violations
+    )
+
+
+def test_metric_names_lint_catches_violations(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_metric_names
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'metrics.inc("Not.Lower.Case")\n'
+        'instr.log_metric(f"unregistered.{x}.key", 1.0)\n'
+        'instr.metrics["also.unregistered"] = 1.0\n'
+        'metrics.inc("exempted.key")  # metric-name-ok\n'
+        'instr.log_metric(variable_key, 1.0)\n'  # not statically checkable
+    )
+    violations = check_metric_names.find_violations(str(tmp_path))
+    keys = {k for _, _, k, _ in violations}
+    assert keys == {"Not.Lower.Case", "unregistered.*.key", "also.unregistered"}

@@ -253,10 +253,14 @@ def test_elbo_checkpoint_keyed_by_objective_surface(rng, tmp_path):
     files_b = set(os.listdir(tmp_path))
     # the second fit added its OWN state file; the first one survived
     assert files_a < files_b
-    # host path: objective-surface digest rides the json tag too
+    # host path: objective-surface digest rides the json tag too (the
+    # run_journal artifact shares the directory — count only state files)
     fit(1e-2, "host")
     fit(1e-3, "host")
-    host_tags = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    host_tags = [
+        f for f in os.listdir(tmp_path)
+        if f.startswith("lbfgs_state_") and f.endswith(".json")
+    ]
     assert len(host_tags) == 2
 
 

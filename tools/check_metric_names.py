@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Tier-1 lint: every emitted metric key is well-formed and catalogued.
+
+Dashboards and alerts bind to metric KEYS; a rename (or a new uncatalogued
+key) ships a silent flatline.  This checker walks the package AST and, for
+every emission call —
+
+* ``.inc(key, ...)`` / ``.set_gauge(key, ...)`` / ``.observe(key, ...)``
+  (:class:`ServingMetrics`), ``.log_metric(key, ...)`` / ``.phase(key)``
+  (:class:`Instrumentation`), and direct subscript writes to a
+  ``.metrics[...]`` / ``.counters[...]`` / ``.gauges[...]`` /
+  ``.timings[...]`` dict —
+
+requires the key to (a) satisfy the dot-separated-lowercase grammar and
+(b) be registered in :mod:`spark_gp_tpu.obs.names` (THE catalog).
+F-strings are checked with their dynamic parts wildcarded: an emission of
+``f"breaker.open.{name}"`` must match a registered ``breaker.open.*``
+pattern verbatim.  Keys that are runtime variables can't be checked
+statically and are skipped — which is exactly why the catalog lookup also
+runs at exposition time (``obs/expo.py`` falls back to a sanitized name).
+
+Run standalone (``python tools/check_metric_names.py``; exit 1 on
+violations) or through the tier-1 wrapper
+(``tests/test_observability.py::test_metric_names_lint_is_clean``).
+A deliberate exemption opts out with a trailing ``# metric-name-ok``
+comment — greppable, so every escape stays auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_EMITTERS = {"inc", "set_gauge", "observe", "log_metric", "phase"}
+_METRIC_DICTS = {"metrics", "counters", "gauges", "timings"}
+_ALLOW = "metric-name-ok"
+
+
+def _key_expr(node: ast.expr) -> Optional[str]:
+    """Constant string -> the key; f-string -> a ``*``-wildcarded pattern;
+    anything else -> None (not statically checkable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for value in node.values:
+            if isinstance(value, ast.Constant) and isinstance(value.value, str):
+                parts.append(value.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _emissions(tree: ast.AST) -> List[Tuple[int, str]]:
+    """``(lineno, key_or_pattern)`` for every statically-visible emission."""
+    found: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _EMITTERS
+                and node.args
+            ):
+                key = _key_expr(node.args[0])
+                if key is not None:
+                    found.append((node.lineno, key))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for target in targets:
+                if (
+                    isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Attribute)
+                    and target.value.attr in _METRIC_DICTS
+                ):
+                    key = _key_expr(target.slice)
+                    if key is not None:
+                        found.append((target.lineno, key))
+    return found
+
+
+def check_file(path: str) -> List[Tuple[str, int, str, str]]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [(path, exc.lineno or 0, "<unparseable>", str(exc))]
+
+    from spark_gp_tpu.obs import names
+
+    violations = []
+    for lineno, key in _emissions(tree):
+        line_text = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        if _ALLOW in line_text:
+            continue
+        if not names.grammar_ok(key):
+            violations.append((
+                path, lineno, key,
+                "not dot-separated lowercase ([a-z0-9_]+, '.'-joined)",
+            ))
+        elif not names.is_registered(key):
+            violations.append((
+                path, lineno, key,
+                "not registered in spark_gp_tpu/obs/names.py",
+            ))
+    return violations
+
+
+def find_violations(package_root: str) -> List[Tuple[str, int, str, str]]:
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(os.path.abspath(package_root)):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                violations.extend(check_file(os.path.join(dirpath, name)))
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = (argv if argv is not None else sys.argv[1:]) or [
+        os.path.join(repo_root, "spark_gp_tpu")
+    ]
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    violations = find_violations(args[0])
+    if violations:
+        print(
+            "unregistered or ill-formed metric keys — register every "
+            "emitted key in spark_gp_tpu/obs/names.py (dot-separated "
+            "lowercase; '*' for runtime-data parts), or mark a deliberate "
+            f"exemption with '# {_ALLOW}':",
+            file=sys.stderr,
+        )
+        for path, lineno, key, why in violations:
+            rel = os.path.relpath(path, repo_root)
+            print(f"  {rel}:{lineno}: {key!r}: {why}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
