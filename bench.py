@@ -850,6 +850,117 @@ def worker() -> None:
     else:
         observability = {"skipped": "BENCH_OBSERVABILITY != 1"}
 
+    # Multi-host coordination (the ISSUE 6 DCN layer): what the hardened
+    # protocols cost.  Single-container CI cannot time a real DCN hop, so
+    # the numbers price the PROTOCOL work (key packing, barrier
+    # rendezvous, digest verification) over the in-process KV client with
+    # two lockstep logical hosts on threads — the floor a real
+    # coordination-service RTT adds to.  Headline: coordinated checkpoint
+    # save (barrier + writer election + digest cross-check) vs PR 2's
+    # plain atomic save, and the barrier/allreduce round-trip latency the
+    # DCN-fallback fit pays per L-BFGS evaluation.
+    def _multihost_resilience_section():
+        import statistics
+        import tempfile
+        import threading as _threading
+
+        from spark_gp_tpu.kernels.rbf import RBFKernel as _RBF
+        from spark_gp_tpu.parallel import coord as _coord
+        from spark_gp_tpu.utils.checkpoint import LbfgsCheckpointer
+
+        rounds = int(os.environ.get("BENCH_COORD_ROUNDS", 40))
+
+        def two_hosts(fn):
+            """Run fn(pid, ctx) on two lockstep logical hosts; returns
+            host 0's per-round seconds."""
+            store = _coord.InProcessCoordStore()
+            ctxs = [
+                _coord.DcnContext(
+                    _coord.InProcessCoordClient(store, pid, 2),
+                    timeout_s=30.0,
+                )
+                for pid in range(2)
+            ]
+            timings = {}
+
+            def runner(pid):
+                t0 = time.perf_counter()
+                fn(pid, ctxs[pid])
+                timings[pid] = (time.perf_counter() - t0) / rounds
+
+            threads = [
+                _threading.Thread(target=runner, args=(pid,))
+                for pid in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return timings[0]
+
+        def barrier_rounds(pid, ctx):
+            for i in range(rounds):
+                ctx.client.barrier(f"bench/{i}", timeout_s=30.0)
+
+        def allreduce_rounds(pid, ctx):
+            grad = np.full(4, float(pid + 1))
+            for _ in range(rounds):
+                ctx.allreduce_arrays("bench_vag", np.ones(1), grad)
+
+        barrier_s = two_hosts(barrier_rounds)
+        allreduce_s = two_hosts(allreduce_rounds)
+
+        theta_bench = np.asarray([1.0])
+        with tempfile.TemporaryDirectory() as tmp:
+            plain = LbfgsCheckpointer(tmp, _RBF(1.0), tag="bench_plain")
+            plain_samples = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                plain(theta_bench)
+                plain_samples.append(time.perf_counter() - t0)
+            plain_save_s = statistics.median(plain_samples)
+
+            def coordinated_saves(pid, ctx):
+                ck = _coord.CoordinatedLbfgsCheckpointer(
+                    LbfgsCheckpointer(
+                        tmp, _RBF(1.0), tag="bench_coord",
+                        elastic=_coord.elastic_meta(None, process_count=2),
+                    ),
+                    ctx,
+                )
+                for _ in range(rounds):
+                    ck(theta_bench)
+
+            coord_save_s = two_hosts(coordinated_saves)
+
+        return {
+            "barrier_roundtrip_us": barrier_s * 1e6,
+            "allreduce_roundtrip_us": allreduce_s * 1e6,
+            "checkpoint_save_us": {
+                "uncoordinated": plain_save_s * 1e6,
+                "coordinated_2host": coord_save_s * 1e6,
+            },
+            "coordinated_ckpt_overhead_ratio": (
+                coord_save_s / max(plain_save_s, 1e-12)
+            ),
+            "rounds": rounds,
+            "note": (
+                "in-process KV client, 2 lockstep logical hosts on "
+                "threads: prices the coordination PROTOCOL (packing, "
+                "barrier rendezvous, writer election, digest cross-check) "
+                "— a real pod adds the coordination-service RTT on top "
+                "(parallel/coord.py, docs/RESILIENCE.md Multi-host)"
+            ),
+        }
+
+    if os.environ.get("BENCH_MULTIHOST", "1") == "1":
+        try:
+            multihost_resilience = _multihost_resilience_section()
+        except Exception as exc:  # noqa: BLE001 — secondary metric only
+            multihost_resilience = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    else:
+        multihost_resilience = {"skipped": "BENCH_MULTIHOST != 1"}
+
     def _classifier_fit_seconds(estimator_cls, labels):
         """Warm-up + timed fit of a classifier at the same shape/config as
         the primary metric (one definition, so the binary and multiclass
@@ -959,6 +1070,7 @@ def worker() -> None:
             "resilience": resilience,
             "precision_lanes": precision_lanes,
             "observability": observability,
+            "multihost_resilience": multihost_resilience,
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,
             "cpu_proxy_host_cores": host_cores,

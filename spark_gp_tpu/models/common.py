@@ -229,6 +229,12 @@ class GaussianProcessParams:
         return self
 
     def _resolved_optimizer(self) -> str:
+        if getattr(self, "_dcn_ctx", None) is not None:
+            # DCN-fallback fits interleave a KV-store allreduce into every
+            # objective evaluation — only the host-driven optimizer has a
+            # host boundary per evaluation to do that at (the device
+            # optimizer's whole L-BFGS loop is one XLA program)
+            return "host"
         if self._optimizer != "auto":
             return self._optimizer
         import jax
@@ -337,6 +343,49 @@ class GaussianProcessCommons(GaussianProcessParams):
         """User kernel + sigma2 * I — the noise-augmented model kernel
         (GaussianProcessCommons.scala:18)."""
         return self._kernel_factory() + Const(self._sigma2) * EyeKernel()
+
+    @contextlib.contextmanager
+    def _dcn_scope(self):
+        """Bind the process's DCN coordination context (parallel/coord.py)
+        to this fit: inside the scope the optimizer is forced host-side,
+        every objective evaluation's (value, grad) is KV-allreduced, the
+        (U1, u2) statistics are KV-allreduced, and checkpoints run the
+        coordinated protocol.  ``None`` (single process / native
+        global-array backends) leaves every path untouched."""
+        from spark_gp_tpu.parallel import coord
+
+        prev = getattr(self, "_dcn_ctx", None)
+        prev_flag = getattr(self, "_fit_is_distributed", False)
+        self._dcn_ctx = coord.dcn_context()
+        # the scope marker is separate from the ctx: global-array pods run
+        # fit_distributed WITHOUT a DCN ctx but still need coordinated
+        # checkpoints — while a plain per-host fit() on the same pod must
+        # keep plain local writers (_coord_ctx_for_checkpoint)
+        self._fit_is_distributed = True
+        try:
+            yield self._dcn_ctx
+        finally:
+            self._dcn_ctx = prev
+            self._fit_is_distributed = prev_flag
+
+    def _coord_ctx_for_checkpoint(self):
+        """The coordination context checkpoint writers should use: the DCN
+        fit context when one is bound; else — ONLY inside a
+        ``fit_distributed`` scope — the process's cached bare context on
+        real multi-process (global-array) runtimes (cached so its round
+        counters stay monotonic across fits); else ``None``.  A plain
+        per-host ``fit()`` on a pod keeps plain local writers: two
+        INDEPENDENT fits must never rendezvous on shared KV gathers (the
+        digests would spuriously mismatch) or resume from each other's
+        payloads."""
+        from spark_gp_tpu.parallel import coord
+
+        ctx = getattr(self, "_dcn_ctx", None)
+        if ctx is not None:
+            return ctx
+        if not getattr(self, "_fit_is_distributed", False):
+            return None
+        return coord.checkpoint_coordination_context()
 
     def _observed_fit(self, instr: Instrumentation, run):
         """Observability shell around one COMPLETE public fit: opens the
@@ -704,12 +753,45 @@ class GaussianProcessCommons(GaussianProcessParams):
     def _make_checkpointer(self, kernel):
         if self._checkpoint_dir is None:
             return None
+        from spark_gp_tpu.parallel import coord
         from spark_gp_tpu.utils.checkpoint import LbfgsCheckpointer
 
-        return LbfgsCheckpointer(
+        ctx = self._coord_ctx_for_checkpoint()
+        inner = LbfgsCheckpointer(
             self._checkpoint_dir, kernel, tag=self._checkpoint_tag(),
             seed=self._seed,
+            elastic=coord.elastic_meta(
+                self._mesh,
+                process_count=None if ctx is None else ctx.num_processes,
+            ),
         )
+        if ctx is None:
+            return inner
+        # multi-host: barrier-agreed save step, process 0 writes, every
+        # peer digest-verifies through the KV store (parallel/coord.py)
+        return coord.CoordinatedLbfgsCheckpointer(inner, ctx)
+
+    def _make_device_checkpointer(self, file_tag: str, data):
+        """The device-optimizer counterpart: PR 2's atomic npz writer,
+        stamped with the elastic-resume metadata and wrapped in the
+        coordinated protocol on multi-process runtimes.  One home so the
+        four estimator families cannot wire it differently."""
+        from spark_gp_tpu.parallel import coord
+        from spark_gp_tpu.utils.checkpoint import DeviceOptimizerCheckpointer
+
+        ctx = self._coord_ctx_for_checkpoint()
+        inner = DeviceOptimizerCheckpointer(
+            self._checkpoint_dir, file_tag,
+            elastic=coord.elastic_meta(
+                self._mesh,
+                num_experts=int(data.x.shape[0]),
+                expert_size=int(data.x.shape[1]),
+                process_count=None if ctx is None else ctx.num_processes,
+            ),
+        )
+        if ctx is None:
+            return inner
+        return coord.CoordinatedDeviceCheckpointer(inner, ctx)
 
     def _optimize_hypers(
         self,
@@ -721,6 +803,15 @@ class GaussianProcessCommons(GaussianProcessParams):
         """L-BFGS-B over the box-constrained hyperparameters
         (GaussianProcessCommons.scala:66-92)."""
         instr.log_info("Optimising the kernel hyperparameters")
+        from spark_gp_tpu.parallel import coord as coord_mod
+
+        dcn = getattr(self, "_dcn_ctx", None)
+        if dcn is not None:
+            # the DCN analogue of the objective's cross-host psum: every
+            # evaluation's local (value, grad) is deterministically summed
+            # over the KV store, so each host's L-BFGS walks the IDENTICAL
+            # global-objective trajectory (parallel/coord.py)
+            value_and_grad = dcn.wrap_value_and_grad(value_and_grad)
         theta0 = kernel.init_theta()
         done_iters = 0
         if self._checkpoint_dir is not None:
@@ -730,10 +821,9 @@ class GaussianProcessCommons(GaussianProcessParams):
             from spark_gp_tpu.utils.checkpoint import (
                 CheckpointMismatchError,
                 kernel_signature,
-                load_checkpoint,
             )
 
-            ck = load_checkpoint(self._checkpoint_dir, tag=self._checkpoint_tag())
+            ck = self._load_host_resume_state()
             if ck is not None:
                 expected = kernel_signature(kernel, theta0.shape[0])
                 if np.asarray(ck[1]).shape != theta0.shape or (
@@ -781,16 +871,25 @@ class GaussianProcessCommons(GaussianProcessParams):
                     ),
                 )
             else:
-                res = minimize_lbfgsb(
-                    value_and_grad,
-                    theta0,
-                    lower,
-                    upper,
-                    max_iter=self._max_iter - done_iters,
-                    tol=self._tol,
-                    callback=callback,
-                    log_space=self._use_log_space(kernel),
+                # SIGTERM watch only while a save boundary exists to act
+                # on it (the per-iteration checkpoint callback); restored
+                # — and a deferred signal re-delivered — on exit
+                watch = (
+                    coord_mod.preemption_watch()
+                    if self._checkpoint_dir is not None
+                    else contextlib.nullcontext()
                 )
+                with watch:
+                    res = minimize_lbfgsb(
+                        value_and_grad,
+                        theta0,
+                        lower,
+                        upper,
+                        max_iter=self._max_iter - done_iters,
+                        tol=self._tol,
+                        callback=callback,
+                        log_space=self._use_log_space(kernel),
+                    )
         instr.log_metric("lbfgs_iters", res.nit)
         instr.log_metric("lbfgs_nfev", res.nfev)
         instr.log_metric("final_nll", res.fun)
@@ -803,6 +902,51 @@ class GaussianProcessCommons(GaussianProcessParams):
             )
         instr.log_info("Optimal kernel: " + kernel.describe(res.theta))
         return res.theta
+
+    def _load_host_resume_state(self):
+        """``(iteration, theta, kernel_sig)`` from the host checkpoint, or
+        ``None`` — with two multi-host duties the plain loader has not:
+
+        * only process 0 is guaranteed to hold the file (it is the
+          coordinated writer, and after rescheduling the others may sit on
+          fresh machines), so its payload is broadcast over the KV store
+          and every process resumes from the identical state;
+        * a payload stamped by a different process count is an **elastic
+          resume** — counted (``coord.elastic_resumes``) and span-marked,
+          then resumed normally: the host iterate is replicated.
+        """
+        import json as _json
+
+        from spark_gp_tpu.utils.checkpoint import load_checkpoint_payload
+
+        ctx = self._coord_ctx_for_checkpoint()
+        payload = None
+        if ctx is None or ctx.process_id == 0:
+            payload = load_checkpoint_payload(
+                self._checkpoint_dir, tag=self._checkpoint_tag()
+            )
+        if ctx is not None and ctx.num_processes > 1:
+            blob = _json.dumps(payload or {}).encode()
+            parts = ctx.allgather_bytes("ckpt_resume", blob)
+            payload = _json.loads(parts[0].decode()) or None
+        if payload is None:
+            return None
+        elastic = payload.get("elastic")
+        if elastic is not None:
+            current_p = 1 if ctx is None else ctx.num_processes
+            if elastic.get("process_count") not in (None, current_p):
+                from spark_gp_tpu.obs import trace as obs_trace
+                from spark_gp_tpu.obs.runtime import telemetry
+
+                telemetry.inc("coord.elastic_resumes")
+                obs_trace.add_event(
+                    "coord.elastic_resume",
+                    stored_process_count=elastic.get("process_count"),
+                    current_process_count=current_p,
+                )
+        from spark_gp_tpu.utils.checkpoint import payload_state
+
+        return payload_state(payload)
 
     def _restart_theta_batch(self, kernel) -> np.ndarray:
         """``[R, h]`` multi-start starting points: row 0 is the user's
@@ -871,7 +1015,7 @@ class GaussianProcessCommons(GaussianProcessParams):
         preparation lives in ``prepare`` (label-domain checks, one-hot
         construction, ...)."""
         instr = Instrumentation(name=name)
-        with self._stack_mesh(data):
+        with self._stack_mesh(data), self._dcn_scope():
             # observation shell INSIDE the mesh context but around the
             # whole body: the data screen's quarantine events and the
             # restart driver land in one root span (the gpr.py convention)
@@ -887,7 +1031,13 @@ class GaussianProcessCommons(GaussianProcessParams):
 
         instr.log_metric("num_experts", int(data.x.shape[0]))
         instr.log_metric("expert_size", int(data.x.shape[1]))
-        if self._expert_quarantine and jax.process_count() == 1:
+        screenable = (
+            jax.process_count() == 1
+            # DCN-fallback stacks are host-local even on multi-process
+            # clusters: the screen (and with_experts_masked) can fetch them
+            or getattr(self, "_dcn_ctx", None) is not None
+        )
+        if self._expert_quarantine and screenable:
             # same pre-fit data screen as the in-process fit paths: a
             # bad shard's NaN rows must not poison the mesh-wide psum
             from spark_gp_tpu.resilience.quarantine import (
@@ -964,6 +1114,7 @@ class GaussianProcessCommons(GaussianProcessParams):
             # distributed mode: no host holds the rows — the provider
             # selects from the sharded stack itself (data.y carries the
             # targets: labels for GPR, latent modes for GPC)
+            provider = self._dcn_safe_provider(provider)
             active = provider.from_stack(
                 self._active_set_size, data, kernel,
                 np.asarray(theta, dtype=np.float64), self._seed,
@@ -983,6 +1134,32 @@ class GaussianProcessCommons(GaussianProcessParams):
                 self._active_set_size, x, None, kernel, None, self._seed
             )
         return np.asarray(active)
+
+    def _dcn_safe_provider(self, provider):
+        """In DCN-fallback mode a ``from_stack`` provider that runs mesh
+        collectives (k-means Lloyd, greedy Seeger) would compute over the
+        LOCAL stack only — every host silently selecting a different
+        active set, the classic diverged-cluster wrong-results bug.  Until
+        those providers grow a KV-coordinated path, fall back (loudly) to
+        the uniform draw, whose DCN route is exact."""
+        if getattr(self, "_dcn_ctx", None) is None:
+            return provider
+        from spark_gp_tpu.models.active_set import _RandomActiveSetProvider
+
+        if isinstance(provider, _RandomActiveSetProvider):
+            return provider
+        import warnings
+
+        warnings.warn(
+            f"{type(provider).__name__} has no DCN-coordinated "
+            "implementation; falling back to uniform sampling for this "
+            "multi-host fit (the KV-store fallback mode cannot run "
+            "cross-host mesh collectives).",
+            stacklevel=2,
+        )
+        from spark_gp_tpu.models.active_set import RandomActiveSetProvider
+
+        return RandomActiveSetProvider
 
     def _projected_process(
         self,
@@ -1044,6 +1221,12 @@ class GaussianProcessCommons(GaussianProcessParams):
                 )
             u1 = np.asarray(u1)
             u2 = np.asarray(u2)
+            dcn = getattr(self, "_dcn_ctx", None)
+            if dcn is not None:
+                # the (U1, u2) psum's DCN analogue: each host's sums over
+                # its local experts, reduced deterministically over the KV
+                # store — every host then runs the identical magic solve
+                u1, u2 = dcn.allreduce_arrays("kmn_stats", u1, u2)
 
         return self._build_predictor(
             instr, kernel, theta_opt, active, u1, u2, data=data
@@ -1106,10 +1289,11 @@ class GaussianProcessCommons(GaussianProcessParams):
             return
         import jax
 
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and getattr(self, "_dcn_ctx", None) is None:
             # probing needs the first expert's rows on this host, which a
             # cross-process sharding cannot satisfy (same restriction as
-            # the quarantine data screen) — skip rather than crash
+            # the quarantine data screen; DCN-fallback stacks are local
+            # and probe fine) — skip rather than crash
             instr.log_warning(
                 "mixed_precision_guard skipped: the stack spans "
                 f"{jax.process_count()} processes and cannot be "

@@ -258,10 +258,6 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                 )
                 import hashlib
 
-                from spark_gp_tpu.utils.checkpoint import (
-                    DeviceOptimizerCheckpointer,
-                )
-
                 # likelihood-keyed FILE tag: NB and Poisson fits (or two NB
                 # fits with different dispersions) sharing a dir must not
                 # clobber each other's resumable state — the same hazard
@@ -276,9 +272,9 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         self._mesh, log_space, theta0, lower, upper,
                         data.x, data.y, data.mask, self._max_iter,
                         self._checkpoint_interval,
-                        DeviceOptimizerCheckpointer(
-                            self._checkpoint_dir,
+                        self._make_device_checkpointer(
                             f"generic-{type(lik).__name__}-{lik_digest}",
+                            data,
                         ),
                     )
                 )

@@ -297,16 +297,13 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                 from spark_gp_tpu.models.laplace import (
                     fit_gpc_device_checkpointed,
                 )
-                from spark_gp_tpu.utils.checkpoint import (
-                    DeviceOptimizerCheckpointer,
-                )
 
                 theta, f_final, f, n_iter, n_fev, stalled = (
                     fit_gpc_device_checkpointed(
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data, self._max_iter,
                         self._checkpoint_interval,
-                        DeviceOptimizerCheckpointer(self._checkpoint_dir, "gpc"),
+                        self._make_device_checkpointer("gpc", data),
                     )
                 )
             elif self._mesh is not None:

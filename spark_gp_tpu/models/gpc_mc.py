@@ -195,6 +195,15 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
             n_cls = n_classes
             if n_cls is None:
                 n_cls = int(np.asarray(_max_label(data.y, data.mask))) + 1
+                dcn = getattr(self, "_dcn_ctx", None)
+                if dcn is not None:
+                    # DCN-fallback: the reduction above only saw the LOCAL
+                    # shard — agree on max(classes) across hosts, or a
+                    # shard missing the top class trains a narrower head
+                    parts = dcn.allgather_arrays(
+                        "num_classes", np.asarray([n_cls], dtype=np.int64)
+                    )
+                    n_cls = max(int(p[0][0]) for p in parts)
             if n_cls < 2:
                 raise ValueError("need at least 2 classes")
             if not bool(_labels_valid(data.y, data.mask, float(n_cls))):
@@ -271,18 +280,12 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
         with instr.phase("optimize_hypers"):
             if self._checkpoint_dir is not None:
-                from spark_gp_tpu.utils.checkpoint import (
-                    DeviceOptimizerCheckpointer,
-                )
-
                 theta, f_final, nll, n_iter, n_fev, stalled = (
                     fit_gpc_mc_device_checkpointed(
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data.x, y1h, data.mask,
                         self._max_iter, self._checkpoint_interval,
-                        DeviceOptimizerCheckpointer(
-                            self._checkpoint_dir, "gpc_mc"
-                        ),
+                        self._make_device_checkpointer("gpc_mc", data),
                     )
                 )
             elif self._mesh is not None:
@@ -335,7 +338,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                     y=_margin_targets(latents, data.mask),
                     mask=data.mask,
                 )
-                active = provider.from_stack(
+                active = self._dcn_safe_provider(provider).from_stack(
                     self._active_set_size, sdata, kernel,
                     np.asarray(theta_opt, dtype=np.float64), self._seed,
                     self._mesh,
@@ -375,6 +378,11 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                 u1, u2 = ppa.kmn_stats_sharded(kernel, self._mesh, *args)
             u1 = np.asarray(u1)
             u2 = np.asarray(u2)
+            dcn = getattr(self, "_dcn_ctx", None)
+            if dcn is not None:
+                # cross-host (U1, U2) sum over the KV store (the common.py
+                # _projected_process convention)
+                u1, u2 = dcn.allreduce_arrays("kmn_stats_mc", u1, u2)
 
         with instr.phase("magic_solve"):
             # the generic magic solve handles the [m, C] right-hand sides

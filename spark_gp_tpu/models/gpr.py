@@ -460,9 +460,6 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 from spark_gp_tpu.models.likelihood import (
                     fit_gpr_device_checkpointed,
                 )
-                from spark_gp_tpu.utils.checkpoint import (
-                    DeviceOptimizerCheckpointer,
-                )
 
                 # the objective is part of the FILE tag too (not only the
                 # resume-meta family): a loo fit must not overwrite a
@@ -477,7 +474,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device_checkpointed(
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data, self._max_iter, tol, self._checkpoint_interval,
-                    DeviceOptimizerCheckpointer(self._checkpoint_dir, file_tag),
+                    self._make_device_checkpointer(file_tag, data),
                     objective=self._objective, extra=extra,
                 )
             elif self._mesh is not None and self._objective != "elbo":

@@ -115,6 +115,15 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("memory.bytes_in_use", "gauge", "device HBM bytes in use at the last sample"),
     MetricName("memory.peak_bytes_in_use", "gauge", "peak device HBM bytes in use"),
     MetricName("memory.host_peak_rss_bytes", "gauge", "host process peak RSS (CPU fallback proxy)"),
+    # -- multi-host coordination (parallel/coord.py) -----------------------
+    MetricName("coord.degraded", "counter", "distributed.initialize silently degraded to single-process"),
+    MetricName("coord.heartbeats", "counter", "liveness stamps this process published"),
+    MetricName("coord.stragglers", "counter", "peers flagged straggling (stale heartbeat)"),
+    MetricName("coord.dead_hosts", "counter", "peers declared dead (heartbeat past the dead threshold)"),
+    MetricName("coord.barrier_timeouts", "counter", "deadline-guarded coordination steps that timed out"),
+    MetricName("coord.checkpoints", "counter", "coordinated checkpoint saves completed"),
+    MetricName("coord.elastic_resumes", "counter", "resumes under a different process count than the save"),
+    MetricName("coord.preemptions", "counter", "SIGTERM preemption signals observed by the watcher"),
 )
 
 _EXACT = {spec.key: spec for spec in CATALOG if "*" not in spec.key}

@@ -15,4 +15,7 @@ __all__ = [
     "EXPERT_AXIS",
     "expert_mesh",
     "shard_experts",
+    # parallel.coord (imported lazily by consumers: the hardened DCN
+    # coordination layer — deadline-guarded barriers, liveness,
+    # coordinated checkpoints, the KV-store fit fallback)
 ]

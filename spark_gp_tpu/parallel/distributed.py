@@ -65,11 +65,10 @@ def initialize(
     environments (e.g. a single-host TPU site) where coordinator env vars
     happen to be set.
     """
-    import jax
-
+    from spark_gp_tpu.parallel import coord
     from spark_gp_tpu.utils.platform import backends_already_initialized
 
-    if jax.distributed.is_initialized():
+    if coord.runtime_initialized():
         return
     auto = coordinator_address is None and num_processes is None
     multi_host = False
@@ -104,6 +103,7 @@ def initialize(
             # silently training 1/num_processes of the data per host would
             # be a correctness bug — fail loudly.
             raise RuntimeError(late_msg)
+        _degraded_to_single_process("backend_already_initialized")
         import warnings
 
         warnings.warn(
@@ -113,10 +113,8 @@ def initialize(
         )
         return
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
+        coord.initialize_runtime(
+            coordinator_address, num_processes, process_id
         )
     except (RuntimeError, ValueError) as exc:
         # RuntimeError: the backend raced us up; ValueError: env vars present
@@ -124,6 +122,7 @@ def initialize(
         # address on a single-host TPU site).
         if not auto or multi_host:
             raise  # real cluster: surface the failure, don't train 1/P-wrong
+        _degraded_to_single_process(type(exc).__name__)
         import warnings
 
         warnings.warn(
@@ -132,6 +131,18 @@ def initialize(
             RuntimeWarning,
             stacklevel=2,
         )
+
+
+def _degraded_to_single_process(reason: str) -> None:
+    """A warning that scrolls by is how pod misconfiguration ships: the
+    silent-degrade branches ALSO count ``coord.degraded`` (OpenMetrics /
+    run journals) and stamp a span event, so a fleet dashboard sees every
+    process that quietly fell back to 1/P of the job."""
+    from spark_gp_tpu.obs import trace as obs_trace
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    telemetry.inc("coord.degraded")
+    obs_trace.add_event("coord.degraded", reason=reason)
 
 
 def num_processes() -> int:
@@ -145,7 +156,19 @@ def global_expert_mesh():
 
     ``jax.devices()`` is global after :func:`initialize`; the expert axis
     spans hosts so the psum collectives ride ICI within a slice and DCN only
-    between slices (XLA picks the hierarchical reduction)."""
+    between slices (XLA picks the hierarchical reduction).
+
+    On backends whose runtime cannot execute one program across processes
+    (``coord.dcn_required()`` — this jax's CPU backend), a cross-host mesh
+    would make every fit program hang or crash; the mesh then covers the
+    LOCAL devices only and the cross-host sums ride the KV store instead
+    (the DCN-fallback fit mode, ``parallel/coord.py``)."""
+    import jax
+
+    from spark_gp_tpu.parallel import coord
+
+    if coord.dcn_required():
+        return expert_mesh(jax.local_devices())
     return expert_mesh()
 
 
@@ -167,8 +190,21 @@ def distribute_global_experts(
     expert axis is sharded across all hosts' devices.
 
     Single-process: equivalent to ``shard_experts(group_for_experts(...))``.
+
+    Multi-process, two modes (``parallel/coord.py``):
+
+    * **global-array** (TPU pods): the per-host dims exchange rides
+      ``coord.kv_allgather`` (deadline-guarded, names a dead host instead
+      of hanging; falls back to ``process_allgather`` when the KV client
+      is unavailable) and the stitch itself is entered through a guarded
+      barrier.
+    * **DCN fallback** (backends with no cross-process execution): the
+      local rows become a LOCAL expert stack on the local mesh; the fit's
+      cross-host sums ride the KV store.
     """
     import jax
+
+    from spark_gp_tpu.parallel import coord
 
     if mesh is None:
         mesh = global_expert_mesh()
@@ -178,15 +214,25 @@ def distribute_global_experts(
             group_for_experts(x_local, y_local, dataset_size_for_expert), mesh
         )
 
+    if coord.dcn_required():
+        # DCN-fallback: host-local stack, host-local mesh; dims need no
+        # exchange (each host's objective terms are summed over the KV
+        # store, so per-host expert counts may differ freely).  Creating
+        # the context here also starts the heartbeat monitor.
+        coord.dcn_context()
+        if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
+            mesh = expert_mesh(jax.local_devices())
+        return shard_experts(
+            group_for_experts(x_local, y_local, dataset_size_for_expert), mesh
+        )
+
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     local = group_for_experts(x_local, y_local, dataset_size_for_expert)
     # Every process must contribute the same expert count for a dense global
     # axis: pad to the max across hosts (masked experts contribute nothing).
-    from jax.experimental import multihost_utils
-
     dims = np.asarray([local.num_experts, local.expert_size], dtype=np.int64)
-    gathered = multihost_utils.process_allgather(dims, tiled=False)
+    gathered = _exchange_dims(dims)
     e_max, s_max = (int(v) for v in np.max(gathered.reshape(-1, 2), axis=0))
     # The stitched global expert axis (e_max * num_processes) must divide
     # evenly over the mesh actually used for P(EXPERT_AXIS) sharding: round
@@ -197,15 +243,57 @@ def distribute_global_experts(
     if local.expert_size != s_max or local.num_experts != e_max:
         local = _pad_stack(local, e_max, s_max)
 
+    # ONE guarded rendezvous covers all three stitches (each barrier is a
+    # cluster round-trip; the three native calls share the fate the first
+    # barrier already established)
+    coord.guard_collective("stitch")
+
     def stitch(a):
         spec = P(EXPERT_AXIS, *([None] * (a.ndim - 1)))
-        return multihost_utils.host_local_array_to_global_array(
-            np.asarray(a), mesh, spec
+        return coord.host_local_to_global(
+            np.asarray(a), mesh, spec, guarded=False
         )
 
     return ExpertData(
         x=stitch(local.x), y=stitch(local.y), mask=stitch(local.mask)
     )
+
+
+_DIMS_ROUND = 0
+
+
+def _exchange_dims(dims: np.ndarray) -> np.ndarray:
+    """``[P, 2]`` per-host (num_experts, expert_size): through the KV store
+    when the coordination service is up — deadline-guarded, and the only
+    path the CPU backend can take at all (its ``process_allgather`` runs a
+    jitted collective the runtime refuses cross-process) — else the legacy
+    raw collective."""
+    from spark_gp_tpu.parallel import coord
+
+    client = coord.coord_client()
+    if client is not None:
+        # every process runs the same program, so its k-th dims exchange is
+        # every peer's k-th too — the lockstep counter IS the shared nonce
+        # (stale keys from exchange k-1 can never satisfy exchange k).  No
+        # extra guard barrier: kv_allgather is itself a deadline-guarded
+        # rendezvous with the chaos hooks applied.
+        global _DIMS_ROUND
+        round_id, _DIMS_ROUND = _DIMS_ROUND, _DIMS_ROUND + 1
+        payloads = coord.kv_allgather(
+            f"dims/{round_id}", dims.tobytes(), client=client
+        )
+        if round_id >= 2:
+            # same r-2 GC as DcnContext.allgather_bytes: completing round
+            # r means every peer published round r, i.e. finished reading
+            # all earlier rounds — our r-2 key is provably drained
+            client.delete(f"ag/dims/{round_id - 2}/{client.process_id}")
+        return np.stack([
+            np.frombuffer(p, dtype=dims.dtype) for p in payloads
+        ])
+    from jax.experimental import multihost_utils  # collective-guard-ok
+
+    gathered = multihost_utils.process_allgather(dims, tiled=False)  # collective-guard-ok
+    return gathered.reshape(-1, 2)
 
 
 def replicated_valid_indices(data: ExpertData, mesh) -> np.ndarray:
@@ -236,10 +324,20 @@ def sample_active_from_stack(
     shared seed (via :func:`replicated_valid_indices`), then the [m, p] row
     gather runs as one XLA program with a replicated output — the cross-host
     traffic is the m selected rows, not the dataset.
+
+    In DCN-fallback mode the replicated gather cannot run (no cross-process
+    programs); the draw rides ``coord.sample_active_dcn`` instead — same
+    uniform semantics, the m selected rows travel over the KV store.
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_gp_tpu.parallel import coord
+
+    ctx = coord.dcn_context()
+    if ctx is not None:
+        return coord.sample_active_dcn(ctx, data, m, seed)
 
     rep = NamedSharding(mesh, P())
     valid = replicated_valid_indices(data, mesh)

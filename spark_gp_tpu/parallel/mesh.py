@@ -48,3 +48,15 @@ def shard_experts(data, mesh: Mesh):
 def replicated(a, mesh: Mesh):
     """Replicate an array on every device of the mesh (the ``broadcast``)."""
     return jax.device_put(a, NamedSharding(mesh, P()))
+
+
+def mesh_shape(mesh):
+    """JSON-able ``[[axis, size], ...]`` topology of a mesh (or ``None``)
+    — the form the elastic-resume checkpoint stamp records so a resumed
+    fit can tell "same mesh" from "re-sharded" (``parallel/coord.py``)."""
+    if mesh is None:
+        return None
+    return [
+        [str(name), int(size)]
+        for name, size in zip(mesh.axis_names, mesh.devices.shape)
+    ]

@@ -17,7 +17,20 @@ Fault classes:
   after the k-th checkpoint save — a deterministic preemption for
   kill-and-resume tests;
 * :class:`FlakyPredictor` — a predict path that fails and/or stalls on
-  schedule, for circuit-breaker and poisoned-batch isolation tests.
+  schedule, for circuit-breaker and poisoned-batch isolation tests;
+* **multi-host faults** (consumed by ``parallel/coord.py``'s guarded
+  collectives and coordinated checkpointers):
+  :class:`StragglerHost` — inject a fixed delay before a named
+  collective (the slow-host fault the deadline guards must survive);
+  :class:`DeadHost` — stop heartbeating and die (or raise) before the
+  next collective (the preempted-host fault the guards must NAME within
+  the deadline instead of hanging on);
+  :func:`kill_process_after` — ``os._exit(137)`` after N checkpoint-save
+  / segment boundaries.  All three are env-drivable
+  (``GP_CHAOS_STRAGGLER_S`` [+ ``GP_CHAOS_STRAGGLER_OP``],
+  ``GP_CHAOS_DEAD_HOST``, ``GP_CHAOS_KILL_AFTER_ITERS``) so subprocess
+  tests can stage real multi-process failures without patching code in
+  the child.
 """
 
 from __future__ import annotations
@@ -185,6 +198,135 @@ class FlakyPredictor:
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+
+# --------------------------------------------------------------------------
+# multi-host faults (parallel/coord.py consumes these at its choke points)
+# --------------------------------------------------------------------------
+
+#: in-process staged faults; the env vars below are the subprocess channel
+_mp_state = {
+    "straggler_s": None,      # float | None
+    "straggler_op": None,     # substring filter | None
+    "dead_host": False,       # True -> die before the next collective
+    "dead_exit": True,        # os._exit vs SimulatedPreemption
+    "no_heartbeat": False,    # True -> suppress heartbeat stamps
+    "kill_after": None,       # int | None remaining save/segment ticks
+    "preempt": False,         # True -> coord.preemption_requested()
+}
+
+
+def _env_chaos_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def apply_straggler_delay(op: str) -> float:
+    """Sleep the staged straggler delay before the named collective (when
+    the op filter matches); returns the seconds actually slept.  Driven by
+    :class:`StragglerHost` in-process or ``GP_CHAOS_STRAGGLER_S`` (+
+    optional ``GP_CHAOS_STRAGGLER_OP`` substring filter) in a subprocess.
+    """
+    delay = _mp_state["straggler_s"]
+    op_filter = _mp_state["straggler_op"]
+    if delay is None:
+        delay = _env_chaos_float("GP_CHAOS_STRAGGLER_S")
+        op_filter = os.environ.get("GP_CHAOS_STRAGGLER_OP", "") or None
+    if not delay or (op_filter and op_filter not in op):
+        return 0.0
+    time.sleep(delay)
+    return delay
+
+
+def maybe_die_before_collective(op: str) -> None:
+    """The DeadHost trigger point: guarded collectives call this first, so
+    a staged dead host exits (or raises) BEFORE entering a native call its
+    peers would otherwise block on forever."""
+    if _mp_state["dead_host"] or os.environ.get("GP_CHAOS_DEAD_HOST", "") == "1":
+        if _mp_state["dead_host"] and not _mp_state["dead_exit"]:
+            raise SimulatedPreemption(
+                f"chaos: DeadHost died before collective {op!r}"
+            )
+        os._exit(PREEMPTION_EXIT_CODE)
+
+
+def heartbeats_suppressed() -> bool:
+    return (
+        _mp_state["no_heartbeat"]
+        or _mp_state["dead_host"]
+        or os.environ.get("GP_CHAOS_NO_HEARTBEAT", "") == "1"
+        or os.environ.get("GP_CHAOS_DEAD_HOST", "") == "1"
+    )
+
+
+def preemption_staged() -> bool:
+    """In-process analogue of a delivered SIGTERM (tests stage it with
+    :func:`stage_preemption` instead of signalling themselves)."""
+    return bool(_mp_state["preempt"])
+
+
+def stage_preemption(on: bool = True) -> None:
+    _mp_state["preempt"] = bool(on)
+
+
+@contextlib.contextmanager
+def StragglerHost(delay_s: float, op: Optional[str] = None):
+    """Make THIS process a straggler: every guarded collective (optionally
+    only those whose name contains ``op``) is entered ``delay_s`` late —
+    the deterministic slow-host fault for liveness/deadline tests."""
+    prev = (_mp_state["straggler_s"], _mp_state["straggler_op"])
+    _mp_state["straggler_s"], _mp_state["straggler_op"] = float(delay_s), op
+    try:
+        yield
+    finally:
+        _mp_state["straggler_s"], _mp_state["straggler_op"] = prev
+
+
+@contextlib.contextmanager
+def DeadHost(exit_process: bool = False):
+    """Make THIS process die before its next guarded collective and stop
+    heartbeating immediately.  ``exit_process=True`` uses ``os._exit(137)``
+    (subprocess tests); the default raises :class:`SimulatedPreemption`
+    at the collective — the tier-1-safe variant."""
+    prev = (_mp_state["dead_host"], _mp_state["dead_exit"])
+    _mp_state["dead_host"], _mp_state["dead_exit"] = True, bool(exit_process)
+    try:
+        yield
+    finally:
+        _mp_state["dead_host"], _mp_state["dead_exit"] = prev
+
+
+def kill_process_after(n_iters: int) -> None:
+    """Stage a hard ``os._exit(137)`` after ``n_iters`` more checkpoint
+    save / segment boundaries (``tick_kill_counter`` is called at each by
+    ``utils/checkpoint.py``) — the deterministic mid-fit preemption for
+    kill-and-resume tests.  Subprocesses stage it with
+    ``GP_CHAOS_KILL_AFTER_ITERS=<n>`` instead."""
+    if int(n_iters) < 1:
+        raise ValueError("n_iters must be >= 1")
+    _mp_state["kill_after"] = int(n_iters)
+
+
+def tick_kill_counter() -> None:
+    remaining = _mp_state["kill_after"]
+    if remaining is None:
+        raw = os.environ.get("GP_CHAOS_KILL_AFTER_ITERS", "").strip()
+        if not raw:
+            return
+        try:
+            remaining = int(raw)
+        except ValueError:
+            return
+        _mp_state["kill_after"] = remaining
+    remaining -= 1
+    _mp_state["kill_after"] = remaining
+    if remaining <= 0:
+        os._exit(PREEMPTION_EXIT_CODE)
 
 
 def break_model(server, name: str, version: Optional[int] = None, **flaky_kw):

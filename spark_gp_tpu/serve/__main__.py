@@ -21,8 +21,11 @@ Protocol (one JSON object per line, in either direction):
 
 ``health`` answers immediately (it does not ride the ordered writer
 queue): an orchestrator's liveness probe must not block behind a stalled
-predict backlog — that is exactly when it needs an answer.  Error
-replies carry a machine-readable ``code`` when the failure has one
+predict backlog — that is exactly when it needs an answer.  On
+multi-process deployments the reply also carries ``coord`` — the DCN
+heartbeat registry's view (process topology, stragglers, dead peers;
+``parallel/coord.py``) — and a dead peer marks the process ``degraded``.
+Error replies carry a machine-readable ``code`` when the failure has one
 (``queue.shed.deadline``, ``queue.shed.backpressure``), so clients can
 tell shed classes apart (docs/RESILIENCE.md).
 

@@ -349,7 +349,25 @@ class GPServeServer:
             status = "degraded"
         else:
             status = "ok"
+        # multi-host: surface coordination liveness (heartbeat stragglers /
+        # dead peers, parallel/coord.py) — a pod whose sibling died serves
+        # fine locally but its distributed fits will not, and the health
+        # probe is where an orchestrator looks first.  Absent (None) on
+        # single-process deployments, and a dead peer marks the whole
+        # process degraded.
+        coord_live = None
+        try:
+            from spark_gp_tpu.parallel import coord
+
+            coord_live = coord.liveness_snapshot()
+        except Exception:  # noqa: BLE001 — health must answer regardless
+            pass
+        if coord_live is not None and (
+            coord_live.get("dead") or coord_live.get("stragglers")
+        ):
+            status = "degraded" if status == "ok" else status
         return {
+            **({"coord": coord_live} if coord_live is not None else {}),
             "status": status,
             "ready": self.ready(),
             "models": self.registry.names(),

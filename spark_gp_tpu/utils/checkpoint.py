@@ -44,6 +44,16 @@ class CheckpointCorruptError(RuntimeError):
     restart the fit from scratch."""
 
 
+class ElasticResumeError(RuntimeError):
+    """An elastic (multi-host-coordinated) checkpoint cannot resume under
+    the current configuration: its identity — kernel, objective, data
+    fingerprint, stack shapes — differs from the fit trying to resume.
+    Changing the PROCESS COUNT is fine (the iterate is replicated and the
+    expert stack re-shards); changing what is being optimized is not, and
+    silently restarting from scratch (the legacy warn-and-ignore) would
+    discard a pod's worth of work without a trace — hence a hard error."""
+
+
 def _fsync_replace(tmp: str, path: str) -> None:
     """The preemption-safe publish: flush ``tmp`` to stable storage, then
     atomically rename over ``path`` and fsync the directory entry.  A kill
@@ -108,16 +118,21 @@ class LbfgsCheckpointer:
 
     def __init__(
         self, directory: str, kernel, tag: str = "gp",
-        seed: int | None = None,
+        seed: int | None = None, elastic: dict | None = None,
     ) -> None:
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"lbfgs_state_{tag}.json")
         self.kernel = kernel
         self.seed = seed
+        self.elastic = elastic
         self.iteration = 0
         self._history: list[list[float]] = []
 
-    def __call__(self, theta) -> None:
+    def build_payload(self, theta) -> dict:
+        """Advance the iteration state and render the (deterministic)
+        payload — split from the disk write so the coordinated writer
+        (``parallel/coord.py``) can digest-verify the SAME payload every
+        host would have written before only process 0 persists it."""
         theta = np.asarray(theta, dtype=np.float64)
         self.iteration += 1
         self._history.append(theta.tolist())
@@ -131,12 +146,54 @@ class LbfgsCheckpointer:
             "kernel": self.kernel.describe(theta),
             "kernel_sig": kernel_signature(self.kernel, theta.shape[0]),
         }
+        if self.elastic is not None:
+            payload["elastic"] = self.elastic
         payload["checksum"] = _payload_checksum(payload)
+        return payload
+
+    def write_payload(self, payload: dict) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(payload, fh)
             fh.flush()
         _fsync_replace(tmp, self.path)
+
+    def __call__(self, theta) -> None:
+        from spark_gp_tpu.resilience import chaos
+
+        self.write_payload(self.build_payload(theta))
+        # tick AFTER the write (like run_segmented): "kill after N save
+        # boundaries" must leave N COMPLETED saves on disk
+        chaos.tick_kill_counter()
+        _raise_if_preempted()
+
+
+def _raise_if_preempted() -> None:
+    """Preemption watcher hook (``parallel/coord.py``): if SIGTERM landed,
+    the save that just completed is the coordinated final save — stop here
+    instead of burning the eviction grace period on doomed iterations.
+    The telemetry record happens HERE (not in the signal handler, where
+    lock acquisition could self-deadlock the interrupted thread)."""
+    from spark_gp_tpu.parallel import coord
+
+    if coord.preemption_requested():
+        coord.note_preemption_observed()
+        coord.consume_preemption()  # acted on: must not poison later fits
+        raise coord.PreemptedError(
+            "preemption signalled: the checkpoint just written is the "
+            "final coordinated save — resume after rescheduling"
+        )
+
+
+def payload_state(payload: dict):
+    """``(iteration, theta, kernel_sig)`` from a host-checkpoint payload
+    — THE one mapping, shared by the local loader below and the
+    coordinated broadcast-resume path (``models/common.py``)."""
+    return (
+        payload["iteration"],
+        np.asarray(payload["theta"], dtype=np.float64),
+        payload.get("kernel_sig"),
+    )
 
 
 def load_checkpoint(directory: str, tag: str = "gp"):
@@ -144,6 +201,15 @@ def load_checkpoint(directory: str, tag: str = "gp"):
 
     Raises :class:`CheckpointCorruptError` on a checksum failure (v2
     payloads; v1 files predate checksums and load as-is)."""
+    payload = load_checkpoint_payload(directory, tag)
+    if payload is None:
+        return None
+    return payload_state(payload)
+
+
+def load_checkpoint_payload(directory: str, tag: str = "gp"):
+    """The full checksum-verified host-checkpoint payload dict (including
+    the ``elastic`` stamp when present), or ``None`` if absent."""
     path = os.path.join(directory, f"lbfgs_state_{tag}.json")
     if not os.path.exists(path):
         return None
@@ -160,11 +226,7 @@ def load_checkpoint(directory: str, tag: str = "gp"):
             f"checkpoint {path} failed its content checksum — delete it to "
             "restart the fit from scratch"
         )
-    return (
-        payload["iteration"],
-        np.asarray(payload["theta"], dtype=np.float64),
-        payload.get("kernel_sig"),
-    )
+    return payload
 
 
 class DeviceOptimizerCheckpointer:
@@ -179,13 +241,20 @@ class DeviceOptimizerCheckpointer:
     meta mismatch) is ignored with a warning rather than trusted.
     """
 
-    def __init__(self, directory: str, tag: str = "gp") -> None:
+    def __init__(self, directory: str, tag: str = "gp",
+                 elastic: dict | None = None) -> None:
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, f"{tag}_device_lbfgs.npz")
+        self.elastic = elastic
 
-    def save(self, state, meta: dict) -> None:
+    def build_arrays(self, state, meta: dict) -> dict:
+        """The complete named-array payload (checksum included) — split
+        from the disk write so the coordinated writer (parallel/coord.py)
+        can digest-verify every host's state before process 0 persists."""
         import jax
 
+        if self.elastic is not None and "elastic" not in meta:
+            meta = {**meta, "elastic": self.elastic}
         leaves = jax.tree.leaves(jax.device_get(state))
         arrays = {f"leaf_{i}": np.asarray(v) for i, v in enumerate(leaves)}
         arrays["meta_json"] = np.frombuffer(
@@ -194,9 +263,15 @@ class DeviceOptimizerCheckpointer:
         arrays["checksum"] = np.frombuffer(
             _npz_digest(arrays).encode(), dtype=np.uint8
         )
+        return arrays
+
+    def write_arrays(self, arrays: dict) -> None:
         tmp = self.path + ".tmp.npz"
         np.savez(tmp, **arrays)
         _fsync_replace(tmp, self.path)
+
+    def save(self, state, meta: dict) -> None:
+        self.write_arrays(self.build_arrays(state, meta))
 
     def load(self, template_state, meta: dict):
         """Rebuild a state pytree from disk, or ``None`` when absent/stale.
@@ -204,7 +279,19 @@ class DeviceOptimizerCheckpointer:
         ``template_state`` (a freshly-initialized state of the current
         configuration) supplies the pytree structure; the stored leaves must
         match its shapes exactly.
-        """
+
+        The ``"elastic"`` meta key — ``(process_count, mesh_shape,
+        expert_assignment)``, stamped by the coordinated multi-host path —
+        is compared SEPARATELY from the fit's identity: an identity match
+        with a different elastic stamp is an **elastic resume** (a
+        P-process fit continuing on P' processes: the iterate is
+        replicated, only the expert stack re-sharded) and loads with a
+        ``coord.elastic_resumes`` metric + span event; an identity
+        MISMATCH on a payload carrying an elastic stamp raises
+        :class:`ElasticResumeError` — silently restarting a pod-scale fit
+        from scratch is exactly the wrong-results failure mode this layer
+        exists to prevent.  Legacy (stampless) payloads keep the old
+        warn-and-ignore semantics."""
         import warnings
 
         import jax
@@ -221,13 +308,60 @@ class DeviceOptimizerCheckpointer:
                     )
             stored_meta = json.loads(bytes(npz["meta_json"]))
             template_leaves, treedef = jax.tree.flatten(template_state)
-            if stored_meta != meta:
+            want_meta = dict(meta)
+            if self.elastic is not None and "elastic" not in want_meta:
+                want_meta["elastic"] = self.elastic
+            stored_elastic = stored_meta.pop("elastic", None)
+            want_elastic = want_meta.pop("elastic", None)
+            if stored_meta != want_meta:
+                stored_procs = (
+                    (stored_elastic or {}).get("process_count") or 1
+                )
+                if stored_procs > 1:
+                    # a COORDINATED (multi-host) payload: silently ignoring
+                    # it would discard a pod's worth of training with only
+                    # a warning that scrolls by — hard-error instead.
+                    # Single-process payloads keep the legacy
+                    # warn-and-ignore (a stale local checkpoint is cheap
+                    # to redo and often deliberate).
+                    diff = sorted(
+                        k for k in set(stored_meta) | set(want_meta)
+                        if stored_meta.get(k) != want_meta.get(k)
+                    )
+                    raise ElasticResumeError(
+                        f"device checkpoint {self.path} was written by a "
+                        f"{stored_procs}-process coordinated fit but its "
+                        f"identity differs from this fit (mismatched: "
+                        f"{diff}) — it cannot seed this configuration; "
+                        "clear the directory or fix the config to match "
+                        "the interrupted run"
+                    )
                 warnings.warn(
                     f"ignoring device checkpoint {self.path}: configuration "
-                    f"changed ({stored_meta} != {meta})",
+                    f"changed ({stored_meta} != {want_meta})",
                     stacklevel=2,
                 )
                 return None
+            stored_procs_now = (stored_elastic or {}).get("process_count")
+            want_procs = (want_elastic or {}).get("process_count")
+            if (
+                stored_elastic is not None
+                and stored_procs_now != want_procs
+            ):
+                # count ELASTIC resumes only — a different PROCESS COUNT
+                # than the save (the catalog's definition).  A same-count
+                # stamp difference (e.g. a local re-mesh) resumes fine
+                # but is not an elastic transition and must not light up
+                # dashboards watching this counter.
+                from spark_gp_tpu.obs import trace as _trace
+                from spark_gp_tpu.obs.runtime import telemetry
+
+                telemetry.inc("coord.elastic_resumes")
+                _trace.add_event(
+                    "coord.elastic_resume",
+                    stored_process_count=stored_procs_now,
+                    current_process_count=want_procs,
+                )
             leaves = []
             for i, tmpl in enumerate(template_leaves):
                 key = f"leaf_{i}"
@@ -308,14 +442,25 @@ def run_segmented(init, run, saver, meta, init_args, max_iter, chunk, log_space)
     import jax
     import jax.numpy as jnp
 
+    from spark_gp_tpu.parallel import coord
+    from spark_gp_tpu.resilience import chaos
+
     template = jax.eval_shape(init, *init_args)
     state = saver.load(template, meta)
     if state is None:
         state = init(*init_args)
-    while not bool(state.done) and int(state.n_iter) < max_iter:
-        limit = jnp.asarray(min(int(state.n_iter) + chunk, max_iter), jnp.int32)
-        state = run(state, limit)
-        saver.save(state, meta)
+    # SIGTERM watch scoped to the segment loop (the save boundaries that
+    # can act on it); previous disposition restored — and a deferred
+    # signal re-delivered — when the loop exits
+    with coord.preemption_watch():
+        while not bool(state.done) and int(state.n_iter) < max_iter:
+            limit = jnp.asarray(
+                min(int(state.n_iter) + chunk, max_iter), jnp.int32
+            )
+            state = run(state, limit)
+            saver.save(state, meta)
+            chaos.tick_kill_counter()
+            _raise_if_preempted()
     theta = jnp.exp(state.theta) if log_space else state.theta
     return theta, state
 

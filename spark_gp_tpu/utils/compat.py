@@ -54,18 +54,18 @@ def install_jax_compat() -> None:
 
         jax.lax.pcast = _pcast
 
-    if not hasattr(jax.distributed, "is_initialized"):
+    if not hasattr(jax.distributed, "is_initialized"):  # collective-guard-ok (shim installer)
         # jax < 0.5 has no public probe; the coordination client handle
         # in jax._src.distributed.global_state is the same signal
         def _is_initialized() -> bool:
             try:
-                from jax._src.distributed import global_state
+                from jax._src.distributed import global_state  # collective-guard-ok
 
                 return global_state.client is not None
             except Exception:  # noqa: BLE001 — internals moved: assume no
                 return False
 
-        jax.distributed.is_initialized = _is_initialized
+        jax.distributed.is_initialized = _is_initialized  # collective-guard-ok
 
 
 def shard_map_needs_explicit_grad_psum() -> bool:

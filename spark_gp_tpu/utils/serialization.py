@@ -10,6 +10,7 @@ objects).
 
 from __future__ import annotations
 
+import json
 import pickle
 
 import numpy as np
@@ -45,6 +46,18 @@ def save_model(path: str, model, kind: str) -> None:
     if getattr(raw, "u1", None) is not None:
         extras["u1"] = raw.u1
         extras["u2"] = raw.u2
+    # fit provenance: the PoE/BCM aggregate is only correct when every
+    # contributing expert was accounted for (Healing Products of GPs,
+    # PAPERS.md) — a model trained on a P-process pod records P, so a
+    # wrong-results investigation can tell "coordinated product of P
+    # hosts" from "one host's 1/P fragment" after the fact.  Extra npz
+    # entry: pre-provenance loaders ignore it, no format bump needed.
+    import jax
+
+    extras["provenance_json"] = np.frombuffer(
+        json.dumps({"process_count": jax.process_count()}).encode(),
+        dtype=np.uint8,
+    )
     np.savez(
         _normalize(path),
         format_version=np.array(FORMAT_VERSION),
@@ -85,6 +98,10 @@ def load_model(path: str):
             )
         kind = str(data["kind"])
         kernel = pickle.loads(data["kernel_pickle"].tobytes())
+        provenance = (
+            json.loads(bytes(data["provenance_json"]))
+            if "provenance_json" in data else None
+        )
         magic_matrix = data["magic_matrix"]
         raw = ProjectedProcessRawPredictor(
             kernel=kernel,
@@ -97,15 +114,18 @@ def load_model(path: str):
             u2=data["u2"] if "u2" in data else None,
         )
     if kind == "classification":
-        return GaussianProcessClassificationModel(raw)
-    if kind == "ep_classification":
+        model = GaussianProcessClassificationModel(raw)
+    elif kind == "ep_classification":
         from spark_gp_tpu.models.gpc_ep import (
             GaussianProcessEPClassificationModel,
         )
 
-        return GaussianProcessEPClassificationModel(raw)
-    if kind == "multiclass":
-        return GaussianProcessMulticlassModel(raw)
-    if kind == "poisson":
-        return GaussianProcessPoissonModel(raw)
-    return GaussianProcessRegressionModel(raw)
+        model = GaussianProcessEPClassificationModel(raw)
+    elif kind == "multiclass":
+        model = GaussianProcessMulticlassModel(raw)
+    elif kind == "poisson":
+        model = GaussianProcessPoissonModel(raw)
+    else:
+        model = GaussianProcessRegressionModel(raw)
+    model.provenance = provenance
+    return model

@@ -1,0 +1,130 @@
+"""Worker body for the multi-host coordination-hardening tests
+(test_multiprocess.py): kill-and-elastic-resume and dead-host no-hang,
+with REAL process boundaries.
+
+Modes (argv[1]):
+
+* ``fit <pid> <nproc> <port> <ckpt_dir>`` — join the cluster, fit a GPR
+  over this process's deterministic row shard via the DCN-fallback path
+  with coordinated host checkpoints, print ``THETA <json>``.  Chaos is
+  staged by the parent through the env (``GP_CHAOS_KILL_AFTER_ITERS``,
+  ``GP_CHAOS_DEAD_HOST``, ``GP_COORD_TIMEOUT_S``).  A
+  CoordinationTimeoutError exits rc=3 after printing
+  ``COORDTIMEOUT missing=<ids>`` — the parent asserts both the exit
+  path and the named processes.
+* ``resume <nproc_orig> <ckpt_dir>`` — SINGLE process, no cluster: build
+  the union of all original shards' expert stacks (same global expert
+  assignment, re-sharded) and resume from the coordinated checkpoint —
+  the elastic P -> 1 transition.  Prints ``THETA <json>`` and
+  ``ELASTIC <n>`` (the coord.elastic_resumes counter).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# f64 end-to-end: the theta-reproduction proof compares the 2-process
+# KV-summed objective against the 1-process union objective, whose f64
+# summation-order difference is ~1e-16 — in f32 it shifts the optimum by
+# ~1e-5, an order above the 1e-6 acceptance bar
+jax.config.update("jax_enable_x64", True)
+
+EXPERT_SIZE = 16
+
+
+def shard_rows(pid: int):
+    import numpy as np
+
+    # sizes grouping to identical expert widths so the union stack can
+    # concatenate the per-host stacks (the elastic-resume requirement)
+    rng = np.random.default_rng(100 + pid)
+    n = 144 if pid == 0 else 112
+    x = rng.normal(size=(n, 2))
+    y = np.sin(x.sum(axis=1)) + 0.01 * rng.normal(size=n)
+    return x, y
+
+
+def make_gp(ckpt_dir: str):
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    return (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(48)
+        .setMaxIter(50)
+        .setTol(1e-13)
+        .setSeed(3)
+        .setCheckpointDir(ckpt_dir)
+    )
+
+
+def mode_fit() -> int:
+    pid, nproc, port = int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+    ckpt_dir = sys.argv[5]
+
+    from spark_gp_tpu.parallel import distributed as dist
+    from spark_gp_tpu.parallel.coord import CoordinationTimeoutError
+
+    dist.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    mesh = dist.global_expert_mesh()
+    x, y = shard_rows(pid)
+    data = dist.distribute_global_experts(x, y, EXPERT_SIZE, mesh)
+    try:
+        model = make_gp(ckpt_dir).setMesh(mesh).fit_distributed(data)
+    except CoordinationTimeoutError as exc:
+        print(f"COORDTIMEOUT missing={list(exc.missing)}", flush=True)
+        # hard exit: interpreter teardown would run jax's coordination
+        # shutdown barrier, which blocks ~60 s on the already-dead peer
+        # and then aborts the process — exactly the hang-on-death behavior
+        # the guarded path just avoided
+        os._exit(3)
+    theta = [float(v) for v in model.raw_predictor.theta]
+    print("THETA " + json.dumps({"pid": pid, "theta": theta}), flush=True)
+    return 0
+
+
+def mode_resume() -> int:
+    nproc_orig, ckpt_dir = int(sys.argv[2]), sys.argv[3]
+    import jax.numpy as jnp
+
+    from spark_gp_tpu.obs.runtime import telemetry
+    from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+    from spark_gp_tpu.parallel.mesh import expert_mesh, shard_experts
+
+    mesh = expert_mesh()
+    stacks = [
+        shard_experts(
+            group_for_experts(*shard_rows(pid), EXPERT_SIZE), mesh
+        )
+        for pid in range(nproc_orig)
+    ]
+    union = shard_experts(
+        ExpertData(
+            x=jnp.concatenate([s.x for s in stacks]),
+            y=jnp.concatenate([s.y for s in stacks]),
+            mask=jnp.concatenate([s.mask for s in stacks]),
+        ),
+        mesh,
+    )
+    model = make_gp(ckpt_dir).setMesh(mesh).fit_distributed(union)
+    theta = [float(v) for v in model.raw_predictor.theta]
+    print("THETA " + json.dumps({"pid": 0, "theta": theta}), flush=True)
+    print(
+        f"ELASTIC {int(telemetry.counters.get('coord.elastic_resumes', 0))}"
+        f" RESUMED {int(model.instr.metrics.get('resumed_from_iteration', 0))}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(mode_fit() if sys.argv[1] == "fit" else mode_resume())

@@ -41,7 +41,10 @@ def _run(script, env_extra, args=(), timeout=900):
     env.pop("GP_TRACE_DIR", None)
     env.pop("GP_RUN_JOURNAL_DIR", None)
     for var in list(env):
-        if var.startswith("BENCH_") or var.startswith("QUALITY_"):
+        # GP_CHAOS_*: a staged fault (dead host / kill counter) from a
+        # chaos shell would kill the bench worker mid-measurement;
+        # GP_COORD_*: a shrunken deadline would fail healthy coordination
+        if var.startswith(("BENCH_", "QUALITY_", "GP_CHAOS_", "GP_COORD_")):
             env.pop(var)
     env.pop("JAX_COMPILATION_CACHE_DIR", None)
     env.update(env_extra)
@@ -134,6 +137,17 @@ def test_bench_emits_one_parseable_result_line():
     assert obs["fit"]["spans_per_fit"] >= 3, obs["fit"]
     assert obs["fit"]["overhead_pct"] < 2.0, obs["fit"]
     assert obs["serve_predict"]["overhead_pct"] < 2.0, obs["serve_predict"]
+    # the multi-host coordination contract (parallel/coord.py): barrier and
+    # per-evaluation allreduce round-trips are measured, and a coordinated
+    # checkpoint save (barrier + writer election + digest cross-check)
+    # completed against the plain atomic writer baseline
+    mh = detail["multihost_resilience"]
+    assert "error" not in mh, mh
+    assert mh["barrier_roundtrip_us"] > 0
+    assert mh["allreduce_roundtrip_us"] > 0
+    assert mh["checkpoint_save_us"]["uncoordinated"] > 0
+    assert mh["checkpoint_save_us"]["coordinated_2host"] > 0
+    assert np.isfinite(mh["coordinated_ckpt_overhead_ratio"])
 
 
 @pytest.mark.slow

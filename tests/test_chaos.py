@@ -180,9 +180,11 @@ def _preempting_factory(kill_after, **kw):
 
     original = ckpt.DeviceOptimizerCheckpointer
 
-    def factory(directory, tag="gp"):
+    def factory(directory, tag="gp", **ck_kw):
+        # pass through e.g. the elastic stamp _make_device_checkpointer adds
         return PreemptingCheckpointer(
-            original(directory, tag), kill_after_saves=kill_after, **kw
+            original(directory, tag, **ck_kw), kill_after_saves=kill_after,
+            **kw
         )
 
     return factory
@@ -241,8 +243,8 @@ import numpy as np
 import spark_gp_tpu.utils.checkpoint as ckpt
 from spark_gp_tpu.resilience.chaos import PreemptingCheckpointer
 _orig = ckpt.DeviceOptimizerCheckpointer
-ckpt.DeviceOptimizerCheckpointer = lambda d, t="gp": PreemptingCheckpointer(
-    _orig(d, t), kill_after_saves=2, exit_process=True
+ckpt.DeviceOptimizerCheckpointer = lambda d, t="gp", **kw: PreemptingCheckpointer(
+    _orig(d, t, **kw), kill_after_saves=2, exit_process=True
 )
 from spark_gp_tpu import GaussianProcessRegression, RBFKernel
 rng = np.random.default_rng(1)

@@ -1,0 +1,816 @@
+"""Tests for the hardened DCN coordination layer (parallel/coord.py).
+
+Everything here is tier-1: logical "hosts" are threads sharing an
+:class:`InProcessCoordStore`, deadlines run on a fake clock where real
+waiting would cost seconds, and the acceptance proofs — no-hang under a
+dead host, kill-one-host-mid-fit then ELASTIC resume on a different
+process count reproducing the uninterrupted theta — run entirely
+in-process.  The full-fidelity subprocess variants live in
+``tests/test_multiprocess.py``.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu.parallel import coord
+from spark_gp_tpu.parallel.coord import (
+    CoordinationTimeoutError,
+    DcnContext,
+    HeartbeatMonitor,
+    InProcessCoordClient,
+    InProcessCoordStore,
+)
+
+
+class FakeClock:
+    """Deterministic clock whose ``sleep`` advances time — a 120 s deadline
+    resolves in microseconds of wall-clock."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += max(float(dt), 1e-4)
+
+
+def _client(store, pid, nproc, clock=None):
+    return InProcessCoordClient(
+        store, pid, nproc,
+        clock=clock if clock is not None else time.monotonic,
+        sleep=clock.sleep if clock is not None else None,
+    )
+
+
+# -- barriers / allgather ---------------------------------------------------
+
+
+def test_barrier_timeout_names_missing_processes_fake_clock():
+    clock = FakeClock()
+    store = InProcessCoordStore()
+    c0 = _client(store, 0, 3, clock)
+    # processes 1 and 2 never arrive; the deadline must resolve with BOTH
+    # named, without any real waiting
+    t0 = time.monotonic()
+    with pytest.raises(CoordinationTimeoutError) as err:
+        c0.barrier("b", timeout_s=120.0)
+    assert time.monotonic() - t0 < 5.0  # fake clock: no real 120 s wait
+    assert err.value.missing == (1, 2)
+    assert "missing process id(s) [1, 2]" in str(err.value)
+    assert err.value.timeout_s == 120.0
+
+
+def test_barrier_completes_across_threads():
+    store = InProcessCoordStore()
+    errors = []
+
+    def arrive(pid, delay):
+        time.sleep(delay)
+        try:
+            _client(store, pid, 2).barrier("sync", timeout_s=10.0)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=arrive, args=(pid, 0.05 * pid))
+        for pid in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+
+
+def test_kv_allgather_orders_by_pid():
+    store = InProcessCoordStore()
+    out = {}
+
+    def gather(pid):
+        client = _client(store, pid, 3)
+        out[pid] = coord.kv_allgather(
+            "g/0", f"payload-{pid}".encode(), client=client, timeout_s=10.0
+        )
+
+    threads = [threading.Thread(target=gather, args=(pid,)) for pid in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    expected = [b"payload-0", b"payload-1", b"payload-2"]
+    assert out[0] == out[1] == out[2] == expected
+
+
+def test_kv_allgather_timeout_names_dead_process():
+    clock = FakeClock()
+    store = InProcessCoordStore()
+    c0 = _client(store, 0, 2, clock)
+    with pytest.raises(CoordinationTimeoutError) as err:
+        coord.kv_allgather("g/1", b"x", client=c0, timeout_s=60.0)
+    assert err.value.missing == (1,)
+
+
+def test_allreduce_is_deterministic_and_identical_across_hosts():
+    store = InProcessCoordStore()
+    results = {}
+
+    def reduce(pid):
+        ctx = DcnContext(_client(store, pid, 2), timeout_s=10.0)
+        value, grad = ctx.allreduce_arrays(
+            "vag",
+            np.asarray([1.25 if pid == 0 else 2.5]),
+            np.asarray([0.1, 0.2]) * (pid + 1),
+        )
+        results[pid] = (value, grad)
+
+    threads = [threading.Thread(target=reduce, args=(pid,)) for pid in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+    np.testing.assert_array_equal(results[0][1], results[1][1])
+    np.testing.assert_allclose(results[0][0], [3.75])
+    np.testing.assert_allclose(results[0][1], [0.3, 0.6])
+
+
+# -- heartbeat / liveness ---------------------------------------------------
+
+
+def _counter(key):
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    return telemetry.counters.get(key, 0.0)
+
+
+def test_heartbeat_monitor_flags_straggler_then_dead_then_recovery():
+    clock = FakeClock()
+    store = InProcessCoordStore()
+    m0 = HeartbeatMonitor(
+        _client(store, 0, 2, clock),
+        interval_s=1.0, straggler_after_s=3.0, dead_after_s=10.0,
+    )
+    m1 = HeartbeatMonitor(
+        _client(store, 1, 2, clock),
+        interval_s=1.0, straggler_after_s=3.0, dead_after_s=10.0,
+    )
+    stragglers_before = _counter("coord.stragglers")
+    dead_before = _counter("coord.dead_hosts")
+
+    m0.poll_once()
+    m1.poll_once()
+    m0.poll_once()  # observes pid 1's stamp
+    assert m0.stragglers() == [] and m0.dead_pids() == []
+
+    clock.t += 5.0  # pid 1 goes quiet past the straggler threshold
+    m0.poll_once()
+    assert m0.stragglers() == [1]
+    assert _counter("coord.stragglers") == stragglers_before + 1
+
+    clock.t += 6.0  # now past the dead threshold
+    m0.poll_once()
+    assert m0.dead_pids() == [1]
+    assert m0.stragglers() == []
+    assert _counter("coord.dead_hosts") == dead_before + 1
+
+    m1.poll_once()  # pid 1 comes back
+    m0.poll_once()
+    assert m0.dead_pids() == [] and m0.stragglers() == []
+
+    snap = m0.snapshot()
+    assert snap["process_count"] == 2
+    assert snap["dead"] == [] and snap["stragglers"] == []
+
+
+def test_allgather_aborts_early_on_dead_verdict():
+    """A gather must not sleep out its full deadline once the heartbeat
+    monitor has already declared the awaited peer dead."""
+    store = InProcessCoordStore()
+    c0 = _client(store, 0, 2)  # real clock: proves the EARLY abort
+    t0 = time.monotonic()
+    with pytest.raises(CoordinationTimeoutError) as err:
+        coord.kv_allgather(
+            "g/2", b"x", client=c0, timeout_s=30.0,
+            dead_pids=lambda: [1],
+        )
+    assert time.monotonic() - t0 < 5.0
+    assert err.value.missing == (1,)
+
+
+# -- chaos hooks ------------------------------------------------------------
+
+
+def test_straggler_host_delays_guarded_collective():
+    from spark_gp_tpu.resilience import chaos
+
+    with chaos.StragglerHost(0.05):
+        assert chaos.apply_straggler_delay("any_op") == 0.05
+    assert chaos.apply_straggler_delay("any_op") == 0.0
+    with chaos.StragglerHost(0.05, op="vag"):
+        assert chaos.apply_straggler_delay("ckpt") == 0.0
+        assert chaos.apply_straggler_delay("vag/3") == 0.05
+
+
+def test_dead_host_raises_before_collective():
+    from spark_gp_tpu.resilience import chaos
+
+    with chaos.DeadHost(exit_process=False):
+        assert chaos.heartbeats_suppressed()
+        with pytest.raises(chaos.SimulatedPreemption):
+            coord.guard_collective("stitch")
+    assert not chaos.heartbeats_suppressed()
+
+
+def test_kill_process_after_validates():
+    from spark_gp_tpu.resilience import chaos
+
+    with pytest.raises(ValueError):
+        chaos.kill_process_after(0)
+
+
+# -- elastic-resume metadata ------------------------------------------------
+
+
+def test_mesh_shape_and_elastic_meta():
+    import jax
+
+    from spark_gp_tpu.parallel.mesh import expert_mesh, mesh_shape
+
+    assert mesh_shape(None) is None
+    mesh = expert_mesh()
+    assert mesh_shape(mesh) == [["experts", len(jax.devices())]]
+    meta = coord.elastic_meta(
+        mesh, num_experts=8, expert_size=16, process_count=4
+    )
+    assert meta["process_count"] == 4
+    assert meta["expert_assignment"] == {"num_experts": 8, "expert_size": 16}
+    json.dumps(meta)  # must be JSON-serializable (checkpoint payloads)
+
+
+def test_elastic_device_checkpoint_resumes_across_process_counts(tmp_path):
+    """Identity match + different process count = elastic resume (loads,
+    counted); identity mismatch on a multi-host payload = hard error."""
+    from spark_gp_tpu.utils.checkpoint import (
+        DeviceOptimizerCheckpointer,
+        ElasticResumeError,
+    )
+
+    state = {"a": np.arange(6.0), "b": np.ones((2, 2))}
+    meta = {"kind": "t", "theta_dim": 3}
+    writer = DeviceOptimizerCheckpointer(
+        str(tmp_path), "el",
+        elastic=coord.elastic_meta(None, num_experts=8, expert_size=16,
+                                   process_count=2),
+    )
+    writer.save(state, meta)
+
+    resumes_before = _counter("coord.elastic_resumes")
+    reader = DeviceOptimizerCheckpointer(
+        str(tmp_path), "el",
+        elastic=coord.elastic_meta(None, num_experts=8, expert_size=16,
+                                   process_count=1),
+    )
+    loaded = reader.load(state, meta)
+    assert loaded is not None
+    np.testing.assert_array_equal(loaded["a"], state["a"])
+    assert _counter("coord.elastic_resumes") == resumes_before + 1
+
+    # identity mismatch against a 2-process coordinated payload: hard error,
+    # never the legacy silent warn-and-ignore
+    with pytest.raises(ElasticResumeError, match="2-process coordinated"):
+        reader.load(state, {"kind": "t", "theta_dim": 4})
+
+
+# -- coordinated checkpointing ---------------------------------------------
+
+
+def _run_hosts(fns):
+    """Run one callable per logical host on its own thread; return
+    {pid: exception_or_None}."""
+    outcomes = {}
+
+    def runner(pid, fn):
+        try:
+            fn()
+            outcomes[pid] = None
+        except BaseException as exc:  # noqa: BLE001 — collected for asserts
+            outcomes[pid] = exc
+
+    threads = [
+        threading.Thread(target=runner, args=(pid, fn))
+        for pid, fn in enumerate(fns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outcomes
+
+
+def test_coordinated_host_checkpointer_writer_election_and_digest(tmp_path):
+    from spark_gp_tpu.kernels.rbf import RBFKernel
+    from spark_gp_tpu.utils.checkpoint import (
+        LbfgsCheckpointer,
+        load_checkpoint_payload,
+    )
+
+    kernel = RBFKernel(1.0)
+    store = InProcessCoordStore()
+    theta = np.asarray([0.5])
+
+    def host(pid):
+        def run():
+            ctx = DcnContext(_client(store, pid, 2), timeout_s=10.0)
+            inner = LbfgsCheckpointer(
+                str(tmp_path), kernel, tag="coordtest", seed=0,
+                elastic=coord.elastic_meta(None, process_count=2),
+            )
+            ck = coord.CoordinatedLbfgsCheckpointer(inner, ctx)
+            ck(theta)  # identical state on both hosts
+        return run
+
+    outcomes = _run_hosts([host(0), host(1)])
+    assert outcomes == {0: None, 1: None}
+    payload = load_checkpoint_payload(str(tmp_path), tag="coordtest")
+    assert payload["iteration"] == 1
+    assert payload["elastic"]["process_count"] == 2
+    np.testing.assert_allclose(payload["theta"], [0.5])
+
+
+def test_coordinated_device_checkpointer_load_broadcasts_from_writer(tmp_path):
+    """Only process 0 holds the npz (it is the elected writer; after
+    rescheduling the peers sit on fresh disks) — load must ship process
+    0's validated state to every peer, or peers fresh-init at n_iter=0
+    and the segment barriers desynchronize immediately."""
+    from spark_gp_tpu.utils.checkpoint import DeviceOptimizerCheckpointer
+
+    state = {"a": np.arange(5.0), "b": np.full((2, 3), 7.0)}
+    meta = {"kind": "t"}
+    # process 0's disk has the checkpoint; process 1's directory is empty
+    DeviceOptimizerCheckpointer(str(tmp_path / "p0"), "bc").save(state, meta)
+
+    store = InProcessCoordStore()
+    loaded = {}
+
+    def host(pid):
+        def run():
+            ctx = DcnContext(_client(store, pid, 2), timeout_s=10.0)
+            ck = coord.CoordinatedDeviceCheckpointer(
+                DeviceOptimizerCheckpointer(str(tmp_path / f"p{pid}"), "bc"),
+                ctx,
+            )
+            loaded[pid] = ck.load(state, meta)
+        return run
+
+    outcomes = _run_hosts([host(0), host(1)])
+    assert outcomes == {0: None, 1: None}
+    for pid in range(2):
+        assert loaded[pid] is not None, f"pid {pid} fresh-inits"
+        np.testing.assert_array_equal(loaded[pid]["a"], state["a"])
+        np.testing.assert_array_equal(loaded[pid]["b"], state["b"])
+
+
+def test_heartbeat_flags_peer_that_never_stamped():
+    """A peer that dies before its FIRST stamp must still escalate — the
+    liveness registry seeds every expected pid at the first poll."""
+    clock = FakeClock()
+    store = InProcessCoordStore()
+    m0 = HeartbeatMonitor(
+        _client(store, 0, 2, clock),
+        interval_s=1.0, straggler_after_s=3.0, dead_after_s=10.0,
+    )
+    m0.poll_once()  # pid 1 has never stamped
+    clock.t += 50.0
+    m0.poll_once()
+    assert m0.dead_pids() == [1]
+
+
+def test_allgather_round_keys_are_garbage_collected():
+    store = InProcessCoordStore()
+
+    def host(pid):
+        def run():
+            ctx = DcnContext(_client(store, pid, 2), timeout_s=10.0)
+            for _ in range(5):
+                ctx.allgather_arrays("gc", np.ones(2))
+        return run
+
+    outcomes = _run_hosts([host(0), host(1)])
+    assert outcomes == {0: None, 1: None}
+    live = [k for k in store.kv if k.startswith("ag/gc/")]
+    # rounds 0..2 GC'd (r-2 rule at rounds 2..4); only the last two
+    # rounds' keys may remain
+    assert len(live) <= 4, sorted(live)
+
+
+def test_coordinated_checkpointer_catches_diverged_host(tmp_path):
+    """Two hosts whose lockstep states differ must fail the digest
+    cross-check — a silently forked training run is the one outcome the
+    coordinated protocol exists to rule out."""
+    from spark_gp_tpu.kernels.rbf import RBFKernel
+    from spark_gp_tpu.utils.checkpoint import (
+        CheckpointMismatchError,
+        LbfgsCheckpointer,
+    )
+
+    kernel = RBFKernel(1.0)
+    store = InProcessCoordStore()
+
+    def host(pid):
+        def run():
+            ctx = DcnContext(_client(store, pid, 2), timeout_s=10.0)
+            inner = LbfgsCheckpointer(
+                str(tmp_path), kernel, tag="div", seed=0,
+            )
+            ck = coord.CoordinatedLbfgsCheckpointer(inner, ctx)
+            ck(np.asarray([0.5 if pid == 0 else 0.75]))  # DIVERGED
+        return run
+
+    outcomes = _run_hosts([host(0), host(1)])
+    # the all-to-all digest exchange makes the fork visible EVERYWHERE —
+    # the writer included, each naming the peer(s) that differ from it
+    assert isinstance(outcomes[0], CheckpointMismatchError)
+    assert isinstance(outcomes[1], CheckpointMismatchError)
+    assert "forked" in str(outcomes[1])
+    assert "[0]" in str(outcomes[1]) and "[1]" in str(outcomes[0])
+
+
+# -- the DCN-fallback fit: lockstep, no-hang, elastic resume ---------------
+
+
+def _half_rows(pid):
+    # sizes chosen so both halves group to expert_size 16 exactly (the
+    # union stack for the elastic-resume run concatenates the two local
+    # stacks, which needs matching expert widths)
+    rng = np.random.default_rng(100 + pid)
+    n = 144 if pid == 0 else 112
+    x = rng.normal(size=(n, 2))
+    y = np.sin(x.sum(axis=1)) + 0.01 * rng.normal(size=n)
+    return x, y
+
+
+def _local_stack(pid):
+    from spark_gp_tpu.parallel.experts import group_for_experts
+    from spark_gp_tpu.parallel.mesh import expert_mesh, shard_experts
+
+    x, y = _half_rows(pid)
+    mesh = expert_mesh()
+    return shard_experts(group_for_experts(x, y, 16), mesh), mesh
+
+
+def _gp(maxiter=50, ckpt_dir=None):
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(48)
+        .setMaxIter(maxiter)
+        .setTol(1e-10)
+        .setSeed(3)
+    )
+    if ckpt_dir is not None:
+        gp.setCheckpointDir(str(ckpt_dir))
+    return gp
+
+
+def _dcn_fit(pid, ctx, results, ckpt_dir=None, maxiter=50):
+    coord.set_dcn_context_for_testing(ctx)
+    try:
+        data, mesh = _local_stack(pid)
+        model = _gp(maxiter, ckpt_dir).setMesh(mesh).fit_distributed(data)
+        results[pid] = model
+    except BaseException as exc:  # noqa: BLE001 — collected for asserts
+        results[pid] = exc
+    finally:
+        coord.set_dcn_context_for_testing(None)
+
+
+def _run_dcn_pair(ctxs, ckpt_dir=None, maxiter=50):
+    results = {}
+    threads = [
+        threading.Thread(
+            target=_dcn_fit, args=(pid, ctxs[pid], results, ckpt_dir, maxiter)
+        )
+        for pid in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def _pair_ctxs(store, timeout_s=30.0, ctx_cls=DcnContext, **kw):
+    return [
+        ctx_cls(_client(store, pid, 2), timeout_s=timeout_s, **kw)
+        for pid in range(2)
+    ]
+
+
+def test_dcn_fit_two_logical_hosts_lockstep():
+    """Two logical hosts, disjoint unequal row shards, KV-store reductions:
+    both converge to the IDENTICAL model (bit-equal theta and predictions
+    — the deterministic pid-ordered sum at work) and the joint fit learns
+    the shared function."""
+    results = _run_dcn_pair(_pair_ctxs(InProcessCoordStore()))
+    for pid in range(2):
+        assert not isinstance(results[pid], BaseException), results[pid]
+    m0, m1 = results[0], results[1]
+    np.testing.assert_array_equal(
+        m0.raw_predictor.theta, m1.raw_predictor.theta
+    )
+    probe = np.random.default_rng(999).normal(size=(32, 2))
+    np.testing.assert_array_equal(m0.predict(probe), m1.predict(probe))
+    x0, y0 = _half_rows(0)
+    rmse = float(np.sqrt(np.mean((m0.predict(x0) - y0) ** 2)))
+    assert rmse < 0.15, rmse
+
+
+class _DyingCtx(DcnContext):
+    """A host that dies (stops participating) after N objective rounds —
+    the in-process DeadHost: it never publishes round N+1, so its peer
+    must hit the deadline guard, not hang."""
+
+    def __init__(self, client, timeout_s=None, die_after_vag_rounds=10**9):
+        super().__init__(client, timeout_s=timeout_s)
+        self.die_after = die_after_vag_rounds
+        self._vag_rounds = 0
+
+    def allgather_bytes(self, name, payload):
+        if name == "vag":
+            self._vag_rounds += 1
+            if self._vag_rounds > self.die_after:
+                from spark_gp_tpu.resilience.chaos import SimulatedPreemption
+
+                raise SimulatedPreemption(
+                    f"chaos: host died before vag round {self._vag_rounds}"
+                )
+        return super().allgather_bytes(name, payload)
+
+
+def test_dcn_fit_dead_host_raises_named_timeout_within_deadline(tmp_path):
+    """The no-hang guarantee: host 1 dies mid-fit; host 0 must raise
+    CoordinationTimeoutError NAMING process 1 within the configured
+    deadline — never block past it."""
+    from spark_gp_tpu.resilience.chaos import SimulatedPreemption
+
+    store = InProcessCoordStore()
+    ctxs = [
+        DcnContext(_client(store, 0, 2), timeout_s=3.0),
+        _DyingCtx(_client(store, 1, 2), timeout_s=3.0,
+                  die_after_vag_rounds=6),
+    ]
+    t0 = time.monotonic()
+    results = _run_dcn_pair(ctxs, ckpt_dir=tmp_path, maxiter=50)
+    elapsed = time.monotonic() - t0
+    assert isinstance(results[1], SimulatedPreemption)
+    assert isinstance(results[0], CoordinationTimeoutError), results[0]
+    assert results[0].missing == (1,)
+    assert "1" in str(results[0])
+    # deadline 3 s + some slack for the fit work itself — nowhere near a hang
+    assert elapsed < 30.0, elapsed
+    # the coordinated checkpoints survived host 0's abort: iteration state
+    # is on disk for the elastic resume (next test runs the full proof)
+    from spark_gp_tpu.utils.checkpoint import load_checkpoint_payload
+
+    payload = load_checkpoint_payload(
+        str(tmp_path), tag="GaussianProcessRegression"
+    )
+    assert payload is not None and payload["iteration"] >= 1
+    assert payload["elastic"]["process_count"] == 2
+
+
+def _union_stack():
+    import jax.numpy as jnp
+
+    from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+    from spark_gp_tpu.parallel.mesh import expert_mesh, shard_experts
+
+    mesh = expert_mesh()
+    stacks = []
+    for pid in range(2):
+        x, y = _half_rows(pid)
+        stacks.append(shard_experts(group_for_experts(x, y, 16), mesh))
+    union = ExpertData(
+        x=jnp.concatenate([s.x for s in stacks]),
+        y=jnp.concatenate([s.y for s in stacks]),
+        mask=jnp.concatenate([s.mask for s in stacks]),
+    )
+    return shard_experts(union, mesh), mesh
+
+
+def test_kill_one_host_then_elastic_resume_on_different_process_count(tmp_path):
+    """THE elastic-resume acceptance proof, in-process: a 2-host DCN fit is
+    killed mid-run (host 1 dies; host 0 stops at the named timeout with
+    coordinated checkpoints on disk), then a 1-process fit over the SAME
+    global expert assignment resumes from the 2-process checkpoint —
+    different process count, elastic-counted — and lands on the
+    uninterrupted fit's theta to atol 1e-6."""
+    # uninterrupted reference: the same 2-host DCN fit, run to convergence
+    ref = _run_dcn_pair(_pair_ctxs(InProcessCoordStore()))
+    assert not isinstance(ref[0], BaseException), ref[0]
+    theta_ref = ref[0].raw_predictor.theta
+
+    # killed run: host 1 dies after 6 objective rounds
+    store = InProcessCoordStore()
+    ctxs = [
+        DcnContext(_client(store, 0, 2), timeout_s=3.0),
+        _DyingCtx(_client(store, 1, 2), timeout_s=3.0,
+                  die_after_vag_rounds=6),
+    ]
+    results = _run_dcn_pair(ctxs, ckpt_dir=tmp_path)
+    assert isinstance(results[0], CoordinationTimeoutError)
+
+    # elastic resume: ONE process, the union of both hosts' expert stacks
+    # (same global expert assignment — only the sharding changed), same
+    # checkpoint dir.  The 2-process stamp on the payload vs the 1-process
+    # fit is the elastic transition under test.
+    resumes_before = _counter("coord.elastic_resumes")
+    union, mesh = _union_stack()
+    resumed = _gp(ckpt_dir=tmp_path).setMesh(mesh).fit_distributed(union)
+    assert _counter("coord.elastic_resumes") == resumes_before + 1
+    assert resumed.instr.metrics.get("resumed_from_iteration", 0) >= 1
+    np.testing.assert_allclose(
+        resumed.raw_predictor.theta, theta_ref, atol=1e-6
+    )
+
+
+# -- liveness surfaces ------------------------------------------------------
+
+
+def test_plain_fit_checkpoints_stay_local_on_clusters(tmp_path):
+    """A plain per-host fit() on a multi-process cluster must keep PLAIN
+    local checkpoint writers: two INDEPENDENT fits coordinating through
+    shared KV gathers would spuriously digest-mismatch (and resume from
+    each other's payloads).  Only fit_distributed coordinates."""
+    store = InProcessCoordStore()
+    ctx = DcnContext(_client(store, 0, 2), timeout_s=0.5)
+    coord.set_dcn_context_for_testing(ctx)
+    try:
+        x, y = _half_rows(0)
+        _gp(maxiter=3, ckpt_dir=tmp_path).fit(x, y)
+    finally:
+        coord.set_dcn_context_for_testing(None)
+    assert (tmp_path / "lbfgs_state_GaussianProcessRegression.json").exists()
+    # no coordination traffic: the fit never touched the KV store
+    assert not [k for k in store.kv if k.startswith("ag/")], store.kv.keys()
+
+
+def test_liveness_snapshot_none_single_process():
+    assert coord.liveness_snapshot() is None
+
+
+def test_serve_health_reports_coord_liveness_when_distributed():
+    clock = FakeClock()
+    store = InProcessCoordStore()
+    monitor = HeartbeatMonitor(
+        _client(store, 0, 2, clock),
+        interval_s=1.0, straggler_after_s=3.0, dead_after_s=10.0,
+    )
+    ctx = DcnContext(_client(store, 0, 2), monitor=monitor)
+    coord.set_dcn_context_for_testing(ctx)
+    try:
+        # stamp both, then let pid 1 die
+        monitor.poll_once()
+        _client(store, 1, 2, clock).set(
+            "heartbeat/1", json.dumps({"n": 1, "t": clock.t}).encode()
+        )
+        monitor.poll_once()
+        clock.t += 50.0
+        monitor.poll_once()
+        from spark_gp_tpu.serve.server import GPServeServer
+
+        health = GPServeServer().health()
+        assert health["coord"]["dead"] == [1]
+        assert health["status"] in ("degraded", "unready")
+        snap = coord.liveness_snapshot()
+        assert snap["dead"] == [1]
+    finally:
+        coord.set_dcn_context_for_testing(None)
+
+
+# -- preemption watcher -----------------------------------------------------
+
+
+def test_staged_preemption_stops_fit_at_save_boundary(tmp_path):
+    """PR 2's PreemptingCheckpointer semantics through the watcher flag: a
+    staged preemption makes the fit stop right after the next checkpoint
+    save with PreemptedError; the state on disk resumes the fit."""
+    from spark_gp_tpu.resilience import chaos
+
+    x, y = _half_rows(0)
+    try:
+        chaos.stage_preemption(True)
+        with pytest.raises(coord.PreemptedError):
+            _gp(maxiter=30, ckpt_dir=tmp_path).fit(x, y)
+    finally:
+        chaos.stage_preemption(False)
+    from spark_gp_tpu.utils.checkpoint import load_checkpoint_payload
+
+    payload = load_checkpoint_payload(
+        str(tmp_path), tag="GaussianProcessRegression"
+    )
+    assert payload is not None and payload["iteration"] == 1
+    # cleared: the resumed fit completes and matches the clean fit
+    resumed = _gp(maxiter=30, ckpt_dir=tmp_path).fit(x, y)
+    clean = _gp(maxiter=30).fit(x, y)
+    np.testing.assert_allclose(
+        resumed.raw_predictor.theta, clean.raw_predictor.theta, atol=1e-6
+    )
+
+
+def test_preemption_watcher_install_is_idempotent():
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        assert coord.install_preemption_watcher()
+        assert coord.install_preemption_watcher()
+        assert not coord.preemption_requested()
+    finally:
+        # the permanent watcher is an opt-in for real training drivers;
+        # the test process must get its disposition back
+        signal.signal(signal.SIGTERM, prev)
+        coord._WATCHER_INSTALLED = False
+        coord.clear_preemption_for_testing()
+
+
+def test_preemption_watch_scoped_install_restore_and_consume():
+    """The production wiring: the handler exists only inside the scope,
+    the previous disposition comes back on exit, and a CONSUMED
+    preemption (save boundary raised PreemptedError) is not re-delivered
+    — the process survives scope exit."""
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+    with coord.preemption_watch():
+        inside = signal.getsignal(signal.SIGTERM)
+        assert inside is not prev  # scoped handler active
+        inside(signal.SIGTERM, None)  # simulate delivery: flag only
+        assert coord.preemption_requested()
+        coord.note_preemption_observed()
+        coord.consume_preemption()  # what _raise_if_preempted does
+        assert not coord.preemption_requested()
+    # restored, flag clear, and (since consumed) nothing was re-delivered
+    assert signal.getsignal(signal.SIGTERM) is prev
+    coord.clear_preemption_for_testing()
+
+
+# -- lints ------------------------------------------------------------------
+
+
+def test_collective_guards_lint_is_clean():
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo_root, "tools"))
+    try:
+        import check_collective_guards
+
+        assert check_collective_guards.main(
+            [os.path.join(repo_root, "spark_gp_tpu")]
+        ) == 0
+    finally:
+        sys.path.pop(0)
+
+
+def test_collective_guards_lint_catches_raw_calls(tmp_path):
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo_root, "tools"))
+    try:
+        import check_collective_guards
+
+        bad = tmp_path / "pkg" / "x.py"
+        bad.parent.mkdir()
+        bad.write_text(
+            "from jax.experimental import multihost_utils\n"
+            "import jax\n"
+            "def f(a):\n"
+            "    jax.distributed.initialize()\n"
+            "    return multihost_utils.process_allgather(a)\n"
+            "def g(a):\n"
+            "    return multihost_utils.broadcast_one_to_all(a)"
+            "  # collective-guard-ok\n"
+        )
+        violations = check_collective_guards.find_violations(
+            str(tmp_path / "pkg")
+        )
+        flagged = {what for _, _, what in violations}
+        assert "from jax.experimental import ..." in flagged
+        assert "jax.distributed.initialize" in flagged
+        assert "multihost_utils.process_allgather" in flagged
+        # the exempted line stays out
+        assert not any("broadcast_one_to_all" in w for w in flagged)
+    finally:
+        sys.path.pop(0)

@@ -68,6 +68,28 @@ def test_initialize_after_backend_single_host_site_warns(monkeypatch):
     assert dist.num_processes() == 1
 
 
+def test_silent_degrade_counts_coord_degraded_metric(monkeypatch):
+    """The RuntimeWarning branches are how pod misconfiguration ships: a
+    warning scrolls by, the fit trains 1/P of the data.  Each silent
+    degrade must ALSO land in the telemetry (``coord.degraded``) and as a
+    span event, so OpenMetrics pages and run journals carry the evidence."""
+    import pytest
+
+    from spark_gp_tpu.obs import trace as obs_trace
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    jax.devices()
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    before = telemetry.counters.get("coord.degraded", 0.0)
+    with obs_trace.span("degrade_probe") as root:
+        with pytest.warns(RuntimeWarning, match="Continuing single-process"):
+            dist.initialize()
+    assert telemetry.counters.get("coord.degraded", 0.0) == before + 1
+    assert any(e["name"] == "coord.degraded" for e in root.events)
+
+
 def test_global_mesh_spans_devices():
     mesh = dist.global_expert_mesh()
     assert mesh.axis_names == (EXPERT_AXIS,)

@@ -63,6 +63,9 @@ def test_model_save_load_roundtrip(tmp_path):
 
     restored = GaussianProcessRegressionModel.load(path)
     np.testing.assert_allclose(restored.predict(x[:20]), model.predict(x[:20]), rtol=1e-12)
+    # fit provenance rode along: the saved file records the process
+    # topology that produced the BCM aggregate (utils/serialization.py)
+    assert restored.provenance == {"process_count": 1}
 
 
 def test_duplicate_rows_survive_via_jitter(rng):

@@ -25,16 +25,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.xfail(
-    reason="container jax 0.4.37: multihost_utils.process_allgather fails "
-    "with 'Multiprocess computations aren't implemented on the CPU backend' "
-    "inside distribute_global_experts (_mp_worker.py:53) — a jitted "
-    "cross-process collective the CPU/Gloo backend of this jax version "
-    "cannot run; pre-existing at seed (CHANGES.md PR 1), needs a jax "
-    "upgrade or a KV-store allgather fallback in parallel/distributed.py",
-    strict=False,
-)
 def test_two_process_fit_distributed():
+    # Formerly xfailed: this jax's CPU runtime refuses ANY cross-process
+    # computation ("Multiprocess computations aren't implemented"), so both
+    # the old process_allgather(dims) AND the fit's own collectives were
+    # unrunnable.  parallel/coord.py's DCN-fallback mode fixed both: dims
+    # ride coord.kv_allgather and the fit's cross-host sums ((NLL, grad)
+    # per evaluation, (U1, u2), the active-set rows) ride the KV store
+    # while each host runs local compiled programs — the reference's
+    # treeAggregate architecture on the jax coordination service.
     # bounded by the workers' communicate(timeout=560) below
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
@@ -87,3 +86,134 @@ def test_two_process_fit_distributed():
     np.testing.assert_allclose(r0["mpred"], r1["mpred"], rtol=0, atol=1e-8)
     # and the joint fit actually learned the shared function
     assert r0["rmse_local"] < 0.2, r0["rmse_local"]
+
+
+def _coord_worker_cmd(mode_args):
+    worker = os.path.join(os.path.dirname(__file__), "_mp_coord_worker.py")
+    return [sys.executable, worker] + [str(a) for a in mode_args]
+
+
+def _clean_env(**extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # one device per process: a REAL process boundary
+    env.pop("JAX_NUM_PROCESSES", None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _run_pair(args_by_pid, envs, timeout_s=300):
+    procs = [
+        subprocess.Popen(
+            _coord_worker_cmd(args), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for args, env in zip(args_by_pid, envs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout_s)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return procs, outs
+
+
+def _theta_from(out: str):
+    for line in out.splitlines():
+        if line.startswith("THETA "):
+            return np.asarray(json.loads(line[len("THETA "):])["theta"])
+    raise AssertionError(f"no THETA line in:\n{out[-2000:]}")
+
+
+def test_two_process_dead_host_raises_named_timeout_no_hang(tmp_path):
+    """THE no-hang acceptance proof over a real process boundary: process 1
+    is a chaos DeadHost (os._exit before its first DCN collective);
+    process 0 must raise CoordinationTimeoutError NAMING process 1 within
+    the configured deadline — never block past it."""
+    import time
+
+    port = _free_port()
+    deadline_s = 8
+    t0 = time.monotonic()
+    procs, outs = _run_pair(
+        [
+            ["fit", 0, 2, port, str(tmp_path / "ck")],
+            ["fit", 1, 2, port, str(tmp_path / "ck")],
+        ],
+        [
+            _clean_env(GP_COORD_TIMEOUT_S=deadline_s),
+            _clean_env(GP_COORD_TIMEOUT_S=deadline_s, GP_CHAOS_DEAD_HOST=1),
+        ],
+        timeout_s=180,
+    )
+    elapsed = time.monotonic() - t0
+    from spark_gp_tpu.resilience.chaos import PREEMPTION_EXIT_CODE
+
+    assert procs[1].returncode == PREEMPTION_EXIT_CODE, outs[1][-1500:]
+    assert procs[0].returncode == 3, outs[0][-1500:]
+    assert "COORDTIMEOUT missing=[1]" in outs[0], outs[0][-1500:]
+    # startup + one deadline + teardown; nowhere near the 180 s hang fence
+    assert elapsed < 120.0, elapsed
+
+
+@pytest.mark.slow
+def test_two_process_kill_then_elastic_resume_matches_uninterrupted(tmp_path):
+    """The elastic-resume acceptance proof over REAL process death: an
+    uninterrupted 2-process DCN fit gives the reference theta; the same
+    fit is rerun with process 1 staged to os._exit(137) after 3
+    checkpoint saves (process 0 stops at the named timeout, coordinated
+    checkpoints on disk); then ONE fresh process resumes the union stack
+    from the 2-process checkpoint and must reproduce the reference theta
+    to atol 1e-6."""
+    # 1. uninterrupted reference
+    port = _free_port()
+    procs, outs = _run_pair(
+        [
+            ["fit", 0, 2, port, str(tmp_path / "ref_ck")],
+            ["fit", 1, 2, port, str(tmp_path / "ref_ck")],
+        ],
+        [_clean_env(), _clean_env()],
+        timeout_s=280,
+    )
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    theta_ref = _theta_from(outs[0])
+    np.testing.assert_array_equal(theta_ref, _theta_from(outs[1]))
+
+    # 2. killed run: process 1 dies after its 3rd coordinated save
+    from spark_gp_tpu.resilience.chaos import PREEMPTION_EXIT_CODE
+
+    port = _free_port()
+    procs, outs = _run_pair(
+        [
+            ["fit", 0, 2, port, str(tmp_path / "ck")],
+            ["fit", 1, 2, port, str(tmp_path / "ck")],
+        ],
+        [
+            _clean_env(GP_COORD_TIMEOUT_S=8),
+            _clean_env(GP_COORD_TIMEOUT_S=8, GP_CHAOS_KILL_AFTER_ITERS=3),
+        ],
+        timeout_s=280,
+    )
+    assert procs[1].returncode == PREEMPTION_EXIT_CODE, outs[1][-1500:]
+    assert procs[0].returncode == 3, outs[0][-1500:]
+    assert "COORDTIMEOUT missing=[1]" in outs[0]
+    assert os.path.exists(
+        tmp_path / "ck" / "lbfgs_state_GaussianProcessRegression.json"
+    )
+
+    # 3. elastic resume: one process, union stack, different process count
+    proc = subprocess.Popen(
+        _coord_worker_cmd(["resume", 2, str(tmp_path / "ck")]),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=_clean_env(),
+    )
+    out, _ = proc.communicate(timeout=280)
+    assert proc.returncode == 0, out[-2000:]
+    assert "ELASTIC 1" in out, out[-1500:]  # the P=2 -> P'=1 transition
+    np.testing.assert_allclose(_theta_from(out), theta_ref, atol=1e-6)

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Tier-1 lint: raw cross-host coordination calls stay inside parallel/coord.py.
+
+The no-hang guarantee of the multi-host layer (docs/RESILIENCE.md,
+"Multi-host") holds only if EVERY blocking cross-host interaction goes
+through the deadline-guarded wrappers in ``spark_gp_tpu/parallel/coord.py``
+— one raw ``multihost_utils.process_allgather`` (or a direct poke at the
+``jax.distributed`` runtime/KV client) reintroduces an uninterruptible
+native wait that a dead peer turns into an indefinite hang with no
+diagnosis.  This checker walks the package AST and flags, outside
+``parallel/coord.py``:
+
+* any import of ``jax.experimental.multihost_utils`` or
+  ``jax._src.distributed`` (the KV client lives there);
+* any dotted use of ``multihost_utils.*`` or ``jax.distributed.*``.
+
+A deliberate exemption opts out with a trailing ``# collective-guard-ok``
+comment — greppable, so every escape stays auditable (today:
+``utils/compat.py``, which installs the cross-version
+``jax.distributed.is_initialized`` shim the guards themselves rely on).
+
+Run standalone (``python tools/check_collective_guards.py``; exit 1 on
+violations) or through the tier-1 wrapper
+(``tests/test_coord.py::test_collective_guards_lint_is_clean``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_ALLOW = "collective-guard-ok"
+_EXEMPT_FILES = (os.path.join("parallel", "coord.py"),)
+_BANNED_MODULES = (
+    "jax.experimental.multihost_utils",
+    "jax._src.distributed",
+)
+_BANNED_PREFIXES = (
+    "multihost_utils.",
+    "jax.distributed.",
+)
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _violating_nodes(tree: ast.AST) -> List[Tuple[int, str]]:
+    found: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            names = {alias.name for alias in node.names}
+            if any(mod.startswith(b) for b in _BANNED_MODULES) or (
+                mod == "jax.experimental" and "multihost_utils" in names
+            ) or (mod == "jax._src" and "distributed" in names):
+                found.append((node.lineno, f"from {mod} import ..."))
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if any(alias.name.startswith(b) for b in _BANNED_MODULES):
+                    found.append((node.lineno, f"import {alias.name}"))
+        elif isinstance(node, ast.Attribute):
+            dotted = _dotted(node)
+            if dotted and any(
+                dotted.startswith(p) or dotted == p.rstrip(".")
+                for p in _BANNED_PREFIXES
+            ):
+                # flag the OUTERMOST chain only (jax.distributed.initialize,
+                # not also jax.distributed) — ast.walk visits children too,
+                # so skip prefixes of an already-flagged line
+                if not any(
+                    ln == node.lineno and text.startswith(dotted)
+                    for ln, text in found
+                ):
+                    found.append((node.lineno, dotted))
+    return found
+
+
+def check_file(path: str) -> List[Tuple[str, int, str]]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [(path, exc.lineno or 0, f"<unparseable: {exc}>")]
+    violations = []
+    for lineno, what in _violating_nodes(tree):
+        line_text = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        if _ALLOW in line_text:
+            continue
+        violations.append((path, lineno, what))
+    return violations
+
+
+def find_violations(package_root: str) -> List[Tuple[str, int, str]]:
+    violations = []
+    root = os.path.abspath(package_root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            if any(rel.endswith(e) for e in _EXEMPT_FILES):
+                continue
+            violations.extend(check_file(path))
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = (argv if argv is not None else sys.argv[1:]) or [
+        os.path.join(repo_root, "spark_gp_tpu")
+    ]
+    violations = find_violations(args[0])
+    if violations:
+        print(
+            "raw cross-host coordination calls outside parallel/coord.py — "
+            "route them through the deadline-guarded wrappers there "
+            "(coord.kv_allgather / coord.barrier / coord.host_local_to_global "
+            "/ coord.initialize_runtime), or mark a deliberate exemption "
+            f"with '# {_ALLOW}':",
+            file=sys.stderr,
+        )
+        for path, lineno, what in violations:
+            rel = os.path.relpath(path, repo_root)
+            print(f"  {rel}:{lineno}: {what}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
