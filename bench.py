@@ -961,6 +961,97 @@ def worker() -> None:
     else:
         multihost_resilience = {"skipped": "BENCH_MULTIHOST != 1"}
 
+    # Serve lifecycle (the ISSUE 7 hardening): what a deploy and a
+    # shutdown cost in requests.  Headlines: a canary rollout under a
+    # closed-loop client must lose ZERO requests (the candidate is warmed
+    # before it takes traffic, shadow-scored, auto-promoted), and a drain
+    # against a queued burst must answer everything inside the deadline.
+    def _lifecycle_section():
+        import tempfile
+        import threading as _threading
+
+        from spark_gp_tpu.serve import CanaryPolicy, GPServeServer
+
+        server = GPServeServer(
+            max_batch=64, min_bucket=8, max_wait_ms=1.0,
+            capacity=8192, request_timeout_ms=None,
+        )
+        with tempfile.TemporaryDirectory() as tmp:
+            mpath = os.path.join(tmp, "bench_lifecycle.npz")
+            model.save(mpath)
+            server.register("lc", mpath)
+            server.start()
+
+            stop_traffic = _threading.Event()
+            counts = {"ok": 0, "failed": 0}
+
+            def client():
+                i = 0
+                while not stop_traffic.is_set():
+                    row = (i * 29) % max(1, n - 8)
+                    try:
+                        server.predict("lc", x[row : row + 4])
+                        counts["ok"] += 1
+                    except Exception:  # noqa: BLE001 — counting IS the bar
+                        counts["failed"] += 1
+                    i += 1
+
+            traffic = _threading.Thread(target=client, daemon=True)
+            traffic.start()
+            t0 = time.perf_counter()
+            entry = server.rollout(
+                "lc",
+                canary_policy=CanaryPolicy(fraction=0.25, promote_after=5),
+            )
+            promoted = False
+            while time.perf_counter() - t0 < 60.0:
+                if server.registry.get("lc").version == entry.version:
+                    promoted = True
+                    break
+                time.sleep(0.005)
+            rollout_seconds = time.perf_counter() - t0
+            stop_traffic.set()
+            traffic.join(timeout=10.0)
+
+            burst = [
+                server.submit("lc", x[(i * 17) % max(1, n - 8) :][:4])
+                for i in range(64)
+            ]
+            t0 = time.perf_counter()
+            drained = server.drain(deadline_s=30.0)
+            drain_seconds = time.perf_counter() - t0
+            answered = sum(
+                1 for f in burst if f.done() and f.exception() is None
+            )
+        return {
+            "rollout_seconds": rollout_seconds,
+            "rollout_promoted": promoted,
+            "rollout_requests_ok": counts["ok"],
+            "rollout_failed_requests": counts["failed"],
+            "canary_shadow_scores": server.metrics.counter(
+                "canary.shadow_scores"
+            ),
+            "drain_seconds": drain_seconds,
+            "drained_clean": drained,
+            "drain_burst_requests": len(burst),
+            "drain_burst_answered": answered,
+            "note": (
+                "zero-downtime swap: closed-loop client scores while a "
+                "canary of the same artifact rolls out (load + AOT warmup "
+                "+ shadow scoring + auto-promote inside rollout_seconds) — "
+                "rollout_failed_requests must be 0; drain_seconds answers "
+                "a 64-request queued burst before stopping"
+            ),
+        }
+
+    if os.environ.get("BENCH_LIFECYCLE", "1") == "1":
+        try:
+            lifecycle = _lifecycle_section()
+        except Exception as exc:  # noqa: BLE001 — secondary metric only
+            lifecycle = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    else:
+        lifecycle = {"skipped": "BENCH_LIFECYCLE != 1"}
+
     def _classifier_fit_seconds(estimator_cls, labels):
         """Warm-up + timed fit of a classifier at the same shape/config as
         the primary metric (one definition, so the binary and multiclass
@@ -1071,6 +1162,7 @@ def worker() -> None:
             "precision_lanes": precision_lanes,
             "observability": observability,
             "multihost_resilience": multihost_resilience,
+            "lifecycle": lifecycle,
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,
             "cpu_proxy_host_cores": host_cores,

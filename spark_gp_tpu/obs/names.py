@@ -56,6 +56,8 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("shed", "counter", "submits rejected at the door (aggregate)"),
     MetricName("queue.shed.deadline", "counter", "requests whose deadline expired while queued"),
     MetricName("queue.shed.backpressure", "counter", "submits rejected on a full queue"),
+    MetricName("queue.shed.draining", "counter", "submits rejected while the server drains for shutdown"),
+    MetricName("queue.shed.memory", "counter", "low-priority submits shed by the memory admission gate"),
     MetricName("queue.poisoned", "counter", "requests isolated as poisoned after a batch failure"),
     MetricName("shed.breaker", "counter", "submits rejected while a model's breaker was open"),
     MetricName("shed.poison", "counter", "submits rejected for non-finite payloads"),
@@ -64,6 +66,22 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("compiles", "counter", "XLA bucket compiles paid at registry warmup"),
     MetricName("models_loaded", "counter", "registry loads"),
     MetricName("models_reloaded", "counter", "registry hot-swap reloads"),
+    MetricName("registry.evictions", "counter", "retired model versions unloaded (compiled caches freed)"),
+    MetricName("exec.hung", "counter", "dispatches failed by the hang watchdog"),
+    # -- serve lifecycle (serve/lifecycle.py) ------------------------------
+    MetricName("lifecycle.drains", "counter", "drain sequences begun (SIGTERM/SIGINT or explicit)"),
+    MetricName("lifecycle.watchdog_trips", "counter", "hang-watchdog verdicts fired"),
+    MetricName("lifecycle.draining", "gauge", "1 while the server is draining"),
+    MetricName("lifecycle.memory_pressure", "gauge", "1 while the memory admission gate is shedding"),
+    MetricName("lifecycle.drain_s", "histogram", "seconds from drain begin to stopped"),
+    MetricName("canary.starts", "counter", "canary rollouts begun"),
+    MetricName("canary.routed", "counter", "default-traffic requests routed to a canary candidate"),
+    MetricName("canary.shadow_scores", "counter", "candidate answers shadow-scored against the incumbent"),
+    MetricName("canary.breaches", "counter", "shadow scores past the guard bar"),
+    MetricName("canary.errors", "counter", "raising candidate dispatches during a canary"),
+    MetricName("canary.promotions", "counter", "canaries auto-promoted to latest"),
+    MetricName("canary.rollbacks", "counter", "canaries auto-rolled-back and quarantined"),
+    MetricName("canary.active.*", "gauge", "1 while the model has an active canary", label="model"),
     # -- serve gauges ------------------------------------------------------
     MetricName("queue_depth", "gauge", "requests currently queued"),
     MetricName("breaker.open.*", "gauge", "1 while the model's breaker is open", label="model"),

@@ -264,6 +264,73 @@ def on_phase_boundary(instr_name: str, phase_name: str) -> None:
 
 JOURNAL_FORMAT = "spark_gp_tpu.run_journal/v1"
 
+#: per-fit artifacts that accumulate in a long-lived checkpoint/journal
+#: directory (journals are stamped unique per fit; host-optimizer
+#: checkpoints are per-tag) — the retention GC's prune targets
+_RETENTION_PATTERNS = ("run_journal_*.json", "lbfgs_state_*")
+
+
+def artifact_retention() -> Optional[int]:
+    """The opt-in retention budget: ``GP_ARTIFACT_RETENTION=K`` keeps the
+    newest K files per artifact class; unset/invalid/K<1 disables the GC
+    (retention stays the operator's, exactly as before)."""
+    raw = os.environ.get("GP_ARTIFACT_RETENTION", "").strip()
+    if not raw:
+        return None
+    try:
+        keep = int(raw)
+    except ValueError:
+        return None
+    return keep if keep >= 1 else None
+
+
+def prune_artifacts(
+    directory: str,
+    keep: Optional[int] = None,
+    protect: Optional[str] = None,
+) -> int:
+    """Prune old run journals and host-optimizer checkpoint files in
+    ``directory``, keeping the newest ``keep`` of EACH pattern by mtime.
+    ``protect`` names the artifact the caller JUST wrote: mtime has
+    filesystem-tick granularity, so a same-tick neighbor could otherwise
+    win the tiebreak and the GC would delete the very file it was invoked
+    for.  Returns the number of files removed; every failure is
+    best-effort-ignored — GC is housekeeping, never a fit or serve
+    failure.  NOTE the checkpoint-file leg: with several concurrent fits
+    sharing one directory and a small K, one fit's live ``lbfgs_state_*``
+    can be another's "old" file — the knob is opt-in for precisely that
+    reason."""
+    keep = artifact_retention() if keep is None else int(keep)
+    if keep is None or keep < 1:
+        return 0
+    import glob
+
+    def _mtime(path: str) -> float:
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return float("inf")  # racing writer: treat as newest, skip
+
+    protect = None if protect is None else os.path.abspath(protect)
+    removed = 0
+    for pattern in _RETENTION_PATTERNS:
+        paths = sorted(
+            glob.glob(os.path.join(directory, pattern)),
+            key=lambda p: (
+                os.path.abspath(p) == protect,  # the fresh write is newest
+                _mtime(p),
+                p,
+            ),
+            reverse=True,
+        )
+        for path in paths[keep:]:
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
 
 def write_run_journal(
     instr,
@@ -281,8 +348,11 @@ def write_run_journal(
     ``journal_dir`` when given — callers pass the checkpoint directory,
     falling back to ``GP_RUN_JOURNAL_DIR``.  The unique tag keeps
     concurrent or repeated fits of one estimator family from clobbering
-    each other's journal (retention in a long-lived dir is the operator's
-    to manage — journals are small).  Schema: docs/OBSERVABILITY.md."""
+    each other's journal.  Retention: by default a long-lived dir is the
+    operator's to manage (journals are small); ``GP_ARTIFACT_RETENTION=K``
+    opts into :func:`prune_artifacts` after each persist — keep the
+    newest K journals and host checkpoints.  Schema:
+    docs/OBSERVABILITY.md."""
     from spark_gp_tpu.ops.precision import active_lane
 
     spans = _trace.spans_of_root(root) if getattr(root, "trace_id", 0) else []
@@ -346,6 +416,9 @@ def write_run_journal(
                 json.dump(journal, fh, default=str)
             _fsync_replace(tmp, path)
             journal["path"] = path
+            # opt-in (GP_ARTIFACT_RETENTION); the fresh journal is
+            # protected against same-mtime-tick tiebreaks
+            prune_artifacts(journal_dir, protect=path)
         except OSError as exc:
             # the journal is telemetry, never a fit failure — but say so
             import logging

@@ -86,6 +86,7 @@ __all__ = [
     "kv_allgather",
     "liveness_snapshot",
     "install_preemption_watcher",
+    "make_flag_handler",
     "preemption_requested",
 ]
 
@@ -1296,19 +1297,25 @@ def install_preemption_watcher() -> bool:
     return True
 
 
-def _sigterm_flag_handler(prev):
-    """THE handler body both installers share: set the flag — nothing
-    else (metrics/span emission acquire locks the interrupted thread may
-    hold: a self-deadlock inside a signal handler) — then chain any real
+def make_flag_handler(flag: threading.Event, prev=None):
+    """THE flag-only signal-handler factory every installer shares (both
+    preemption watchers here, and the serve drain path in
+    ``serve/lifecycle.py``): set the flag — nothing else (metrics/span
+    emission acquire locks the interrupted thread may hold: a
+    self-deadlock inside a signal handler) — then chain any real
     previous handler."""
     import signal
 
-    def _on_sigterm(signum, frame):
-        _PREEMPTION.set()
+    def _on_signal(signum, frame):
+        flag.set()
         if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
             prev(signum, frame)
 
-    return _on_sigterm
+    return _on_signal
+
+
+def _sigterm_flag_handler(prev):
+    return make_flag_handler(_PREEMPTION, prev)
 
 
 _WATCH_DEPTH = 0

@@ -102,6 +102,21 @@ class CircuitBreaker:
             self._consecutive_failures = 0
             self._probe_in_flight = False
 
+    def trip(self) -> None:
+        """Force the breaker open NOW, regardless of the consecutive-failure
+        count — for out-of-band verdicts like the serve hang watchdog
+        (``serve/lifecycle.py``), where one wedged execution is already
+        proof the target must stop receiving dispatches."""
+        with self._lock:
+            if self._state != self.OPEN:
+                self.trip_count += 1
+            self._state = self.OPEN
+            self._opened_at = self._clock()
+            self._consecutive_failures = max(
+                self._consecutive_failures, self.failure_threshold
+            )
+            self._probe_in_flight = False
+
     def record_failure(self) -> None:
         with self._lock:
             self._consecutive_failures += 1

@@ -18,6 +18,8 @@ Fault classes:
   kill-and-resume tests;
 * :class:`FlakyPredictor` — a predict path that fails and/or stalls on
   schedule, for circuit-breaker and poisoned-batch isolation tests;
+* :class:`HangingPredictor` — a predict path that BLOCKS until released
+  (the wedged-device fault), for the serve hang-watchdog proof;
 * **multi-host faults** (consumed by ``parallel/coord.py``'s guarded
   collectives and coordinated checkpointers):
   :class:`StragglerHost` — inject a fixed delay before a named
@@ -37,6 +39,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from typing import Optional
 
@@ -198,6 +201,61 @@ class FlakyPredictor:
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+
+class HangingPredictor:
+    """Predict path that BLOCKS — the wedged-device fault the serve hang
+    watchdog (``serve/lifecycle.py``) exists for, as distinct from
+    :class:`FlakyPredictor`'s raising/slow faults.
+
+    The first ``hang_first`` predicts (every one with ``hang_forever``)
+    park on an internal event until :meth:`release` — deterministic, no
+    wall-clock races: the test picks the hang deadline, trips the
+    watchdog, then releases so the wedged thread unwinds instead of
+    leaking blocked for the rest of the suite.  ``max_block_s`` is the
+    leak backstop if a test forgets.  Duck-types
+    :class:`~spark_gp_tpu.serve.batcher.BucketedPredictor` like
+    FlakyPredictor does.
+    """
+
+    def __init__(
+        self,
+        inner,
+        hang_first: int = 0,
+        hang_forever: bool = False,
+        max_block_s: float = 60.0,
+    ) -> None:
+        self._inner = inner
+        self.hang_first = int(hang_first)
+        self.hang_forever = bool(hang_forever)
+        self.max_block_s = float(max_block_s)
+        self._release = threading.Event()
+        self.calls = 0
+        self.hung = 0
+
+    def predict(self, x, *args, **kwargs):
+        self.calls += 1
+        if self.hang_forever or self.calls <= self.hang_first:
+            self.hung += 1
+            self._release.wait(self.max_block_s)
+        return self._inner.predict(x, *args, **kwargs)
+
+    def release(self) -> None:
+        """Unblock every parked (and future would-hang) predict."""
+        self._release.set()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def hang_model(server, name: str, version: Optional[int] = None, **hang_kw):
+    """Swap a registered model's predictor for a :class:`HangingPredictor`
+    (the watchdog-proof analogue of :func:`break_model`).  Returns the
+    wrapper — call ``release()`` in teardown."""
+    entry = server.registry.get(name, version)
+    hanging = HangingPredictor(entry.predictor, **hang_kw)
+    entry.predictor = hanging
+    return hanging
 
 
 # --------------------------------------------------------------------------

@@ -27,7 +27,14 @@ Failures degrade per model / per request, never the process: per-model
 circuit breakers, poisoned-request isolation, classed shed metrics and
 a health verb (``resilience/``, docs/RESILIENCE.md).
 
-See docs/SERVING.md for architecture and tuning.
+The process lifecycle is hardened too
+(:mod:`~spark_gp_tpu.serve.lifecycle`): graceful drain on
+SIGTERM/SIGINT, canary rollouts shadow-scored against the incumbent
+with auto-promote/auto-rollback, a hang watchdog over device
+dispatches, and memory-pressure admission with hysteresis.
+
+See docs/SERVING.md for architecture, tuning and the
+"Deployment & lifecycle" section.
 """
 
 from spark_gp_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
@@ -36,6 +43,14 @@ from spark_gp_tpu.serve.batcher import (
     BucketedPredictor,
     RecompileGuardError,
     bucket_sizes,
+)
+from spark_gp_tpu.serve.lifecycle import (
+    CanaryPolicy,
+    DrainingError,
+    ExecHungError,
+    HangWatchdog,
+    MemoryAdmissionGate,
+    MemoryPressureError,
 )
 from spark_gp_tpu.serve.metrics import LatencyHistogram, ServingMetrics
 from spark_gp_tpu.serve.queue import (
@@ -51,8 +66,14 @@ __all__ = [
     "BreakerOpenError",
     "BucketedPredictor",
     "BucketOverflowError",
+    "CanaryPolicy",
     "CircuitBreaker",
     "DeadlineExpiredError",
+    "DrainingError",
+    "ExecHungError",
+    "HangWatchdog",
+    "MemoryAdmissionGate",
+    "MemoryPressureError",
     "RecompileGuardError",
     "bucket_sizes",
     "ServingMetrics",

@@ -32,6 +32,16 @@ tell shed classes apart (docs/RESILIENCE.md).
 Responses to predicts are emitted in submission order by a writer thread,
 so the reader loop never blocks on a result and the micro-batcher sees
 concurrent requests even from a single-stream client.
+
+Lifecycle (docs/SERVING.md "Deployment & lifecycle"): SIGTERM/SIGINT
+flip the process to a graceful drain in BOTH stdin and TCP modes — new
+submits are rejected with ``code=queue.shed.draining``, queued and
+in-flight work completes under ``--drain-deadline-s``, the final line is
+``{"event": "shutdown", "drained": true, ...}`` and the exit status is
+0.  ``{"cmd": "reload", "model": m, "canary_fraction": 0.1}`` rolls the
+new version out as a shadow-scored canary instead of an instant swap;
+predicts may carry ``"priority"`` (only consulted by the
+memory-pressure admission gate).
 """
 
 from __future__ import annotations
@@ -71,6 +81,19 @@ def _parse_args(argv):
                         "model's circuit breaker open")
     parser.add_argument("--breaker-reset-s", type=float, default=5.0,
                         help="breaker cooldown before a half-open probe")
+    parser.add_argument("--drain-deadline-s", type=float, default=30.0,
+                        help="graceful-drain budget on SIGTERM/SIGINT: "
+                        "queued and in-flight work gets this long to "
+                        "complete before leftovers are failed fast")
+    parser.add_argument("--hang-timeout-s", type=float, default=30.0,
+                        help="hang-watchdog deadline per device dispatch "
+                        "(0 disables): past it the batch fails with "
+                        "code=exec.hung and the model's breaker trips")
+    parser.add_argument("--memory-limit-bytes", type=float, default=None,
+                        help="memory-pressure admission limit (default: "
+                        "GP_SERVE_MEMORY_LIMIT_BYTES env; unset disables): "
+                        "low-priority submits are shed with "
+                        "code=queue.shed.memory above the high watermark")
     parser.add_argument("--port", type=int, default=None,
                         help="serve a TCP socket on 127.0.0.1:PORT instead of stdin")
     parser.add_argument(
@@ -201,12 +224,24 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                 # on a side thread: a reload pays a full load + AOT warmup,
                 # and blocking the reader here would keep NEW requests from
                 # even reaching the (still-serving) old version.  The reply
-                # rides the pending queue, so ordering is preserved.
+                # rides the pending queue, so ordering is preserved.  With
+                # "canary_fraction" the reload goes through the canary gate
+                # (shadow-scored slice, auto-promote/rollback) instead of
+                # an instant hot swap.
                 def _do_reload(m=msg):
                     try:
-                        entry = server.registry.reload(
-                            m["model"], m.get("path")
-                        )
+                        fraction = m.get("canary_fraction")
+                        if fraction is not None:
+                            entry = server.rollout(
+                                m["model"], m.get("path"),
+                                canary_fraction=float(fraction),
+                            )
+                            return {
+                                "event": "canary",
+                                "model": entry.name,
+                                "version": entry.version,
+                            }
+                        entry = server.reload(m["model"], m.get("path"))
                         return {
                             "event": "reloaded",
                             "model": entry.name,
@@ -231,6 +266,10 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                     msg["model"], msg["x"],
                     version=msg.get("version"),
                     timeout_ms=msg.get("timeout_ms"),
+                    # priority only matters under memory pressure: >= the
+                    # gate's floor keeps being admitted while low-priority
+                    # work is shed with code=queue.shed.memory
+                    priority=int(msg.get("priority", 0)),
                 )
             except Exception as exc:  # noqa: BLE001 — shed/shape errors
                 # through the writer queue, not directly: error replies
@@ -267,7 +306,7 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
     return shutdown
 
 
-def _serve_socket(server, port: int, out_lock) -> None:
+def _serve_socket(server, port: int, out_lock, drain_flag=None) -> None:
     import socket
 
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -286,7 +325,11 @@ def _serve_socket(server, port: int, out_lock) -> None:
 
     try:
         sock.settimeout(0.5)
-        while not stop.is_set():
+        # a set drain flag (SIGTERM/SIGINT) closes the LISTENER first —
+        # stop accepting, then main() drains what is already in flight
+        while not stop.is_set() and not (
+            drain_flag is not None and drain_flag.is_set()
+        ):
             try:
                 conn, _ = sock.accept()
             except socket.timeout:
@@ -322,6 +365,13 @@ def main(argv=None) -> int:
         print("at least one --model NAME=PATH is required", file=sys.stderr)
         return 2
 
+    # SIGTERM/SIGINT -> graceful drain (serve/lifecycle.py): the handlers
+    # only set a flag; the serving loops below watch it.  Installed BEFORE
+    # the slow load/warmup so a deploy rollback mid-boot still exits clean.
+    from spark_gp_tpu.serve.lifecycle import install_drain_signals
+
+    drain_flag = install_drain_signals()
+
     server = GPServeServer(
         max_batch=args.max_batch,
         min_bucket=args.min_bucket,
@@ -333,6 +383,11 @@ def main(argv=None) -> int:
         ),
         breaker_threshold=args.breaker_threshold,
         breaker_reset_s=args.breaker_reset_s,
+        hang_timeout_s=(
+            None if args.hang_timeout_s == 0 else args.hang_timeout_s
+        ),
+        memory_limit_bytes=args.memory_limit_bytes,
+        drain_deadline_s=args.drain_deadline_s,
     )
     for spec in args.model:
         name, sep, path = spec.partition("=")
@@ -373,23 +428,74 @@ def main(argv=None) -> int:
     explicit_shutdown = False
     try:
         if args.port is not None:
-            _serve_socket(server, args.port, out_lock)
+            _serve_socket(server, args.port, out_lock, drain_flag)
         else:
-            explicit_shutdown = _serve_stream(
-                server, sys.stdin, sys.stdout, out_lock
-            )
+            # the stdin reader runs on a side thread so a drain signal can
+            # act even while the reader is parked in a blocking readline
+            # (PEP 475 restarts the read after the flag-only handler runs,
+            # so the main thread would never regain control otherwise)
+            done = threading.Event()
+            result: dict = {}
+
+            def _read_stdin():
+                try:
+                    result["shutdown"] = _serve_stream(
+                        server, sys.stdin, sys.stdout, out_lock
+                    )
+                finally:
+                    done.set()
+
+            threading.Thread(
+                target=_read_stdin, name="gp-serve-stdin", daemon=True
+            ).start()
+            while not done.wait(0.1):
+                if drain_flag is not None and drain_flag.is_set():
+                    break
+            explicit_shutdown = bool(result.get("shutdown"))
     finally:
         if scrape is not None:
             scrape.stop()
-        server.stop(drain=True)
-        if not explicit_shutdown:
-            # EOF / socket-mode exit: the session stream never carried a
-            # shutdown reply, so emit the process-level event here
+        # decided HERE, not in the loop: a signal racing a concurrent
+        # stream EOF must still take the drain path (the flag is the
+        # truth; only an explicit {"cmd": "shutdown"} outranks it)
+        drain_requested = (
+            drain_flag is not None
+            and drain_flag.is_set()
+            and not explicit_shutdown
+        )
+        if drain_requested:
+            # graceful drain: reject new submits (code=queue.shed.draining),
+            # complete queued + in-flight work under the deadline, exit 0.
+            # A short grace lets the session writer threads flush the final
+            # answers before the process-level shutdown line.
+            drained = server.drain(args.drain_deadline_s)
+            import time as _time
+
+            _time.sleep(0.2)
             _out(out_lock, sys.stdout, {
                 "event": "shutdown",
+                "drained": drained,
                 "requests": server.metrics.counter("requests"),
                 "batches": server.metrics.counter("batches"),
             })
+            sys.stdout.flush()
+            # hard exit AFTER the flushed shutdown line: a daemon thread
+            # still inside native XLA code (e.g. a canary reload's warmup
+            # compile the signal interrupted) aborts the whole process
+            # ("terminate called without an active exception") if normal
+            # interpreter finalization tears Python down underneath it —
+            # the drained work is done and flushed, so skip finalization
+            os._exit(0)
+        else:
+            server.stop(drain=True)
+            if not explicit_shutdown:
+                # EOF / socket-mode exit: the session stream never carried a
+                # shutdown reply, so emit the process-level event here
+                _out(out_lock, sys.stdout, {
+                    "event": "shutdown",
+                    "requests": server.metrics.counter("requests"),
+                    "batches": server.metrics.counter("batches"),
+                })
     return 0
 
 

@@ -143,6 +143,12 @@ class BucketedPredictor:
         # test_precision_policy.py asserts both lowerings carry the
         # donor/aliasing annotations).
         self._jit = self._make_jit(donate=True)
+        #: set by release(): the registry evicted this predictor — NEW
+        #: predicts refuse, and the compiled surface / device operands are
+        #: freed as soon as the last in-flight predict finishes
+        self.released = False
+        self._active_calls = 0
+        self._freed = False
 
     def _make_jit(self, donate: bool):
         """jit the bucket impl, optionally donating the padded request
@@ -195,6 +201,48 @@ class BucketedPredictor:
 
         return impl
 
+    def release(self) -> None:
+        """Drop the compiled bucket executables and device-resident
+        operands.  Called by registry eviction (``max_versions`` trim,
+        canary retire): each warmed predictor pins a ladder of XLA
+        executables plus theta/active/magic HBM buffers, and Python GC
+        alone frees them only whenever the last stray reference dies —
+        eviction must reclaim deterministically.  Idempotent.  NEW
+        predicts refuse immediately, but the actual free is deferred
+        until the last IN-FLIGHT predict finishes (refcounted below) —
+        the hot-swap invariant says a batch that already resolved this
+        version must complete against its warm executables, never die
+        mid-serve on a concurrent eviction."""
+        with self._lock:
+            self.released = True
+        self._maybe_free()
+
+    def _maybe_free(self) -> None:
+        """The one arbitration for the deferred free: run it exactly once,
+        after release, once nothing is in flight (called by release() and
+        by the last predict's exit)."""
+        with self._lock:
+            free_now = (
+                self.released and self._active_calls == 0 and not self._freed
+            )
+            if free_now:
+                self._freed = True
+        if free_now:
+            self._free()
+
+    def _free(self) -> None:
+        jit = self._jit
+        self._jit = None
+        try:
+            if jit is not None and hasattr(jit, "clear_cache"):
+                jit.clear_cache()
+        except Exception:  # noqa: BLE001 — best-effort on older jax
+            pass
+        self._theta = None
+        self._active = None
+        self._magic_vector = None
+        self._magic_matrix = None
+
     def bucket_for(self, n: int) -> Optional[int]:
         """Smallest bucket >= n, or None when n exceeds the top bucket
         (the caller then chunks by the top bucket)."""
@@ -222,6 +270,14 @@ class BucketedPredictor:
         return dict(self.compile_counts)
 
     def _dispatch(self, bucket: int, x_padded):
+        if self._jit is None:
+            # only reachable after the deferred free completed (no predict
+            # was in flight) — the released gate at predict() entry is
+            # what concurrent callers actually hit
+            raise RuntimeError(
+                "predictor was released (its registry version is retired); "
+                "resolve the model again for the current version"
+            )
         if self._frozen and bucket not in self._warmed:
             raise RecompileGuardError(
                 f"bucket {bucket} was not warmed; compiled surface is "
@@ -268,6 +324,22 @@ class BucketedPredictor:
         ``chunk_oversize`` (default), else raise
         :class:`BucketOverflowError`.
         """
+        with self._lock:
+            if self.released:
+                raise RuntimeError(
+                    "predictor was released (its registry version is "
+                    "retired); resolve the model again for the current "
+                    "version"
+                )
+            self._active_calls += 1
+        try:
+            return self._predict_counted(x_test, chunk_oversize)
+        finally:
+            with self._lock:
+                self._active_calls -= 1
+            self._maybe_free()
+
+    def _predict_counted(self, x_test, chunk_oversize: bool):
         x = self._normalize(x_test)
         t = x.shape[0]
         if t == 0:

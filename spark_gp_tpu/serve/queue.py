@@ -90,6 +90,11 @@ class PredictRequest:
     # episode would multi-count failures and trip the breaker mid-loop,
     # erroring the innocent batchmates still waiting their turn
     isolation_retry: bool = False
+    # True when the version in model_key was picked by the CANARY ROUTER
+    # rather than the client: only routed requests may be re-served from
+    # the stable latest after a rollback — a client-pinned version is a
+    # contract (serve THAT one or fail)
+    routed: bool = False
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and (
@@ -129,6 +134,15 @@ class MicroBatchQueue:
         self.max_batch_rows = int(max_batch_rows)
         self._thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
+        # worker generation: replace_worker() bumps it to ABANDON a wedged
+        # worker (stuck inside execute — the hang-watchdog case) and hand
+        # dispatching to a fresh thread; the stale worker notices the bump
+        # when (if ever) its stuck call returns, and exits instead of
+        # double-consuming.  _in_flight counts the CURRENT generation's
+        # dispatches for wait_idle (drain).
+        self._gen = 0
+        self._gen_lock = threading.Lock()
+        self._in_flight = 0
 
     # -- producer side ----------------------------------------------------
     def submit(self, request: PredictRequest) -> ServeFuture:
@@ -157,24 +171,68 @@ class MicroBatchQueue:
         """Start (or restart) the worker.  ``stop``/``start`` are
         symmetric: a stopped queue restarted here accepts and serves
         requests again."""
-        if self._thread is not None:
-            return
-        self._stopping.clear()
-        self._thread = threading.Thread(
-            target=self._loop, name="gp-serve-batcher", daemon=True
-        )
-        self._thread.start()
+        # _thread handoffs happen under _gen_lock so a concurrent stop()
+        # can never observe a created-but-not-yet-started Thread (join on
+        # one raises) — replace_worker keeps the same invariant
+        with self._gen_lock:
+            if self._thread is not None:
+                return
+            self._stopping.clear()
+            self._in_flight = 0
+            self._thread = threading.Thread(
+                target=self._loop, args=(self._gen,),
+                name="gp-serve-batcher", daemon=True,
+            )
+            self._thread.start()
+
+    def replace_worker(self) -> None:
+        """Abandon the current worker (wedged in an execute the hang
+        watchdog just failed) and start a replacement, so the OTHER
+        models' queued work dispatches again.  The stuck thread is left
+        blocked (a wedged device call cannot be interrupted) and exits on
+        its own when the call eventually returns."""
+        with self._gen_lock:
+            self._gen += 1  # abandon the wedged worker unconditionally
+            self._in_flight = 0
+            if self._stopping.is_set() or self._thread is None:
+                # a hang verdict racing stop(): the queue is (being) shut
+                # down — spawning a replacement would repopulate _thread
+                # and break a later stop/start cycle; leftovers are failed
+                # by stop()'s own sweep
+                return
+            gen = self._gen
+            self._thread = threading.Thread(
+                target=self._loop, args=(gen,),
+                name=f"gp-serve-batcher-{gen}", daemon=True,
+            )
+            self._thread.start()
+
+    def wait_idle(self, timeout: float) -> bool:
+        """Block until no request is queued or in flight (the drain
+        barrier); False when the timeout lapses first."""
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            with self._gen_lock:
+                busy = self._in_flight
+            if busy == 0 and self._q.qsize() == 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the worker; with ``drain`` (default) queued requests are
         still executed, without it they fail fast with shutdown errors."""
-        if self._thread is None:
+        with self._gen_lock:  # see start(): atomic _thread handoff
+            thread = self._thread
+        if thread is None:
             return
         if not drain:
             self._stopping.set()
         self._q.put(_SENTINEL)  # blocking put: always deliverable
-        self._thread.join(timeout)
-        self._thread = None
+        thread.join(timeout)
+        with self._gen_lock:
+            self._thread = None
         self._stopping.set()
         # whatever is left after the join window fails explicitly
         self._fail_leftovers()
@@ -188,8 +246,10 @@ class MicroBatchQueue:
             if item is not _SENTINEL:
                 item.future.set_error(RuntimeError("server shut down"))
 
-    def _loop(self) -> None:
+    def _loop(self, my_gen: int) -> None:
         while True:
+            if my_gen != self._gen:
+                return  # abandoned by replace_worker: a successor dispatches
             try:
                 first = self._q.get(timeout=0.1)
             except _queue.Empty:
@@ -199,6 +259,9 @@ class MicroBatchQueue:
             if self._stopping.is_set():
                 first.future.set_error(RuntimeError("server shut down"))
                 continue
+            with self._gen_lock:
+                if my_gen == self._gen:
+                    self._in_flight += 1
             batch = [first]
             rows = first.x.shape[0]
             # coalescing window opens at first dequeue: an idle server
@@ -206,20 +269,27 @@ class MicroBatchQueue:
             # one fills toward max_batch_rows
             deadline = time.monotonic() + self.max_wait_s
             saw_sentinel = False
-            while rows < self.max_batch_rows:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._q.get(timeout=remaining)
-                except _queue.Empty:
-                    break
-                if nxt is _SENTINEL:
-                    saw_sentinel = True
-                    break
-                batch.append(nxt)
-                rows += nxt.x.shape[0]
-            self._run_batch(batch)
+            try:
+                while rows < self.max_batch_rows:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=remaining)
+                    except _queue.Empty:
+                        break
+                    if nxt is _SENTINEL:
+                        saw_sentinel = True
+                        break
+                    batch.append(nxt)
+                    rows += nxt.x.shape[0]
+                self._run_batch(batch)
+            finally:
+                with self._gen_lock:
+                    # an abandoned worker's counter was already reset by
+                    # replace_worker — only the live generation decrements
+                    if my_gen == self._gen:
+                        self._in_flight = max(0, self._in_flight - 1)
             if saw_sentinel:
                 return
 

@@ -123,11 +123,19 @@ class ModelRegistry:
         path: str,
         version: Optional[int] = None,
         warmup: bool = True,
+        make_latest: bool = True,
     ) -> ServableModel:
         """Load ``path`` and publish it as ``name`` at ``version``
         (default: one past the current latest; 1 for a new name).  The
         entry is fully built — loaded, compiled, warmed — before it
-        becomes visible."""
+        becomes visible.
+
+        ``make_latest=False`` publishes the version addressable-but-not-
+        default (the canary shape, ``serve/lifecycle.py``): default
+        traffic keeps resolving the incumbent until an explicit
+        :meth:`promote` moves the pointer — and retention is NOT trimmed,
+        so a pending candidate can never evict the incumbent it is being
+        judged against."""
         with self._lock:
             if version is None:
                 version = self._allocated.get(name, 0) + 1
@@ -147,14 +155,76 @@ class ModelRegistry:
                     "concurrently"
                 )
             versions[entry.version] = entry
-            if entry.version >= self._latest.get(name, 0):
+            if name not in self._latest or (
+                make_latest and entry.version >= self._latest[name]
+            ):
                 self._latest[name] = entry.version
-            for old in sorted(versions)[: -self._max_versions]:
-                # never trim the entry this very call just published — an
-                # explicitly re-registered old version must stay gettable
-                if old != entry.version:
-                    del versions[old]
+            evicted = (
+                self._trim_locked(name, keep=entry.version)
+                if make_latest else []
+            )
+        self._release_evicted(evicted)
         self.metrics.inc("models_loaded")
+        return entry
+
+    def _trim_locked(self, name: str, keep: int) -> List[ServableModel]:
+        """Drop the oldest versions past ``max_versions`` (caller holds
+        the lock).  Never trims ``keep`` (the entry the caller just
+        published or promoted) or the latest pointer's target; returns
+        the evicted entries for the caller to release OUTSIDE the lock."""
+        versions = self._models.get(name, {})
+        evicted: List[ServableModel] = []
+        for old in sorted(versions)[: -self._max_versions]:
+            if old != keep and old != self._latest.get(name):
+                evicted.append(versions.pop(old))
+        return evicted
+
+    def _release_evicted(self, entries: List[ServableModel]) -> None:
+        """Account + actually unload evicted entries: each one pins host
+        arrays, device buffers AND a ladder of compiled executables —
+        eviction that only drops the dict slot would leak a full warmed
+        model per reload until GC happened to notice."""
+        for entry in entries:
+            self.metrics.inc("registry.evictions")
+            release = getattr(entry.predictor, "release", None)
+            if release is not None:
+                release()
+
+    def retire(self, name: str, version: int) -> bool:
+        """Remove ONE version (rolled-back canary, manual unload) and free
+        its compiled bucket caches.  Retiring the latest repoints the
+        default to the newest survivor; retiring the only version removes
+        the name.  Returns False when the version was not registered."""
+        version = int(version)
+        with self._lock:
+            versions = self._models.get(name)
+            entry = versions.pop(version, None) if versions else None
+            if entry is None:
+                return False
+            if not versions:
+                del self._models[name]
+                self._latest.pop(name, None)
+            elif self._latest.get(name) == version:
+                self._latest[name] = max(versions)
+        self._release_evicted([entry])
+        return True
+
+    def promote(self, name: str, version: int) -> ServableModel:
+        """Move the latest pointer to an already-registered version (the
+        canary's clean-promotion step) and trim retention — the retired
+        predecessors beyond ``max_versions`` are evicted and released."""
+        version = int(version)
+        with self._lock:
+            versions = self._models.get(name, {})
+            entry = versions.get(version)
+            if entry is None:
+                raise KeyError(
+                    f"model {name!r} has no version {version} to promote; "
+                    f"available: {sorted(versions)}"
+                )
+            self._latest[name] = version
+            evicted = self._trim_locked(name, keep=version)
+        self._release_evicted(evicted)
         return entry
 
     def reload(self, name: str, path: Optional[str] = None) -> ServableModel:

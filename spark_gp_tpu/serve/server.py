@@ -19,6 +19,15 @@ import numpy as np
 
 from spark_gp_tpu.obs import trace as obs_trace
 from spark_gp_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
+from spark_gp_tpu.serve.lifecycle import (
+    CanaryController,
+    CanaryPolicy,
+    DrainingError,
+    ExecHungError,
+    HangWatchdog,
+    MemoryAdmissionGate,
+    MemoryPressureError,
+)
 from spark_gp_tpu.serve.metrics import ServingMetrics
 from spark_gp_tpu.serve.queue import (
     MicroBatchQueue,
@@ -53,6 +62,9 @@ class GPServeServer:
         max_versions: int = 2,
         breaker_threshold: int = 3,
         breaker_reset_s: float = 5.0,
+        hang_timeout_s: Optional[float] = 30.0,
+        memory_limit_bytes: Optional[float] = None,
+        drain_deadline_s: float = 30.0,
     ):
         self.metrics = metrics if metrics is not None else ServingMetrics()
         # one circuit breaker per model NAME (not version: a reload that
@@ -87,6 +99,25 @@ class GPServeServer:
             on_poison=lambda n: self.metrics.inc("queue.poisoned", n),
         )
         self._started = False
+        # lifecycle layer (serve/lifecycle.py): process state machine,
+        # hang watchdog, memory-pressure admission, canary controller
+        self._state = "starting"
+        self._drain_deadline_s = float(drain_deadline_s)
+        self._hang_timeout_s = (
+            None if hang_timeout_s is None or hang_timeout_s <= 0
+            else float(hang_timeout_s)
+        )
+        self._watchdog = (
+            None if self._hang_timeout_s is None
+            else HangWatchdog(self._on_hang, self._hang_timeout_s)
+        )
+        self.memory_gate = MemoryAdmissionGate(
+            limit_bytes=memory_limit_bytes,
+            on_state=lambda shedding: self.metrics.set_gauge(
+                "lifecycle.memory_pressure", 1.0 if shedding else 0.0
+            ),
+        )
+        self.canaries = CanaryController(self.registry, self.metrics)
 
     def _breaker_for(self, name: str) -> CircuitBreaker:
         breaker = self._breakers.get(name)
@@ -106,19 +137,127 @@ class GPServeServer:
         return self._request_timeout_s
 
     # -- lifecycle --------------------------------------------------------
-    def register(self, name: str, path: str, **kw) -> ServableModel:
-        return self.registry.register(name, path, **kw)
+    def register(
+        self,
+        name: str,
+        path: str,
+        canary_fraction: Optional[float] = None,
+        canary_policy: Optional[CanaryPolicy] = None,
+        **kw,
+    ) -> ServableModel:
+        """Load and publish a model.  With ``canary_fraction`` (and an
+        already-serving incumbent) the new version is published as a
+        CANARY instead of an instant hot swap: it takes that fraction of
+        default traffic, shadow-scored against the incumbent, and is
+        auto-promoted or auto-rolled-back by the controller
+        (serve/lifecycle.py)."""
+        if canary_fraction is None and canary_policy is None:
+            # a DIRECT register during an active canary supersedes the
+            # experiment: cancel it first, or retention would evict the
+            # canary's incumbent and the orphaned controller state could
+            # later drag the latest pointer backwards
+            self.canaries.cancel(name, reason="superseded by direct register")
+            return self.registry.register(name, path, **kw)
+        try:
+            incumbent = self.registry.get(name).version
+        except KeyError:
+            # first version of a name: nothing to canary against — a
+            # plain register IS the safe rollout
+            return self.registry.register(name, path, **kw)
+        policy = canary_policy if canary_policy is not None else CanaryPolicy(
+            fraction=canary_fraction
+        )
+        entry = self.registry.register(name, path, make_latest=False, **kw)
+        try:
+            self.canaries.start(name, entry.version, incumbent, policy)
+        except ValueError:
+            # a canary is already active for this name: retire the version
+            # we just built rather than leak an unroutable warmed entry
+            self.registry.retire(name, entry.version)
+            raise
+        return entry
+
+    def rollout(
+        self,
+        name: str,
+        path: Optional[str] = None,
+        canary_fraction: float = 0.1,
+        canary_policy: Optional[CanaryPolicy] = None,
+    ) -> ServableModel:
+        """Canary-reload: like ``registry.reload`` but through the canary
+        gate (default source: the incumbent's own path)."""
+        source = path or self.registry.get(name).path
+        return self.register(
+            name, source,
+            canary_fraction=canary_fraction, canary_policy=canary_policy,
+        )
+
+    def reload(self, name: str, path: Optional[str] = None) -> ServableModel:
+        """Plain hot-swap reload THROUGH the lifecycle layer: an active
+        canary for the name is cancelled first (direct reload supersedes
+        the experiment), then the registry hot-swaps as usual.  Callers
+        going straight to ``registry.reload`` bypass that cancellation."""
+        self.canaries.cancel(name, reason="superseded by direct reload")
+        return self.registry.reload(name, path)
 
     def start(self) -> None:
         self._queue.start()
+        if self._watchdog is not None:
+            self._watchdog.start()
         self._started = True
+        self._state = "serving"
+        self.metrics.set_gauge("lifecycle.draining", 0.0)
 
     def ready(self) -> bool:
-        return self._started and bool(self.registry.names())
+        return (
+            self._started
+            and self._state == "serving"
+            and bool(self.registry.names())
+        )
 
     def stop(self, drain: bool = True) -> None:
         self._queue.stop(drain=drain)
+        if self._watchdog is not None:
+            self._watchdog.stop()
         self._started = False
+        self._state = "stopped"
+        # begin_drain() -> stop() (without drain()) must not leave the
+        # draining gauge latched at 1 on a stopped server
+        self.metrics.set_gauge("lifecycle.draining", 0.0)
+
+    def begin_drain(self) -> None:
+        """Flip to draining: every NEW submit is rejected with
+        ``code=queue.shed.draining`` while queued and in-flight work keeps
+        completing.  Idempotent; :meth:`drain` waits out the queue."""
+        if self._state in ("draining", "stopped"):
+            return
+        self._state = "draining"
+        self.metrics.inc("lifecycle.drains")
+        self.metrics.set_gauge("lifecycle.draining", 1.0)
+        obs_trace.add_event("lifecycle.drain_begin")
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Graceful shutdown: reject new work, complete what is queued and
+        in flight (bounded by the drain deadline), then stop.  Returns
+        True when everything completed inside the deadline; past it the
+        leftovers are failed fast (shutdown errors) so no client blocks on
+        a future nobody will complete."""
+        deadline_s = (
+            self._drain_deadline_s if deadline_s is None else float(deadline_s)
+        )
+        started = time.monotonic()
+        self.begin_drain()
+        drained = self._queue.wait_idle(deadline_s)
+        # past-deadline leftovers are failed by stop(drain=False)
+        self._queue.stop(drain=drained)
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        self._started = False
+        self._state = "stopped"
+        self.metrics.observe("lifecycle.drain_s", time.monotonic() - started)
+        self.metrics.set_gauge("lifecycle.draining", 0.0)
+        obs_trace.add_event("lifecycle.drain_end", drained=drained)
+        return drained
 
     # -- request path -----------------------------------------------------
     def submit(
@@ -127,14 +266,36 @@ class GPServeServer:
         x,
         version: Optional[int] = None,
         timeout_ms: Optional[float] = None,
+        priority: int = 0,
     ) -> ServeFuture:
         """Enqueue a predict; returns immediately with a future.
 
-        Shape errors and backpressure surface HERE, in the caller's
-        thread — an invalid request must never occupy queue capacity or
-        a batch slot.
+        Shape errors, drain/memory shedding and backpressure surface
+        HERE, in the caller's thread — an invalid or shed request must
+        never occupy queue capacity or a batch slot.  ``priority`` only
+        matters under memory pressure: requests at or above the gate's
+        priority floor keep being admitted while lower ones are shed.
         """
-        entry = self.registry.get(name, version)  # KeyError for unknowns
+        if self._state == "draining":
+            self.metrics.inc("shed")
+            self.metrics.inc("queue.shed.draining")
+            raise DrainingError()
+        routed = None
+        if version is None:
+            # canary slice: a deterministic fraction of default traffic
+            # is pinned to the candidate version (lifecycle.py); explicit
+            # versions bypass routing — the client asked for THAT one
+            routed = self.canaries.route(name)
+        try:
+            entry = self.registry.get(
+                name, routed if version is None else version
+            )  # KeyError for unknowns
+        except KeyError:
+            if routed is None:
+                raise
+            # the canary rolled back between route and resolve: this is
+            # default traffic — serve it from the (stable) latest
+            entry = self.registry.get(name)
         breaker = self._breaker_for(name)
         if breaker.state == CircuitBreaker.OPEN:
             # fail fast at the door while the breaker cools: no queue
@@ -142,6 +303,12 @@ class GPServeServer:
             # probes are admitted (and accounted) in _execute.
             self.metrics.inc("shed.breaker")
             raise BreakerOpenError(name, breaker.reset_timeout_s)
+        try:
+            self.memory_gate.check(priority)
+        except MemoryPressureError:
+            self.metrics.inc("shed")
+            self.metrics.inc("queue.shed.memory")
+            raise
         # cast straight to the predictor's compiled dtype: one conversion
         # on the hot path, and _normalize's later asarray is then a no-op
         x = np.asarray(x, dtype=entry.predictor.dtype)
@@ -173,6 +340,7 @@ class GPServeServer:
             deadline=(
                 None if timeout_s is None else time.monotonic() + timeout_s
             ),
+            routed=routed is not None and entry.version == routed,
         )
         try:
             future = self._queue.submit(request)
@@ -214,13 +382,18 @@ class GPServeServer:
         it — so a model whose compiled predict is broken stops consuming
         batcher dispatches after ``breaker_threshold`` failures while
         every other model keeps serving."""
-        name = group[0].model_key[0]
+        name, version = group[0].model_key
         breaker = self._breaker_for(name)
+        # a canary candidate's failures must not poison the NAME-level
+        # breaker the stable version serves behind — its error budget is
+        # the canary controller's (rollback after max_errors), and the
+        # rollout bar is "zero failed requests on the stable version"
+        is_canary = self.canaries.is_candidate(name, version)
         # isolation re-runs are PAYLOAD probes of an already-counted batch
         # failure: gating/accounting them would multi-count one poisoned
         # episode, trip the breaker mid-loop, and error the innocent
         # batchmates still waiting their turn (queue.py isolation_retry)
-        guarded = not group[0].isolation_retry
+        guarded = not group[0].isolation_retry and not is_canary
         if guarded:
             try:
                 breaker.before_call()  # raises BreakerOpenError while open
@@ -228,7 +401,29 @@ class GPServeServer:
                 obs_trace.add_event("breaker.reject", model=name)
                 raise
         try:
-            entry = self.registry.resolve(group[0].model_key)
+            try:
+                entry = self.registry.resolve(group[0].model_key)
+            except KeyError:
+                if not self.canaries.is_quarantined(name, version) or not all(
+                    req.routed for req in group
+                ):
+                    # a client-PINNED version is a contract: serve that
+                    # one or fail.  (A mixed routed/pinned group fails
+                    # here as a batch; the queue's isolation pass then
+                    # re-runs each singly and the routed ones recover.)
+                    raise
+                # requests ROUTED to a canary that rolled back while they
+                # sat in the queue: this is default traffic — re-serve it
+                # from the stable latest instead of failing it on a
+                # version the client never asked for by name.  The stable
+                # dispatch re-enters the breaker gate it skipped at
+                # canary admission (a guarded=False re-serve would let
+                # repeated stable failures bypass all breaker accounting).
+                entry = self.registry.get(name)
+                is_canary = False
+                if not group[0].isolation_retry:
+                    breaker.before_call()  # BreakerOpenError rejects batch
+                    guarded = True
             rows = [req.x.shape[0] for req in group]
             total = sum(rows)
             x = (
@@ -244,15 +439,29 @@ class GPServeServer:
                 breaker.abort_call()
             raise
         started = time.monotonic()
+        # the hang watchdog observes the dispatch from OUTSIDE this thread
+        # (which is exactly what wedges on a hang); a fired token means the
+        # futures were already failed and the worker replaced — this
+        # thread's outcome is void (lifecycle.py)
+        token = (
+            self._watchdog.begin(name, group)
+            if self._watchdog is not None else None
+        )
         try:
             with obs_trace.span(
                 "serve.predict", model=name, version=group[0].model_key[1],
                 rows=total, requests=len(group),
-                isolation_retry=not guarded,
+                isolation_retry=group[0].isolation_retry,
             ):
                 mean, var = entry.predict(x)
         except BaseException:
+            if token is not None:
+                self._watchdog.end(token)
+                if token.fired:
+                    return  # already adjudicated as hung; stale outcome
             self.metrics.inc("predict.failures")
+            if is_canary:
+                self.canaries.observe_error(name, entry.version)
             if guarded:
                 trips_before = breaker.trip_count
                 breaker.record_failure()
@@ -261,12 +470,35 @@ class GPServeServer:
                     self.metrics.set_gauge(f"breaker.open.{name}", 1.0)
                     obs_trace.add_event("breaker.open", model=name)
             raise
+        if token is not None:
+            self._watchdog.end(token)
+            if token.fired:
+                return  # the watchdog answered for us; do not double-set
         if guarded:
             was_broken = breaker.state != CircuitBreaker.CLOSED
             breaker.record_success()
             self.metrics.set_gauge(f"breaker.open.{name}", 0.0)
             if was_broken:
                 obs_trace.add_event("breaker.close", model=name)
+        if is_canary:
+            # shadow-score against the incumbent on the same rows, then
+            # let the controller adjudicate (promote / rollback) — on
+            # this thread, so a verdict is in force before the next batch.
+            # The scoring dispatch gets its OWN watchdog token: an
+            # incumbent that wedges here would otherwise pin the batcher
+            # with no outstanding token — the exact hole the watchdog
+            # exists to close.
+            token = (
+                self._watchdog.begin(name, group, phase="shadow")
+                if self._watchdog is not None else None
+            )
+            try:
+                self.canaries.observe_success(name, entry.version, x, mean)
+            finally:
+                if token is not None:
+                    self._watchdog.end(token)
+            if token is not None and token.fired:
+                return  # futures already failed, worker already replaced
         elapsed = time.monotonic() - started
         padded = entry.predictor.padded_rows(total)
         self.metrics.inc("batches")
@@ -279,16 +511,68 @@ class GPServeServer:
         now = time.monotonic()
         offset = 0
         for req, t in zip(group, rows):
-            req.future.set_result(
-                (
-                    mean[offset : offset + t],
-                    None if var is None else var[offset : offset + t],
+            if not req.future.done():  # a hang verdict may have answered
+                req.future.set_result(
+                    (
+                        mean[offset : offset + t],
+                        None if var is None else var[offset : offset + t],
+                    )
                 )
-            )
             offset += t
             self.metrics.observe("request_latency_s", now - req.enqueued_at)
 
+    def _on_hang(self, token) -> None:
+        """Watchdog verdict (runs on the WATCHDOG thread — the batcher is
+        the thing that is wedged): fail the stuck batch with
+        ``code=exec.hung``, trip the model's breaker so further dispatches
+        are rejected at the door, and replace the batcher worker so every
+        other model's queued work starts moving again."""
+        name = token.model
+        version = token.group[0].model_key[1]
+        self.metrics.inc("exec.hung")
+        self.metrics.inc("lifecycle.watchdog_trips")
+        if token.phase != "shadow" and self.canaries.is_candidate(
+            name, version
+        ):
+            # a hung CANDIDATE counts against the canary error budget
+            # (enough of them roll it back), never the name-level breaker
+            # the stable version serves behind — same isolation as the
+            # raising-canary path in _execute.  A "shadow" token is the
+            # opposite party: the wedged call is the INCUMBENT's scoring
+            # predict — blaming the (healthy, already-answered) candidate
+            # would roll back every redeploy while the broken incumbent
+            # kept serving, so that case falls through to the breaker.
+            self.canaries.observe_error(name, version)
+        else:
+            breaker = self._breaker_for(name)
+            trips_before = breaker.trip_count
+            breaker.trip()
+            if breaker.trip_count > trips_before:
+                self.metrics.inc("breaker.trips")
+                self.metrics.set_gauge(f"breaker.open.{name}", 1.0)
+        error = ExecHungError(name, self._watchdog.hang_timeout_s)
+        for req in token.group:
+            if not req.future.done():
+                req.future.set_error(error)
+        self.metrics.inc("predict.failures")
+        self._queue.replace_worker()
+
     # -- introspection ----------------------------------------------------
+    def lifecycle_snapshot(self) -> dict:
+        """The lifecycle layer's state in one dict (health verb + CLI)."""
+        return {
+            "state": self._state,
+            "draining": self._state == "draining",
+            "drain_deadline_s": self._drain_deadline_s,
+            "watchdog": {
+                "enabled": self._watchdog is not None,
+                "hang_timeout_s": self._hang_timeout_s,
+                "trips": 0 if self._watchdog is None else self._watchdog.trips,
+            },
+            "memory": self.memory_gate.snapshot(),
+            "canary": self.canaries.snapshot(),
+        }
+
     def snapshot(self) -> dict:
         snap = self.metrics.snapshot()
         snap["models"] = self.registry.describe()
@@ -302,6 +586,7 @@ class GPServeServer:
             # copy first: reader threads insert breakers concurrently
             name: b.snapshot() for name, b in sorted(dict(self._breakers).items())
         }
+        snap["lifecycle"] = self.lifecycle_snapshot()
         return snap
 
     def openmetrics(self) -> str:
@@ -329,9 +614,11 @@ class GPServeServer:
 
         ``status``: ``"ok"`` (ready, all breakers closed),
         ``"degraded"`` (serving, but at least one model's breaker is
-        open/half-open or the queue is above 90% capacity) or
-        ``"unready"`` (not started / no models).  A degraded server still
-        answers requests for its healthy models — that is the point.
+        open/half-open, the queue is above 90% capacity, or the memory
+        gate is shedding), ``"draining"`` (shutdown in progress: finish
+        queued work, route new traffic elsewhere) or ``"unready"`` (not
+        started / no models).  A degraded server still answers requests
+        for its healthy models — that is the point.
         """
         breakers = {
             # copy first: reader threads insert breakers concurrently
@@ -343,9 +630,12 @@ class GPServeServer:
             name for name, b in breakers.items()
             if b["state"] != CircuitBreaker.CLOSED
         )
-        if not self.ready():
+        lifecycle = self.lifecycle_snapshot()
+        if lifecycle["draining"]:
+            status = "draining"
+        elif not self.ready():
             status = "unready"
-        elif broken or queue_pressure > 0.9:
+        elif broken or queue_pressure > 0.9 or lifecycle["memory"]["shedding"]:
             status = "degraded"
         else:
             status = "ok"
@@ -373,6 +663,7 @@ class GPServeServer:
             "models": self.registry.names(),
             "broken_models": broken,
             "breakers": breakers,
+            "lifecycle": lifecycle,
             "queue": {
                 "depth": depth,
                 "capacity": self._queue.capacity,
@@ -383,8 +674,9 @@ class GPServeServer:
                 for key in (
                     "requests", "batches", "shed", "timeouts",
                     "queue.shed.deadline", "queue.shed.backpressure",
+                    "queue.shed.draining", "queue.shed.memory",
                     "queue.poisoned", "shed.breaker", "shed.poison",
-                    "predict.failures", "breaker.trips",
+                    "predict.failures", "breaker.trips", "exec.hung",
                 )
             },
         }

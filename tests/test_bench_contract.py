@@ -148,6 +148,19 @@ def test_bench_emits_one_parseable_result_line():
     assert mh["checkpoint_save_us"]["uncoordinated"] > 0
     assert mh["checkpoint_save_us"]["coordinated_2host"] > 0
     assert np.isfinite(mh["coordinated_ckpt_overhead_ratio"])
+    # the serve lifecycle contract (serve/lifecycle.py): a canary rollout
+    # under a closed-loop client is a ZERO-downtime swap (no failed
+    # requests, auto-promoted), and a drain answers the whole queued
+    # burst before stopping
+    lc = detail["lifecycle"]
+    assert "error" not in lc, lc
+    assert lc["rollout_promoted"] is True, lc
+    assert lc["rollout_failed_requests"] == 0, lc
+    assert lc["rollout_requests_ok"] > 0
+    assert lc["canary_shadow_scores"] >= 5
+    assert lc["drain_seconds"] > 0
+    assert lc["drained_clean"] is True, lc
+    assert lc["drain_burst_answered"] == lc["drain_burst_requests"], lc
 
 
 @pytest.mark.slow

@@ -1,0 +1,658 @@
+"""Serve lifecycle hardening (spark_gp_tpu/serve/lifecycle.py): graceful
+drain, canary rollout with auto-rollback, hang watchdog, memory-pressure
+admission, bounded registry retention.
+
+The ISSUE 7 acceptance proofs live here (plus the CLI drain proof at the
+real process boundary):
+(a) drain completes in-flight work and rejects new submits with
+    ``code=queue.shed.draining``;
+(b) a chaos-hung predict trips the watchdog within its hang deadline
+    while the other model keeps answering;
+(c) a guard-breaching canary auto-rolls back with zero failed requests
+    on the stable version;
+(d) eviction actually frees the retired version's compiled bucket cache.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
+from spark_gp_tpu.resilience.chaos import FlakyPredictor, hang_model
+from spark_gp_tpu.serve import (
+    CanaryPolicy,
+    DrainingError,
+    ExecHungError,
+    GPServeServer,
+    MemoryAdmissionGate,
+    MemoryPressureError,
+    ModelRegistry,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def two_models(tmp_path_factory):
+    def fit(seed):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(120, 3))
+        y = np.sin(x.sum(axis=1))
+        return (
+            GaussianProcessRegression()
+            .setKernel(lambda: RBFKernel(1.0))
+            .setDatasetSizeForExpert(30).setActiveSetSize(30)
+            .setMaxIter(5).setSeed(seed).fit(x, y)
+        ), x
+
+    d = tmp_path_factory.mktemp("lifecycle")
+    model_a, x = fit(1)
+    model_b, _ = fit(7)
+    pa, pb = str(d / "a.npz"), str(d / "b.npz")
+    model_a.save(pa)
+    model_b.save(pb)
+    return pa, pb, x
+
+
+def _server(**kw):
+    defaults = dict(max_batch=16, min_bucket=8, max_wait_ms=1.0)
+    defaults.update(kw)
+    return GPServeServer(**defaults)
+
+
+# -- graceful drain --------------------------------------------------------
+
+
+def test_drain_completes_inflight_and_rejects_new(two_models):
+    pa, _, x = two_models
+    server = _server(request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    futs = [server.submit("m", x[i : i + 3]) for i in range(12)]
+
+    server.begin_drain()
+    health = server.health()
+    assert health["status"] == "draining"
+    assert health["lifecycle"]["draining"]
+
+    with pytest.raises(DrainingError) as exc:
+        server.submit("m", x[:3])
+    assert exc.value.code == "queue.shed.draining"
+    assert server.metrics.counter("queue.shed.draining") == 1
+
+    assert server.drain(deadline_s=30.0) is True
+    # every in-flight/queued request completed with an ANSWER, not an error
+    for fut in futs:
+        mean, var = fut.result(timeout=0.1)
+        assert np.isfinite(mean).all() and len(mean) == 3
+    assert server.metrics.counter("lifecycle.drains") == 1
+    assert server.health()["lifecycle"]["state"] == "stopped"
+    hist = server.metrics.histogram("lifecycle.drain_s")
+    assert hist is not None and hist.snapshot()["count"] == 1
+
+
+def test_drain_past_deadline_fails_leftovers_fast(two_models):
+    pa, _, x = two_models
+    # max_batch 8 and 8-row requests: one request per dispatch, so the
+    # backlog is 8 SERIAL slow dispatches the tiny deadline cannot cover
+    # (smaller requests would coalesce into one batch and all complete)
+    server = _server(max_batch=8, request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    entry = server.registry.get("m")
+    entry.predictor = FlakyPredictor(entry.predictor, latency_s=0.25)
+    futs = [server.submit("m", x[:8]) for _ in range(8)]
+    # far too short for 8 serial 0.25s dispatches: the drain must give up
+    # at the deadline and fail the leftovers instead of blocking forever
+    assert server.drain(deadline_s=0.05) is False
+    outcomes = []
+    for fut in futs:
+        try:
+            fut.result(timeout=5.0)
+            outcomes.append("ok")
+        except RuntimeError:
+            outcomes.append("failed")
+    assert "failed" in outcomes  # leftovers were NOT silently completed
+
+
+# -- hang watchdog ---------------------------------------------------------
+
+
+def test_watchdog_trips_hung_model_while_other_keeps_serving(two_models):
+    pa, pb, x = two_models
+    server = _server(
+        hang_timeout_s=0.25, breaker_reset_s=30.0, request_timeout_ms=None
+    )
+    server.register("hang", pa)
+    server.register("ok", pb)
+    server.start()
+
+    def timed_ok_predicts(k=5):
+        samples = []
+        for _ in range(k):
+            t0 = time.monotonic()
+            mean, _ = server.predict("ok", x[:4], timeout_ms=5000)
+            samples.append(time.monotonic() - t0)
+            assert np.isfinite(mean).all() and len(mean) == 4
+        return sorted(samples)
+
+    clean = timed_ok_predicts()  # model B's clean baseline, same process
+    hanging = hang_model(server, "hang", hang_forever=True, max_block_s=30.0)
+    try:
+        t0 = time.monotonic()
+        fut = server.submit("hang", x[:4])
+        with pytest.raises(ExecHungError) as exc:
+            fut.result(timeout=5.0)
+        # the verdict came from the WATCHDOG near its deadline — not from a
+        # request deadline (disabled here) and not after the full block
+        assert time.monotonic() - t0 < 3.0
+        assert exc.value.code == "exec.hung"
+        assert hanging.hung == 1
+
+        # the model's breaker tripped: rejected at the door, no dispatch
+        with pytest.raises(BreakerOpenError):
+            server.submit("hang", x[:4])
+        assert server.metrics.counter("exec.hung") == 1
+        assert server.metrics.counter("lifecycle.watchdog_trips") == 1
+        assert server.metrics.counter("breaker.trips") == 1
+
+        # the OTHER model keeps serving: the replacement worker dispatches
+        # even though the hung thread is still parked in the device call —
+        # and its tail latency stays within 2x its clean baseline (plus a
+        # small absolute floor so a shared-CI scheduling blip cannot flake
+        # a sub-millisecond comparison)
+        after = timed_ok_predicts()
+        assert after[-1] <= max(2.0 * clean[-1], 0.25), (clean, after)
+
+        health = server.health()
+        assert health["status"] == "degraded"
+        assert health["broken_models"] == ["hang"]
+        assert health["lifecycle"]["watchdog"]["trips"] == 1
+    finally:
+        hanging.release()
+        server.stop()
+
+
+def test_released_hang_does_not_double_answer(two_models):
+    """The stale dispatch eventually returns AFTER the watchdog answered:
+    its futures are already failed, its breaker outcome is void — nothing
+    may double-set or close the tripped breaker."""
+    pa, _, x = two_models
+    server = _server(
+        hang_timeout_s=0.2, breaker_reset_s=30.0, request_timeout_ms=None
+    )
+    server.register("m", pa)
+    server.start()
+    hanging = hang_model(server, "m", hang_first=1, max_block_s=30.0)
+    try:
+        fut = server.submit("m", x[:4])
+        with pytest.raises(ExecHungError):
+            fut.result(timeout=5.0)
+        hanging.release()  # the wedged thread now unwinds with a SUCCESS
+        time.sleep(0.3)
+        # the stale success must not have closed the watchdog-tripped breaker
+        assert server._breaker_for("m").state == CircuitBreaker.OPEN
+        with pytest.raises(ExecHungError):
+            fut.result(timeout=0.1)  # still the hang verdict, not a result
+    finally:
+        hanging.release()
+        server.stop()
+
+
+# -- canary rollout --------------------------------------------------------
+
+
+def test_clean_canary_auto_promotes(two_models):
+    pa, _, x = two_models
+    server = _server(request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    try:
+        entry = server.rollout(
+            "m",
+            canary_policy=CanaryPolicy(fraction=0.5, promote_after=3),
+        )
+        assert entry.version == 2
+        # candidate is NOT the default yet: the latest pointer stays put
+        assert server.registry.get("m").version == 1
+        assert server.health()["lifecycle"]["canary"]["active"]["m"][
+            "candidate"
+        ] == 2
+
+        for i in range(12):
+            mean, _ = server.predict("m", x[i : i + 3], timeout_ms=5000)
+            assert np.isfinite(mean).all()
+            if server.registry.get("m").version == 2:
+                break
+        assert server.registry.get("m").version == 2  # promoted
+        assert server.metrics.counter("canary.promotions") == 1
+        assert server.metrics.counter("canary.shadow_scores") >= 3
+        assert server.canaries.active("m") is None
+        # the predecessor survives bounded retention (max_versions=2)
+        assert server.registry.get("m", 1).version == 1
+    finally:
+        server.stop()
+
+
+def test_guard_breaching_canary_rolls_back_zero_stable_failures(two_models):
+    pa, pb, x = two_models
+    server = _server(request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    try:
+        server.rollout("m", pb, canary_fraction=0.5)  # a DIFFERENT model
+        failed = 0
+        for i in range(10):
+            try:
+                mean, _ = server.predict("m", x[i : i + 3], timeout_ms=5000)
+                assert np.isfinite(mean).all()
+            except Exception:  # noqa: BLE001 — counting is the assertion
+                failed += 1
+        # the breach rolled the candidate back...
+        assert server.metrics.counter("canary.breaches") >= 1
+        assert server.metrics.counter("canary.rollbacks") == 1
+        assert server.registry.get("m").version == 1
+        with pytest.raises(KeyError):
+            server.registry.get("m", 2)  # retired + released
+        assert "m:2" in server.canaries.snapshot()["quarantined"]
+        # ...and NOT ONE request failed: the canary slice was answered by
+        # the (working) candidate before the verdict, the rest by stable
+        assert failed == 0
+    finally:
+        server.stop()
+
+
+def test_erroring_canary_rolls_back_without_tripping_stable_breaker(two_models):
+    pa, _, x = two_models
+    server = _server(request_timeout_ms=None, breaker_threshold=2)
+    server.register("m", pa)
+    server.start()
+    try:
+        entry = server.rollout(
+            "m",
+            canary_policy=CanaryPolicy(
+                fraction=1.0, max_errors=2, promote_after=100
+            ),
+        )
+        broken = server.registry.get("m", entry.version)
+        broken.predictor = FlakyPredictor(broken.predictor, fail_forever=True)
+        errors = 0
+        for i in range(6):
+            try:
+                server.predict("m", x[i : i + 3], timeout_ms=5000)
+            except RuntimeError:
+                errors += 1
+        assert errors == 2  # exactly the canary error budget
+        assert server.metrics.counter("canary.rollbacks") == 1
+        assert server.registry.get("m").version == 1
+        # candidate failures never counted against the NAME-level breaker
+        # the stable version serves behind
+        assert server._breaker_for("m").state == CircuitBreaker.CLOSED
+        mean, _ = server.predict("m", x[:3], timeout_ms=5000)
+        assert np.isfinite(mean).all()
+    finally:
+        server.stop()
+
+
+def test_hung_canary_rolls_back_without_tripping_stable_breaker(two_models):
+    """A WEDGED candidate (not merely raising) counts against the canary
+    error budget, never the name-level breaker — a hung canary must not
+    shed stable traffic."""
+    pa, _, x = two_models
+    server = _server(hang_timeout_s=0.2, request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    entry = server.rollout(
+        "m",
+        canary_policy=CanaryPolicy(fraction=1.0, max_errors=1, promote_after=100),
+    )
+    hanging = hang_model(
+        server, "m", version=entry.version, hang_forever=True, max_block_s=30.0
+    )
+    try:
+        fut = server.submit("m", x[:3])  # fraction 1.0: routed to candidate
+        with pytest.raises(ExecHungError):
+            fut.result(timeout=5.0)
+        assert server.metrics.counter("canary.rollbacks") == 1
+        assert server._breaker_for("m").state == CircuitBreaker.CLOSED
+        mean, _ = server.predict("m", x[:3], timeout_ms=5000)  # stable serves
+        assert np.isfinite(mean).all()
+    finally:
+        hanging.release()
+        server.stop()
+
+
+def test_queued_canary_requests_survive_rollback(two_models):
+    """Default-traffic requests pinned to the candidate while QUEUED are
+    re-served by the stable latest after a rollback, not failed on a
+    version the client never asked for."""
+    pa, pb, x = two_models
+    server = _server(request_timeout_ms=None)
+    server.register("m", pa)
+    entry = server.rollout(
+        "m", pb,
+        canary_policy=CanaryPolicy(fraction=1.0, max_errors=1, promote_after=100),
+    )
+    # server not started: every submit pins to the candidate and queues
+    futs = [server.submit("m", x[i : i + 3]) for i in range(4)]
+    # one request EXPLICITLY pinned to the candidate version: that is a
+    # contract ("serve THAT one or fail"), not re-routable default traffic
+    pinned = server.submit("m", x[:3], version=entry.version)
+    server.canaries.observe_error("m", entry.version)  # rollback NOW
+    assert server.metrics.counter("canary.rollbacks") == 1
+    server.start()
+    try:
+        for fut in futs:
+            mean, _ = fut.result(timeout=5.0)
+            assert np.isfinite(mean).all() and len(mean) == 3
+        with pytest.raises(KeyError):
+            pinned.result(timeout=5.0)
+    finally:
+        server.stop()
+
+
+def test_direct_reload_supersedes_active_canary(two_models):
+    """A plain reload during an active canary cancels the experiment
+    first — otherwise retention would evict the canary's incumbent and
+    the orphaned controller could later drag the latest pointer
+    backwards onto the stale candidate."""
+    pa, pb, x = two_models
+    server = _server(request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    try:
+        server.rollout(
+            "m",
+            canary_policy=CanaryPolicy(fraction=1.0, promote_after=100),
+        )
+        v3 = server.reload("m", pb)  # direct reload wins
+        assert server.canaries.active("m") is None
+        assert server.metrics.counter("canary.rollbacks") == 1
+        assert server.registry.get("m").version == v3.version == 3
+        with pytest.raises(KeyError):
+            server.registry.get("m", 2)  # the cancelled candidate is gone
+        mean, _ = server.predict("m", x[:3], timeout_ms=5000)
+        assert np.isfinite(mean).all()
+        assert server.registry.get("m").version == 3  # never dragged back
+    finally:
+        server.stop()
+
+
+def test_retired_incumbent_resolves_canary_by_promotion(two_models):
+    """An operator retiring the incumbent out from under an active canary
+    must not wedge the state machine: with nothing left to score against,
+    the candidate (the only version serving) is formally promoted."""
+    pa, _, x = two_models
+    server = _server(request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    try:
+        server.rollout(
+            "m",
+            canary_policy=CanaryPolicy(fraction=1.0, promote_after=100),
+        )
+        server.registry.retire("m", 1)
+        mean, _ = server.predict("m", x[:3], timeout_ms=5000)
+        assert np.isfinite(mean).all()
+        assert server.registry.get("m").version == 2
+        assert server.canaries.active("m") is None
+        assert server.metrics.counter("canary.promotions") == 1
+    finally:
+        server.stop()
+
+
+def test_replace_worker_after_stop_does_not_respawn(two_models):
+    """A hang verdict racing stop() must not repopulate the worker slot —
+    that would break the stop/start cycle (start() would see a live
+    thread and never clear the stopping flag)."""
+    pa, _, x = two_models
+    server = _server(request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    server.stop()
+    server._queue.replace_worker()  # the racing verdict's recovery call
+    assert server._queue._thread is None
+    server.start()  # the cycle still works
+    try:
+        mean, _ = server.predict("m", x[:3], timeout_ms=5000)
+        assert np.isfinite(mean).all()
+    finally:
+        server.stop()
+
+
+def test_second_rollout_while_canary_active_is_refused_and_released(two_models):
+    pa, pb, _ = two_models
+    server = _server(request_timeout_ms=None)
+    server.register("m", pa)
+    server.start()
+    try:
+        server.rollout(
+            "m", canary_policy=CanaryPolicy(fraction=0.5, promote_after=100)
+        )
+        with pytest.raises(ValueError, match="active canary"):
+            server.rollout("m", pb, canary_fraction=0.5)
+        # the refused candidate (v3) was retired, not leaked as an
+        # unroutable warmed entry
+        with pytest.raises(KeyError):
+            server.registry.get("m", 3)
+        assert server.canaries.active("m")["candidate"] == 2
+    finally:
+        server.stop()
+
+
+def test_hung_incumbent_during_shadow_scoring_blames_incumbent(two_models):
+    """When the INCUMBENT wedges during shadow scoring, the verdict must
+    land on it (name-level breaker), not roll back the healthy candidate
+    whose answer already succeeded — otherwise a broken incumbent would
+    kill every redeploy attempt while itself serving on."""
+    pa, _, x = two_models
+    server = _server(
+        hang_timeout_s=0.25, breaker_reset_s=30.0, request_timeout_ms=None
+    )
+    server.register("m", pa)
+    server.start()
+    server.rollout(
+        "m",
+        canary_policy=CanaryPolicy(
+            fraction=1.0, promote_after=100, max_errors=100
+        ),
+    )
+    hanging = hang_model(
+        server, "m", version=1, hang_forever=True, max_block_s=30.0
+    )
+    try:
+        fut = server.submit("m", x[:3])  # candidate answers, scoring wedges
+        with pytest.raises(ExecHungError):
+            fut.result(timeout=5.0)
+        assert server.metrics.counter("canary.rollbacks") == 0
+        assert server.canaries.active("m") is not None  # candidate survives
+        assert server._breaker_for("m").state == CircuitBreaker.OPEN
+    finally:
+        hanging.release()
+        server.stop()
+
+
+# -- bounded retention / eviction ------------------------------------------
+
+
+def test_eviction_frees_retired_bucket_cache(two_models):
+    pa, pb, _ = two_models
+    reg = ModelRegistry(max_batch=16, min_bucket=8, max_versions=1)
+    v1 = reg.register("m", pa)
+    old_predictor = v1.predictor
+    assert old_predictor.released is False
+    v2 = reg.reload("m", pb)
+    assert reg.metrics.counter("registry.evictions") == 1
+    assert old_predictor.released is True
+    assert old_predictor._jit is None and old_predictor._theta is None
+    with pytest.raises(RuntimeError, match="released"):
+        v1.predict(np.zeros((2, 3)))
+    assert reg.get("m") is v2
+    with pytest.raises(KeyError):
+        reg.get("m", 1)
+
+
+def test_release_defers_free_until_inflight_predict_finishes(two_models):
+    """Eviction racing an in-flight predict: the hot-swap invariant says
+    a batch that already resolved the version completes against its warm
+    executables — release refuses NEW predicts immediately but frees the
+    compiled surface only when the last in-flight call exits."""
+    pa, _, x = two_models
+    reg = ModelRegistry(max_batch=16, min_bucket=8)
+    predictor = reg.register("m", pa).predictor
+
+    started, resume = threading.Event(), threading.Event()
+    original = predictor._normalize
+
+    def gated_normalize(x_test):  # runs AFTER the refcount is taken
+        started.set()
+        assert resume.wait(10.0)
+        return original(x_test)
+
+    predictor._normalize = gated_normalize
+    results = []
+    worker = threading.Thread(
+        target=lambda: results.append(predictor.predict(x[:4])), daemon=True
+    )
+    worker.start()
+    assert started.wait(5.0)
+    predictor.release()  # mid-flight eviction
+    assert predictor.released and predictor._jit is not None  # deferred
+    resume.set()
+    worker.join(10.0)
+    mean, var = results[0]
+    assert np.isfinite(mean).all() and len(mean) == 4  # in-flight survived
+    assert predictor._jit is None  # ...and the free ran right after
+    with pytest.raises(RuntimeError, match="released"):
+        predictor.predict(x[:4])
+
+
+def test_stop_after_begin_drain_clears_draining_gauge(two_models):
+    pa, _, _ = two_models
+    server = _server()
+    server.register("m", pa)
+    server.start()
+    server.begin_drain()
+    server.stop()
+    assert server.metrics.snapshot()["gauges"]["lifecycle.draining"] == 0.0
+
+
+def test_retire_repoints_latest_and_releases(two_models):
+    pa, pb, _ = two_models
+    reg = ModelRegistry(max_batch=16, min_bucket=8, max_versions=4)
+    reg.register("m", pa)
+    v2 = reg.reload("m", pb)
+    assert reg.get("m") is v2
+    assert reg.retire("m", 2) is True
+    assert reg.get("m").version == 1  # latest repointed to the survivor
+    assert v2.predictor.released is True
+    assert reg.retire("m", 9) is False
+
+
+# -- memory-pressure admission ---------------------------------------------
+
+
+def test_memory_gate_hysteresis_and_priority_floor():
+    usage = {"bytes": 50.0}
+    gate = MemoryAdmissionGate(
+        limit_bytes=100.0, high_watermark=0.9, low_watermark=0.5,
+        sample_interval_s=0.0, sampler=lambda: usage["bytes"],
+    )
+    gate.check(priority=0)  # healthy: admitted
+
+    usage["bytes"] = 95.0  # past the high watermark: shed low priority
+    with pytest.raises(MemoryPressureError) as exc:
+        gate.check(priority=0)
+    assert exc.value.code == "queue.shed.memory"
+    gate.check(priority=1)  # at the floor: still admitted
+
+    usage["bytes"] = 70.0  # between the watermarks: hysteresis holds shed
+    with pytest.raises(MemoryPressureError):
+        gate.check(priority=0)
+
+    usage["bytes"] = 40.0  # under the low watermark: automatic recovery
+    gate.check(priority=0)
+    snap = gate.snapshot()
+    assert snap["shedding"] is False and snap["sheds"] == 2
+
+
+def test_server_sheds_on_memory_pressure_with_code(two_models):
+    pa, _, x = two_models
+    server = _server(request_timeout_ms=None)
+    usage = {"bytes": 95.0}
+    server.memory_gate = MemoryAdmissionGate(
+        limit_bytes=100.0, high_watermark=0.9, low_watermark=0.5,
+        sample_interval_s=0.0, sampler=lambda: usage["bytes"],
+    )
+    server.register("m", pa)
+    server.start()
+    try:
+        with pytest.raises(MemoryPressureError):
+            server.submit("m", x[:3])
+        assert server.metrics.counter("queue.shed.memory") == 1
+        health = server.health()
+        assert health["status"] == "degraded"
+        assert health["lifecycle"]["memory"]["shedding"] is True
+        # priority >= the floor is what "shed the LOWEST-priority work" means
+        mean, _ = server.submit("m", x[:3], priority=1).result(timeout=5.0)
+        assert np.isfinite(mean).all()
+        usage["bytes"] = 40.0
+        mean, _ = server.submit("m", x[:3]).result(timeout=5.0)  # recovered
+        assert np.isfinite(mean).all()
+    finally:
+        server.stop()
+
+
+# -- the CLI drain proof (real process boundary) ---------------------------
+
+
+def test_cli_sigterm_drains_and_exits_zero(two_models):
+    pa, _, x = two_models
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spark_gp_tpu.serve",
+         "--model", f"m={pa}", "--drain-deadline-s", "20"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env, start_new_session=True,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["event"] == "ready"
+        rows = x[:3].tolist()
+        for i in (1, 2):
+            proc.stdin.write(json.dumps({"id": i, "model": "m", "x": rows}) + "\n")
+        proc.stdin.flush()
+        answers = [json.loads(proc.stdout.readline()) for _ in (1, 2)]
+        # in-flight work answered; stdin stays OPEN — the exit below is
+        # the signal path, not EOF
+        assert all("mean" in a for a in answers), answers
+        # a canary reload whose load+warmup is (likely) still compiling on
+        # its side thread when the signal lands: the drain exit must not
+        # abort in native code under interpreter finalization (regression
+        # — "terminate called without an active exception")
+        proc.stdin.write(json.dumps(
+            {"cmd": "reload", "model": "m", "canary_fraction": 0.5}
+        ) + "\n")
+        proc.stdin.flush()
+        time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    except Exception:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.communicate()
+        raise
+    assert proc.returncode == 0, err[-800:]
+    events = [json.loads(ln) for ln in out.strip().splitlines() if ln.strip()]
+    shutdown = next(e for e in events if e.get("event") == "shutdown")
+    assert shutdown["drained"] is True
+    assert shutdown["requests"] >= 2

@@ -326,6 +326,69 @@ def test_run_journals_do_not_clobber_across_fits(tmp_path):
     assert all(os.path.exists(p) for p in paths)
 
 
+def test_artifact_retention_prunes_oldest_per_pattern(tmp_path):
+    """GP_ARTIFACT_RETENTION=K keeps the newest K run journals AND host
+    checkpoints (per pattern, by mtime); unrelated files are untouched."""
+    from spark_gp_tpu.obs.runtime import prune_artifacts
+
+    def touch(name, age_s):
+        path = tmp_path / name
+        path.write_text("{}")
+        stamp = 1_700_000_000 - age_s
+        os.utime(path, (stamp, stamp))
+        return path
+
+    journals = [touch(f"run_journal_gp-{i}.json", age_s=i * 60) for i in range(4)]
+    states = [touch(f"lbfgs_state_tag{i}.json", age_s=i * 60) for i in range(3)]
+    keeper = touch("model.npz", age_s=9999)  # not an artifact pattern
+
+    removed = prune_artifacts(str(tmp_path), keep=2)
+    assert removed == 3  # 2 old journals + 1 old checkpoint
+    assert [p.exists() for p in journals] == [True, True, False, False]
+    assert [p.exists() for p in states] == [True, True, False]
+    assert keeper.exists()
+
+
+def test_artifact_retention_protects_fresh_write_on_mtime_tie(tmp_path):
+    """mtime has filesystem-tick granularity: a same-tick neighbor whose
+    name sorts higher must not win the tiebreak against the artifact the
+    GC was invoked FOR (regression — keep=1 deleted the just-written
+    journal and kept a stale lexically-larger one)."""
+    from spark_gp_tpu.obs.runtime import prune_artifacts
+
+    fresh = tmp_path / "run_journal_aaa-fresh.json"
+    stale = tmp_path / "run_journal_zzz-stale.json"
+    for path in (stale, fresh):
+        path.write_text("{}")
+        os.utime(path, (1_700_000_000, 1_700_000_000))  # identical tick
+
+    assert prune_artifacts(str(tmp_path), keep=1, protect=str(fresh)) == 1
+    assert fresh.exists() and not stale.exists()
+
+
+def test_artifact_retention_is_opt_in_via_env(tmp_path, monkeypatch):
+    from spark_gp_tpu.obs.runtime import prune_artifacts, write_run_journal
+
+    monkeypatch.delenv("GP_ARTIFACT_RETENTION", raising=False)
+    for i in range(3):
+        (tmp_path / f"run_journal_old-{i}.json").write_text("{}")
+    assert prune_artifacts(str(tmp_path)) == 0  # unset: operator-managed
+
+    monkeypatch.setenv("GP_ARTIFACT_RETENTION", "nonsense")
+    assert prune_artifacts(str(tmp_path)) == 0  # invalid: disabled, no raise
+
+    # the journal writer applies retention after each persist
+    monkeypatch.setenv("GP_ARTIFACT_RETENTION", "1")
+    instr = Instrumentation(name="RetentionProbe")
+    with trace.span("fit.RetentionProbe") as root:
+        pass
+    journal = write_run_journal(instr, root, None, journal_dir=str(tmp_path))
+    survivors = sorted(
+        p for p in os.listdir(tmp_path) if p.startswith("run_journal_")
+    )
+    assert survivors == [os.path.basename(journal["path"])]
+
+
 def test_openmetrics_pattern_collapses_to_label():
     page = expo.render_openmetrics(_exercised_metrics())
     families = _parse_openmetrics(page)
