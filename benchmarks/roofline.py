@@ -32,11 +32,12 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_gp_tpu.utils.subproc import run_captured  # noqa: E402
 
 # chip peaks and precision-pass costs: ONE importable home shared with
 # bench.py so the two can never disagree about a chip's peak
@@ -120,10 +121,19 @@ def measure(precision: str) -> dict:
         "total_points": TOTAL_POINTS,
     }
 
-    # calibration: one big matmul at this precision — the stack's ceiling
+    # calibration: one big matmul at this precision — the stack's ceiling.
+    # The precision is passed EXPLICITLY from the policy resolution
+    # (ops/precision.matmul_precision reads GP_MATMUL_PRECISION at trace
+    # time): a bare `u @ v` ignores the knob entirely and runs 1-pass
+    # bf16, so the 'highest' lane was reporting bf16 throughput against
+    # the 6-pass ceiling — a ~6x flattering calibration row.
+    from spark_gp_tpu.ops.precision import matmul_precision
+
     dim = 4096
     a = jnp.asarray(np.random.default_rng(0).normal(size=(dim, dim)), jnp.float32)
-    mm = jax.jit(lambda u, v: u @ v)
+    mm = jax.jit(
+        lambda u, v: jnp.matmul(u, v, precision=matmul_precision())
+    )
     secs = _timed(mm, a, a)
     report["calibration_matmul_4096"] = _row(
         f"matmul {dim}^3 f32 (trace-time precision={precision})",
@@ -201,23 +211,29 @@ def _run_child(precision: str) -> dict:
     subprocess and the parent NEVER touches jax: the precision knob is
     trace-time (a fresh process is the only clean full retrace), and libtpu
     is single-process-exclusive — a parent holding the chip would doom
-    every child to an init failure."""
+    every child to an init failure.
+
+    Runs through utils.subproc.run_captured, NOT subprocess.run: run()'s
+    timeout path drains the killed child's pipes with an UNBOUNDED
+    communicate(), so a tunnel helper process inheriting the pipe write
+    ends would wedge a standalone roofline run past its own fence (the
+    exact hazard bench.py's supervisor already defends against)."""
     env = dict(os.environ)
     env["GP_MATMUL_PRECISION"] = precision
     # 600s default: both lanes must fit inside bench.py's outer
     # BENCH_ROOFLINE_TIMEOUT=1500s fence with slack
-    child = subprocess.run(
+    child = run_captured(
         [sys.executable, os.path.abspath(__file__), "--child"],
-        capture_output=True, text=True,
-        timeout=float(os.environ.get("ROOFLINE_CHILD_TIMEOUT", 600)), env=env,
+        float(os.environ.get("ROOFLINE_CHILD_TIMEOUT", 600)), env=env,
     )
     for line in reversed(child.stdout.strip().splitlines()):
         try:
             return json.loads(line)
         except ValueError:
             continue
+    status = "timed out" if child.timed_out else f"rc={child.returncode}"
     raise RuntimeError(
-        f"no JSON from {precision} lane (rc={child.returncode}): "
+        f"no JSON from {precision} lane ({status}): "
         + (child.stderr or "")[-300:]
     )
 

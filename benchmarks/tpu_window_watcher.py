@@ -61,6 +61,28 @@ def _probe_tpu(timeout_s: float = 90.0) -> bool:
     return (not r.timed_out) and r.stdout.strip().endswith("tpu")
 
 
+def _bench_fence_s() -> float:
+    """Bench-lane fence sized from the knobs bench.py actually honors,
+    instead of a hardcoded 4500 s that happened to equal the defaults
+    with ZERO slack (an operator raising BENCH_WORKER_TIMEOUT would have
+    silently had the watcher kill a healthy bench mid-measurement).
+
+    Budget: every preflight attempt of BOTH plans — the default plan's
+    ``pf_attempts`` (with bench.py's linear 15 s-per-attempt backoff
+    sleeps between them) plus the CPU-fallback plan's single attempt —
+    TWO worker runs (both plans run when the first fails), the
+    post-worker roofline, and a fixed supervisor/IO margin."""
+    pf_timeout = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", 150))
+    pf_attempts = int(os.environ.get("BENCH_PREFLIGHT_ATTEMPTS", 4))
+    worker = float(os.environ.get("BENCH_WORKER_TIMEOUT", 2400))
+    roofline = float(os.environ.get("BENCH_ROOFLINE_TIMEOUT", 1500))
+    backoff = 15.0 * pf_attempts * (pf_attempts - 1) / 2.0
+    return (
+        (pf_attempts + 1) * pf_timeout + backoff + 2.0 * worker
+        + roofline + 300.0
+    )
+
+
 def _run(cmd, out_path, timeout_s, env=None):
     stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
     # run_captured, not subprocess.run: run()'s post-kill pipe drain is
@@ -129,8 +151,8 @@ def capture_window(note) -> bool:
 
     Lane order is deliberate: bench first (it lands the round's headline
     number, warms the persistent compile cache, and appends the
-    post-worker roofline; its 4500s fence = worker watchdog 2400 +
-    roofline 1500 + preflight with slack, and it prints the primary line
+    post-worker roofline; its fence is derived from the constituent
+    timeout knobs — ``_bench_fence_s`` — and it prints the primary line
     early so even a fence trip salvages the measurement), then the
     Mosaic + on-chip-quality tests (VERDICT r4 #2), the matched-config
     and large-m lanes (r4 #3/#4), and the Pallas sweep last.
@@ -141,7 +163,7 @@ def capture_window(note) -> bool:
     tenv["GP_TEST_PLATFORM"] = "tpu"
     lanes = [
         ([sys.executable, "bench.py"],
-         "TPU_WINDOW_BENCH.json", 4500, env, "bench"),
+         "TPU_WINDOW_BENCH.json", _bench_fence_s(), env, "bench"),
         ([sys.executable, "-m", "pytest",
           "tests/test_pallas_linalg.py",
           "tests/test_tpu_quality_slice.py", "-q"],

@@ -18,7 +18,21 @@ under JAX tracing and would forfeit autodiff.  Here a kernel is an immutable
   ``lax.Precision`` (enforced by ``tools/check_precision_pins.py``);
 * derivatives w.r.t. ``theta`` come from autodiff — there is no analogue of
   ``trainingKernelAndDerivative``'s hand algebra to maintain (the reference's
-  finite-difference kernel tests are kept as oracles in ``tests/``).
+  finite-difference kernel tests are kept as oracles in ``tests/``);
+* the **theta-invariant precompute plane**: kernels whose Gram matrix
+  factors through a theta-independent structure (the squared-distance
+  block for isotropic RBF/Matérn/RationalQuadratic, the raw inner-product
+  matrix for DotProduct/Polynomial) declare ``prepare(x) -> cache`` and
+  ``gram_from_cache(theta, cache)``.  Fit drivers build the cache ONCE
+  per fit (outside the differentiated objective, under the gram-stage
+  precision lane) and pass it as a traced operand into the hot loop, so
+  every L-BFGS evaluation pays elementwise ``exp`` + Cholesky instead of
+  re-running the O(s^2 p) MXU distance contraction ~40+ times per fit —
+  the reference's precompute-and-carry design (RBFKernel.scala:37-48)
+  recovered functionally.  ``prepare`` composes structurally through the
+  Sum/Product/scale/override algebra; ARD kernels (theta-dependent
+  weighted distances) and custom kernels without an invariant keep
+  ``prepare = None`` and ride today's recompute path unchanged.
 
 The composition DSL mirrors the reference's
 (``1 * k1 + 0.5.const * k2``, kernel/package.scala:3-9):
@@ -30,6 +44,7 @@ The composition DSL mirrors the reference's
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Tuple
 
@@ -55,9 +70,21 @@ class Kernel:
       the kernel (kernel/Kernel.scala:97); may depend on ``theta`` when a
       trainable scalar scales an ``EyeKernel``.
     * ``describe(theta)`` — human-readable form for instrumentation logs.
+    * ``prepare(x) -> cache`` / ``gram_from_cache(theta, cache)`` — the
+      OPTIONAL theta-invariant precompute hooks (module docstring).
+      ``prepare`` is ``None`` (the class default) when the kernel has no
+      invariant; when defined, ``gram_from_cache(theta, prepare(x))``
+      must equal ``gram(theta, x)`` to float rounding for every theta —
+      tested for all shipped kernels in tests/test_gram_cache.py.
     """
 
     n_hypers: int = 0
+
+    #: Theta-invariant precompute hook.  ``None`` means "no invariant";
+    #: kernels with one override this as a method.  Composites null it out
+    #: per-instance (``self.prepare = None``) when any child lacks it, so
+    #: ``kernel.prepare is None`` is THE capability test everywhere.
+    prepare = None
 
     def _spec(self) -> tuple:
         """Hashable identity of this kernel spec.  Kernels are immutable, so
@@ -90,6 +117,13 @@ class Kernel:
 
     def self_diag(self, theta: jax.Array, x: jax.Array) -> jax.Array:
         raise NotImplementedError
+
+    def gram_from_cache(self, theta: jax.Array, cache) -> jax.Array:
+        raise NotImplementedError(
+            f"{type(self).__name__} declares no theta-invariant structure "
+            "(prepare is None); callers must check kernel.prepare before "
+            "taking the cached gram path"
+        )
 
     def white_noise_var(self, theta: jax.Array) -> jax.Array:
         return jnp.zeros((), dtype=theta.dtype if hasattr(theta, "dtype") else jnp.float32)
@@ -208,6 +242,15 @@ class EyeKernel(Kernel):
     def white_noise_var(self, theta):
         return jnp.asarray(1.0)
 
+    def prepare(self, x):
+        # zero-byte shape/dtype carrier: the identity gram needs only n,
+        # but the cache protocol transports arrays — a [n, 0] view costs
+        # nothing and keeps the Eye ridge composable under vmap
+        return jnp.zeros((x.shape[0], 0), dtype=x.dtype)
+
+    def gram_from_cache(self, theta, cache):
+        return jnp.eye(cache.shape[0], dtype=cache.dtype)
+
     def describe(self, theta) -> str:
         return "I"
 
@@ -239,6 +282,8 @@ class ThetaOverrideKernel(Kernel):
                 f"{inner.n_hypers} hyperparameters"
             )
         self.n_hypers = inner.n_hypers
+        if inner.prepare is None:
+            self.prepare = None
 
     def _spec(self) -> tuple:
         return (self.inner,)
@@ -261,6 +306,14 @@ class ThetaOverrideKernel(Kernel):
     def self_diag(self, theta, x):
         return self.inner.self_diag(theta, x)
 
+    def prepare(self, x):
+        # theta0 plays no part: the cache is theta-invariant by contract,
+        # so every restart's wrapper shares ONE cache with the base kernel
+        return self.inner.prepare(x)
+
+    def gram_from_cache(self, theta, cache):
+        return self.inner.gram_from_cache(theta, cache)
+
     def white_noise_var(self, theta):
         return self.inner.white_noise_var(theta)
 
@@ -277,9 +330,16 @@ class _PairKernel(Kernel):
         self.k1 = k1
         self.k2 = k2
         self.n_hypers = k1.n_hypers + k2.n_hypers
+        if k1.prepare is None or k2.prepare is None:
+            # the composite's cache is the tuple of child caches, so it
+            # only exists when BOTH children carry an invariant
+            self.prepare = None
 
     def _spec(self) -> tuple:
         return (self.k1, self.k2)
+
+    def prepare(self, x):
+        return (self.k1.prepare(x), self.k2.prepare(x))
 
     def _split(self, theta):
         return theta[: self.k1.n_hypers], theta[self.k1.n_hypers :]
@@ -350,6 +410,13 @@ class ProductKernel(_PairKernel):
         t1, t2 = self._split(theta)
         return self.k1.gram(t1, x) * self.k2.gram(t2, x)
 
+    def gram_from_cache(self, theta, cache):
+        t1, t2 = self._split(theta)
+        c1, c2 = cache
+        return self.k1.gram_from_cache(t1, c1) * self.k2.gram_from_cache(
+            t2, c2
+        )
+
     def cross(self, theta, x_test, x_train):
         t1, t2 = self._split(theta)
         return self.k1.cross(t1, x_test, x_train) * self.k2.cross(
@@ -376,6 +443,13 @@ class SumKernel(_PairKernel):
     def gram(self, theta, x):
         t1, t2 = self._split(theta)
         return self.k1.gram(t1, x) + self.k2.gram(t2, x)
+
+    def gram_from_cache(self, theta, cache):
+        t1, t2 = self._split(theta)
+        c1, c2 = cache
+        return self.k1.gram_from_cache(t1, c1) + self.k2.gram_from_cache(
+            t2, c2
+        )
 
     def cross(self, theta, x_test, x_train):
         t1, t2 = self._split(theta)
@@ -411,6 +485,8 @@ class TrainableScaleKernel(Kernel):
         self.lower = float(lower)
         self.upper = float(upper)
         self.n_hypers = 1 + kernel.n_hypers
+        if kernel.prepare is None:
+            self.prepare = None
 
     def _spec(self) -> tuple:
         return (self.kernel, self.c0, self.lower, self.upper)
@@ -427,6 +503,12 @@ class TrainableScaleKernel(Kernel):
 
     def gram(self, theta, x):
         return theta[0] * self.kernel.gram(theta[1:], x)
+
+    def prepare(self, x):
+        return self.kernel.prepare(x)
+
+    def gram_from_cache(self, theta, cache):
+        return theta[0] * self.kernel.gram_from_cache(theta[1:], cache)
 
     def cross(self, theta, x_test, x_train):
         return theta[0] * self.kernel.cross(theta[1:], x_test, x_train)
@@ -455,6 +537,8 @@ class ConstScaleKernel(Kernel):
         self.kernel = kernel
         self.c = float(c)
         self.n_hypers = kernel.n_hypers
+        if kernel.prepare is None:
+            self.prepare = None
 
     def _spec(self) -> tuple:
         return (self.kernel, self.c)
@@ -467,6 +551,12 @@ class ConstScaleKernel(Kernel):
 
     def gram(self, theta, x):
         return self.c * self.kernel.gram(theta, x)
+
+    def prepare(self, x):
+        return self.kernel.prepare(x)
+
+    def gram_from_cache(self, theta, cache):
+        return self.c * self.kernel.gram_from_cache(theta, cache)
 
     def cross(self, theta, x_test, x_train):
         return self.c * self.kernel.cross(theta, x_test, x_train)
@@ -542,3 +632,80 @@ def WhiteNoiseKernel(initial: float, lower: float, upper: float) -> Kernel:
     """Trainable white noise: ``(initial between lower and upper) * EyeKernel``
     (kernel/Kernel.scala:166-169)."""
     return Scalar(initial, lower, upper) * EyeKernel()
+
+
+# --- theta-invariant precompute plane (module docstring) ------------------
+
+
+def gram_cache_enabled() -> bool:
+    """The process-wide kill switch: ``GP_GRAM_CACHE=0`` disables the
+    precompute plane everywhere (every fit then recomputes the distance
+    stack per evaluation — today's pre-cache behavior).  Read on the host
+    at cache-build time, so toggling between fits needs no retrace of the
+    fit programs: the cache operand's pytree structure is part of the jit
+    key and each setting selects its own compiled path.  The bench's
+    ``fit_hot_loop`` section uses exactly this knob for its uncached leg."""
+    import os
+
+    return os.environ.get("GP_GRAM_CACHE", "1") != "0"
+
+
+def supports_gram_cache(kernel: Kernel) -> bool:
+    """True when ``kernel`` declares a theta-invariant structure AND the
+    process knob has not disabled the plane."""
+    return kernel.prepare is not None and gram_cache_enabled()
+
+
+@functools.partial(jax.jit, static_argnums=0, static_argnames=("lane",))
+def _prepare_stack_impl(kernel: Kernel, x, *, lane=None):
+    from spark_gp_tpu.ops.precision import precision_lane_scope
+
+    with precision_lane_scope(lane):
+        return jax.vmap(kernel.prepare)(x)
+
+
+def prepare_gram_cache(kernel: Kernel, x, lane=None):
+    """Per-expert theta-invariant cache for an ``[E, s, p]`` expert stack,
+    or ``None`` when the kernel has no invariant (``prepare is None``) or
+    the plane is disabled (``GP_GRAM_CACHE=0``).
+
+    Built as ONE jitted vmapped program under the gram-stage precision
+    lane (``lane=None`` resolves the ambient lane at call time, like the
+    fit entry points of models/likelihood.py) — so the compensated bf16
+    build of the ``mixed`` lane is paid once per fit instead of once per
+    L-BFGS evaluation, and the cached distances are bit-identical to what
+    the per-eval rebuild would have produced at the same lane.
+    """
+    if not supports_gram_cache(kernel):
+        return None
+    from spark_gp_tpu.ops.precision import active_lane
+
+    return _prepare_stack_impl(
+        kernel, x, lane=active_lane() if lane is None else lane
+    )
+
+
+def masked_gram_stack(kernel: Kernel, theta, x, mask, cache=None):
+    """``[E, s, s]`` stack of masked per-expert Gram matrices — THE gram
+    build of every fit objective (marginal NLL, LOO, the Laplace families).
+
+    ``cache=None`` (the fallback/compatibility path) evaluates
+    ``kernel.gram`` on the raw rows exactly as before; a cache from
+    :func:`prepare_gram_cache` routes through ``gram_from_cache``, so the
+    differentiated objective never touches the distance contraction — per
+    evaluation only the elementwise theta-map (exp for RBF) and the
+    masking remain, and autodiff's backward pass shrinks accordingly.
+    One home so the lint-style unit test (tests/test_gram_cache.py) can
+    assert no fit objective calls ``kernel.gram`` when a cache is live.
+    """
+    from spark_gp_tpu.ops.linalg import masked_kernel_matrix
+
+    if cache is None:
+        return jax.vmap(
+            lambda x_e, m_e: masked_kernel_matrix(kernel.gram(theta, x_e), m_e)
+        )(x, mask)
+    return jax.vmap(
+        lambda c_e, m_e: masked_kernel_matrix(
+            kernel.gram_from_cache(theta, c_e), m_e
+        )
+    )(cache, mask)

@@ -103,6 +103,14 @@ class RationalQuadraticKernel(_TwoHyperStationary):
     def gram(self, theta, x):
         return self._k(theta, sq_dist_self(x))
 
+    def prepare(self, x):
+        # theta-invariant squared-distance block (kernels/base.py
+        # protocol): sigma and alpha both act through the elementwise _k
+        return sq_dist_self(x)
+
+    def gram_from_cache(self, theta, cache):
+        return self._k(theta, cache)
+
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))
 
@@ -247,6 +255,13 @@ class DotProductKernel(Kernel):
     def gram(self, theta, x):
         return theta[0] * theta[0] + mxu_inner(x, x)
 
+    def prepare(self, x):
+        # the inner-product matrix IS the invariant: sigma0 only shifts it
+        return mxu_inner(x, x)
+
+    def gram_from_cache(self, theta, cache):
+        return theta[0] * theta[0] + cache
+
     def cross(self, theta, x_test, x_train):
         return theta[0] * theta[0] + mxu_inner(x_test, x_train)
 
@@ -301,6 +316,14 @@ class PolynomialKernel(Kernel):
 
     def gram(self, theta, x):
         return self._pow(mxu_inner(x, x) + theta[0])
+
+    def prepare(self, x):
+        # the inner-product matrix is theta-invariant; the trainable
+        # offset c and the static power act elementwise on it
+        return mxu_inner(x, x)
+
+    def gram_from_cache(self, theta, cache):
+        return self._pow(cache + theta[0])
 
     def cross(self, theta, x_test, x_train):
         return self._pow(mxu_inner(x_test, x_train) + theta[0])

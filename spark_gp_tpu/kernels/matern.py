@@ -71,6 +71,15 @@ class _MaternIso(ScalarLengthscaleHypers):
     def gram(self, theta, x):
         return self._k(theta, sq_dist_self(x))
 
+    def prepare(self, x):
+        # theta-invariant squared-distance block (kernels/base.py
+        # protocol); sigma enters only through the elementwise _k map, so
+        # one cached block serves every L-BFGS evaluation
+        return sq_dist_self(x)
+
+    def gram_from_cache(self, theta, cache):
+        return self._k(theta, cache)
+
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))
 

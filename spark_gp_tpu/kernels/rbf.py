@@ -37,6 +37,15 @@ class RBFKernel(ScalarLengthscaleHypers):
     def gram(self, theta, x):
         return self._k(theta, sq_dist_self(x))
 
+    def prepare(self, x):
+        # theta-invariant structure (kernels/base.py protocol): the
+        # pinned-diagonal squared-distance block — the reference's carried
+        # object state (RBFKernel.scala:37-48), functional
+        return sq_dist_self(x)
+
+    def gram_from_cache(self, theta, cache):
+        return self._k(theta, cache)
+
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))
 

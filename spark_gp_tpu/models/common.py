@@ -591,6 +591,30 @@ class GaussianProcessCommons(GaussianProcessParams):
             return x, n_orig, (lambda t: t)
         return x[finite], n_orig, (lambda t: np.asarray(t)[finite])
 
+    def _gram_cache(self, instr, data: ExpertData):
+        """Build the theta-invariant per-expert gram cache ONCE per fit
+        (the precompute plane, kernels/base.py): one jitted vmapped
+        ``prepare`` pass over the ``[E, s, p]`` stack, under the ambient
+        gram-stage precision lane — so the ``mixed`` lane's compensated
+        bf16 distance build is paid once instead of per L-BFGS
+        evaluation.  Returns ``None`` (and the fit keeps today's
+        recompute path bit-for-bit) when the kernel declares no invariant
+        (ARD / custom ``prepare=None`` kernels), when ``GP_GRAM_CACHE=0``,
+        or for the ELBO objective (dominated by cross-kernel terms the
+        self-distance cache does not cover).  Memory cost: one extra
+        ``[E, s, s]`` stack (docs/ROOFLINE.md).  The decision is recorded
+        as the ``gram_cache_engaged`` metric so artifacts can prove which
+        path a fit ran."""
+        from spark_gp_tpu.kernels.base import prepare_gram_cache
+
+        if getattr(self, "_objective", "marginal") == "elbo":
+            cache = None
+        else:
+            cache = prepare_gram_cache(self._get_kernel(), data.x)
+        if instr is not None:
+            instr.log_metric("gram_cache_engaged", float(cache is not None))
+        return cache
+
     def _apply_quarantine(self, instr, data, bad, source: str) -> ExpertData:
         """Drop ``bad`` experts from the stack; account for renormalization.
 
@@ -646,8 +670,8 @@ class GaussianProcessCommons(GaussianProcessParams):
     def _run_with_expert_resilience(self, instr, data, run_fit):
         """Bounded recovery driver around one COMPLETE fit attempt.
 
-        ``run_fit(data, resilience_extra) -> model`` is the whole
-        optimize→PPA pipeline; on a non-finite failure
+        ``run_fit(data, resilience_extra, gram_cache) -> model`` is the
+        whole optimize→PPA pipeline; on a non-finite failure
         (``NotPositiveDefiniteException`` from any factorization,
         ``NonFiniteFitError`` from a device fit) the per-expert health
         probe runs at theta0, unhealthy experts walk the adaptive jitter
@@ -656,9 +680,18 @@ class GaussianProcessCommons(GaussianProcessParams):
         on the host, never inside the compiled programs.  A failure the
         diagnosis cannot attribute to specific experts (every expert
         healthy in isolation) is re-raised untouched.
+
+        The theta-invariant gram cache is built HERE, once, and reused
+        verbatim by jitter-escalation retries (the jitter operand changes,
+        the stack does not); a quarantine retry rebuilds it — quarantine
+        replaces the dropped experts' feature rows with benign copies, so
+        the cached distances of those experts are stale (masked-out, but
+        rebuilt anyway so the cached path can never read poisoned
+        distances the uncached path would not).
         """
+        cache = self._gram_cache(instr, data)
         if not self._expert_quarantine or self._fit_retries < 1:
-            return run_fit(data, ())
+            return run_fit(data, (), cache)
         from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
         from spark_gp_tpu.resilience.quarantine import (
             NonFiniteFitError,
@@ -669,11 +702,11 @@ class GaussianProcessCommons(GaussianProcessParams):
             retry_with_backoff,
         )
 
-        state = {"data": data, "extra": ()}
+        state = {"data": data, "extra": (), "cache": cache}
         objective = getattr(self, "_objective", "marginal")
 
         def attempt():
-            return run_fit(state["data"], state["extra"])
+            return run_fit(state["data"], state["extra"], state["cache"])
 
         # the health probe needs a per-expert-DECOMPOSABLE objective; the
         # ELBO is a nonlinear function of global sums, so its faults are
@@ -719,6 +752,9 @@ class GaussianProcessCommons(GaussianProcessParams):
                 state["data"] = self._apply_quarantine(
                     instr, state["data"], report.bad, "fit recovery"
                 )
+                # the repaired stack has fresh (benign) feature rows for
+                # the dropped experts — rebuild the distance cache from it
+                state["cache"] = self._gram_cache(None, state["data"])
             instr.log_metric("fit_retries", float(attempt_idx + 1))
 
         try:

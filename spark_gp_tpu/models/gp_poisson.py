@@ -76,16 +76,20 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
             data = self._group_screened(instr, x, y_f)
         instr.log_metric("num_experts", data.num_experts)
 
+        # theta-invariant gram cache, built once per fit and shared by
+        # every restart (common._gram_cache)
+        cache = self._gram_cache(instr, data)
+
         if self._use_batched_multistart():
-            return self._fit_device_multistart(instr, data, x)
+            return self._fit_device_multistart(instr, data, x, cache)
 
         def fit_once(kernel, instr_r):
-            return self._fit_from_stack(instr_r, kernel, data, x)
+            return self._fit_from_stack(instr_r, kernel, data, x, cache=cache)
 
         return self._fit_with_restarts(instr, fit_once)
 
     def _fit_device_multistart(
-        self, instr, data, x
+        self, instr, data, x, cache=None
     ) -> "GaussianProcessPoissonModel":
         """Batched on-device multi-start: R starting points in one vmapped
         generic-Laplace + L-BFGS dispatch; one PPA build for the winner."""
@@ -120,6 +124,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         jnp.asarray(upper, dtype=dtype),
                         data.x, data.y, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
+                        cache,
                     )
                 )
                 phase_sync(theta, nll)
@@ -169,9 +174,12 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                     "targets must be non-negative integer counts"
                 )
 
+            cache = self._gram_cache(instr, data)
+
             def fit_once(kernel, instr_r):
                 return self._fit_from_stack(
-                    instr_r, kernel, data, None, active_override=active64
+                    instr_r, kernel, data, None, active_override=active64,
+                    cache=cache,
                 )
 
             return fit_once
@@ -181,7 +189,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         )
 
     def _fit_from_stack(
-        self, instr, kernel, data, x, active_override=None
+        self, instr, kernel, data, x, active_override=None, cache=None
     ) -> "GaussianProcessPoissonModel":
         from spark_gp_tpu.parallel.experts import (
             ExpertData,
@@ -192,9 +200,13 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
 
         with maybe_profile(self._profile_dir):
             if self._resolved_optimizer() == "device":
-                theta_opt, f_final = self._fit_device(instr, kernel, data)
+                theta_opt, f_final = self._fit_device(
+                    instr, kernel, data, cache
+                )
             else:
-                theta_opt, f_final = self._fit_host(instr, kernel, data)
+                theta_opt, f_final = self._fit_host(
+                    instr, kernel, data, cache
+                )
 
             latent_y = f_final * data.mask
             # latent log-rates substitute for y in the PPA build AND as the
@@ -226,21 +238,22 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         model.instr = instr
         return model
 
-    def _fit_host(self, instr, kernel, data):
+    def _fit_host(self, instr, kernel, data, cache=None):
         lik = self._likelihood
         if self._mesh is not None:
             objective = make_sharded_generic_objective(
-                lik, kernel, data.x, data.y, data.mask, self._tol, self._mesh
+                lik, kernel, data.x, data.y, data.mask, self._tol,
+                self._mesh, cache,
             )
         else:
             objective = make_generic_objective(
-                lik, kernel, data.x, data.y, data.mask, self._tol
+                lik, kernel, data.x, data.y, data.mask, self._tol, cache
             )
         return self._optimize_latent_host(
             instr, kernel, objective, jnp.zeros_like(data.y)
         )
 
-    def _fit_device(self, instr, kernel, data):
+    def _fit_device(self, instr, kernel, data, cache=None):
         """One-dispatch on-device fit — the same mesh/checkpoint dispatch as
         the other three families (GaussianProcessCommons.scala:66-92 is one
         skeleton for every estimator; so is this)."""
@@ -276,6 +289,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                             f"generic-{type(lik).__name__}-{lik_digest}",
                             data,
                         ),
+                        cache,
                     )
                 )
             elif self._mesh is not None:
@@ -289,6 +303,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         self._mesh, log_space, theta0, lower, upper,
                         data.x, data.y, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
+                        cache,
                     )
                 )
             else:
@@ -298,6 +313,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         theta0, lower, upper,
                         data.x, data.y, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
+                        cache,
                     )
                 )
             phase_sync(theta, nll)

@@ -94,11 +94,19 @@ class GaussianProcessClassifier(GaussianProcessCommons):
 
             return targets_fn
 
+        # the theta-invariant gram cache, built once and shared by every
+        # restart (all restarts wrap ONE kernel spec — common._gram_cache)
+        cache = self._gram_cache(instr, data)
+
         if self._use_batched_multistart():
-            return self._fit_device_multistart(instr, data, x, make_targets_fn)
+            return self._fit_device_multistart(
+                instr, data, x, make_targets_fn, cache
+            )
 
         def fit_once(kernel, instr_r):
-            raw = self._fit_from_stack(instr_r, kernel, data, x, make_targets_fn)
+            raw = self._fit_from_stack(
+                instr_r, kernel, data, x, make_targets_fn, cache=cache
+            )
             instr_r.log_success()
             model = GaussianProcessClassificationModel(raw)
             model.instr = instr_r
@@ -111,18 +119,21 @@ class GaussianProcessClassifier(GaussianProcessCommons):
     _engine_log_tag = ""
 
     def _multistart_device_call(
-        self, kernel, log_space, theta_batch, lower, upper, data, max_iter
+        self, kernel, log_space, theta_batch, lower, upper, data, max_iter,
+        cache=None,
     ):
         """Engine hook for the shared multistart skeleton: run the vmapped
         R-restart device fit and return ``(theta, latent_y, nll, n_iter,
         n_fev, stalled, f_all, best)`` with ``latent_y`` the winner's PPA
-        targets (masked latent stack)."""
+        targets (masked latent stack).  ``cache`` is the theta-invariant
+        gram cache, broadcast across the restart lanes (EP ignores it —
+        its site-update engine has no cached-gram path yet)."""
         from spark_gp_tpu.models.laplace import fit_gpc_device_multistart
 
         theta, f_final, nll, n_iter, n_fev, stalled, f_all, best = (
             fit_gpc_device_multistart(
                 kernel, float(self._tol), log_space, theta_batch,
-                lower, upper, data.x, data.y, data.mask, max_iter,
+                lower, upper, data.x, data.y, data.mask, max_iter, cache,
             )
         )
         return (
@@ -131,7 +142,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         )
 
     def _fit_device_multistart(
-        self, instr, data, x, make_targets_fn
+        self, instr, data, x, make_targets_fn, cache=None
     ) -> "GaussianProcessClassificationModel":
         """Batched on-device multi-start (single chip): R starting points
         run in one vmapped inference + L-BFGS dispatch (the engine hook
@@ -161,6 +172,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                         jnp.asarray(upper, dtype=dtype),
                         data,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
+                        cache,
                     )
                 )
                 phase_sync(theta, nll)
@@ -205,9 +217,11 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             if not bool(_labels_are_01(data.y, data.mask)):
                 raise ValueError("Only 0 and 1 labels are supported.")
 
+            cache = self._gram_cache(instr, data)
+
             def fit_once(kernel, instr_r):
                 raw = self._fit_from_stack(
-                    instr_r, kernel, data, None, None, active64
+                    instr_r, kernel, data, None, None, active64, cache=cache
                 )
                 instr_r.log_success()
                 model = GaussianProcessClassificationModel(raw)
@@ -221,28 +235,34 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         )
 
     def _fit_from_stack(
-        self, instr, kernel, data, x, make_targets_fn, active_override=None
+        self, instr, kernel, data, x, make_targets_fn, active_override=None,
+        cache=None,
     ) -> ProjectedProcessRawPredictor:
         """Shared optimize → settle latents → active set → PPA tail of
         ``fit`` and ``fit_distributed``.  ``make_targets_fn(latent_y)`` must
         return a zero-arg callable producing the provider's flat targets
         (deferred: fetching latents is a device sync the random/kmeans
-        providers never need)."""
+        providers never need).  ``cache`` is the per-fit theta-invariant
+        gram cache (common._gram_cache)."""
         from spark_gp_tpu.utils.instrumentation import maybe_profile
 
         with maybe_profile(self._profile_dir):
             return self._fit_from_stack_profiled(
-                instr, kernel, data, x, make_targets_fn, active_override
+                instr, kernel, data, x, make_targets_fn, active_override,
+                cache,
             )
 
     def _fit_from_stack_profiled(
-        self, instr, kernel, data, x, make_targets_fn, active_override=None
+        self, instr, kernel, data, x, make_targets_fn, active_override=None,
+        cache=None,
     ) -> ProjectedProcessRawPredictor:
         if self._resolved_optimizer() == "device":
             # Fully async pipeline: on-device Laplace + L-BFGS, the latent
             # modes stay on device as the PPA targets, and the host syncs
             # exactly once inside _finalize_device_fit.
-            theta_dev, f_final, pending = self._fit_device(instr, kernel, data)
+            theta_dev, f_final, pending = self._fit_device(
+                instr, kernel, data, cache
+            )
             latent_y = f_final * data.mask
             latent_data = ExpertData(x=data.x, y=latent_y, mask=data.mask)
             raw, _ = self._finalize_device_fit(
@@ -254,10 +274,12 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         else:
             if self._mesh is not None:
                 objective = make_sharded_laplace_objective(
-                    kernel, data, self._tol, self._mesh
+                    kernel, data, self._tol, self._mesh, cache
                 )
             else:
-                objective = make_laplace_objective(kernel, data, self._tol)
+                objective = make_laplace_objective(
+                    kernel, data, self._tol, cache
+                )
 
             theta_opt, f_final = self._optimize_latent_host(
                 instr, kernel, objective, jnp.zeros_like(data.y)
@@ -275,7 +297,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             )
         return raw
 
-    def _fit_device(self, instr: Instrumentation, kernel, data):
+    def _fit_device(self, instr: Instrumentation, kernel, data, cache=None):
         """Dispatch the one-program on-device Laplace optimization without
         blocking: returns device (theta, latent modes) plus pending scalars."""
         from spark_gp_tpu.models.laplace import (
@@ -304,6 +326,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                         theta0, lower, upper, data, self._max_iter,
                         self._checkpoint_interval,
                         self._make_device_checkpointer("gpc", data),
+                        cache,
                     )
                 )
             elif self._mesh is not None:
@@ -311,13 +334,13 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                     fit_gpc_device_sharded(
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data.x, data.y, data.mask,
-                        max_iter,
+                        max_iter, cache,
                     )
                 )
             else:
                 theta, f_final, f, n_iter, n_fev, stalled = fit_gpc_device(
                     kernel, float(self._tol), log_space, theta0, lower, upper,
-                    data.x, data.y, data.mask, max_iter,
+                    data.x, data.y, data.mask, max_iter, cache,
                 )
             phase_sync(theta, f)
         pending = {

@@ -46,12 +46,25 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
 
     _engine_log_tag = " EP"
 
+    def _gram_cache(self, instr, data):
+        """The EP engine's site sweeps have no cached-gram path yet: never
+        BUILD a cache its fit paths would silently discard (the prepare
+        pass is a full O(E s^2 p) contraction plus an [E, s, s] stack of
+        HBM), and report ``gram_cache_engaged=0`` truthfully."""
+        if instr is not None:
+            instr.log_metric("gram_cache_engaged", 0.0)
+        return None
+
     def _multistart_device_call(
-        self, kernel, log_space, theta_batch, lower, upper, data, max_iter
+        self, kernel, log_space, theta_batch, lower, upper, data, max_iter,
+        cache=None,
     ):
         """Engine hook for the parent's multistart skeleton: the vmapped
         EP + L-BFGS dispatch, site pairs riding per lane; the winner's
-        latent mean comes back from the same program."""
+        latent mean comes back from the same program.  ``cache`` (the
+        theta-invariant gram cache) is accepted for hook-signature parity
+        and ignored: the EP engine's site sweeps have no cached-gram path
+        yet."""
         from spark_gp_tpu.models.ep import fit_gpc_ep_device_multistart
 
         return fit_gpc_ep_device_multistart(
@@ -60,7 +73,8 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
         )
 
     def _fit_from_stack_profiled(
-        self, instr, kernel, data, x, make_targets_fn, active_override=None
+        self, instr, kernel, data, x, make_targets_fn, active_override=None,
+        cache=None,
     ) -> ProjectedProcessRawPredictor:
         if (
             self._resolved_optimizer() == "device"

@@ -116,16 +116,22 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
 
         y1h = _one_hot_masked(data.y, data.mask, n_classes)
 
+        # theta-invariant gram cache, built once per fit and shared by
+        # every restart (common._gram_cache)
+        cache = self._gram_cache(instr, data)
+
         if self._use_batched_multistart():
-            return self._fit_device_multistart(instr, data, y1h, x)
+            return self._fit_device_multistart(instr, data, y1h, x, cache)
 
         def fit_once(kernel, instr_r):
-            return self._fit_from_stack(instr_r, kernel, data, y1h, x)
+            return self._fit_from_stack(
+                instr_r, kernel, data, y1h, x, cache=cache
+            )
 
         return self._fit_with_restarts(instr, fit_once)
 
     def _fit_device_multistart(
-        self, instr, data, y1h, x
+        self, instr, data, y1h, x, cache=None
     ) -> "GaussianProcessMulticlassModel":
         """Batched on-device multi-start: R starting points in one vmapped
         softmax-Laplace + L-BFGS dispatch; one PPA build for the winner."""
@@ -152,6 +158,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                         jnp.asarray(upper, dtype=dtype),
                         data.x, y1h, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
+                        cache,
                     )
                 )
                 phase_sync(theta, nll)
@@ -211,10 +218,12 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
             instr.log_metric("num_classes", n_cls)
             y1h = _one_hot_masked(data.y, data.mask, n_cls)
 
+            cache = self._gram_cache(instr, data)
+
             def fit_once(kernel, instr_r):
                 return self._fit_from_stack(
                     instr_r, kernel, data, y1h, None,
-                    active_override=active64,
+                    active_override=active64, cache=cache,
                 )
 
             return fit_once
@@ -224,7 +233,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         )
 
     def _fit_from_stack(
-        self, instr, kernel, data, y1h, x, active_override=None
+        self, instr, kernel, data, y1h, x, active_override=None, cache=None
     ) -> "GaussianProcessMulticlassModel":
         """Shared optimize → settle latents → PPA tail of ``fit`` and
         ``fit_distributed`` (the gpc.py:_fit_from_stack pattern; ``x is
@@ -233,9 +242,13 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
 
         with maybe_profile(self._profile_dir):
             if self._resolved_optimizer() == "device":
-                theta_opt, f_final = self._fit_device(instr, kernel, data, y1h)
+                theta_opt, f_final = self._fit_device(
+                    instr, kernel, data, y1h, cache
+                )
             else:
-                theta_opt, f_final = self._fit_host(instr, kernel, data, y1h)
+                theta_opt, f_final = self._fit_host(
+                    instr, kernel, data, y1h, cache
+                )
 
             latents = f_final * data.mask[..., None]  # [E, s, C]
             raw = self._projected_process_multi(
@@ -247,22 +260,22 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         model.instr = instr
         return model
 
-    def _fit_host(self, instr, kernel, data, y1h):
+    def _fit_host(self, instr, kernel, data, y1h, cache=None):
         """Host-driven L-BFGS-B over the jitted (possibly sharded)
         multiclass objective (shared driver: _optimize_latent_host)."""
         if self._mesh is not None:
             objective = make_sharded_mc_objective(
-                kernel, data.x, y1h, data.mask, self._tol, self._mesh
+                kernel, data.x, y1h, data.mask, self._tol, self._mesh, cache
             )
         else:
             objective = make_mc_objective(
-                kernel, data.x, y1h, data.mask, self._tol
+                kernel, data.x, y1h, data.mask, self._tol, cache
             )
         return self._optimize_latent_host(
             instr, kernel, objective, jnp.zeros_like(y1h)
         )
 
-    def _fit_device(self, instr, kernel, data, y1h):
+    def _fit_device(self, instr, kernel, data, y1h, cache=None):
         """On-device fit: one-dispatch single-chip / mesh-sharded, or the
         segmented checkpointable variant when ``setCheckpointDir`` is set
         (the same routing as the binary classifier, gpc.py:_fit_device)."""
@@ -286,6 +299,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                         theta0, lower, upper, data.x, y1h, data.mask,
                         self._max_iter, self._checkpoint_interval,
                         self._make_device_checkpointer("gpc_mc", data),
+                        cache,
                     )
                 )
             elif self._mesh is not None:
@@ -294,6 +308,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data.x, y1h, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
+                        cache,
                     )
                 )
             else:
@@ -301,6 +316,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                     kernel, float(self._tol), log_space, theta0, lower, upper,
                     data.x, y1h, data.mask,
                     jnp.asarray(self._max_iter, dtype=jnp.int32),
+                    cache,
                 )
             phase_sync(theta, nll)
         theta_host = np.asarray(theta, dtype=np.float64)

@@ -150,7 +150,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
         # providers sample raw host rows — hand them only finite ones
         x, y = self._screen_rows(x, y)
 
-        def run_fit(data_r, rextra):
+        def run_fit(data_r, rextra, cache):
             x_r, y_r = x, y
             if data_r is not data:
                 # fit recovery rebuilt the stack: provider rows must come
@@ -166,7 +166,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 # model is built once, for the winner (vs the sequential
                 # driver's full-fit-per-restart)
                 return self._fit_device_multistart(
-                    instr, data_r, x_r, y_r, rextra
+                    instr, data_r, x_r, y_r, rextra, cache
                 )
 
             # ELBO: ONE inducing set, selected at the base kernel's init
@@ -189,7 +189,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
             def fit_once(kernel, instr_r):
                 return self._fit_from_stack(
                     instr_r, kernel, data_r, x_r, lambda: y_r, active_shared,
-                    resilience_extra=rextra,
+                    resilience_extra=rextra, cache=cache,
                 )
 
             return self._fit_with_restarts(instr, fit_once)
@@ -258,7 +258,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
         )
 
     def _fit_device_multistart(
-        self, instr, data, x, y, resilience_extra=()
+        self, instr, data, x, y, resilience_extra=(), cache=None
     ) -> "GaussianProcessRegressionModel":
         """Batched on-device multi-start (single chip): R starting points
         run in one vmapped L-BFGS dispatch
@@ -283,7 +283,8 @@ class GaussianProcessRegression(GaussianProcessCommons):
             active_override = None
             if self._objective == "elbo":
                 # one inducing set, shared by every restart lane and the
-                # PPA build below
+                # PPA build below (the gram cache is None for the ELBO —
+                # common._gram_cache)
                 active_override, extra = self._elbo_setup(
                     instr, kernel, x, lambda: y, data, active_override
                 )
@@ -300,7 +301,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                         data.x, data.y, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
                         jnp.asarray(self._tol, dtype=dtype),
-                        extra,
+                        extra, cache,
                         objective=self._objective,
                     )
                 )
@@ -328,10 +329,12 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
     def _fit_from_stack(
         self, instr, kernel, data, x, targets_fn, active_override,
-        resilience_extra=(),
+        resilience_extra=(), cache=None,
     ) -> "GaussianProcessRegressionModel":
         """Shared optimize → active set → PPA tail of ``fit`` and
-        ``fit_distributed``."""
+        ``fit_distributed``.  ``cache`` is the per-fit theta-invariant
+        gram cache (common._gram_cache), threaded into whichever optimizer
+        path runs."""
         from spark_gp_tpu.utils.instrumentation import maybe_profile
 
         with maybe_profile(self._profile_dir):
@@ -346,7 +349,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 # statistics and the scalar diagnostics drain in one host
                 # sync inside _finalize_device_fit.
                 theta_dev, pending = self._fit_device(
-                    instr, kernel, data, extra
+                    instr, kernel, data, extra, cache
                 )
                 raw, fetched = self._finalize_device_fit(
                     instr, kernel, theta_dev, pending, x, targets_fn, data,
@@ -368,14 +371,15 @@ class GaussianProcessRegression(GaussianProcessCommons):
             else:
                 if self._mesh is not None and self._objective != "elbo":
                     vag = make_sharded_value_and_grad(
-                        kernel, data, self._mesh, self._objective
+                        kernel, data, self._mesh, self._objective,
+                        cache=cache,
                     )
                 else:
                     # the ELBO (a nonlinear function of global sums) rides
                     # jit/GSPMD over the possibly-sharded stack instead of
                     # the shard_map path (models/sgpr.py)
                     vag = make_value_and_grad(
-                        kernel, data, self._objective, extra
+                        kernel, data, self._objective, extra, cache
                     )
 
                 checkpointer = self._make_checkpointer(kernel)
@@ -419,10 +423,13 @@ class GaussianProcessRegression(GaussianProcessCommons):
                         base_kernel, base_kernel.init_theta(), None, None,
                         data,
                     )
+            # one cache per distributed fit too: sharded like the stack it
+            # was built from, it rides the shard_map/DCN local programs
+            cache = self._gram_cache(instr, data)
 
             def fit_once(kernel, instr_r):
                 return self._fit_from_stack(
-                    instr_r, kernel, data, None, None, active64
+                    instr_r, kernel, data, None, None, active64, cache=cache
                 )
 
             return fit_once
@@ -431,7 +438,9 @@ class GaussianProcessRegression(GaussianProcessCommons):
             "GaussianProcessRegression", data, active_set, prepare
         )
 
-    def _fit_device(self, instr: Instrumentation, kernel, data, extra=()):
+    def _fit_device(
+        self, instr: Instrumentation, kernel, data, extra=(), cache=None
+    ):
         """Dispatch the one-program on-device optimization
         (optimize/lbfgs_device.py) WITHOUT blocking: returns the device theta
         plus the pending diagnostic scalars for a single deferred fetch."""
@@ -475,12 +484,12 @@ class GaussianProcessRegression(GaussianProcessCommons):
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data, self._max_iter, tol, self._checkpoint_interval,
                     self._make_device_checkpointer(file_tag, data),
-                    objective=self._objective, extra=extra,
+                    objective=self._objective, extra=extra, cache=cache,
                 )
             elif self._mesh is not None and self._objective != "elbo":
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device_sharded(
                     kernel, self._mesh, log_space, theta0, lower, upper,
-                    data.x, data.y, data.mask, max_iter, tol,
+                    data.x, data.y, data.mask, max_iter, tol, cache,
                     objective=self._objective,
                 )
             else:
@@ -488,7 +497,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 # sharded stack and replicates the [m, m] algebra
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device(
                     kernel, log_space, theta0, lower, upper,
-                    data.x, data.y, data.mask, max_iter, tol, extra,
+                    data.x, data.y, data.mask, max_iter, tol, extra, cache,
                     objective=self._objective,
                 )
             phase_sync(theta, f)

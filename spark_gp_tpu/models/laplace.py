@@ -42,11 +42,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
 from spark_gp_tpu.ops.linalg import masked_kernel_matrix
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 from spark_gp_tpu.parallel.experts import ExpertData
-from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+from spark_gp_tpu.parallel.mesh import EXPERT_AXIS, sharded_cache_operand
 
 
 class _NewtonState(NamedTuple):
@@ -186,28 +186,44 @@ def laplace_mode_batch(kmat, y, mask, f0, tol):
     return final.f, final.new_obj
 
 
-def _dk_stack(kernel: Kernel, theta, x, mask):
-    """dK/dtheta for every expert: ``[E, s, s, h]`` via vmapped jacfwd."""
+def _dk_stack(kernel: Kernel, theta, x, mask, cache=None):
+    """dK/dtheta for every expert: ``[E, s, s, h]`` via vmapped jacfwd.
 
-    def one(x_e, m_e):
+    With a theta-invariant ``cache`` the jacobian runs through
+    ``gram_from_cache`` — the forward-mode tangents never traverse the
+    distance contraction, only the elementwise theta-map."""
+
+    if cache is None:
+        def one(x_e, m_e):
+            return jax.jacfwd(
+                lambda t: masked_kernel_matrix(kernel.gram(t, x_e), m_e)
+            )(theta)
+
+        return jax.vmap(one)(x, mask)
+
+    def one_cached(c_e, m_e):
         return jax.jacfwd(
-            lambda t: masked_kernel_matrix(kernel.gram(t, x_e), m_e)
+            lambda t: masked_kernel_matrix(
+                kernel.gram_from_cache(t, c_e), m_e
+            )
         )(theta)
 
-    return jax.vmap(one)(x, mask)
+    return jax.vmap(one_cached)(cache, mask)
 
 
-def batched_neg_logz(kernel: Kernel, tol, theta, data: ExpertData, f0):
+def batched_neg_logz(
+    kernel: Kernel, tol, theta, data: ExpertData, f0, cache=None
+):
     """Sum over the local expert stack; returns (nll, grad, f_stack).
 
     Everything batch-level — the Newton loop, the Algorithm 5.1 gradient
     assembly (GPClf.scala:113-128) and the dK/dtheta stack — so the inner
-    factorizations are one fused batched pass per iteration.
+    factorizations are one fused batched pass per iteration.  ``cache``
+    is the theta-invariant gram cache (kernels/base.py): both the Gram
+    stack AND the dK/dtheta jacobian then skip the distance contraction.
     """
 
-    kmat = jax.vmap(
-        lambda x, m: masked_kernel_matrix(kernel.gram(theta, x), m)
-    )(data.x, data.mask)
+    kmat = masked_gram_stack(kernel, theta, data.x, data.mask, cache)
     y, mask = data.y, data.mask
     f, new_obj = laplace_mode_batch(kmat, y, mask, f0, tol)
 
@@ -234,7 +250,7 @@ def batched_neg_logz(kernel: Kernel, tol, theta, data: ExpertData, f0):
     kdiag = jnp.diagonal(kmat, axis1=-2, axis2=-1)
     s2 = -0.5 * (kdiag - csum) * d3_log_p
 
-    dk = _dk_stack(kernel, theta, data.x, mask)  # [E, s, s, h]
+    dk = _dk_stack(kernel, theta, data.x, mask, cache)  # [E, s, s, h]
 
     s1 = 0.5 * jnp.einsum("es,esth,et->eh", a, dk, a) - 0.5 * jnp.einsum(
         "esth,est->eh", dk, r_mat
@@ -268,39 +284,52 @@ def expert_neg_logz_and_grad(kernel: Kernel, tol, theta, x, y, mask, f0):
 
 
 @partial(jax.jit, static_argnums=(0, 1))
-def _laplace_impl(kernel: Kernel, tol, theta, x, y, mask, f0):
+def _laplace_impl(kernel: Kernel, tol, theta, x, y, mask, f0, cache=None):
     data = ExpertData(x=x, y=y, mask=mask)
-    return batched_neg_logz(kernel, tol, theta, data, f0)
+    return batched_neg_logz(kernel, tol, theta, data, f0, cache)
 
 
-def make_laplace_objective(kernel: Kernel, data: ExpertData, tol):
+def make_laplace_objective(kernel: Kernel, data: ExpertData, tol, cache=None):
     """Single-device jitted ``(theta, f0) -> (nll, grad, f_new)``.  Kernel and
-    tol are static args of a module-level jit (executable reuse across fits)."""
+    tol are static args of a module-level jit (executable reuse across fits).
+    ``cache`` is the theta-invariant gram cache (kernels/base.py), resident
+    on device across the host optimizer's evaluations."""
 
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
-        return _laplace_impl(kernel, float(tol), theta, data.x, data.y, data.mask, f0)
+        return _laplace_impl(
+            kernel, float(tol), theta, data.x, data.y, data.mask, f0, cache
+        )
 
     return obj
 
 
-def _make_sharded_logz(kernel: Kernel, tol, mesh):
-    """shard_map'd ``(theta, f, x, y, mask) -> (value, grad, f_new)`` core,
-    shared by the host-driven objective, the one-dispatch fit and the
-    segmented checkpointing loop."""
+def _make_sharded_logz(
+    kernel: Kernel, tol, mesh, cache_specs=(),
+    cache_of=lambda maybe_cache: None,
+):
+    """shard_map'd ``(theta, f, x, y, mask[, cache]) -> (value, grad,
+    f_new)`` core, shared by the host-driven objective, the one-dispatch
+    fit and the segmented checkpointing loop.  ``(cache_specs, cache_of)``
+    come from :func:`parallel.mesh.sharded_cache_operand`."""
+
+    in_specs = (
+        P(), P(EXPERT_AXIS),
+        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+    ) + tuple(cache_specs)
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            P(), P(EXPERT_AXIS),
-            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-        ),
+        in_specs=in_specs,
         out_specs=(P(), P(), P(EXPERT_AXIS)),
     )
-    def core(theta, f_carry, x_, y_, mask_):
+    def core(theta, f_carry, x_, y_, mask_, *maybe_cache):
         local = ExpertData(x=x_, y=y_, mask=mask_)
-        value, grad, f_new = batched_neg_logz(kernel, tol, theta, local, f_carry)
+        cache = cache_of(maybe_cache)
+        value, grad, f_new = batched_neg_logz(
+            kernel, tol, theta, local, f_carry, cache
+        )
         # The Laplace gradient is assembled manually (Alg 5.1), not by
         # differentiating w.r.t. the replicated theta, so unlike the GPR
         # path it DOES need its own psum.
@@ -314,18 +343,25 @@ def _make_sharded_logz(kernel: Kernel, tol, mesh):
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
-def _sharded_laplace_impl(kernel: Kernel, tol, mesh, theta, x, y, mask, f0):
-    return _make_sharded_logz(kernel, tol, mesh)(theta, f0, x, y, mask)
+def _sharded_laplace_impl(
+    kernel: Kernel, tol, mesh, theta, x, y, mask, f0, cache=None
+):
+    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+    core = _make_sharded_logz(kernel, tol, mesh, cache_specs, cache_of)
+    return core(theta, f0, x, y, mask, *cache_args)
 
 
-def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
+def make_sharded_laplace_objective(
+    kernel: Kernel, data: ExpertData, tol, mesh, cache=None
+):
     """Sharded objective: experts and latent state sharded, (value, grad)
     psum-reduced over ICI — the treeAggregate of GPC.scala:73-78."""
 
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
         return _sharded_laplace_impl(
-            kernel, float(tol), mesh, theta, data.x, data.y, data.mask, f0
+            kernel, float(tol), mesh, theta, data.x, data.y, data.mask, f0,
+            cache,
         )
 
     return obj
@@ -336,11 +372,13 @@ def make_sharded_laplace_objective(kernel: Kernel, data: ExpertData, tol, mesh):
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
 def fit_gpc_device(
-    kernel: Kernel, tol, log_space, theta0, lower, upper, x, y, mask, max_iter
+    kernel: Kernel, tol, log_space, theta0, lower, upper, x, y, mask,
+    max_iter, cache=None,
 ):
     """Single-chip on-device classifier fit; the latent warm-start stack is
     the optimizer's auxiliary carry.  Returns (theta, f_latents, nll, n_iter,
-    n_fev, stalled)."""
+    n_fev, stalled).  ``cache`` sits outside the L-BFGS while_loop and is
+    reused by every evaluation's gram + dK/dtheta builds."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
@@ -349,7 +387,9 @@ def fit_gpc_device(
     data = ExpertData(x=x, y=y, mask=mask)
 
     def vag(theta, f_carry):
-        value, grad, f_new = batched_neg_logz(kernel, tol, theta, data, f_carry)
+        value, grad, f_new = batched_neg_logz(
+            kernel, tol, theta, data, f_carry, cache
+        )
         return value, grad, f_new
 
     if log_space:
@@ -367,34 +407,40 @@ def fit_gpc_device(
 # --- segmented device fit: checkpoint/resume (likelihood.py counterpart) --
 
 
-def _gpc_segment_vag(kernel: Kernel, tol, mesh, log_space, data: ExpertData):
+def _gpc_segment_vag(
+    kernel: Kernel, tol, mesh, log_space, data: ExpertData, cache=None
+):
     from spark_gp_tpu.optimize.lbfgs_device import log_transform_vag
 
     if mesh is None:
 
         def base(theta, f_carry):
             value, grad, f_new = batched_neg_logz(
-                kernel, tol, theta, data, f_carry
+                kernel, tol, theta, data, f_carry, cache
             )
             return value, grad, f_new
 
     else:
-        core = _make_sharded_logz(kernel, tol, mesh)
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        core = _make_sharded_logz(kernel, tol, mesh, cache_specs, cache_of)
 
         def base(theta, f_carry):
-            return core(theta, f_carry, data.x, data.y, data.mask)
+            return core(
+                theta, f_carry, data.x, data.y, data.mask, *cache_args
+            )
 
     return log_transform_vag(base) if log_space else base
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def gpc_device_segment_init(
-    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask
+    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask,
+    cache=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
     data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data)
+    vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data, cache)
     t0 = jnp.log(theta0) if log_space else theta0
     return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
 
@@ -407,7 +453,7 @@ def gpc_device_segment_init(
 )
 def gpc_device_segment_run(
     kernel: Kernel, tol, mesh, log_space, state, lower, upper, x, y, mask,
-    iter_limit,
+    iter_limit, cache=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
@@ -415,7 +461,7 @@ def gpc_device_segment_run(
     )
 
     data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data)
+    vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data, cache)
     lo, hi = (
         log_transform_bounds(lower, upper) if log_space else (lower, upper)
     )
@@ -424,25 +470,31 @@ def gpc_device_segment_run(
 
 def fit_gpc_device_checkpointed(
     kernel: Kernel, tol, mesh, log_space, theta0, lower, upper,
-    data: ExpertData, max_iter: int, chunk: int, saver,
+    data: ExpertData, max_iter: int, chunk: int, saver, cache=None,
 ):
     """Segmented on-device classifier fit with state persistence — see
     likelihood.fit_gpr_device_checkpointed.  The aux carry here is the
     latent warm-start stack, so a resume continues from the settled modes,
     not from zero latents.  Returns (theta, f_latents, nll, n_iter, n_fev,
-    stalled).
+    stalled).  The gram cache rides every segment dispatch (it is derived
+    state, rebuilt per fit — never part of the persisted checkpoint).
     """
     from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
     meta = segment_meta(
         "gpc", kernel, tol, log_space, theta0, data.x, data.y, data.mask
     )
-    init = partial(gpc_device_segment_init, kernel, float(tol), mesh, log_space)
+
+    def init(theta0_, lower_, upper_, x_, y_, mask_):
+        return gpc_device_segment_init(
+            kernel, float(tol), mesh, log_space, theta0_, lower_, upper_,
+            x_, y_, mask_, cache,
+        )
 
     def run(state, limit):
         return gpc_device_segment_run(
             kernel, float(tol), mesh, log_space, state, lower, upper,
-            data.x, data.y, data.mask, limit,
+            data.x, data.y, data.mask, limit, cache,
         )
 
     theta, state = run_segmented(
@@ -455,10 +507,12 @@ def fit_gpc_device_checkpointed(
 
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def fit_gpc_device_sharded(
-    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask, max_iter
+    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask,
+    max_iter, cache=None,
 ):
     """Multi-chip on-device classifier fit inside one shard_map: latent
-    stacks stay device-resident and sharded for the entire optimization."""
+    stacks stay device-resident and sharded for the entire optimization;
+    the (expert-sharded) gram cache rides into each local program."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
@@ -469,24 +523,31 @@ def fit_gpc_device_sharded(
         # old-jax compat (utils/compat.py): the L-BFGS while_loop inside
         # shard_map wedges the compile; GSPMD partitions the same stack
         return fit_gpc_device(
-            kernel, tol, log_space, theta0, lower, upper, x, y, mask, max_iter
+            kernel, tol, log_space, theta0, lower, upper, x, y, mask,
+            max_iter, cache,
         )
+
+    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+    in_specs = (
+        P(), P(), P(),
+        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+        P(),
+    ) + cache_specs
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            P(), P(), P(),
-            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-            P(),
-        ),
+        in_specs=in_specs,
         out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
     )
-    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_):
+    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, *maybe_cache):
         local = ExpertData(x=x_, y=y_, mask=mask_)
+        local_cache = cache_of(maybe_cache)
 
         def vag(theta, f_carry):
-            value, grad, f_new = batched_neg_logz(kernel, tol, theta, local, f_carry)
+            value, grad, f_new = batched_neg_logz(
+                kernel, tol, theta, local, f_carry, local_cache
+            )
             return (
                 jax.lax.psum(value, EXPERT_AXIS),
                 jax.lax.psum(grad, EXPERT_AXIS),
@@ -504,17 +565,18 @@ def fit_gpc_device_sharded(
         )
         return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
-    return run(theta0, lower, upper, x, y, mask, max_iter)
+    return run(theta0, lower, upper, x, y, mask, max_iter, *cache_args)
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
 def fit_gpc_device_multistart(
     kernel: Kernel, tol, log_space, theta0_batch, lower, upper, x, y, mask,
-    max_iter,
+    max_iter, cache=None,
 ):
     """Multi-start single-chip classifier fit: R restarts as ONE vmapped
     device program (see lbfgs_device.lbfgs_minimize_device_multistart); the
-    latent warm-start stacks ride per-lane ([R, E, s] total).  Returns
+    latent warm-start stacks ride per-lane ([R, E, s] total), while ONE
+    gram cache broadcasts to every lane (theta-invariant).  Returns
     ``(theta_best, f_latents_best, nll_best, n_iter, n_fev, stalled,
     f_all [R], best)``."""
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
@@ -522,7 +584,9 @@ def fit_gpc_device_multistart(
     data = ExpertData(x=x, y=y, mask=mask)
 
     def vag(theta, f_carry):
-        value, grad, f_new = batched_neg_logz(kernel, tol, theta, data, f_carry)
+        value, grad, f_new = batched_neg_logz(
+            kernel, tol, theta, data, f_carry, cache
+        )
         return value, grad, f_new
 
     theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (

@@ -37,8 +37,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from spark_gp_tpu.kernels.base import Kernel
-from spark_gp_tpu.ops.linalg import masked_kernel_matrix
+from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 
 
@@ -267,22 +266,25 @@ def laplace_generic_mode(lik: Likelihood, kmat, y, mask, f0, tol):
     return final.f, final.new_obj
 
 
-def _gram_stack(kernel: Kernel, theta, x, mask):
-    return jax.vmap(
-        lambda xe, me: masked_kernel_matrix(kernel.gram(theta, xe), me)
-    )(x, mask)
+def _gram_stack(kernel: Kernel, theta, x, mask, cache=None):
+    """Thin alias of :func:`kernels.base.masked_gram_stack` kept for the
+    test oracles that build expert gram stacks directly."""
+    return masked_gram_stack(kernel, theta, x, mask, cache)
 
 
 def batched_neg_logz_generic(
-    lik: Likelihood, kernel: Kernel, tol, theta, x, y, mask, f0
+    lik: Likelihood, kernel: Kernel, tol, theta, x, y, mask, f0, cache=None
 ):
     """Summed ``-log Z`` with gradient over the local stack for any
     likelihood; returns ``(nll, grad, f_modes)``.  Newton-fixed-point
     gradient (module docstring): stop-gradient mode, one differentiable
-    step, determinant re-evaluated at the differentiable iterate."""
+    step, determinant re-evaluated at the differentiable iterate.
+    ``cache`` is the theta-invariant gram cache (kernels/base.py): the
+    differentiated gram build then runs through ``gram_from_cache`` and
+    autodiff never traverses the distance contraction."""
 
     def nll(theta_):
-        kmat = _gram_stack(kernel, theta_, x, mask)
+        kmat = masked_gram_stack(kernel, theta_, x, mask, cache)
         f_hat = jax.lax.stop_gradient(
             laplace_generic_mode(
                 lik, jax.lax.stop_gradient(kmat), y, mask, f0, tol
@@ -301,37 +303,51 @@ def batched_neg_logz_generic(
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
-def _generic_vag_impl(lik, kernel, tol, theta, x, y, mask, f0):
-    return batched_neg_logz_generic(lik, kernel, tol, theta, x, y, mask, f0)
+def _generic_vag_impl(lik, kernel, tol, theta, x, y, mask, f0, cache=None):
+    return batched_neg_logz_generic(
+        lik, kernel, tol, theta, x, y, mask, f0, cache
+    )
 
 
-def make_generic_objective(lik: Likelihood, kernel: Kernel, x, y, mask, tol):
-    """Single-device jitted ``(theta, f0) -> (nll, grad, f_modes)``."""
+def make_generic_objective(
+    lik: Likelihood, kernel: Kernel, x, y, mask, tol, cache=None
+):
+    """Single-device jitted ``(theta, f0) -> (nll, grad, f_modes)``.
+    ``cache`` is the theta-invariant gram cache (kernels/base.py),
+    device-resident across the host optimizer's evaluations."""
 
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=x.dtype)
-        return _generic_vag_impl(lik, kernel, float(tol), theta, x, y, mask, f0)
+        return _generic_vag_impl(
+            lik, kernel, float(tol), theta, x, y, mask, f0, cache
+        )
 
     return obj
 
 
-def _make_sharded_generic_logz(lik: Likelihood, kernel: Kernel, tol, mesh):
+def _make_sharded_generic_logz(
+    lik: Likelihood, kernel: Kernel, tol, mesh, cache_specs=(),
+    cache_of=lambda maybe_cache: None,
+):
     from jax.sharding import PartitionSpec as P
 
     from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 
+    in_specs = (
+        P(), P(EXPERT_AXIS),
+        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+    ) + tuple(cache_specs)
+
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            P(), P(EXPERT_AXIS),
-            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-        ),
+        in_specs=in_specs,
         out_specs=(P(), P(), P(EXPERT_AXIS)),
     )
-    def core(theta, f_carry, x_, y_, mask_):
+    def core(theta, f_carry, x_, y_, mask_, *maybe_cache):
+        cache = cache_of(maybe_cache)
         value, grad, f_new = batched_neg_logz_generic(
-            lik, kernel, tol, theta, x_, y_, mask_, f_carry
+            lik, kernel, tol, theta, x_, y_, mask_, f_carry, cache
         )
         return (
             jax.lax.psum(value, EXPERT_AXIS),
@@ -343,19 +359,25 @@ def _make_sharded_generic_logz(lik: Likelihood, kernel: Kernel, tol, mesh):
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
-def _sharded_generic_vag_impl(lik, kernel, tol, mesh, theta, x, y, mask, f0):
-    return _make_sharded_generic_logz(lik, kernel, tol, mesh)(
-        theta, f0, x, y, mask
+def _sharded_generic_vag_impl(
+    lik, kernel, tol, mesh, theta, x, y, mask, f0, cache=None
+):
+    from spark_gp_tpu.parallel.mesh import sharded_cache_operand
+
+    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+    core = _make_sharded_generic_logz(
+        lik, kernel, tol, mesh, cache_specs, cache_of
     )
+    return core(theta, f0, x, y, mask, *cache_args)
 
 
 def make_sharded_generic_objective(
-    lik: Likelihood, kernel: Kernel, x, y, mask, tol, mesh
+    lik: Likelihood, kernel: Kernel, x, y, mask, tol, mesh, cache=None
 ):
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=x.dtype)
         return _sharded_generic_vag_impl(
-            lik, kernel, float(tol), mesh, theta, x, y, mask, f0
+            lik, kernel, float(tol), mesh, theta, x, y, mask, f0, cache
         )
 
     return obj
@@ -364,11 +386,12 @@ def make_sharded_generic_objective(
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def fit_generic_device(
     lik: Likelihood, kernel: Kernel, tol, log_space,
-    theta0, lower, upper, x, y, mask, max_iter,
+    theta0, lower, upper, x, y, mask, max_iter, cache=None,
 ):
     """Single-chip on-device fit for any likelihood: the latent warm-start
     stack rides as the optimizer's auxiliary carry (laplace.py pattern).
-    Returns ``(theta, f_latents, nll, n_iter, n_fev, stalled)``."""
+    Returns ``(theta, f_latents, nll, n_iter, n_fev, stalled)``.  ``cache``
+    sits outside the L-BFGS while_loop and serves every evaluation."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
@@ -376,7 +399,7 @@ def fit_generic_device(
 
     def vag(theta, f_carry):
         value, grad, f_new = batched_neg_logz_generic(
-            lik, kernel, tol, theta, x, y, mask, f_carry
+            lik, kernel, tol, theta, x, y, mask, f_carry, cache
         )
         return value, grad, f_new
 
@@ -395,7 +418,7 @@ def fit_generic_device(
 @partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def fit_generic_device_sharded(
     lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
-    theta0, lower, upper, x, y, mask, max_iter,
+    theta0, lower, upper, x, y, mask, max_iter, cache=None,
 ):
     """Multi-chip on-device fit for any likelihood inside one shard_map:
     latent stacks stay device-resident and sharded for the entire
@@ -416,23 +439,30 @@ def fit_generic_device_sharded(
         # shard_map wedges the compile; GSPMD partitions the same stack
         return fit_generic_device(
             lik, kernel, tol, log_space, theta0, lower, upper, x, y, mask,
-            max_iter,
+            max_iter, cache,
         )
+
+    from spark_gp_tpu.parallel.mesh import sharded_cache_operand
+
+    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+    in_specs = (
+        P(), P(), P(),
+        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+        P(),
+    ) + cache_specs
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            P(), P(), P(),
-            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-            P(),
-        ),
+        in_specs=in_specs,
         out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
     )
-    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_):
+    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, *maybe_cache):
+        local_cache = cache_of(maybe_cache)
+
         def vag(theta, f_carry):
             value, grad, f_new = batched_neg_logz_generic(
-                lik, kernel, tol, theta, x_, y_, mask_, f_carry
+                lik, kernel, tol, theta, x_, y_, mask_, f_carry, local_cache
             )
             return (
                 jax.lax.psum(value, EXPERT_AXIS),
@@ -451,28 +481,33 @@ def fit_generic_device_sharded(
         )
         return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
-    return run(theta0, lower, upper, x, y, mask, max_iter)
+    return run(theta0, lower, upper, x, y, mask, max_iter, *cache_args)
 
 
 # --- segmented device fit: checkpoint/resume (laplace.py counterpart) ------
 
 
 def _generic_segment_vag(lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
-                         x, y, mask):
+                         x, y, mask, cache=None):
     from spark_gp_tpu.optimize.lbfgs_device import log_transform_vag
 
     if mesh is None:
 
         def base(theta, f_carry):
             return batched_neg_logz_generic(
-                lik, kernel, tol, theta, x, y, mask, f_carry
+                lik, kernel, tol, theta, x, y, mask, f_carry, cache
             )
 
     else:
-        core = _make_sharded_generic_logz(lik, kernel, tol, mesh)
+        from spark_gp_tpu.parallel.mesh import sharded_cache_operand
+
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        core = _make_sharded_generic_logz(
+            lik, kernel, tol, mesh, cache_specs, cache_of
+        )
 
         def base(theta, f_carry):
-            return core(theta, f_carry, x, y, mask)
+            return core(theta, f_carry, x, y, mask, *cache_args)
 
     return log_transform_vag(base) if log_space else base
 
@@ -480,11 +515,13 @@ def _generic_segment_vag(lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
 @partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def generic_device_segment_init(
     lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
-    theta0, lower, upper, x, y, mask,
+    theta0, lower, upper, x, y, mask, cache=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
-    vag = _generic_segment_vag(lik, kernel, tol, mesh, log_space, x, y, mask)
+    vag = _generic_segment_vag(
+        lik, kernel, tol, mesh, log_space, x, y, mask, cache
+    )
     t0 = jnp.log(theta0) if log_space else theta0
     return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
 
@@ -497,14 +534,16 @@ def generic_device_segment_init(
 )
 def generic_device_segment_run(
     lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
-    state, lower, upper, x, y, mask, iter_limit,
+    state, lower, upper, x, y, mask, iter_limit, cache=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
         log_transform_bounds,
     )
 
-    vag = _generic_segment_vag(lik, kernel, tol, mesh, log_space, x, y, mask)
+    vag = _generic_segment_vag(
+        lik, kernel, tol, mesh, log_space, x, y, mask, cache
+    )
     lo, hi = (
         log_transform_bounds(lower, upper) if log_space else (lower, upper)
     )
@@ -513,26 +552,31 @@ def generic_device_segment_run(
 
 def fit_generic_device_checkpointed(
     lik: Likelihood, kernel: Kernel, tol, mesh, log_space, theta0, lower,
-    upper, x, y, mask, max_iter: int, chunk: int, saver,
+    upper, x, y, mask, max_iter: int, chunk: int, saver, cache=None,
 ):
     """Segmented on-device generic-likelihood fit with state persistence —
     see laplace.fit_gpc_device_checkpointed.  The aux carry is the latent
     warm-start stack, so a resume continues from the settled modes.
-    Returns ``(theta, f_latents, nll, n_iter, n_fev, stalled)``."""
+    Returns ``(theta, f_latents, nll, n_iter, n_fev, stalled)``.  The
+    gram cache rides every segment dispatch (derived state — never part
+    of the persisted checkpoint)."""
     from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
     meta = segment_meta(
         f"generic:{type(lik).__name__}{lik._spec()}", kernel, tol, log_space,
         theta0, x, y, mask,
     )
-    init = partial(
-        generic_device_segment_init, lik, kernel, float(tol), mesh, log_space
-    )
+
+    def init(theta0_, lower_, upper_, x_, y_, mask_):
+        return generic_device_segment_init(
+            lik, kernel, float(tol), mesh, log_space, theta0_, lower_,
+            upper_, x_, y_, mask_, cache,
+        )
 
     def run(state, limit):
         return generic_device_segment_run(
             lik, kernel, float(tol), mesh, log_space, state, lower, upper,
-            x, y, mask, limit,
+            x, y, mask, limit, cache,
         )
 
     theta, state = run_segmented(
@@ -545,16 +589,17 @@ def fit_generic_device_checkpointed(
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def fit_generic_device_multistart(
     lik: Likelihood, kernel: Kernel, tol, log_space,
-    theta0_batch, lower, upper, x, y, mask, max_iter,
+    theta0_batch, lower, upper, x, y, mask, max_iter, cache=None,
 ):
     """Multi-start single-chip fit for any likelihood: R restarts as ONE
-    vmapped device program.  Returns ``(theta_best, f_latents_best,
-    nll_best, n_iter, n_fev, stalled, f_all [R], best)``."""
+    vmapped device program; one gram cache broadcasts to every lane.
+    Returns ``(theta_best, f_latents_best, nll_best, n_iter, n_fev,
+    stalled, f_all [R], best)``."""
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
     def vag(theta, f_carry):
         value, grad, f_new = batched_neg_logz_generic(
-            lik, kernel, tol, theta, x, y, mask, f_carry
+            lik, kernel, tol, theta, x, y, mask, f_carry, cache
         )
         return value, grad, f_new
 

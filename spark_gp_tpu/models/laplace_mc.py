@@ -48,8 +48,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from spark_gp_tpu.kernels.base import Kernel
-from spark_gp_tpu.ops.linalg import masked_kernel_matrix
+from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 
 
@@ -209,13 +208,15 @@ def laplace_mc_mode(kmat, y1h, mask, f0, tol):
     return final.f, final.new_obj
 
 
-def _gram_stack(kernel: Kernel, theta, x, mask):
-    return jax.vmap(
-        lambda xe, me: masked_kernel_matrix(kernel.gram(theta, xe), me)
-    )(x, mask)
+def _gram_stack(kernel: Kernel, theta, x, mask, cache=None):
+    """Thin alias of :func:`kernels.base.masked_gram_stack` kept for the
+    test oracles that build expert gram stacks directly."""
+    return masked_gram_stack(kernel, theta, x, mask, cache)
 
 
-def batched_neg_logz_mc(kernel: Kernel, tol, theta, x, y1h, mask, f0):
+def batched_neg_logz_mc(
+    kernel: Kernel, tol, theta, x, y1h, mask, f0, cache=None
+):
     """Summed multiclass ``-log Z`` with gradient, over the local stack.
 
     Returns ``(nll, grad, f_modes)``.  The gradient comes from autodiff
@@ -223,10 +224,12 @@ def batched_neg_logz_mc(kernel: Kernel, tol, theta, x, y1h, mask, f0):
     by the implicit function theorem (module docstring); the determinant
     terms are re-evaluated at the differentiable iterate so their implicit
     f-dependence (the binary path's s2/s3 correction) is carried too.
+    ``cache`` is the theta-invariant gram cache (kernels/base.py): the
+    differentiated gram build then skips the distance contraction.
     """
 
     def nll(theta_):
-        kmat = _gram_stack(kernel, theta_, x, mask)
+        kmat = masked_gram_stack(kernel, theta_, x, mask, cache)
         f_hat = jax.lax.stop_gradient(
             laplace_mc_mode(
                 jax.lax.stop_gradient(kmat), y1h, mask, f0, tol
@@ -248,21 +251,28 @@ def batched_neg_logz_mc(kernel: Kernel, tol, theta, x, y1h, mask, f0):
 
 
 @partial(jax.jit, static_argnums=(0, 1))
-def _mc_vag_impl(kernel: Kernel, tol, theta, x, y1h, mask, f0):
-    return batched_neg_logz_mc(kernel, tol, theta, x, y1h, mask, f0)
+def _mc_vag_impl(kernel: Kernel, tol, theta, x, y1h, mask, f0, cache=None):
+    return batched_neg_logz_mc(kernel, tol, theta, x, y1h, mask, f0, cache)
 
 
-def make_mc_objective(kernel: Kernel, x, y1h, mask, tol):
-    """Single-device jitted ``(theta, f0) -> (nll, grad, f_modes)``."""
+def make_mc_objective(kernel: Kernel, x, y1h, mask, tol, cache=None):
+    """Single-device jitted ``(theta, f0) -> (nll, grad, f_modes)``.
+    ``cache`` is the theta-invariant gram cache (kernels/base.py),
+    device-resident across the host optimizer's evaluations."""
 
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=x.dtype)
-        return _mc_vag_impl(kernel, float(tol), theta, x, y1h, mask, f0)
+        return _mc_vag_impl(
+            kernel, float(tol), theta, x, y1h, mask, f0, cache
+        )
 
     return obj
 
 
-def _make_sharded_mc_logz(kernel: Kernel, tol, mesh):
+def _make_sharded_mc_logz(
+    kernel: Kernel, tol, mesh, cache_specs=(),
+    cache_of=lambda maybe_cache: None,
+):
     """shard_map'd multiclass objective core: experts and latents sharded,
     (value, grad) psum-reduced over ICI — the exact communication pattern
     of the binary classifier's sharded objective (laplace.py)."""
@@ -270,18 +280,21 @@ def _make_sharded_mc_logz(kernel: Kernel, tol, mesh):
 
     from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 
+    in_specs = (
+        P(), P(EXPERT_AXIS),
+        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+    ) + tuple(cache_specs)
+
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            P(), P(EXPERT_AXIS),
-            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-        ),
+        in_specs=in_specs,
         out_specs=(P(), P(), P(EXPERT_AXIS)),
     )
-    def core(theta, f_carry, x_, y1h_, mask_):
+    def core(theta, f_carry, x_, y1h_, mask_, *maybe_cache):
+        cache = cache_of(maybe_cache)
         value, grad, f_new = batched_neg_logz_mc(
-            kernel, tol, theta, x_, y1h_, mask_, f_carry
+            kernel, tol, theta, x_, y1h_, mask_, f_carry, cache
         )
         return (
             jax.lax.psum(value, EXPERT_AXIS),
@@ -293,15 +306,23 @@ def _make_sharded_mc_logz(kernel: Kernel, tol, mesh):
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
-def _sharded_mc_vag_impl(kernel: Kernel, tol, mesh, theta, x, y1h, mask, f0):
-    return _make_sharded_mc_logz(kernel, tol, mesh)(theta, f0, x, y1h, mask)
+def _sharded_mc_vag_impl(
+    kernel: Kernel, tol, mesh, theta, x, y1h, mask, f0, cache=None
+):
+    from spark_gp_tpu.parallel.mesh import sharded_cache_operand
+
+    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+    core = _make_sharded_mc_logz(kernel, tol, mesh, cache_specs, cache_of)
+    return core(theta, f0, x, y1h, mask, *cache_args)
 
 
-def make_sharded_mc_objective(kernel: Kernel, x, y1h, mask, tol, mesh):
+def make_sharded_mc_objective(
+    kernel: Kernel, x, y1h, mask, tol, mesh, cache=None
+):
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=x.dtype)
         return _sharded_mc_vag_impl(
-            kernel, float(tol), mesh, theta, x, y1h, mask, f0
+            kernel, float(tol), mesh, theta, x, y1h, mask, f0, cache
         )
 
     return obj
@@ -309,12 +330,14 @@ def make_sharded_mc_objective(kernel: Kernel, x, y1h, mask, tol, mesh):
 
 @partial(jax.jit, static_argnums=(0, 1, 2))
 def fit_gpc_mc_device(
-    kernel: Kernel, tol, log_space, theta0, lower, upper, x, y1h, mask, max_iter
+    kernel: Kernel, tol, log_space, theta0, lower, upper, x, y1h, mask,
+    max_iter, cache=None,
 ):
     """Single-chip on-device multiclass fit: the latent ``[E, s, C]``
     warm-start stack rides as the optimizer's auxiliary carry, exactly like
     the binary path (laplace.py fit_gpc_device).  Returns
-    ``(theta, f_latents, nll, n_iter, n_fev, stalled)``."""
+    ``(theta, f_latents, nll, n_iter, n_fev, stalled)``.  ``cache`` sits
+    outside the L-BFGS while_loop and serves every evaluation."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
@@ -322,7 +345,7 @@ def fit_gpc_mc_device(
 
     def vag(theta, f_carry):
         value, grad, f_new = batched_neg_logz_mc(
-            kernel, tol, theta, x, y1h, mask, f_carry
+            kernel, tol, theta, x, y1h, mask, f_carry, cache
         )
         return value, grad, f_new
 
@@ -341,7 +364,7 @@ def fit_gpc_mc_device(
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def fit_gpc_mc_device_sharded(
     kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y1h, mask,
-    max_iter,
+    max_iter, cache=None,
 ):
     """Multi-chip on-device multiclass fit inside one shard_map — the
     counterpart of laplace.fit_gpc_device_sharded with the ``[E, s, C]``
@@ -354,20 +377,28 @@ def fit_gpc_mc_device_sharded(
     )
     from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 
+    from spark_gp_tpu.parallel.mesh import sharded_cache_operand
+
+    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+    in_specs = (
+        P(), P(), P(),
+        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+        P(),
+    ) + cache_specs
+
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            P(), P(), P(),
-            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-            P(),
-        ),
+        in_specs=in_specs,
         out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
     )
-    def run(theta0_, lower_, upper_, x_, y1h_, mask_, max_iter_):
+    def run(theta0_, lower_, upper_, x_, y1h_, mask_, max_iter_,
+            *maybe_cache):
+        local_cache = cache_of(maybe_cache)
+
         def vag(theta, f_carry):
             value, grad, f_new = batched_neg_logz_mc(
-                kernel, tol, theta, x_, y1h_, mask_, f_carry
+                kernel, tol, theta, x_, y1h_, mask_, f_carry, local_cache
             )
             return (
                 jax.lax.psum(value, EXPERT_AXIS),
@@ -386,39 +417,44 @@ def fit_gpc_mc_device_sharded(
         )
         return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
-    return run(theta0, lower, upper, x, y1h, mask, max_iter)
+    return run(theta0, lower, upper, x, y1h, mask, max_iter, *cache_args)
 
 
 # --- segmented device fit: checkpoint/resume (laplace.py counterpart) ------
 
 
-def _mc_segment_vag(kernel: Kernel, tol, mesh, log_space, x, y1h, mask):
+def _mc_segment_vag(kernel: Kernel, tol, mesh, log_space, x, y1h, mask,
+                    cache=None):
     from spark_gp_tpu.optimize.lbfgs_device import log_transform_vag
 
     if mesh is None:
 
         def base(theta, f_carry):
             value, grad, f_new = batched_neg_logz_mc(
-                kernel, tol, theta, x, y1h, mask, f_carry
+                kernel, tol, theta, x, y1h, mask, f_carry, cache
             )
             return value, grad, f_new
 
     else:
-        core = _make_sharded_mc_logz(kernel, tol, mesh)
+        from spark_gp_tpu.parallel.mesh import sharded_cache_operand
+
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        core = _make_sharded_mc_logz(kernel, tol, mesh, cache_specs, cache_of)
 
         def base(theta, f_carry):
-            return core(theta, f_carry, x, y1h, mask)
+            return core(theta, f_carry, x, y1h, mask, *cache_args)
 
     return log_transform_vag(base) if log_space else base
 
 
 @partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def gpc_mc_device_segment_init(
-    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y1h, mask
+    kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y1h, mask,
+    cache=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
-    vag = _mc_segment_vag(kernel, tol, mesh, log_space, x, y1h, mask)
+    vag = _mc_segment_vag(kernel, tol, mesh, log_space, x, y1h, mask, cache)
     t0 = jnp.log(theta0) if log_space else theta0
     return lbfgs_init_state(vag, t0, jnp.zeros_like(y1h))
 
@@ -431,14 +467,14 @@ def gpc_mc_device_segment_init(
 )
 def gpc_mc_device_segment_run(
     kernel: Kernel, tol, mesh, log_space, state, lower, upper, x, y1h, mask,
-    iter_limit,
+    iter_limit, cache=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
         log_transform_bounds,
     )
 
-    vag = _mc_segment_vag(kernel, tol, mesh, log_space, x, y1h, mask)
+    vag = _mc_segment_vag(kernel, tol, mesh, log_space, x, y1h, mask, cache)
     lo, hi = (
         log_transform_bounds(lower, upper) if log_space else (lower, upper)
     )
@@ -447,24 +483,30 @@ def gpc_mc_device_segment_run(
 
 def fit_gpc_mc_device_checkpointed(
     kernel: Kernel, tol, mesh, log_space, theta0, lower, upper,
-    x, y1h, mask, max_iter: int, chunk: int, saver,
+    x, y1h, mask, max_iter: int, chunk: int, saver, cache=None,
 ):
     """Segmented on-device multiclass fit with kill-and-resume persistence
     — see laplace.fit_gpc_device_checkpointed; the aux carry here is the
     ``[E, s, C]`` latent warm-start stack.  Returns
-    ``(theta, f_latents, nll, n_iter, n_fev, stalled)``."""
+    ``(theta, f_latents, nll, n_iter, n_fev, stalled)``.  The gram cache
+    rides every segment dispatch (derived state — never checkpointed)."""
     from spark_gp_tpu.utils.checkpoint import run_segmented, segment_meta
 
     meta = segment_meta(
         "gpc_mc", kernel, tol, log_space, theta0, x, y1h, mask,
         num_classes=int(y1h.shape[-1]),
     )
-    init = partial(gpc_mc_device_segment_init, kernel, float(tol), mesh, log_space)
+
+    def init(theta0_, lower_, upper_, x_, y1h_, mask_):
+        return gpc_mc_device_segment_init(
+            kernel, float(tol), mesh, log_space, theta0_, lower_, upper_,
+            x_, y1h_, mask_, cache,
+        )
 
     def run(state, limit):
         return gpc_mc_device_segment_run(
             kernel, float(tol), mesh, log_space, state, lower, upper,
-            x, y1h, mask, limit,
+            x, y1h, mask, limit, cache,
         )
 
     theta, state = run_segmented(
@@ -477,17 +519,18 @@ def fit_gpc_mc_device_checkpointed(
 @partial(jax.jit, static_argnums=(0, 1, 2))
 def fit_gpc_mc_device_multistart(
     kernel: Kernel, tol, log_space, theta0_batch, lower, upper, x, y1h, mask,
-    max_iter,
+    max_iter, cache=None,
 ):
     """Multi-start single-chip multiclass fit: R restarts as ONE vmapped
-    device program; the ``[E, s, C]`` latent stacks ride per lane.  Returns
+    device program; the ``[E, s, C]`` latent stacks ride per lane while one
+    gram cache broadcasts to every lane.  Returns
     ``(theta_best, f_latents_best, nll_best, n_iter, n_fev, stalled,
     f_all [R], best)``."""
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
     def vag(theta, f_carry):
         value, grad, f_new = batched_neg_logz_mc(
-            kernel, tol, theta, x, y1h, mask, f_carry
+            kernel, tol, theta, x, y1h, mask, f_carry, cache
         )
         return value, grad, f_new
 

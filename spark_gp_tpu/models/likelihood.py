@@ -29,17 +29,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from spark_gp_tpu.kernels.base import Kernel
-from spark_gp_tpu.ops.linalg import (
-    chol_logdet,
-    chol_solve,
-    cholesky,
-    masked_kernel_matrix,
-)
+from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
+from spark_gp_tpu.ops.linalg import chol_logdet, chol_solve, cholesky
 from spark_gp_tpu.ops.precision import active_lane, precision_lane_scope
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 from spark_gp_tpu.parallel.experts import ExpertData
-from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
+from spark_gp_tpu.parallel.mesh import EXPERT_AXIS, sharded_cache_operand
 
 # Every jitted fit entry point below carries the resolved precision lane
 # (ops/precision.py) as a STATIC argument and re-pins it with
@@ -50,7 +45,8 @@ from spark_gp_tpu.parallel.mesh import EXPERT_AXIS
 # lane at CALL time.
 
 
-def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None):
+def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
+                cache=None):
     """Sum of per-expert NLLs over the local ``[E, s, ...]`` stack.
 
     On TPU the factor/solve/invert chain for the whole Gram stack runs as
@@ -69,12 +65,17 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None):
     escalation operand (``resilience/quarantine.py``): a *traced* value,
     so recovery retries reuse the compiled program, and the default
     ``None`` path — the clean hot loop — carries zero extra work.
+
+    ``cache`` (a :func:`kernels.base.prepare_gram_cache` pytree, traced)
+    is the theta-invariant precompute plane: when present, the Gram stack
+    is rebuilt per evaluation from the cached distance structure
+    (elementwise theta-map only — no MXU distance contraction, nothing
+    for autodiff to traverse there), and the fit drivers build it once
+    per fit.  ``None`` keeps the recompute path bit-for-bit.
     """
     from spark_gp_tpu.ops.pallas_linalg import _use_pallas, spd_inv_logdet
 
-    kmat = jax.vmap(
-        lambda x, m: masked_kernel_matrix(kernel.gram(theta, x), m)
-    )(data.x, data.mask)
+    kmat = masked_gram_stack(kernel, theta, data.x, data.mask, cache)
     if jitter is not None:
         s = kmat.shape[-1]
         trace = jnp.trace(kmat, axis1=-2, axis2=-1)
@@ -118,26 +119,31 @@ def objective_fn(objective: str):
     marginal NLL (default, the reference's objective), the negative LOO
     log pseudo-likelihood (R&W eq. 5.13, ``models/loo.py``), or the
     negative Titsias collapsed ELBO (``models/sgpr.py``).  Uniform
-    signature ``(kernel, theta, data, *extra) -> scalar`` — ``extra`` is
-    empty for the first two and ``(active, sigma2)`` for the ELBO — so
-    every fit entry point swaps them via one static argument plus one
-    traced operand tuple."""
+    signature ``(kernel, theta, data, *extra, cache=None) -> scalar`` —
+    ``extra`` is empty for the first two and ``(active, sigma2)`` for the
+    ELBO — so every fit entry point swaps them via one static argument
+    plus one traced operand tuple.  ``cache`` is the theta-invariant gram
+    cache (``kernels/base.py``); the ELBO ignores it (its gram work is
+    dominated by cross-kernel terms against the inducing set, which the
+    self-distance cache does not cover)."""
     if objective == "marginal":
         # extra, when present, is the (jitter,) escalation operand of the
         # resilience layer — absent on every clean fit
-        return lambda kernel, theta, data, *extra: batched_nll(
-            kernel, theta, data, *extra
+        return lambda kernel, theta, data, *extra, cache=None: batched_nll(
+            kernel, theta, data, *extra, cache=cache
         )
     if objective == "loo":
         from spark_gp_tpu.models.loo import batched_loo_nll
 
-        return lambda kernel, theta, data, *extra: batched_loo_nll(
-            kernel, theta, data
+        return lambda kernel, theta, data, *extra, cache=None: (
+            batched_loo_nll(kernel, theta, data, cache=cache)
         )
     if objective == "elbo":
         from spark_gp_tpu.models.sgpr import batched_elbo_nll
 
-        return batched_elbo_nll
+        return lambda kernel, theta, data, *extra, cache=None: (
+            batched_elbo_nll(kernel, theta, data, *extra)
+        )
     raise ValueError(
         f"unknown objective {objective!r}; "
         "expected 'marginal', 'loo' or 'elbo'"
@@ -146,29 +152,39 @@ def objective_fn(objective: str):
 
 @partial(jax.jit, static_argnums=0, static_argnames=("objective", "lane"))
 def _vag_impl(
-    kernel: Kernel, theta, x, y, mask, extra=(), *, objective="marginal",
-    lane=None,
+    kernel: Kernel, theta, x, y, mask, extra=(), cache=None, *,
+    objective="marginal", lane=None,
 ):
     with precision_lane_scope(lane):
         data = ExpertData(x=x, y=y, mask=mask)
         obj = objective_fn(objective)
-        return jax.value_and_grad(lambda t: obj(kernel, t, data, *extra))(theta)
+        return jax.value_and_grad(
+            lambda t: obj(kernel, t, data, *extra, cache=cache)
+        )(theta)
 
 
 def make_value_and_grad(
-    kernel: Kernel, data: ExpertData, objective: str = "marginal", extra=()
+    kernel: Kernel, data: ExpertData, objective: str = "marginal", extra=(),
+    cache=None,
 ):
     """Single-device jitted ``theta -> (nll, grad)``.
 
     The kernel spec is a static (hashable) argument of a module-level jit, so
     the compiled executable is reused across estimator instances and fits —
     this matters on runtimes with high per-dispatch/retrace latency.
+
+    ``cache`` is the per-expert theta-invariant gram cache
+    (:func:`kernels.base.prepare_gram_cache`) — a traced operand that
+    stays resident on device across the host optimizer's evaluations, so
+    each of the ~40+ dispatches per fit skips the distance contraction.
+    ``None`` (unsupported kernel / plane disabled) traces the exact
+    pre-cache program.
     """
 
     def vag(theta):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
         return _vag_impl(
-            kernel, theta, data.x, data.y, data.mask, extra,
+            kernel, theta, data.x, data.y, data.mask, extra, cache,
             objective=objective, lane=active_lane(),
         )
 
@@ -180,31 +196,52 @@ def guard_probe_value_and_grad(kernel: Kernel, theta, x, y, mask, *, lane):
     """(NLL, grad) of one probe expert stack at an EXPLICIT lane — the
     fit-time mixed_precision_guard's objective probe (models/common.py).
     ``lane`` is static, so the strict and non-strict evaluations compile
-    as separate executables and can be compared within one process."""
+    as separate executables and can be compared within one process.
+
+    Probes the path the fit ACTUALLY runs: when the kernel carries a
+    theta-invariant cache, the probe builds it (inside this program, under
+    the probed lane — so the lane's compensated cache build is part of
+    what the guard compares) and evaluates the cached objective."""
+    from spark_gp_tpu.kernels.base import supports_gram_cache
+
     with precision_lane_scope(lane):
         data = ExpertData(x=x, y=y, mask=mask)
+        cache = (
+            jax.vmap(kernel.prepare)(x) if supports_gram_cache(kernel)
+            else None
+        )
         return jax.value_and_grad(
-            lambda t: batched_nll(kernel, t, data)
+            lambda t: batched_nll(kernel, t, data, cache=cache)
         )(theta)
 
 
-def _make_sharded_vag(kernel: Kernel, mesh, objective: str = "marginal"):
-    """shard_map'd ``(theta, x, y, mask) -> (nll, grad)`` core, reusable
-    inside larger jitted programs (the one-dispatch fits, the segmented
-    checkpointing loop)."""
+def _make_sharded_vag(
+    kernel: Kernel, mesh, objective: str = "marginal", cache_specs=(),
+    cache_of=lambda maybe_cache: None,
+):
+    """shard_map'd ``(theta, x, y, mask[, cache]) -> (nll, grad)`` core,
+    reusable inside larger jitted programs (the one-dispatch fits, the
+    segmented checkpointing loop).  ``(cache_specs, cache_of)`` come from
+    :func:`parallel.mesh.sharded_cache_operand` — the one home of the
+    optional expert-sharded gram-cache operand convention."""
     _require_shard_map_support(objective)
+
+    in_specs = (
+        P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS)
+    ) + tuple(cache_specs)
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS)),
+        in_specs=in_specs,
         out_specs=(P(), P()),
     )
-    def sharded(theta_, x_, y_, mask_):
+    def sharded(theta_, x_, y_, mask_, *maybe_cache):
         local = ExpertData(x=x_, y=y_, mask=mask_)
+        cache = cache_of(maybe_cache)
         obj = objective_fn(objective)
         value, grad = jax.value_and_grad(
-            lambda t: obj(kernel, t, local)
+            lambda t: obj(kernel, t, local, cache=cache)
         )(theta_)
         # theta is replicated (P()): shard_map's transpose already inserts
         # the cross-device psum for its gradient, so only the value needs an
@@ -226,15 +263,18 @@ def _make_sharded_vag(kernel: Kernel, mesh, objective: str = "marginal"):
 
 @partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective", "lane"))
 def _sharded_vag_impl(
-    kernel: Kernel, mesh, theta, x, y, mask, *, objective="marginal",
-    lane=None,
+    kernel: Kernel, mesh, theta, x, y, mask, cache=None, *,
+    objective="marginal", lane=None,
 ):
     with precision_lane_scope(lane):
-        return _make_sharded_vag(kernel, mesh, objective)(theta, x, y, mask)
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        core = _make_sharded_vag(kernel, mesh, objective, cache_specs, cache_of)
+        return core(theta, x, y, mask, *cache_args)
 
 
 def make_sharded_value_and_grad(
-    kernel: Kernel, data: ExpertData, mesh, objective: str = "marginal"
+    kernel: Kernel, data: ExpertData, mesh, objective: str = "marginal",
+    cache=None,
 ):
     """Multi-chip ``theta -> (nll, grad)`` via ``shard_map`` + ``psum``.
 
@@ -243,12 +283,14 @@ def make_sharded_value_and_grad(
     replicated global (scalar, gradient) — the exact communication pattern of
     the reference's ``treeAggregate`` of ``(Double, BDV)``
     (GaussianProcessCommons.scala:73-78), minus the driver round-trip.
+    ``cache`` (expert-sharded like the stack) rides into the local programs
+    so each evaluation skips the distance contraction.
     """
 
     def vag(theta):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
         return _sharded_vag_impl(
-            kernel, mesh, theta, data.x, data.y, data.mask,
+            kernel, mesh, theta, data.x, data.y, data.mask, cache,
             objective=objective, lane=active_lane(),
         )
 
@@ -263,7 +305,7 @@ def make_sharded_value_and_grad(
 )
 def _fit_gpr_device_impl(
     kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter,
-    tol, extra=(), *, objective="marginal", lane=None,
+    tol, extra=(), cache=None, *, objective="marginal", lane=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
@@ -276,7 +318,7 @@ def _fit_gpr_device_impl(
 
         def vag(theta, aux):
             value, grad = jax.value_and_grad(
-                lambda t: obj(kernel, t, data, *extra)
+                lambda t: obj(kernel, t, data, *extra, cache=cache)
             )(theta)
             return value, grad, aux
 
@@ -296,15 +338,17 @@ def _fit_gpr_device_impl(
 
 def fit_gpr_device(
     kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter,
-    tol, extra=(), *, objective="marginal", lane=None,
+    tol, extra=(), cache=None, *, objective="marginal", lane=None,
 ):
     """Single-chip on-device fit: objective + projected L-BFGS in one XLA
     program.  Returns (theta_opt, final_nll, n_iter, n_fev, stalled).
     ``lane=None`` resolves the ambient precision lane at call time into
-    the jit key (module note above)."""
+    the jit key (module note above).  ``cache`` (the theta-invariant gram
+    cache) enters the program as a constant operand OUTSIDE the L-BFGS
+    while_loop, so every iteration's evaluation reuses it."""
     return _fit_gpr_device_impl(
         kernel, log_space, theta0, lower, upper, x, y, mask, max_iter, tol,
-        extra, objective=objective,
+        extra, cache, objective=objective,
         lane=active_lane() if lane is None else lane,
     )
 
@@ -314,7 +358,7 @@ def fit_gpr_device(
 )
 def _fit_gpr_device_multistart_impl(
     kernel: Kernel, log_space, theta0_batch, lower, upper, x, y, mask,
-    max_iter, tol, extra=(), *, objective="marginal", lane=None,
+    max_iter, tol, extra=(), cache=None, *, objective="marginal", lane=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
@@ -322,9 +366,11 @@ def _fit_gpr_device_multistart_impl(
         data = ExpertData(x=x, y=y, mask=mask)
         obj = objective_fn(objective)
 
+        # the cache is closed over, NOT vmapped: the R restart lanes map
+        # over theta only, so one cache broadcasts to every lane
         def vag(theta, aux):
             value, grad = jax.value_and_grad(
-                lambda t: obj(kernel, t, data, *extra)
+                lambda t: obj(kernel, t, data, *extra, cache=cache)
             )(theta)
             return value, grad, aux
 
@@ -339,16 +385,18 @@ def _fit_gpr_device_multistart_impl(
 
 def fit_gpr_device_multistart(
     kernel: Kernel, log_space, theta0_batch, lower, upper, x, y, mask,
-    max_iter, tol, extra=(), *, objective="marginal", lane=None,
+    max_iter, tol, extra=(), cache=None, *, objective="marginal", lane=None,
 ):
     """Multi-start single-chip fit: the R restarts run as ONE vmapped
     on-device L-BFGS program (optimize/lbfgs_device.py multistart docs) and
     only the winning iterate is returned — the PPA model is then built
-    once, for the winner.  Returns ``(theta_best, f_best, n_iter, n_fev,
+    once, for the winner.  ONE gram cache is shared (broadcast) across all
+    R lanes — the cache is theta-invariant, so per-lane copies would be
+    pure waste.  Returns ``(theta_best, f_best, n_iter, n_fev,
     stalled, f_all [R], best)``."""
     return _fit_gpr_device_multistart_impl(
         kernel, log_space, theta0_batch, lower, upper, x, y, mask,
-        max_iter, tol, extra, objective=objective,
+        max_iter, tol, extra, cache, objective=objective,
         lane=active_lane() if lane is None else lane,
     )
 
@@ -358,7 +406,7 @@ def fit_gpr_device_multistart(
 
 def _gpr_segment_vag(
     kernel: Kernel, mesh, log_space, data: ExpertData, objective="marginal",
-    extra=(),
+    extra=(), cache=None,
 ):
     """The (possibly sharded, possibly log-space) objective used by the
     segmented fit — identical math to the one-dispatch fits above.  The
@@ -371,15 +419,16 @@ def _gpr_segment_vag(
 
         def base(theta, aux):
             value, grad = jax.value_and_grad(
-                lambda t: obj(kernel, t, data, *extra)
+                lambda t: obj(kernel, t, data, *extra, cache=cache)
             )(theta)
             return value, grad, aux
 
     else:
-        core = _make_sharded_vag(kernel, mesh, objective)
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        core = _make_sharded_vag(kernel, mesh, objective, cache_specs, cache_of)
 
         def base(theta, aux):
-            value, grad = core(theta, data.x, data.y, data.mask)
+            value, grad = core(theta, data.x, data.y, data.mask, *cache_args)
             return value, grad, aux
 
     return log_transform_vag(base) if log_space else base
@@ -390,7 +439,7 @@ def _gpr_segment_vag(
 )
 def gpr_device_segment_init(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    extra=(), *, objective="marginal", lane=None,
+    extra=(), cache=None, *, objective="marginal", lane=None,
 ):
     """One objective evaluation -> the optimizer's carried state (the
     checkpoint unit)."""
@@ -398,14 +447,16 @@ def gpr_device_segment_init(
 
     with precision_lane_scope(lane):
         data = ExpertData(x=x, y=y, mask=mask)
-        vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective, extra)
+        vag = _gpr_segment_vag(
+            kernel, mesh, log_space, data, objective, extra, cache
+        )
         t0 = jnp.log(theta0) if log_space else theta0
         return lbfgs_init_state(vag, t0, jnp.zeros((), theta0.dtype))
 
 
 def _gpr_segment_run_impl(
     kernel: Kernel, mesh, log_space, state, lower, upper, x, y, mask,
-    iter_limit, tol, extra=(), *, objective="marginal", lane=None,
+    iter_limit, tol, extra=(), cache=None, *, objective="marginal", lane=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
@@ -414,7 +465,9 @@ def _gpr_segment_run_impl(
 
     with precision_lane_scope(lane):
         data = ExpertData(x=x, y=y, mask=mask)
-        vag = _gpr_segment_vag(kernel, mesh, log_space, data, objective, extra)
+        vag = _gpr_segment_vag(
+            kernel, mesh, log_space, data, objective, extra, cache
+        )
         lo, hi = (
             log_transform_bounds(lower, upper) if log_space else (lower, upper)
         )
@@ -438,7 +491,7 @@ gpr_device_segment_run = jax.jit(
 def fit_gpr_device_checkpointed(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, data: ExpertData,
     max_iter: int, tol, chunk: int, saver, objective: str = "marginal",
-    extra=(),
+    extra=(), cache=None,
 ):
     """On-device fit in K-iteration segments with state persistence.
 
@@ -472,7 +525,7 @@ def fit_gpr_device_checkpointed(
     def init(theta0_, lower_, upper_, x_, y_, mask_):
         return gpr_device_segment_init(
             kernel, mesh, log_space, theta0_, lower_, upper_, x_, y_, mask_,
-            extra, objective=objective, lane=lane,
+            extra, cache, objective=objective, lane=lane,
         )
 
     tol_arr = jnp.asarray(tol, theta0.dtype)
@@ -480,7 +533,7 @@ def fit_gpr_device_checkpointed(
     def run(state, limit):
         return gpr_device_segment_run(
             kernel, mesh, log_space, state, lower, upper,
-            data.x, data.y, data.mask, limit, tol_arr, extra,
+            data.x, data.y, data.mask, limit, tol_arr, extra, cache,
             objective=objective, lane=lane,
         )
 
@@ -497,18 +550,18 @@ def fit_gpr_device_checkpointed(
 )
 def _fit_gpr_device_sharded_impl(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, tol, *, objective="marginal", lane=None,
+    max_iter, tol, cache=None, *, objective="marginal", lane=None,
 ):
     with precision_lane_scope(lane):
         return _fit_gpr_device_sharded_body(
             kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-            max_iter, tol, objective, lane,
+            max_iter, tol, cache, objective, lane,
         )
 
 
 def _fit_gpr_device_sharded_body(
     kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, tol, objective, lane,
+    max_iter, tol, cache, objective, lane,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
@@ -524,26 +577,31 @@ def _fit_gpr_device_sharded_body(
         # the same sharded stack via GSPMD instead
         return fit_gpr_device(
             kernel, log_space, theta0, lower, upper, x, y, mask,
-            max_iter, tol, (), objective=objective, lane=lane,
+            max_iter, tol, (), cache, objective=objective, lane=lane,
         )
+
+    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+    in_specs = (
+        P(), P(), P(),
+        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+        P(), P(),
+    ) + cache_specs
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(
-            P(), P(), P(),
-            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-            P(), P(),
-        ),
+        in_specs=in_specs,
         out_specs=(P(), P(), P(), P(), P()),
     )
-    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, tol_):
+    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, tol_,
+            *maybe_cache):
         local = ExpertData(x=x_, y=y_, mask=mask_)
+        local_cache = cache_of(maybe_cache)
         obj = objective_fn(objective)
 
         def vag(theta, aux):
             value, grad = jax.value_and_grad(
-                lambda t: obj(kernel, t, local)
+                lambda t: obj(kernel, t, local, cache=local_cache)
             )(theta)
             # value is the local shard's partial sum -> explicit psum;
             # grad w.r.t. replicated theta is already globally reduced by
@@ -560,20 +618,21 @@ def _fit_gpr_device_sharded_body(
         )
         return from_u(theta), f, n_iter, n_fev, stalled
 
-    return run(theta0, lower, upper, x, y, mask, max_iter, tol)
+    return run(theta0, lower, upper, x, y, mask, max_iter, tol, *cache_args)
 
 
 def fit_gpr_device_sharded(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, tol, *, objective="marginal", lane=None,
+    max_iter, tol, cache=None, *, objective="marginal", lane=None,
 ):
     """Multi-chip on-device fit: the WHOLE optimizer runs inside shard_map —
     per-iteration communication is exactly one psum of the scalar NLL plus
     the implicit gradient all-reduce, all over ICI, with zero host syncs.
     ``lane=None`` resolves the ambient precision lane at call time into
-    the jit key (module note above)."""
+    the jit key (module note above); ``cache`` (expert-sharded) rides into
+    each device's local program and is reused every iteration."""
     return _fit_gpr_device_sharded_impl(
         kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-        max_iter, tol, objective=objective,
+        max_iter, tol, cache, objective=objective,
         lane=active_lane() if lane is None else lane,
     )

@@ -31,12 +31,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_gp_tpu.kernels.base import Kernel
-from spark_gp_tpu.ops.linalg import masked_kernel_matrix
+from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
 from spark_gp_tpu.parallel.experts import group_for_experts, ungroup
 
 
-def loo_moments(kernel: Kernel, theta, x, y, mask):
+def loo_moments(kernel: Kernel, theta, x, y, mask, cache=None):
     """``[E, s, ...]`` expert stack -> per-slot (mu, var, log_density).
 
     Traceable core, shared by the jitted diagnostics below and the LOO
@@ -44,13 +43,13 @@ def loo_moments(kernel: Kernel, theta, x, y, mask):
     the batched inverse's custom VJP.  Padded slots ride through the
     identity embedding of ``masked_kernel_matrix`` (K^-1 diagonal 1,
     alpha 0): their values are benign constants with zero theta-gradient,
-    never NaN; callers drop them via the mask.
+    never NaN; callers drop them via the mask.  ``cache`` is the
+    theta-invariant gram cache (kernels/base.py): the LOO hot loop skips
+    the distance contraction exactly like the marginal objective.
     """
     from spark_gp_tpu.ops.pallas_linalg import spd_inv_logdet
 
-    kmat = jax.vmap(
-        lambda xi, mi: masked_kernel_matrix(kernel.gram(theta, xi), mi)
-    )(x, mask)
+    kmat = masked_gram_stack(kernel, theta, x, mask, cache)
     ym = y * mask
     kinv, _ = spd_inv_logdet(kmat)
     alpha = jnp.einsum("eij,ej->ei", kinv, ym)
@@ -64,7 +63,7 @@ def loo_moments(kernel: Kernel, theta, x, y, mask):
     return mu, var, log_density
 
 
-def batched_loo_nll(kernel: Kernel, theta, data):
+def batched_loo_nll(kernel: Kernel, theta, data, cache=None):
     """Negative LOO log pseudo-likelihood over the expert stack.
 
     ``-L_LOO(theta)`` of R&W eq. 5.13 — the alternative hyperparameter
@@ -72,9 +71,12 @@ def batched_loo_nll(kernel: Kernel, theta, data):
     NLL (``models/likelihood.batched_nll``).  More robust under model
     misspecification: it scores held-out predictive density rather than
     data fit (R&W §5.4.2 discussion).  Same signature as ``batched_nll``
-    so every fit entry point can swap it in.
+    (including the theta-invariant ``cache`` operand) so every fit entry
+    point can swap it in.
     """
-    _, _, log_density = loo_moments(kernel, theta, data.x, data.y, data.mask)
+    _, _, log_density = loo_moments(
+        kernel, theta, data.x, data.y, data.mask, cache
+    )
     return -jnp.sum(log_density * data.mask)
 
 

@@ -312,14 +312,21 @@ def magic_solve(
 
 @partial(jax.jit, static_argnums=(0, 6))
 def _magic_solve_device_impl(
-    kernel: Kernel, theta, active, u1, u2, tau, with_variance=True
+    kernel: Kernel, theta, active, u1, u2, tau, with_variance=True,
+    cache=None,
 ):
     """One jitted f64 solve attempt with trace-relative jitter ``tau`` (a
     traced scalar: every escalation reuses the same executable).  Returns
     the solution plus a finiteness flag (Cholesky of an indefinite matrix
-    yields NaN, checked on host — can't raise under jit)."""
+    yields NaN, checked on host — can't raise under jit).  ``cache`` is
+    the ACTIVE-SET theta-invariant gram cache (kernels/base.py), built
+    once by the caller so jitter escalations re-dispatching this program
+    skip the [m, m] distance contraction."""
     m = active.shape[0]
-    kmm = kernel.gram(theta, active)
+    kmm = (
+        kernel.gram(theta, active) if cache is None
+        else kernel.gram_from_cache(theta, cache)
+    )
     sn2 = kernel.white_noise_var(theta)
     eye = jnp.eye(m, dtype=u1.dtype)
 
@@ -351,6 +358,11 @@ def _magic_solve_device_impl(
     return magic_vector, magic_matrix, ok
 
 
+@partial(jax.jit, static_argnums=0)
+def _prepare_active_cache_impl(kernel: Kernel, active):
+    return kernel.prepare(active)
+
+
 def magic_solve_device(
     kernel: Kernel, theta64, active64, u1, u2, with_variance: bool = True
 ):
@@ -359,16 +371,26 @@ def magic_solve_device(
     trace-relative jitter semantics as the host path
     (:func:`_psd_safe_cholesky`) driven from the host — each retry re-runs
     the same compiled executable with a bigger traced jitter scalar.
+    The active set's theta-invariant gram cache is built ONCE out here, so
+    escalation retries reuse the [m, m] distance block instead of
+    re-contracting it per attempt (models/common.py precompute plane; f64,
+    hence lane-immune like the rest of the stats path).
     """
+    from spark_gp_tpu.kernels.base import supports_gram_cache
+
     with jax.enable_x64():
         theta_d = jnp.asarray(theta64, dtype=jnp.float64)
         active_d = jnp.asarray(active64, dtype=jnp.float64)
         u1_d = jnp.asarray(u1, dtype=jnp.float64)
         u2_d = jnp.asarray(u2, dtype=jnp.float64)
+        cache_d = (
+            _prepare_active_cache_impl(kernel, active_d)
+            if supports_gram_cache(kernel) else None
+        )
         for k, tau in enumerate(_JITTER_SCHEDULE):
             mv, mm, ok = _magic_solve_device_impl(
                 kernel, theta_d, active_d, u1_d, u2_d,
-                jnp.asarray(tau, jnp.float64), with_variance,
+                jnp.asarray(tau, jnp.float64), with_variance, cache_d,
             )
             if bool(ok):
                 if k > 0:

@@ -110,6 +110,7 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("fit_retries", "metric", "recovery re-dispatches of the fit"),
     MetricName("bcm_renorm", "metric", "E_active / E_kept BCM renormalization factor"),
     MetricName("precision_lane", "metric", "precision lane the fit ran at (strict/mixed/fast)"),
+    MetricName("gram_cache_engaged", "metric", "1 when the theta-invariant gram cache served the fit hot loop"),
     MetricName("mixed_precision_guard.delta_nll_rel", "metric", "guard: relative NLL delta vs strict"),
     MetricName("mixed_precision_guard.delta_grad_rel", "metric", "guard: relative gradient delta vs strict"),
     MetricName("mixed_precision_guard.delta_predict_rel", "metric", "guard: relative predict delta vs strict"),

@@ -21,6 +21,14 @@ mesh) pass through unchanged: ``initialize()`` is a no-op,
 ``global_expert_mesh()`` sees only local devices, and
 ``distribute_global_experts`` degrades to :func:`mesh.shard_experts`.
 
+The theta-invariant gram cache (kernels/base.py precompute plane) needs
+nothing from this module: ``fit_distributed`` builds it from the sharded
+stack it is handed (one jitted vmapped ``prepare`` — GSPMD shards the
+cache like the stack), the shard_map fit programs take it as one more
+``P(EXPERT_AXIS)`` operand, and in DCN-fallback mode the stack is
+host-local so the cache simply rides each host's local objective
+programs across every KV-allreduced evaluation.
+
 Typical multi-host launch (same program on every host, e.g. via the TPU VM
 runtime or mpirun over DCN):
 

@@ -30,6 +30,29 @@ def expert_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (EXPERT_AXIS,))
 
 
+def sharded_cache_operand(cache):
+    """Optional expert-sharded operand plumbing for shard_map programs —
+    THE home of the convention every sharded fit uses to carry the
+    theta-invariant gram cache (kernels/base.py precompute plane).
+
+    Returns ``(extra_specs, extra_args, unpack)``:
+
+    * ``extra_specs`` — append to the program's ``in_specs`` (one
+      ``P(EXPERT_AXIS)`` entry acting as a pytree PREFIX over the whole
+      cache subtree, so composite caches shard every leaf on the expert
+      axis), empty when there is no cache;
+    * ``extra_args`` — append to the call's positional arguments;
+    * ``unpack(maybe_cache)`` — recover the cache (or ``None``) from the
+      body's trailing ``*maybe_cache`` varargs.
+
+    One helper instead of eight hand-rolled copies: changing how the
+    cache operand is sharded or validated happens here, nowhere else.
+    """
+    if cache is None:
+        return (), (), (lambda maybe_cache: None)
+    return (P(EXPERT_AXIS),), (cache,), (lambda maybe_cache: maybe_cache[0])
+
+
 def shard_experts(data, mesh: Mesh):
     """Place an :class:`ExpertData`-like pytree with leading expert axes onto
     the mesh, sharded on the leading axis, padding E to a device multiple."""

@@ -93,9 +93,17 @@ def run_captured(cmd, timeout_s: float, env=None, cwd=None) -> CapturedRun:
         except (ProcessLookupError, PermissionError):
             proc.kill()
         try:
-            proc.wait(timeout=10)  # reap; bounded for unkillable D-state
+            # reap; bounded for unkillable D-state
+            reaped = proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            pass
+            reaped = None
+        # Fence/exit race: the child may have exited on its own between the
+        # timeout firing and the SIGKILL landing.  A killed child reaps as
+        # -SIGKILL (negative); a NON-negative reaped code is the child's
+        # own exit status — report it instead of misclassifying a
+        # completed run (result, exit code and all) as a timeout.
+        if reaped is not None and reaped >= 0:
+            returncode = reaped
     # give the readers a moment to pull what's buffered; they may never
     # see EOF (a surviving pipe holder) — daemon threads, so not joining
     # to completion is safe, and the buffers keep everything read so far

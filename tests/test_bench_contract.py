@@ -129,6 +129,22 @@ def test_bench_emits_one_parseable_result_line():
     # no-breach is only pinned for the production-intended mixed lane
     # (fast is a documented loose tripwire, not an accuracy contract)
     assert lanes["mixed"]["guard"]["breach"] == 0.0, lanes["mixed"]["guard"]
+    # the theta-invariant precompute plane (ISSUE 8, kernels/base.py):
+    # cached isotropic evaluations must beat the per-eval gram rebuild by
+    # >= 1.3x on the distance-dominated CPU probe, the cache must actually
+    # have engaged, and toggling the plane (GP_GRAM_CACHE) must not move
+    # any family's fitted hyperparameters beyond float noise
+    hot = detail["fit_hot_loop"]
+    assert "error" not in hot, hot
+    assert hot["cache_engaged"] is True
+    evals = hot["nll_evals_per_sec"]
+    assert evals["cached"] > 0 and evals["uncached"] > 0
+    assert evals["speedup"] >= 1.3, evals
+    assert set(hot["families"]) == {"gpr", "gpc", "gp_poisson"}
+    for name, fam in hot["families"].items():
+        assert fam["cached_cache_engaged"] == 1.0, (name, fam)
+        assert fam["uncached_cache_engaged"] == 0.0, (name, fam)
+        assert fam["theta_max_abs_delta"] <= 1e-6, (name, fam)
     # the observability contract: the span/journal/telemetry layer stays
     # out of the hot path — <2% on fit and serve_predict (min-of-reps,
     # interleaved; obs/trace.py) — while provably ON (spans recorded)

@@ -1,0 +1,346 @@
+"""Theta-invariant precompute plane (ISSUE 8): cached-vs-recomputed parity.
+
+The contract under test (kernels/base.py): for every kernel declaring
+``prepare``, ``gram_from_cache(theta, prepare(x))`` must reproduce
+``gram(theta, x)`` — and every fit objective fed a cache must produce the
+same NLL/gradient/optimum as the per-evaluation rebuild, while never
+touching ``kernel.gram`` inside the differentiated hot loop.  Kernels
+without an invariant (ARD, custom) must keep today's programs untouched.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_gp_tpu.kernels.base import (
+    Const,
+    EyeKernel,
+    ThetaOverrideKernel,
+    masked_gram_stack,
+    prepare_gram_cache,
+    supports_gram_cache,
+)
+from spark_gp_tpu.kernels.families import (
+    DotProductKernel,
+    PeriodicKernel,
+    PolynomialKernel,
+    RationalQuadraticKernel,
+    SpectralMixtureKernel,
+)
+from spark_gp_tpu.kernels.matern import (
+    ARDMatern32Kernel,
+    Matern12Kernel,
+    Matern32Kernel,
+    Matern52Kernel,
+)
+from spark_gp_tpu.kernels.rbf import ARDRBFKernel, RBFKernel
+from spark_gp_tpu.models.likelihood import batched_nll, make_value_and_grad
+from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+
+P_DIM = 3
+
+# every shipped kernel family with a theta-invariant structure, plus the
+# composition algebra around them (noise-augmented sums, trainable scale,
+# products, theta overrides)
+CACHED_KERNELS = {
+    "rbf": lambda: RBFKernel(0.6, 1e-6, 10.0),
+    "matern12": lambda: Matern12Kernel(0.8),
+    "matern32": lambda: Matern32Kernel(0.8),
+    "matern52": lambda: Matern52Kernel(0.8),
+    "rq": lambda: RationalQuadraticKernel(0.7, 1.3),
+    "dot": lambda: DotProductKernel(0.5),
+    "poly": lambda: PolynomialKernel(2, 0.8),
+    "sum_noise": lambda: 1.0 * RBFKernel(0.6, 1e-6, 10.0)
+    + Const(1e-2) * EyeKernel(),
+    "product": lambda: RBFKernel(0.9) * Matern32Kernel(1.1),
+    "scaled_sum": lambda: Const(0.5) * (
+        Matern52Kernel(0.7) + 2.0 * RationalQuadraticKernel(1.0, 2.0)
+    ),
+    "override": lambda: ThetaOverrideKernel(
+        1.0 * RBFKernel(0.6, 1e-6, 10.0) + Const(1e-2) * EyeKernel(),
+        [1.7, 0.45],
+    ),
+}
+
+# kernels that must DECLINE the plane (theta-dependent distances / maps)
+UNCACHED_KERNELS = {
+    "ard_rbf": lambda: ARDRBFKernel(P_DIM),
+    "ard_matern": lambda: ARDMatern32Kernel(P_DIM),
+    "periodic": lambda: PeriodicKernel(1.0, 1.0),
+    "spectral": lambda: SpectralMixtureKernel(P_DIM, q=2),
+    "mixed_sum": lambda: RBFKernel(0.6) + 1.0 * ARDRBFKernel(P_DIM),
+}
+
+
+def _stack(n=160, s=40, dtype=np.float64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, P_DIM))
+    y = np.sin(x.sum(axis=1))
+    return group_for_experts(x, y, s, dtype=dtype)
+
+
+def _theta(kernel, dtype):
+    t = np.asarray(kernel.init_theta(), dtype=np.float64)
+    # nudge off the init point so scale coefficients are not exactly 1
+    t = t * (1.0 + 0.1 * np.arange(1, t.shape[0] + 1))
+    return jnp.asarray(t, dtype=dtype)
+
+
+@pytest.mark.parametrize("name", sorted(CACHED_KERNELS))
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_cached_gram_nll_grad_parity(name, dtype):
+    """gram / NLL / gradient from the cache match the rebuild: to float
+    noise in f32 (<= 1e-6 relative) and exactly in f64 — the cached path
+    runs the same arithmetic minus the re-contraction."""
+    kernel = CACHED_KERNELS[name]()
+    assert supports_gram_cache(kernel)
+    ctx = jax.enable_x64() if dtype == np.float64 else _nullcontext()
+    with ctx:
+        data = _stack(dtype=dtype)
+        theta = _theta(kernel, data.x.dtype)
+        cache = prepare_gram_cache(kernel, data.x)
+        assert cache is not None
+
+        g_cached = masked_gram_stack(kernel, theta, data.x, data.mask, cache)
+        g_plain = masked_gram_stack(kernel, theta, data.x, data.mask)
+        tol = 0.0 if dtype == np.float64 else 1e-6
+        np.testing.assert_allclose(
+            np.asarray(g_cached), np.asarray(g_plain), rtol=tol, atol=tol
+        )
+
+        # the model kernel may lack a ridge (pure RBF/Matérn grams are
+        # singular-ish at coincident-free data they are fine) — add noise
+        noisy = kernel + Const(1e-2) * EyeKernel()
+        theta_n = jnp.asarray(theta, dtype=data.x.dtype)
+        cache_n = prepare_gram_cache(noisy, data.x)
+        v_c, g_c = make_value_and_grad(noisy, data, cache=cache_n)(theta_n)
+        v_u, g_u = make_value_and_grad(noisy, data)(theta_n)
+        rtol = 0.0 if dtype == np.float64 else 1e-6
+        np.testing.assert_allclose(float(v_c), float(v_u), rtol=max(rtol, 0))
+        np.testing.assert_allclose(
+            np.asarray(g_c), np.asarray(g_u), rtol=rtol, atol=rtol
+        )
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+@pytest.mark.parametrize("name", sorted(UNCACHED_KERNELS))
+def test_prepare_none_fallback(name):
+    """Kernels without an invariant decline the plane: prepare is None,
+    prepare_gram_cache returns None, and the uncached objective runs."""
+    kernel = UNCACHED_KERNELS[name]()
+    assert kernel.prepare is None
+    assert not supports_gram_cache(kernel)
+    data = _stack()
+    assert prepare_gram_cache(kernel, data.x) is None
+    theta = _theta(kernel, data.x.dtype)
+    noisy = kernel + Const(1e-2) * EyeKernel()
+    assert noisy.prepare is None  # composites inherit the opt-out
+    v, g = make_value_and_grad(noisy, data)(
+        jnp.asarray(np.asarray(noisy.init_theta()), dtype=data.x.dtype)
+    )
+    assert np.isfinite(float(v))
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_gram_cache_kill_switch(monkeypatch):
+    """GP_GRAM_CACHE=0 disables the plane process-wide."""
+    kernel = 1.0 * RBFKernel(0.6, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    data = _stack()
+    monkeypatch.setenv("GP_GRAM_CACHE", "0")
+    assert not supports_gram_cache(kernel)
+    assert prepare_gram_cache(kernel, data.x) is None
+    monkeypatch.delenv("GP_GRAM_CACHE")
+    assert supports_gram_cache(kernel)
+
+
+class _GramForbiddenRBF(RBFKernel):
+    """RBF whose ``gram`` refuses to trace: proves the cached objective
+    never routes through the raw gram build.  ``prepare``/``cross``/
+    ``gram_from_cache`` are inherited untouched."""
+
+    def gram(self, theta, x):
+        raise AssertionError(
+            "kernel.gram was called inside a cached fit objective"
+        )
+
+
+def test_no_gram_call_inside_cached_objective():
+    """The lint-style contract of the ISSUE: with a cache available, no
+    fit entry point evaluates ``kernel.gram`` inside the differentiated
+    objective — asserted by tracing the cached programs with a kernel
+    whose ``gram`` raises."""
+    from spark_gp_tpu.models.laplace import batched_neg_logz
+    from spark_gp_tpu.models.loo import batched_loo_nll
+
+    kernel = (
+        1.0 * _GramForbiddenRBF(0.6, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    )
+    data = _stack()
+    theta = jnp.asarray(
+        np.asarray(kernel.init_theta()), dtype=data.x.dtype
+    )
+    cache = prepare_gram_cache(kernel, data.x)
+    assert cache is not None
+    # marginal NLL + gradient (the GPR hot loop)
+    v, g = make_value_and_grad(kernel, data, cache=cache)(theta)
+    assert np.isfinite(float(v))
+    # LOO objective
+    v_loo = jax.jit(
+        lambda t: batched_loo_nll(kernel, t, data, cache=cache),
+        static_argnums=(),
+    )(theta)
+    assert np.isfinite(float(v_loo))
+    # Laplace objective (gram stack + dK/dtheta jacobian both cached)
+    y01 = (np.asarray(data.y) > 0).astype(np.float64)
+    data_b = ExpertData(
+        x=data.x, y=jnp.asarray(y01, data.x.dtype), mask=data.mask
+    )
+    nll, grad, _ = batched_neg_logz(
+        kernel, 1e-6, theta, data_b, jnp.zeros_like(data_b.y), cache
+    )
+    assert np.isfinite(float(nll))
+    assert np.all(np.isfinite(np.asarray(grad)))
+    # and WITHOUT a cache the guard actually bites (the test tests itself)
+    with pytest.raises(AssertionError, match="cached fit objective"):
+        make_value_and_grad(kernel, data)(theta)
+
+
+def test_jitter_operand_rides_cached_objective():
+    """The resilience layer's adaptive-jitter retries re-dispatch the SAME
+    cached program with a traced jitter operand: values must match the
+    uncached jittered objective, and the cache is reused verbatim."""
+    kernel = 1.0 * RBFKernel(0.6, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    data = _stack()
+    theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=data.x.dtype)
+    cache = prepare_gram_cache(kernel, data.x)
+    jitter = jnp.full((data.x.shape[0],), 1e-4, data.x.dtype)
+    v_c = batched_nll(kernel, theta, data, jitter, cache=cache)
+    v_u = batched_nll(kernel, theta, data, jitter)
+    np.testing.assert_allclose(float(v_c), float(v_u), rtol=1e-6)
+
+
+def _gpr(optimizer, restarts=1, **kw):
+    from spark_gp_tpu import GaussianProcessRegression
+
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(0.5, 1e-6, 10.0))
+        .setDatasetSizeForExpert(40)
+        .setActiveSetSize(24)
+        .setSigma2(1e-3)
+        .setSeed(3)
+        .setMaxIter(12)
+        .setOptimizer(optimizer)
+    )
+    if restarts > 1:
+        gp = gp.setNumRestarts(restarts)
+    return gp
+
+
+def _fit_theta(gp, x, y, enabled):
+    prev = os.environ.get("GP_GRAM_CACHE")
+    os.environ["GP_GRAM_CACHE"] = "1" if enabled else "0"
+    try:
+        model = gp.fit(x, y)
+    finally:
+        if prev is None:
+            os.environ.pop("GP_GRAM_CACHE", None)
+        else:
+            os.environ["GP_GRAM_CACHE"] = prev
+    assert model.instr.metrics.get("gram_cache_engaged") == float(enabled)
+    return np.asarray(model.raw_predictor.theta)
+
+
+@pytest.mark.parametrize("optimizer", ["host", "device"])
+def test_fit_theta_parity_cached_vs_uncached(optimizer):
+    """End-to-end: the fitted optimum is identical (<= 1e-6) with the
+    plane on vs off, on both optimizer paths."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(200, P_DIM))
+    y = np.sin(x.sum(axis=1))
+    t_on = _fit_theta(_gpr(optimizer), x, y, True)
+    t_off = _fit_theta(_gpr(optimizer), x, y, False)
+    np.testing.assert_allclose(t_on, t_off, atol=1e-6)
+
+
+def test_multistart_shares_one_cache():
+    """The batched device multi-start broadcasts ONE cache across the R
+    vmapped lanes (it is closed over, not vmapped) and lands on the same
+    winner as the uncached run."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(200, P_DIM))
+    y = np.sin(x.sum(axis=1))
+    t_on = _fit_theta(_gpr("device", restarts=3), x, y, True)
+    t_off = _fit_theta(_gpr("device", restarts=3), x, y, False)
+    np.testing.assert_allclose(t_on, t_off, atol=1e-6)
+
+
+def test_quarantine_retry_rebuilds_cache():
+    """A poisoned expert fit completes on the cached path: the pre-fit
+    screen (or the recovery driver) quarantines it, the cache tracks the
+    repaired stack, and the result matches the uncached recovery."""
+    from spark_gp_tpu.resilience.chaos import poison_expert
+    from spark_gp_tpu.parallel.experts import num_experts_for
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(200, P_DIM))
+    y = np.sin(x.sum(axis=1))
+    e = num_experts_for(x.shape[0], 40)
+    xq, yq = poison_expert(x, y, expert=1, num_experts=e, kind="nan", seed=0)
+    t_on = _fit_theta(_gpr("host"), xq, yq, True)
+    t_off = _fit_theta(_gpr("host"), xq, yq, False)
+    np.testing.assert_allclose(t_on, t_off, atol=1e-6)
+
+
+def test_ard_program_identity_unchanged():
+    """ARD (prepare=None) fits hand the SAME jitted program a ``None``
+    cache whether the plane is enabled or not: toggling GP_GRAM_CACHE
+    must not add a compile cache entry (the acceptance criterion's
+    byte-identical-programs / no-compile-regression check)."""
+    from spark_gp_tpu.models.likelihood import _vag_impl
+
+    kernel = 1.0 * ARDRBFKernel(P_DIM) + Const(1e-2) * EyeKernel()
+    data = _stack(seed=13)
+    theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=data.x.dtype)
+    before = _vag_impl._cache_size()
+    v1, _ = make_value_and_grad(kernel, data)(theta)
+    after_first = _vag_impl._cache_size()
+    prev = os.environ.get("GP_GRAM_CACHE")
+    os.environ["GP_GRAM_CACHE"] = "0"
+    try:
+        cache = prepare_gram_cache(kernel, data.x)
+        assert cache is None
+        v2, _ = make_value_and_grad(kernel, data, cache=cache)(theta)
+    finally:
+        if prev is None:
+            os.environ.pop("GP_GRAM_CACHE", None)
+        else:
+            os.environ["GP_GRAM_CACHE"] = prev
+    # second call re-used the first call's executable: no new entry
+    assert _vag_impl._cache_size() == after_first
+    assert after_first >= before
+    np.testing.assert_allclose(float(v1), float(v2), rtol=0, atol=0)
+
+
+def test_cache_memory_is_one_distance_stack():
+    """The documented memory cost: for the noise-augmented isotropic model
+    kernel the cache is one [E, s, s] block plus a zero-byte Eye carrier
+    (docs/ROOFLINE.md)."""
+    kernel = 1.0 * RBFKernel(0.6, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    data = _stack()
+    cache = prepare_gram_cache(kernel, data.x)
+    leaves = jax.tree.leaves(cache)
+    e, s = data.x.shape[0], data.x.shape[1]
+    sizes = sorted(leaf.size for leaf in leaves)
+    assert sizes == [0, e * s * s]

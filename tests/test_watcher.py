@@ -121,3 +121,27 @@ def test_capture_window_bails_when_tunnel_dies(monkeypatch):
     assert watcher.capture_window(notes.append) is False
     assert ran == ["TPU_WINDOW_BENCH.json"]
     assert any("abandoning" in n for n in notes)
+
+
+def test_bench_fence_sized_from_constituent_knobs(monkeypatch):
+    """The bench lane's fence must follow the timeout knobs bench.py
+    honors (attempts x preflight + backoff + 2 x worker + roofline +
+    margin) instead of a hardcoded zero-slack constant: raising
+    BENCH_WORKER_TIMEOUT must raise the fence past the new worker
+    budget, never let the watcher kill a healthy bench."""
+    for var in (
+        "BENCH_PREFLIGHT_TIMEOUT", "BENCH_PREFLIGHT_ATTEMPTS",
+        "BENCH_WORKER_TIMEOUT", "BENCH_ROOFLINE_TIMEOUT",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    default = watcher._bench_fence_s()
+    # defaults: (4 default + 1 cpu-fallback attempt)*150 + 90 backoff
+    # + 2*2400 workers + 1500 roofline + 300 margin
+    assert default == 5 * 150 + 90 + 2 * 2400 + 1500 + 300
+    # the fence covers both worker plans plus the roofline, with slack
+    assert default > 2 * 2400 + 1500
+    monkeypatch.setenv("BENCH_WORKER_TIMEOUT", "4000")
+    assert watcher._bench_fence_s() >= default + 2 * (4000 - 2400)
+    monkeypatch.setenv("BENCH_PREFLIGHT_ATTEMPTS", "1")
+    # 1 default attempt + 1 fallback attempt, no backoff sleeps
+    assert watcher._bench_fence_s() == 2 * 150 + 2 * 4000 + 1500 + 300
