@@ -95,8 +95,17 @@ def main() -> None:
                           "Pallas runs in interpret mode; timings are NOT "
                           "meaningful"}), flush=True)
     # print AS each size completes (flushed): partial sweeps survive a
-    # mid-run tunnel death in the watcher's captured stdout
-    sweep(on_row=lambda row: print(json.dumps(row), flush=True))
+    # mid-run tunnel death in the watcher's captured stdout.  Size/iter
+    # knobs exist for the watcher dress rehearsal (interpret-mode CPU runs
+    # are ~100x slower per matrix; a tiny sweep still proves the lane).
+    sizes_env = _os.environ.get("PALLAS_SWEEP_SIZES", "").strip()
+    kwargs = {}
+    if sizes_env:
+        kwargs["sizes"] = tuple(int(s) for s in sizes_env.split(","))
+    iters_env = _os.environ.get("PALLAS_SWEEP_ITERS", "").strip()
+    if iters_env:
+        kwargs["iters"] = int(iters_env)
+    sweep(on_row=lambda row: print(json.dumps(row), flush=True), **kwargs)
 
 
 if __name__ == "__main__":

@@ -45,6 +45,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from spark_gp_tpu.utils.subproc import run_captured  # noqa: E402
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: where _run writes lane artifacts; the dress rehearsal points it at a
+#: scratch dir so rehearsal envelopes never clobber real TPU evidence
+ART_DIR = ROOT
 PROBE = (
     # a computed round trip, not just enumeration: the r5 tunnel failure
     # mode can register the platform / list devices yet hang on first
@@ -55,6 +58,10 @@ PROBE = (
 
 
 def _probe_tpu(timeout_s: float = 90.0) -> bool:
+    if os.environ.get("GP_WATCHER_ASSUME_UP") == "1":
+        # dress-rehearsal override: pretend the window is open so the
+        # full capture sequence runs on CPU (rehearse() below)
+        return True
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     r = run_captured([sys.executable, "-c", PROBE], timeout_s, env=env)
@@ -104,7 +111,7 @@ def _run(cmd, out_path, timeout_s, env=None):
     # failed/timed out while the prior recorded a clean exit, OR when the
     # prior measured on TPU and this run didn't reach the chip (bench.py's
     # CPU-fallback plan exits 0 but its numbers are not comparable).
-    target = os.path.join(ROOT, out_path)
+    target = os.path.join(ART_DIR, out_path)
     prior = None
     if os.path.exists(target):
         try:
@@ -158,9 +165,15 @@ def capture_window(note) -> bool:
     and large-m lanes (r4 #3/#4), and the Pallas sweep last.
     """
     env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
+    rehearsal = env.get("GP_WATCHER_REHEARSAL") == "1"
+    if rehearsal:
+        # dress rehearsal (rehearse() below): the SAME five-lane sequence
+        # on the CPU backend — tiny configs, real subprocesses
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env.pop("JAX_PLATFORMS", None)
     tenv = dict(env)
-    tenv["GP_TEST_PLATFORM"] = "tpu"
+    tenv["GP_TEST_PLATFORM"] = "cpu" if rehearsal else "tpu"
     lanes = [
         ([sys.executable, "bench.py"],
          "TPU_WINDOW_BENCH.json", _bench_fence_s(), env, "bench"),
@@ -184,6 +197,93 @@ def capture_window(note) -> bool:
             return False
     note("window capture finished")
     return True
+
+
+#: env-forced tiny configs for the dress rehearsal: every lane's real
+#: knobs at CPU-budget sizes (the same shapes test_bench_contract proves)
+REHEARSAL_ENV = {
+    "GP_WATCHER_REHEARSAL": "1",
+    "GP_WATCHER_ASSUME_UP": "1",
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_N": "1500", "BENCH_EXPERT": "50", "BENCH_MXU_EXPERT": "64",
+    "BENCH_MAXITER": "3", "BENCH_PREFLIGHT_TIMEOUT": "120",
+    "BENCH_PREFLIGHT_ATTEMPTS": "1",
+    "MATCHED_N": "2000", "MATCHED_EXPERT": "50", "MATCHED_MAXITER": "3",
+    "LARGE_M": "2048", "LARGE_M_N": "12000", "LARGE_M_MAXITER": "2",
+    "PALLAS_SWEEP_SIZES": "32,64", "PALLAS_SWEEP_ITERS": "2",
+}
+
+
+def rehearse(out_dir: str, note=print) -> dict:
+    """Watcher dress rehearsal: the FULL five-lane window sequence through
+    :func:`capture_window` itself — real subprocess lanes at env-forced
+    tiny CPU configs, artifacts written to ``out_dir`` (never the real
+    ``TPU_WINDOW_*`` evidence).  Returns a summary dict (also written to
+    ``out_dir/WATCHER_REHEARSAL.json``) recording, per lane, the envelope
+    validity, returncode/timeout and captured platform — the proof the
+    whole capture plumbing works BEFORE the next real tunnel window, not
+    during it.
+    """
+    global ART_DIR
+    prev_art, prev_env = ART_DIR, {}
+    for key, value in REHEARSAL_ENV.items():
+        prev_env[key] = os.environ.get(key)
+        os.environ[key] = value
+    ART_DIR = out_dir
+    os.makedirs(out_dir, exist_ok=True)
+    notes = []
+
+    def _note(msg):
+        notes.append(msg)
+        note(msg)
+
+    start = time.time()
+    try:
+        completed = capture_window(_note)
+    finally:
+        ART_DIR = prev_art
+        for key, value in prev_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+    lanes = {}
+    for name in ("BENCH", "TESTS", "MATCHED", "LARGE_M", "PALLAS"):
+        path = os.path.join(out_dir, f"TPU_WINDOW_{name}.json")
+        lane = {"artifact": os.path.basename(path), "present": False}
+        if os.path.exists(path):
+            try:
+                with open(path) as fh:
+                    envelope = json.load(fh)
+                lane.update(
+                    present=True,
+                    valid_envelope=all(
+                        k in envelope
+                        for k in ("captured", "command", "stdout_tail")
+                    ) and (
+                        "returncode" in envelope
+                        or "timed_out_after_s" in envelope
+                    ),
+                    returncode=envelope.get("returncode"),
+                    timed_out=("timed_out_after_s" in envelope),
+                    platform=_captured_platform(envelope),
+                )
+            except ValueError as exc:
+                lane.update(valid_envelope=False, error=str(exc)[:200])
+        lanes[name] = lane
+    summary = {
+        "format": "spark_gp_tpu.watcher_rehearsal/v1",
+        "captured": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "completed_window": completed,
+        "wall_seconds": round(time.time() - start, 1),
+        "env": dict(REHEARSAL_ENV),
+        "lanes": lanes,
+        "notes": notes,
+    }
+    with open(os.path.join(out_dir, "WATCHER_REHEARSAL.json"), "w") as fh:
+        json.dump(summary, fh, indent=1)
+        fh.write("\n")
+    return summary
 
 
 def main() -> None:
@@ -218,4 +318,16 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--rehearse" in sys.argv:
+        # dress rehearsal: full five-lane capture on CPU, artifacts into
+        # ./rehearsal (or the next argument after --rehearse)
+        idx = sys.argv.index("--rehearse")
+        target = (
+            sys.argv[idx + 1] if len(sys.argv) > idx + 1
+            else os.path.join(ROOT, "rehearsal")
+        )
+        summary = rehearse(target)
+        sys.exit(0 if all(
+            lane.get("valid_envelope") for lane in summary["lanes"].values()
+        ) else 1)
     main()

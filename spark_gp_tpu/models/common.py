@@ -229,6 +229,10 @@ class GaussianProcessParams:
         return self
 
     def _resolved_optimizer(self) -> str:
+        if getattr(self, "_fallback_mode", None) == "host_f64":
+            # the degradation ladder's host rung (resilience/fallback.py):
+            # re-execute the failed fit host-driven, whatever was asked for
+            return "host"
         if getattr(self, "_dcn_ctx", None) is not None:
             # DCN-fallback fits interleave a KV-store allreduce into every
             # objective evaluation — only the host-driven optimizer has a
@@ -240,6 +244,67 @@ class GaussianProcessParams:
         import jax
 
         return "device" if jax.default_backend() == "tpu" else "host"
+
+    # --- degradation-ladder plumbing (resilience/fallback.py) -------------
+    def _fallback_segmented(self) -> bool:
+        """True while the ladder's ``segmented`` rung is executing: the
+        device fit routes through the checkpointed segment driver with an
+        in-memory saver and a halved segment batch."""
+        return getattr(self, "_fallback_mode", None) == "segmented"
+
+    def _segment_saver_and_chunk(self, file_tag: str, data):
+        """``(saver, chunk)`` for a segmented device fit: the real
+        coordinated checkpointer + configured interval when a checkpoint
+        dir is set; the ladder's in-memory null saver + HALVED segment
+        batch when the segmented fallback rung re-executes a fit that
+        never asked for durability."""
+        if self._checkpoint_dir is not None:
+            return (
+                self._make_device_checkpointer(file_tag, data),
+                self._checkpoint_interval,
+            )
+        from spark_gp_tpu.resilience.fallback import (
+            NullSegmentSaver,
+            fallback_segment_chunk,
+        )
+
+        return (
+            NullSegmentSaver(),
+            fallback_segment_chunk(self._checkpoint_interval),
+        )
+
+    def _host_f64_operands(self, data, extra=(), cache=None):
+        """``(data, extra, cache)`` with the expert stack re-materialized
+        in float64 and the gram cache dropped WHEN the ladder's
+        ``host_f64`` rung is executing over an unmeshed stack (the rung
+        runs under ``jax.enable_x64``, so an f32 runtime gets real
+        precision headroom; the f64 recompute path is the exact reference
+        semantics) — and the inputs untouched otherwise.  The gate lives
+        HERE so the four families' host branches stay one unconditional
+        call and cannot drift."""
+        if (
+            getattr(self, "_fallback_mode", None) != "host_f64"
+            or self._mesh is not None
+        ):
+            return data, extra, cache
+        import jax.numpy as jnp
+
+        def cast(a):
+            return jnp.asarray(np.asarray(a), dtype=jnp.float64)
+
+        data64 = ExpertData(x=cast(data.x), y=cast(data.y), mask=cast(data.mask))
+        return data64, tuple(cast(e) for e in extra), None
+
+    def _device_fit_op(self) -> str:
+        """Chaos choke-point name of the device-fit dispatch about to run
+        (``resilience/chaos.maybe_injected_failure``): staged faults scope
+        to one dispatch shape, so e.g. an injected one-dispatch OOM leaves
+        the segmented rung's smaller dispatches clean."""
+        if self._checkpoint_dir is not None or self._fallback_segmented():
+            return "fit.device.segment"
+        if self._mesh is not None:
+            return "fit.device.sharded"
+        return "fit.device.one_dispatch"
 
     def setHyperSpace(self, value: str):
         """Coordinate system for hyperparameter optimization.
@@ -840,6 +905,12 @@ class GaussianProcessCommons(GaussianProcessParams):
         (GaussianProcessCommons.scala:66-92)."""
         instr.log_info("Optimising the kernel hyperparameters")
         from spark_gp_tpu.parallel import coord as coord_mod
+        from spark_gp_tpu.resilience import chaos
+
+        # chaos choke point for the host-driven optimizer (the jitted
+        # objective dispatches can OOM/fail-compile exactly like the
+        # one-dispatch device programs; fallback ladder + soak proof)
+        chaos.maybe_injected_failure("fit.host")
 
         dcn = getattr(self, "_dcn_ctx", None)
         if dcn is not None:
@@ -1051,14 +1122,19 @@ class GaussianProcessCommons(GaussianProcessParams):
         preparation lives in ``prepare`` (label-domain checks, one-hot
         construction, ...)."""
         instr = Instrumentation(name=name)
+        from spark_gp_tpu.resilience import fallback
+
         with self._stack_mesh(data), self._dcn_scope():
             # observation shell INSIDE the mesh context but around the
             # whole body: the data screen's quarantine events and the
-            # restart driver land in one root span (the gpr.py convention)
+            # restart driver land in one root span (the gpr.py convention).
+            # The degradation ladder (sharded -> DCN-fallback ->
+            # single-host) wraps the body; GP_FALLBACK=0 restores the
+            # straight call.
             return self._observed_fit(
                 instr,
-                lambda: self._fit_distributed_body(
-                    instr, data, active_set, prepare
+                lambda: fallback.run_distributed_ladder(
+                    self, instr, data, active_set, prepare
                 ),
             )
 
@@ -1410,6 +1486,15 @@ class GaussianProcessCommons(GaussianProcessParams):
                 "the probe expert — this kernel/data combination should "
                 "run on the strict lane (setPrecisionLane('strict'))"
             )
+            from spark_gp_tpu.ops.precision import guard_action
+            from spark_gp_tpu.resilience import fallback
+
+            if guard_action() == "degrade" and fallback.enabled():
+                # GP_GUARD_ACTION=degrade: escalate the breach into the
+                # degradation ladder, which re-runs this fit on the strict
+                # lane (resilience/fallback.py).  Default ("log") keeps
+                # the pre-ladder warn-only behavior bit-for-bit.
+                raise fallback.GuardBreachError(lane, worst, bar)
 
     def _finalize_device_fit(
         self,

@@ -80,13 +80,18 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         # every restart (common._gram_cache)
         cache = self._gram_cache(instr, data)
 
-        if self._use_batched_multistart():
-            return self._fit_device_multistart(instr, data, x, cache)
-
         def fit_once(kernel, instr_r):
             return self._fit_from_stack(instr_r, kernel, data, x, cache=cache)
 
-        return self._fit_with_restarts(instr, fit_once)
+        def attempt():
+            if self._use_batched_multistart():
+                return self._fit_device_multistart(instr, data, x, cache)
+            return self._fit_with_restarts(instr, fit_once)
+
+        from spark_gp_tpu.resilience import fallback
+
+        # degradation ladder around the complete attempt (gpr.py wrap)
+        return fallback.run_fit_ladder(self, instr, attempt)
 
     def _fit_device_multistart(
         self, instr, data, x, cache=None
@@ -240,6 +245,9 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
 
     def _fit_host(self, instr, kernel, data, cache=None):
         lik = self._likelihood
+        # ladder host_f64 rung: f64 stack, cache dropped (no-op on every
+        # other path — common._host_f64_operands gates itself)
+        data, _, cache = self._host_f64_operands(data, cache=cache)
         if self._mesh is not None:
             objective = make_sharded_generic_objective(
                 lik, kernel, data.x, data.y, data.mask, self._tol,
@@ -264,8 +272,12 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         upper = jnp.asarray(upper, dtype=dtype)
         log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
+        from spark_gp_tpu.resilience import chaos
+
+        # chaos choke point for staged execution faults (fallback ladder)
+        chaos.maybe_injected_failure(self._device_fit_op())
         with instr.phase("optimize_hypers"):
-            if self._checkpoint_dir is not None:
+            if self._checkpoint_dir is not None or self._fallback_segmented():
                 from spark_gp_tpu.models.laplace_generic import (
                     fit_generic_device_checkpointed,
                 )
@@ -279,17 +291,15 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                 lik_digest = hashlib.sha1(
                     repr((type(lik).__name__, lik._spec())).encode()
                 ).hexdigest()[:10]
+                saver, chunk = self._segment_saver_and_chunk(
+                    f"generic-{type(lik).__name__}-{lik_digest}", data
+                )
                 theta, f_final, nll, n_iter, n_fev, stalled = (
                     fit_generic_device_checkpointed(
                         self._likelihood, kernel, float(self._tol),
                         self._mesh, log_space, theta0, lower, upper,
                         data.x, data.y, data.mask, self._max_iter,
-                        self._checkpoint_interval,
-                        self._make_device_checkpointer(
-                            f"generic-{type(lik).__name__}-{lik_digest}",
-                            data,
-                        ),
-                        cache,
+                        chunk, saver, cache,
                     )
                 )
             elif self._mesh is not None:

@@ -98,11 +98,6 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         # restart (all restarts wrap ONE kernel spec — common._gram_cache)
         cache = self._gram_cache(instr, data)
 
-        if self._use_batched_multistart():
-            return self._fit_device_multistart(
-                instr, data, x, make_targets_fn, cache
-            )
-
         def fit_once(kernel, instr_r):
             raw = self._fit_from_stack(
                 instr_r, kernel, data, x, make_targets_fn, cache=cache
@@ -112,7 +107,19 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             model.instr = instr_r
             return model
 
-        return self._fit_with_restarts(instr, fit_once)
+        def attempt():
+            if self._use_batched_multistart():
+                return self._fit_device_multistart(
+                    instr, data, x, make_targets_fn, cache
+                )
+            return self._fit_with_restarts(instr, fit_once)
+
+        from spark_gp_tpu.resilience import fallback
+
+        # degradation ladder around the complete attempt (the same wrap as
+        # gpr._fit_body): classified execution failures re-execute one
+        # rung down; GP_FALLBACK=0 restores raw propagation
+        return fallback.run_fit_ladder(self, instr, attempt)
 
     # human-readable engine tag for the multistart log line; the EP
     # subclass overrides both this and _multistart_device_call
@@ -272,6 +279,9 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                 active_override=active_override,
             )
         else:
+            # ladder host_f64 rung: f64 stack, cache dropped (no-op on
+            # every other path — common._host_f64_operands gates itself)
+            data, _, cache = self._host_f64_operands(data, cache=cache)
             if self._mesh is not None:
                 objective = make_sharded_laplace_objective(
                     kernel, data, self._tol, self._mesh, cache
@@ -314,19 +324,22 @@ class GaussianProcessClassifier(GaussianProcessCommons):
 
         log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
+        from spark_gp_tpu.resilience import chaos
+
+        # chaos choke point for staged execution faults (fallback ladder)
+        chaos.maybe_injected_failure(self._device_fit_op())
         with instr.phase("optimize_hypers"):
-            if self._checkpoint_dir is not None:
+            if self._checkpoint_dir is not None or self._fallback_segmented():
                 from spark_gp_tpu.models.laplace import (
                     fit_gpc_device_checkpointed,
                 )
 
+                saver, chunk = self._segment_saver_and_chunk("gpc", data)
                 theta, f_final, f, n_iter, n_fev, stalled = (
                     fit_gpc_device_checkpointed(
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data, self._max_iter,
-                        self._checkpoint_interval,
-                        self._make_device_checkpointer("gpc", data),
-                        cache,
+                        chunk, saver, cache,
                     )
                 )
             elif self._mesh is not None:

@@ -160,6 +160,9 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
         ep_model = GaussianProcessEPClassificationModel(model.raw_predictor)
         ep_model.instr = model.instr
         ep_model.run_journal = getattr(model, "run_journal", None)
+        if getattr(model, "degradations", None):
+            # the rewrap must not lose the ladder's provenance stamp
+            ep_model.degradations = model.degradations
         return ep_model
 
     def fit_distributed(self, data, active_set=None):
@@ -167,6 +170,8 @@ class GaussianProcessEPClassifier(GaussianProcessClassifier):
         ep_model = GaussianProcessEPClassificationModel(model.raw_predictor)
         ep_model.instr = model.instr
         ep_model.run_journal = getattr(model, "run_journal", None)
+        if getattr(model, "degradations", None):
+            ep_model.degradations = model.degradations
         return ep_model
 
 

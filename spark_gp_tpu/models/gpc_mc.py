@@ -120,15 +120,20 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         # every restart (common._gram_cache)
         cache = self._gram_cache(instr, data)
 
-        if self._use_batched_multistart():
-            return self._fit_device_multistart(instr, data, y1h, x, cache)
-
         def fit_once(kernel, instr_r):
             return self._fit_from_stack(
                 instr_r, kernel, data, y1h, x, cache=cache
             )
 
-        return self._fit_with_restarts(instr, fit_once)
+        def attempt():
+            if self._use_batched_multistart():
+                return self._fit_device_multistart(instr, data, y1h, x, cache)
+            return self._fit_with_restarts(instr, fit_once)
+
+        from spark_gp_tpu.resilience import fallback
+
+        # degradation ladder around the complete attempt (gpr.py wrap)
+        return fallback.run_fit_ladder(self, instr, attempt)
 
     def _fit_device_multistart(
         self, instr, data, y1h, x, cache=None
@@ -263,6 +268,9 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
     def _fit_host(self, instr, kernel, data, y1h, cache=None):
         """Host-driven L-BFGS-B over the jitted (possibly sharded)
         multiclass objective (shared driver: _optimize_latent_host)."""
+        # ladder host_f64 rung: f64 stack + targets, cache dropped (no-op
+        # on every other path — common._host_f64_operands gates itself)
+        data, (y1h,), cache = self._host_f64_operands(data, (y1h,), cache)
         if self._mesh is not None:
             objective = make_sharded_mc_objective(
                 kernel, data.x, y1h, data.mask, self._tol, self._mesh, cache
@@ -291,15 +299,18 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         upper = jnp.asarray(upper, dtype=dtype)
         log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
+        from spark_gp_tpu.resilience import chaos
+
+        # chaos choke point for staged execution faults (fallback ladder)
+        chaos.maybe_injected_failure(self._device_fit_op())
         with instr.phase("optimize_hypers"):
-            if self._checkpoint_dir is not None:
+            if self._checkpoint_dir is not None or self._fallback_segmented():
+                saver, chunk = self._segment_saver_and_chunk("gpc_mc", data)
                 theta, f_final, nll, n_iter, n_fev, stalled = (
                     fit_gpc_mc_device_checkpointed(
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data.x, y1h, data.mask,
-                        self._max_iter, self._checkpoint_interval,
-                        self._make_device_checkpointer("gpc_mc", data),
-                        cache,
+                        self._max_iter, chunk, saver, cache,
                     )
                 )
             elif self._mesh is not None:

@@ -194,7 +194,17 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
             return self._fit_with_restarts(instr, fit_once)
 
-        return self._run_with_expert_resilience(instr, data, run_fit)
+        from spark_gp_tpu.resilience import fallback
+
+        # the degradation ladder wraps the COMPLETE attempt (expert
+        # resilience included): a classified execution failure — OOM,
+        # compile, exhausted numerics, guard breach — re-executes the fit
+        # one rung down instead of propagating raw (GP_FALLBACK=0 restores
+        # raw propagation)
+        return fallback.run_fit_ladder(
+            self, instr,
+            lambda: self._run_with_expert_resilience(instr, data, run_fit),
+        )
 
     def loo(
         self,
@@ -369,6 +379,11 @@ class GaussianProcessRegression(GaussianProcessCommons):
                         "device fit converged to a non-finite objective"
                     )
             else:
+                # ladder host_f64 rung: f64 stack, cache dropped (no-op on
+                # every other path — the gate lives in the helper)
+                data, extra, cache = self._host_f64_operands(
+                    data, extra, cache
+                )
                 if self._mesh is not None and self._objective != "elbo":
                     vag = make_sharded_value_and_grad(
                         kernel, data, self._mesh, self._objective,
@@ -461,11 +476,18 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
         log_space = self._use_log_space(kernel)
         instr.log_info("Optimising the kernel hyperparameters (on-device)")
+        from spark_gp_tpu.resilience import chaos
+
+        # chaos choke point: a staged execution fault (injected OOM /
+        # compile failure) surfaces here, scoped to this dispatch shape
+        chaos.maybe_injected_failure(self._device_fit_op())
         with instr.phase("optimize_hypers"):
-            if self._checkpoint_dir is not None:
+            if self._checkpoint_dir is not None or self._fallback_segmented():
                 # segmented fit: one host sync per checkpointInterval
                 # iterations, full state persisted between segments, resumes
-                # from a matching prior checkpoint automatically
+                # from a matching prior checkpoint automatically.  The
+                # degradation ladder's segmented rung rides the same driver
+                # with an in-memory saver and a halved segment batch.
                 from spark_gp_tpu.models.likelihood import (
                     fit_gpr_device_checkpointed,
                 )
@@ -480,10 +502,10 @@ class GaussianProcessRegression(GaussianProcessCommons):
                 )
                 if extra:
                     file_tag += "-" + self._elbo_checkpoint_salt(extra)
+                saver, chunk = self._segment_saver_and_chunk(file_tag, data)
                 theta, f, n_iter, n_fev, stalled = fit_gpr_device_checkpointed(
                     kernel, self._mesh, log_space, theta0, lower, upper,
-                    data, self._max_iter, tol, self._checkpoint_interval,
-                    self._make_device_checkpointer(file_tag, data),
+                    data, self._max_iter, tol, chunk, saver,
                     objective=self._objective, extra=extra, cache=cache,
                 )
             elif self._mesh is not None and self._objective != "elbo":

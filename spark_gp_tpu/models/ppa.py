@@ -293,14 +293,40 @@ def magic_solve(
     theta64 = np.asarray(theta, dtype=solve_dtype)
     active64 = np.asarray(active, dtype=solve_dtype)
     if active64.shape[0] >= _DEVICE_SOLVE_MIN_M:
+        from spark_gp_tpu.resilience.fallback import run_ppa_solve_ladder
+
         if mesh is not None and mesh.devices.size > 1:
-            return sharded_magic_solve(
-                kernel, theta64, active64, u1, u2, mesh,
-                with_variance=with_variance,
-            )
-        return magic_solve_device(
-            kernel, theta64, active64, u1, u2, with_variance=with_variance
+            def device_attempt():
+                return sharded_magic_solve(
+                    kernel, theta64, active64, u1, u2, mesh,
+                    with_variance=with_variance,
+                )
+        else:
+            def device_attempt():
+                return magic_solve_device(
+                    kernel, theta64, active64, u1, u2,
+                    with_variance=with_variance,
+                )
+
+        # degradation ladder: an OOM/compile failure in the device f64
+        # solve re-executes on the host numpy path (slow but an answer);
+        # numerical NotPositiveDefiniteException stays raw on every branch
+        return run_ppa_solve_ladder(
+            device_attempt,
+            lambda: _host_magic_solve(
+                kernel, theta64, active64, u1, u2, solve_dtype, with_variance
+            ),
         )
+    return _host_magic_solve(
+        kernel, theta64, active64, u1, u2, solve_dtype, with_variance
+    )
+
+
+def _host_magic_solve(
+    kernel, theta64, active64, u1, u2, solve_dtype, with_variance
+):
+    """The host numpy f64 solve — the small-m default and the magic-solve
+    ladder's last rung."""
     kmm, sn2 = _gram_f64_on_host(kernel, theta64, active64)
     u1 = np.asarray(u1, dtype=solve_dtype)
     u2 = np.asarray(u2, dtype=solve_dtype)
@@ -377,7 +403,11 @@ def magic_solve_device(
     hence lane-immune like the rest of the stats path).
     """
     from spark_gp_tpu.kernels.base import supports_gram_cache
+    from spark_gp_tpu.resilience import chaos
 
+    # chaos choke point: a staged device OOM/compile fault surfaces here,
+    # where a real allocator failure on the [m, m] solve would
+    chaos.maybe_injected_failure("ppa.magic_solve")
     with jax.enable_x64():
         theta_d = jnp.asarray(theta64, dtype=jnp.float64)
         active_d = jnp.asarray(active64, dtype=jnp.float64)
@@ -499,7 +529,9 @@ def sharded_magic_solve(
     block (padded rows solve to zero / slice away exactly).
     """
     from spark_gp_tpu.ops import dist_linalg
+    from spark_gp_tpu.resilience import chaos
 
+    chaos.maybe_injected_failure("ppa.magic_solve")
     with jax.enable_x64():
         theta_d = jnp.asarray(theta64, dtype=jnp.float64)
         kmm = np.asarray(kernel.gram(theta_d, jnp.asarray(active64)))
@@ -732,10 +764,33 @@ class ProjectedProcessRawPredictor:
         ) + (() if mean_only else (jnp.asarray(self.magic_matrix, dtype=dtype),))
         predict = _predict_mean_jit if mean_only else _predict_jit
         lane = active_lane()
-        t = x_test.shape[0]
         m = max(1, self.active.shape[0])
-        chunk = max(1, self._PREDICT_CHUNK_ELEMS // m)
+        # clamped to the request: a dispatch never exceeds t rows, so the
+        # ladder's halvings walk down from the size that actually OOMed
+        chunk = max(1, min(self._PREDICT_CHUNK_ELEMS // m, x_test.shape[0]))
+        from spark_gp_tpu.resilience.fallback import run_predict_ladder
+
+        # degradation ladder (resilience/fallback.py): an OOM on a chunk
+        # dispatch halves the chunk — re-dispatching the request at a
+        # shape that fits under the allocator's ceiling — bounded, then
+        # the eager host-f64 solve as the last rung.  Clean requests run
+        # exactly the pre-ladder path.
+        return run_predict_ladder(
+            lambda c: self._run_at_chunk(
+                x_test, args, predict, lane, dtype, mean_only, c
+            ),
+            lambda: self._host_predict(x_test, mean_only),
+            chunk,
+        )
+
+    def _run_at_chunk(
+        self, x_test, args, predict, lane, dtype, mean_only: bool, chunk: int
+    ):
+        from spark_gp_tpu.resilience import chaos
+
+        t = x_test.shape[0]
         if t <= chunk:
+            chaos.maybe_injected_failure("predict.chunk", rows=t)
             out = predict(*args, jnp.asarray(x_test, dtype=dtype), lane=lane)
             return (out, None) if mean_only else out
         # fixed chunk shape (last chunk padded) -> one compiled executable
@@ -747,6 +802,7 @@ class ProjectedProcessRawPredictor:
                 part = jnp.concatenate(
                     [part, jnp.broadcast_to(part[:1], (pad, part.shape[1]))]
                 )
+            chaos.maybe_injected_failure("predict.chunk", rows=chunk)
             out = predict(*args, jnp.asarray(part, dtype=dtype), lane=lane)
             mean, var = (out, None) if mean_only else out
             means.append(mean[: chunk - pad] if pad else mean)
@@ -756,6 +812,52 @@ class ProjectedProcessRawPredictor:
             jnp.concatenate(means),
             jnp.concatenate(vars_) if vars_ else None,
         )
+
+    def _host_predict(self, x_test, mean_only: bool):
+        """Eager f64 host-CPU prediction — the predict ladder's last rung.
+
+        Deliberately UNJITTED (a compile-failure fallback must not compile)
+        and pinned to the host CPU device with x64 enabled, at a small
+        fixed chunk so the [t, m] cross intermediate stays bounded.  Bit
+        accuracy: f64, so at least as accurate as the device path it
+        replaces (slower — this rung answers, it does not race)."""
+        import contextlib
+
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            cpu = None
+        ctx = (
+            jax.default_device(cpu) if cpu is not None
+            else contextlib.nullcontext()
+        )
+        mean_only = mean_only or self.magic_matrix is None
+        with jax.enable_x64(), ctx:
+            theta = jnp.asarray(np.asarray(self.theta), dtype=jnp.float64)
+            active = jnp.asarray(np.asarray(self.active), dtype=jnp.float64)
+            mv = jnp.asarray(np.asarray(self.magic_vector), dtype=jnp.float64)
+            mm = (
+                None if mean_only
+                else jnp.asarray(np.asarray(self.magic_matrix), jnp.float64)
+            )
+            x64 = jnp.asarray(np.asarray(x_test), dtype=jnp.float64)
+            m = max(1, active.shape[0])
+            chunk = max(1, (self._PREDICT_CHUNK_ELEMS // 8) // m)
+            means, vars_ = [], []
+            for start in range(0, x64.shape[0], chunk):
+                part = x64[start : start + chunk]
+                if mean_only:
+                    means.append(
+                        _predict_mean_impl(self.kernel, theta, active, mv, part)
+                    )
+                else:
+                    mean, var = _predict_impl(
+                        self.kernel, theta, active, mv, mm, part
+                    )
+                    means.append(mean)
+                    vars_.append(var)
+            mean = jnp.concatenate(means)
+            return (mean, None) if mean_only else (mean, jnp.concatenate(vars_))
 
 
 def _predict_impl(kernel, theta, active, magic_vector, magic_matrix, x_test):

@@ -84,7 +84,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
             lib.gpdata_num_threads.restype = ctypes.c_int
             _lib = lib
-        except Exception:
+        except Exception:  # hygiene-ok: optional native build; any failure = unavailable
             _build_failed = True
             _lib = None
         return _lib

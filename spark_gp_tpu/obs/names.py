@@ -116,6 +116,12 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("mixed_precision_guard.delta_predict_rel", "metric", "guard: relative predict delta vs strict"),
     MetricName("mixed_precision_guard.breach", "metric", "guard: 1 when a delta exceeded the lane bar"),
     MetricName("*.failed", "metric", "a phase of this name raised", label="phase"),
+    # -- degradation ladder (resilience/fallback.py) -----------------------
+    MetricName("fallback.engaged", "metric", "1 when the fit completed through at least one degradation rung"),
+    MetricName("fallback.transitions", "counter", "degradation-ladder rung transitions executed"),
+    MetricName("fallback.exhausted", "counter", "ladders that ran out of applicable rungs (classified error raised)"),
+    MetricName("fallback.rung.*", "counter", "transitions into this rung", label="rung"),
+    MetricName("fallback.failures.*", "counter", "classified execution failures observed (closed taxonomy)", label="failure_class"),
     # -- phases (Instrumentation.phase -> timings) -------------------------
     MetricName("group_experts", "phase", "host grouping + pre-fit data screen"),
     MetricName("optimize_hypers", "phase", "hyperparameter optimization"),

@@ -360,7 +360,9 @@ def write_run_journal(
         {**event, "span": s.name}
         for s in spans
         for event in s.events
-        if event["name"].startswith(("experts.", "fit.retry", "breaker."))
+        if event["name"].startswith(
+            ("experts.", "fit.retry", "breaker.", "fallback.")
+        )
     ]
     journal = {
         "format": JOURNAL_FORMAT,
@@ -373,6 +375,11 @@ def write_run_journal(
         ),
         "timings": dict(getattr(instr, "timings", {})),
         "metrics": dict(getattr(instr, "metrics", {})),
+        # the degradation ladder's transition history (resilience/
+        # fallback.py): which classified failures re-executed this fit at
+        # which rung — the journal-side twin of the saved model's
+        # provenance_json degradations
+        "degradations": list(getattr(instr, "degradations", [])),
         "quarantine": {
             "experts_quarantined": getattr(instr, "metrics", {}).get(
                 "experts_quarantined", 0.0

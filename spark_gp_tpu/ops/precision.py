@@ -96,6 +96,28 @@ LANES = {
     "fast": PrecisionPolicy("fast", gram="default", linalg="high"),
 }
 
+# What a fit-time guard breach DOES (models/common._emit_precision_guard):
+# "log" (default) — loud warning + mixed_precision_guard.breach=1, the
+# fit completes on its lane (pre-ladder behavior, unchanged); "degrade" —
+# the breach raises into the degradation ladder and the fit re-executes
+# on the strict lane, flagged in provenance (resilience/fallback.py).
+GUARD_ACTIONS = ("log", "degrade")
+
+
+def guard_action() -> str:
+    """The configured breach response: ``GP_GUARD_ACTION`` validated
+    against :data:`GUARD_ACTIONS`; default ``log``."""
+    raw = os.environ.get("GP_GUARD_ACTION", "").strip().lower()
+    if not raw:
+        return "log"
+    if raw not in GUARD_ACTIONS:
+        raise ValueError(
+            f"GP_GUARD_ACTION={raw!r} is not supported; use one of "
+            f"{sorted(GUARD_ACTIONS)}"
+        )
+    return raw
+
+
 # guard bars (relative deltas vs the strict lane on the fit-time probe,
 # models/common.py _emit_precision_guard): a lane whose probe deltas
 # exceed its bar gets a loud warning + mixed_precision_guard.breach=1.

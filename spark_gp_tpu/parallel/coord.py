@@ -988,6 +988,31 @@ def dcn_context() -> Optional[DcnContext]:
     return _DCN_SINGLETON
 
 
+def dcn_fallback_available(current_ctx=None) -> bool:
+    """Whether the degradation ladder's ``dcn_fallback`` rung can engage
+    for a failing sharded fit (``resilience/fallback.py``): a
+    multi-process cluster whose KV-store coordination channel is reachable
+    and which is NOT already coordinating over it (``current_ctx`` is the
+    fit's bound DCN context, if any).  Single-process runtimes — every
+    CPU test harness — answer False and the ladder falls straight to its
+    ``single_host`` rung."""
+    if current_ctx is not None or _forced_ctx() is not None:
+        return False
+    import jax
+
+    try:
+        if jax.process_count() <= 1:
+            return False
+    except RuntimeError:
+        return False
+    if not dcn_required():
+        # on backends with real cross-process execution dcn_context()
+        # answers None — the rung would re-run the identical sharded path
+        # while stamping provenance with a fallback that never engaged
+        return False
+    return coord_client() is not None
+
+
 def liveness_snapshot() -> Optional[dict]:
     """Coordination liveness for health surfaces (the serve CLI's
     ``health`` verb): ``None`` single-process, else the heartbeat

@@ -347,6 +347,18 @@ def sample_active_from_stack(
     if ctx is not None:
         return coord.sample_active_dcn(ctx, data, m, seed)
 
+    if mesh is None:
+        # single-host stack (the degradation ladder's last sharded-fit
+        # rung re-runs the distributed body over a host-fetched local
+        # stack, resilience/fallback.py): the rows are all here — draw the
+        # same uniform valid-row sample directly
+        xf = np.asarray(data.x).reshape(-1, data.x.shape[-1])
+        maskf = np.asarray(data.mask).reshape(-1)
+        valid = np.flatnonzero(maskf > 0)
+        m = min(m, valid.size)
+        rng = np.random.default_rng(seed)
+        return xf[np.sort(rng.choice(valid, size=m, replace=False))]
+
     rep = NamedSharding(mesh, P())
     valid = replicated_valid_indices(data, mesh)
     # clamp like RandomActiveSetProvider so fit_distributed keeps fit()'s

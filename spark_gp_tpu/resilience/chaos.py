@@ -20,6 +20,15 @@ Fault classes:
   schedule, for circuit-breaker and poisoned-batch isolation tests;
 * :class:`HangingPredictor` — a predict path that BLOCKS until released
   (the wedged-device fault), for the serve hang-watchdog proof;
+* :func:`oom_after_calls` / :func:`failing_compile` — execution-
+  environment faults for the degradation ladder
+  (``resilience/fallback.py``): a staged device ``RESOURCE_EXHAUSTED``
+  (count-, op- and dispatch-size-scoped) or XLA compile failure raised as
+  a GENUINE ``XlaRuntimeError`` at the dispatch choke points
+  (:func:`maybe_injected_failure`), so every ladder rung is provable on
+  CPU.  Env channel: ``GP_CHAOS_OOM_AFTER_CALLS`` (+ ``GP_CHAOS_OOM_OP``,
+  ``GP_CHAOS_OOM_ROWS_ABOVE``) and ``GP_CHAOS_FAILING_COMPILE`` (+
+  ``GP_CHAOS_COMPILE_OP``);
 * **multi-host faults** (consumed by ``parallel/coord.py``'s guarded
   collectives and coordinated checkpointers):
   :class:`StragglerHost` — inject a fixed delay before a named
@@ -271,7 +280,147 @@ _mp_state = {
     "no_heartbeat": False,    # True -> suppress heartbeat stamps
     "kill_after": None,       # int | None remaining save/segment ticks
     "preempt": False,         # True -> coord.preemption_requested()
+    "oom_after": None,        # int | None: matching calls allowed before OOM
+    "oom_op": None,           # substring filter | None
+    "oom_rows_above": None,   # int | None: only dispatches above this size
+    "oom_calls": 0,           # matching calls observed so far
+    "oom_fired": None,        # one-element list: injected-OOM count
+    "compile_fail": None,     # int | None: remaining injected compile failures
+    "compile_op": None,       # substring filter | None
+    "compile_fired": None,    # one-element list: injected-failure count
 }
+
+
+def _xla_runtime_error(message: str) -> BaseException:
+    """A GENUINE ``XlaRuntimeError`` when the runtime exposes its
+    constructor (it does on every harness jaxlib), so the classifier and
+    every ``except`` clause see exactly what a real device failure looks
+    like; a plain RuntimeError with the same canonical message otherwise
+    (the classifier matches by message markers too)."""
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        return XlaRuntimeError(message)
+    except Exception:  # hygiene-ok: jaxlib layout drift; message still classifies
+        return RuntimeError(message)
+
+
+def maybe_injected_failure(op: str, rows: Optional[int] = None) -> None:
+    """The execution-failure trigger point: the device-fit dispatchers
+    (each family's ``_fit_device``), the chunked PPA predict and the
+    device magic solve call this before dispatching, so a staged fault
+    surfaces exactly where the real runtime would raise.  Two faults:
+
+    * **OOM** (:func:`oom_after_calls` / ``GP_CHAOS_OOM_AFTER_CALLS``):
+      after ``n`` matching calls, every further matching call raises a
+      genuine ``XlaRuntimeError("RESOURCE_EXHAUSTED: ...")``.  ``op``
+      substring and ``rows_above`` filters scope the fault — e.g.
+      ``op="one_dispatch"`` fails the one-dispatch fit while the
+      segmented rung's smaller dispatches run clean, and
+      ``rows_above=512`` models an allocator ceiling the predict ladder
+      can get under by halving its chunk;
+    * **compile failure** (:func:`failing_compile` /
+      ``GP_CHAOS_FAILING_COMPILE``): the next ``times`` matching calls
+      raise a compilation-shaped ``XlaRuntimeError``.
+    """
+    # -- injected OOM ------------------------------------------------------
+    allow = _mp_state["oom_after"]
+    op_filter = _mp_state["oom_op"]
+    rows_above = _mp_state["oom_rows_above"]
+    if allow is None:
+        env = os.environ.get("GP_CHAOS_OOM_AFTER_CALLS", "").strip()
+        if env:
+            try:
+                allow = int(env)
+            except ValueError:
+                allow = None
+            op_filter = os.environ.get("GP_CHAOS_OOM_OP", "") or None
+            raw_rows = os.environ.get("GP_CHAOS_OOM_ROWS_ABOVE", "").strip()
+            rows_above = int(raw_rows) if raw_rows.isdigit() else None
+    if allow is not None and (not op_filter or op_filter in op):
+        if rows_above is None or (rows is not None and rows > rows_above):
+            _mp_state["oom_calls"] += 1
+            if _mp_state["oom_calls"] > allow:
+                fired = _mp_state["oom_fired"]
+                if fired is not None:
+                    fired[0] += 1
+                raise _xla_runtime_error(
+                    f"RESOURCE_EXHAUSTED: chaos: injected device OOM at "
+                    f"{op!r} (call {_mp_state['oom_calls']})"
+                )
+    # -- injected compile failure -----------------------------------------
+    remaining = _mp_state["compile_fail"]
+    c_filter = _mp_state["compile_op"]
+    if remaining is None:
+        env = os.environ.get("GP_CHAOS_FAILING_COMPILE", "").strip()
+        if env:
+            try:
+                remaining = int(env)
+            except ValueError:
+                remaining = None
+            if remaining is not None:
+                _mp_state["compile_fail"] = remaining
+            c_filter = os.environ.get("GP_CHAOS_COMPILE_OP", "") or None
+            _mp_state["compile_op"] = c_filter
+    if remaining and (not c_filter or c_filter in op):
+        _mp_state["compile_fail"] = remaining - 1
+        fired = _mp_state["compile_fired"]
+        if fired is not None:
+            fired[0] += 1
+        raise _xla_runtime_error(
+            f"INTERNAL: during compilation: chaos: injected XLA "
+            f"compilation failure at {op!r}"
+        )
+
+
+@contextlib.contextmanager
+def oom_after_calls(
+    n: int, op: Optional[str] = None, rows_above: Optional[int] = None
+):
+    """Stage an injected device OOM: the first ``n`` matching dispatches
+    succeed, every later one raises ``RESOURCE_EXHAUSTED`` (see
+    :func:`maybe_injected_failure` for the ``op`` / ``rows_above``
+    scoping).  Yields a one-element list counting injections, so tests
+    can assert the fault actually fired.  Subprocesses stage it with
+    ``GP_CHAOS_OOM_AFTER_CALLS`` (+ ``GP_CHAOS_OOM_OP`` /
+    ``GP_CHAOS_OOM_ROWS_ABOVE``)."""
+    if int(n) < 0:
+        raise ValueError("n must be >= 0")
+    prev = {
+        k: _mp_state[k]
+        for k in ("oom_after", "oom_op", "oom_rows_above", "oom_calls",
+                  "oom_fired")
+    }
+    fired = [0]
+    _mp_state.update(
+        oom_after=int(n), oom_op=op,
+        oom_rows_above=None if rows_above is None else int(rows_above),
+        oom_calls=0, oom_fired=fired,
+    )
+    try:
+        yield fired
+    finally:
+        _mp_state.update(prev)
+
+
+@contextlib.contextmanager
+def failing_compile(times: int = 1, op: Optional[str] = None):
+    """Stage injected XLA compilation failures for the next ``times``
+    matching dispatches (then clean).  Yields the injected-failure
+    counter list.  Subprocess channel: ``GP_CHAOS_FAILING_COMPILE`` (+
+    ``GP_CHAOS_COMPILE_OP``)."""
+    if int(times) < 1:
+        raise ValueError("times must be >= 1")
+    prev = {k: _mp_state[k] for k in ("compile_fail", "compile_op",
+                                      "compile_fired")}
+    fired = [0]
+    _mp_state.update(
+        compile_fail=int(times), compile_op=op, compile_fired=fired
+    )
+    try:
+        yield fired
+    finally:
+        _mp_state.update(prev)
 
 
 def _env_chaos_float(name: str) -> Optional[float]:

@@ -1,0 +1,619 @@
+"""Degradation ladder: classified execution failures + policy-driven
+fallback re-execution.
+
+The resilience layer's earlier pieces heal *numerical* faults — NaN
+experts are quarantined (``quarantine.py``), singular Choleskys climb the
+jitter ladder (``ops/linalg.py``), dead hosts are named within a deadline
+(``parallel/coord.py``).  *Execution-environment* failures — an HBM
+``RESOURCE_EXHAUSTED`` on a one-dispatch device fit, an XLA/Mosaic
+compile failure, an exhausted jitter ladder on an f32 runtime, a
+coordination timeout, a mixed-precision guard breach — used to propagate
+raw.  This module closes that gap with three pieces:
+
+* a **closed failure taxonomy** (:data:`FAILURE_CLASSES`) and
+  :func:`classify_failure`, mapping raw ``XlaRuntimeError`` / framework
+  exceptions into it (every class has a ``fallback.failures.*`` catalog
+  entry — ``obs/names.py``);
+* a **declarative, bounded degradation ladder** per entry point
+  (:data:`LADDERS`), executed by the drivers below: a classified failure
+  re-executes the work one rung down the same axis the system scales —
+  smaller dispatches, stricter precision, host execution — instead of
+  dying.  Fit: one-dispatch → segmented (halved segment batch) →
+  host-f64; sharded fit: → DCN-fallback → single-host; predict: PPA
+  chunk-size halving on OOM → host solve; a guard breach under
+  ``GP_GUARD_ACTION=degrade``: strict-lane re-fit.  Every transition is
+  deterministic, metered (``fallback.*`` metrics + span events), stamped
+  into the run journal and the saved model's ``provenance_json``
+  (``degradations=[...]``), and kill-switched by ``GP_FALLBACK=0``
+  (today's raw propagation, bit-for-bit);
+* the **single-classified-error guarantee**: when the ladder is
+  exhausted the caller sees ONE :class:`DegradationExhaustedError`
+  naming the failure class and the rung history (cause chained) — the
+  invariant ``tools/soak.py`` asserts across randomized chaos campaigns.
+
+Recovery policy lives HERE, on the host, outside every compiled program
+(the design rule of docs/RESILIENCE.md); a rung re-execution dispatches
+ordinary already-tested entry points with degraded knobs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("spark_gp_tpu")
+
+# --------------------------------------------------------------------------
+# the closed taxonomy
+# --------------------------------------------------------------------------
+
+#: device/host allocation failure (HBM RESOURCE_EXHAUSTED, allocator OOM)
+OOM = "oom"
+#: XLA / Mosaic compilation or lowering failure
+COMPILE = "compile"
+#: non-finite objective the per-expert recovery could not attribute/repair
+NON_FINITE_EXHAUSTED = "non_finite_exhausted"
+#: a factorization that exhausted the adaptive jitter ladder
+NOT_PSD_EXHAUSTED = "not_psd_exhausted"
+#: a deadline-guarded multi-host coordination step timed out
+COORD_TIMEOUT = "coord_timeout"
+#: fit-time mixed-precision guard breached its lane bar (GP_GUARD_ACTION)
+GUARD_BREACH = "guard_breach"
+#: everything else — NEVER degraded, always re-raised raw
+UNKNOWN = "unknown"
+
+FAILURE_CLASSES = (
+    OOM, COMPILE, NON_FINITE_EXHAUSTED, NOT_PSD_EXHAUSTED,
+    COORD_TIMEOUT, GUARD_BREACH, UNKNOWN,
+)
+
+#: message fragments identifying an allocation failure inside an
+#: ``XlaRuntimeError`` (PJRT/XLA wording varies by backend/version; the
+#: chaos injector uses the canonical first form)
+_OOM_MARKERS = (
+    "resource_exhausted", "out of memory", "attempting to allocate",
+    "allocation failure",
+)
+#: message fragments identifying a compilation/lowering failure
+_COMPILE_MARKERS = (
+    "compilation failure", "failed to compile", "compile failed",
+    "mosaic", "lowering failed", "internal: during compilation",
+    "xla compilation",
+)
+
+
+class GuardBreachError(RuntimeError):
+    """A non-strict precision lane breached its accuracy bar at fit time
+    (``models/common.py _emit_precision_guard``) under
+    ``GP_GUARD_ACTION=degrade`` — the ladder turns this into a
+    strict-lane re-fit."""
+
+    def __init__(self, lane: str, worst: float, bar: float):
+        super().__init__(
+            f"mixed_precision_guard: lane {lane!r} deviates {worst:.3e} "
+            f"from strict (bar {bar:.1e}) and GP_GUARD_ACTION=degrade "
+            "requested a strict-lane re-fit"
+        )
+        self.lane = lane
+        self.worst = float(worst)
+        self.bar = float(bar)
+
+
+class DegradationExhaustedError(RuntimeError):
+    """Every applicable rung failed: the ONE classified error the caller
+    sees (cause chained to the last underlying failure).  ``degradations``
+    is the full transition history, ``failure_class`` the final class."""
+
+    def __init__(self, entry: str, failure_class: str, degradations: list,
+                 cause: BaseException):
+        rungs = " -> ".join(
+            [degradations[0]["from"]] + [d["to"] for d in degradations]
+        ) if degradations else "(none)"
+        super().__init__(
+            f"{entry}: degradation ladder exhausted "
+            f"(final failure class {failure_class!r}, rungs {rungs}): {cause}"
+        )
+        self.entry = entry
+        self.failure_class = failure_class
+        self.degradations = list(degradations)
+
+
+def enabled() -> bool:
+    """The kill switch: ``GP_FALLBACK=0`` restores raw propagation —
+    every driver becomes a straight call with zero try/except."""
+    return os.environ.get("GP_FALLBACK", "").strip().lower() not in (
+        "0", "false", "off",
+    )
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map a raw exception into the closed taxonomy.
+
+    Typed framework failures classify by type; ``XlaRuntimeError`` (and
+    the chaos injectors' genuine instances of it) by message markers;
+    a :class:`~spark_gp_tpu.resilience.retry.RetryBudgetExceededError`
+    by its cause.  Anything unrecognized is :data:`UNKNOWN` — the ladder
+    never degrades what it cannot name.
+    """
+    from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+    from spark_gp_tpu.resilience.quarantine import (
+        ExpertQuarantineError,
+        NonFiniteFitError,
+    )
+    from spark_gp_tpu.resilience.retry import RetryBudgetExceededError
+
+    if isinstance(exc, DegradationExhaustedError):
+        return exc.failure_class
+    if isinstance(exc, GuardBreachError):
+        return GUARD_BREACH
+    if isinstance(exc, NotPositiveDefiniteException):
+        return NOT_PSD_EXHAUSTED
+    if isinstance(exc, (NonFiniteFitError, ExpertQuarantineError)):
+        return NON_FINITE_EXHAUSTED
+    if isinstance(exc, RetryBudgetExceededError) and exc.__cause__ is not None:
+        return classify_failure(exc.__cause__)
+    try:
+        from spark_gp_tpu.parallel.coord import CoordinationTimeoutError
+
+        if isinstance(exc, CoordinationTimeoutError):
+            return COORD_TIMEOUT
+    except ImportError:  # hygiene-ok: optional coord backend, absence = no class
+        pass
+    # XlaRuntimeError (by name — jaxlib moves it between modules across
+    # versions) and anything runtime-shaped classify by message
+    if type(exc).__name__ == "XlaRuntimeError" or isinstance(
+        exc, (RuntimeError, MemoryError)
+    ):
+        if isinstance(exc, MemoryError):
+            return OOM
+        msg = str(exc).lower()
+        if any(marker in msg for marker in _OOM_MARKERS):
+            return OOM
+        if any(marker in msg for marker in _COMPILE_MARKERS):
+            return COMPILE
+    return UNKNOWN
+
+
+# --------------------------------------------------------------------------
+# metering — every transition lands in telemetry, the span tree, and the
+# instr the fit journal is assembled from
+# --------------------------------------------------------------------------
+
+
+def record_failure(exc: BaseException, entry: str) -> str:
+    """Classify + count one observed failure (``fallback.failures.*``);
+    returns the class.  Usable standalone (the serve layer annotates its
+    predict failures with it) — counting never implies degradation."""
+    cls = classify_failure(exc)
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    telemetry.inc(f"fallback.failures.{cls}", entry=entry)
+    return cls
+
+
+def _record_transition(
+    entry: str, cls: str, from_rung: str, to_rung: str,
+    exc: BaseException, instr=None,
+) -> dict:
+    from spark_gp_tpu.obs import trace as obs_trace
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    telemetry.inc("fallback.transitions", entry=entry)
+    telemetry.inc(f"fallback.rung.{to_rung}", entry=entry)
+    obs_trace.add_event(
+        "fallback.engaged",
+        entry=entry, failure_class=cls, from_rung=from_rung, to_rung=to_rung,
+    )
+    message = (
+        f"degradation ladder [{entry}]: {cls} at rung {from_rung!r} — "
+        f"re-executing at rung {to_rung!r} ({type(exc).__name__}: "
+        f"{str(exc)[:200]})"
+    )
+    if instr is not None:
+        instr.log_warning(message)
+    else:
+        logger.warning("%s", message)
+    return {
+        "entry": entry,
+        "failure_class": cls,
+        "from": from_rung,
+        "to": to_rung,
+        "error": f"{type(exc).__name__}: {exc}"[:200],
+    }
+
+
+def _stamp(instr, model, degradations: List[dict]) -> None:
+    """Attach the transition history to everything the operator reads
+    after the fact: the fit metrics (``fallback.engaged``), the instr the
+    run journal is assembled from, and the model itself (``save_model``
+    folds ``model.degradations`` into ``provenance_json``)."""
+    targets = {id(instr): instr}
+    model_instr = getattr(model, "instr", None)
+    if model_instr is not None:
+        targets[id(model_instr)] = model_instr
+    for target in targets.values():
+        target.degradations = list(degradations)
+        target.log_metric("fallback.engaged", 1.0)
+    if model is not None:
+        model.degradations = list(degradations)
+
+
+# --------------------------------------------------------------------------
+# the declarative ladders
+# --------------------------------------------------------------------------
+
+#: rung order per entry point; per-class policy below selects which of a
+#: ladder's rungs a failure class may fall to (docs/RESILIENCE.md table)
+LADDERS = {
+    "fit": ("native", "segmented", "host_f64", "strict_lane"),
+    "fit_sharded": ("sharded", "dcn_fallback", "single_host", "strict_lane"),
+    "predict": ("chunked", "chunk_halved", "host_solve"),
+    "ppa": ("device_solve", "host_solve"),
+}
+
+#: per-class candidate rungs at the ``fit`` entry, in order
+_FIT_POLICY = {
+    OOM: ("segmented", "host_f64"),
+    COMPILE: ("segmented", "host_f64"),
+    NON_FINITE_EXHAUSTED: ("host_f64",),
+    NOT_PSD_EXHAUSTED: ("host_f64",),
+    GUARD_BREACH: ("strict_lane",),
+}
+
+#: classes the sharded-fit ladder degrades (everything else re-raises)
+_SHARDED_POLICY = (OOM, COMPILE, COORD_TIMEOUT)
+
+#: bounded chunk halvings before the predict ladder jumps to the host
+MAX_PREDICT_HALVINGS = 8
+
+
+def fallback_segment_chunk(checkpoint_interval: int) -> int:
+    """The segmented rung's iteration batch: HALF the configured segment
+    (``setCheckpointInterval``, default 10) — smaller dispatches along the
+    same axis the checkpointed fit already segments on."""
+    return max(1, int(checkpoint_interval) // 2)
+
+
+class NullSegmentSaver:
+    """In-memory stand-in for the device checkpointer: the segmented
+    fallback rung runs ``fit_*_device_checkpointed``'s segment loop for
+    its smaller dispatches WITHOUT persisting state (no checkpoint dir is
+    configured on this fit — durability was never requested)."""
+
+    path = None
+
+    def load(self, template_state, meta: dict):
+        return None
+
+    def save(self, state, meta: dict) -> None:
+        pass
+
+
+def _fit_rung_applies(est, rung: str, cls: str, visited) -> bool:
+    """Whether ``rung`` is a legal next step for this estimator + class.
+
+    The gates keep pre-ladder behavior intact everywhere degradation
+    cannot help: ``segmented`` needs the plain single-chip one-dispatch
+    configuration (a checkpointed fit is already segmented; the batched
+    multi-start has no segment driver); ``host_f64`` is skipped for
+    numerical exhaustion when the runtime already computes in f64 (no
+    precision headroom — the failure is a configuration problem and must
+    keep raising the reference's advice); ``strict_lane`` only applies
+    off the strict lane."""
+    if rung in visited:
+        return False
+    if rung == "segmented":
+        return (
+            getattr(est, "_checkpoint_dir", None) is None
+            and est._mesh is None
+            and getattr(est, "_num_restarts", 1) == 1
+            and est._resolved_optimizer() == "device"
+        )
+    if rung == "host_f64":
+        if cls in (NON_FINITE_EXHAUSTED, NOT_PSD_EXHAUSTED):
+            # numerical exhaustion degrades only where the rung actually
+            # ADDS precision: an f32 runtime AND an unmeshed stack (the
+            # families' f64 re-materialization covers single-chip fits
+            # only — a sharded re-run would repeat the same f32 math and
+            # mask the advice-bearing error for nothing)
+            import jax
+
+            if jax.config.jax_enable_x64 or est._mesh is not None:
+                return False
+        return True
+    if rung == "strict_lane":
+        from spark_gp_tpu.ops.precision import active_lane
+
+        return active_lane() != "strict"
+    return False
+
+
+def _next_fit_rung(est, cls: str, visited) -> Optional[str]:
+    for rung in _FIT_POLICY.get(cls, ()):
+        if _fit_rung_applies(est, rung, cls, visited):
+            return rung
+    return None
+
+
+@contextlib.contextmanager
+def _fit_rung_scope(est, rung: str):
+    """Bind one rung's execution overrides to the estimator for the span
+    of an attempt: ``_fallback_mode`` steers the optimizer/segment
+    dispatch (``common._resolved_optimizer`` / ``_segment_saver_and_chunk``),
+    ``host_f64`` additionally runs under ``jax.enable_x64`` so f32
+    runtimes re-execute with real precision headroom, and ``strict_lane``
+    pins the process lane for the re-fit."""
+    if rung == "native":
+        yield
+        return
+    prev_mode = getattr(est, "_fallback_mode", None)
+    if rung == "strict_lane":
+        from spark_gp_tpu.ops.precision import set_precision_lane
+
+        prev_lane = set_precision_lane("strict")
+        try:
+            yield
+        finally:
+            set_precision_lane(prev_lane)
+        return
+    est._fallback_mode = rung
+    try:
+        if rung == "host_f64":
+            import jax
+
+            with jax.enable_x64():
+                yield
+        else:
+            yield
+    finally:
+        est._fallback_mode = prev_mode
+
+
+def run_fit_ladder(est, instr, attempt: Callable):
+    """The fit entry point's ladder driver, wrapped around the complete
+    per-family fit body (which itself wraps
+    ``_run_with_expert_resilience`` — the per-expert numerical recovery
+    runs INSIDE each rung; the ladder only sees what that layer could not
+    repair).  ``attempt()`` must honor ``est._fallback_mode``."""
+    if not enabled():
+        return attempt()
+    rung = "native"
+    visited = {rung}
+    degradations: List[dict] = []
+    last_cls = UNKNOWN
+    while True:
+        try:
+            with _fit_rung_scope(est, rung):
+                model = attempt()
+        except Exception as exc:  # classified-failure-site: taxonomy dispatch
+            last_cls = record_failure(exc, entry="fit")
+            nxt = _next_fit_rung(est, last_cls, visited)
+            if nxt is None:
+                if degradations:
+                    from spark_gp_tpu.obs.runtime import telemetry
+
+                    telemetry.inc("fallback.exhausted", entry="fit")
+                    raise DegradationExhaustedError(
+                        "fit", last_cls, degradations, exc
+                    ) from exc
+                raise  # nothing engaged: today's raw propagation
+            degradations.append(
+                _record_transition("fit", last_cls, rung, nxt, exc, instr)
+            )
+            if last_cls == GUARD_BREACH:
+                # the re-fit's metrics must describe the re-fit: scrub the
+                # breaching attempt's guard legs so a strict re-fit whose
+                # guard passes (strict emits none) doesn't read as breached
+                for key in [
+                    k for k in getattr(instr, "metrics", {})
+                    if k.startswith("mixed_precision_guard")
+                ]:
+                    del instr.metrics[key]
+            visited.add(nxt)
+            rung = nxt
+            continue
+        if degradations:
+            _stamp(instr, model, degradations)
+        return model
+
+
+def run_distributed_ladder(est, instr, data, active_set, prepare):
+    """The ``fit_distributed`` ladder: sharded → DCN-fallback →
+    single-host.  The DCN rung re-binds the KV-store coordination context
+    (applicable only on multi-process runtimes where one is reachable —
+    ``parallel/coord.dcn_fallback_available``); the single-host rung
+    host-fetches the stack and re-runs the whole body unsharded (legal
+    exactly when a host can see every row: single process, or a
+    DCN-fallback stack which is host-local by construction)."""
+    if not enabled():
+        return est._fit_distributed_body(instr, data, active_set, prepare)
+
+    import jax
+
+    degradations: List[dict] = []
+    rung = "sharded"
+
+    def fetchable() -> bool:
+        # single_host is legal ONLY when this host's stack is the WHOLE
+        # dataset.  A DCN-fallback stack is host-local but holds 1/P of
+        # the data — "degrading" host 0 to a local re-fit would silently
+        # produce a model of one fragment, the exact wrong-results bug
+        # coord.initialize exists to prevent.  Multi-host failures keep
+        # raising their named CoordinationTimeoutError instead.
+        ctx = getattr(est, "_dcn_ctx", None)
+        if ctx is not None:
+            return getattr(ctx, "num_processes", 2) <= 1
+        return jax.process_count() == 1
+
+    while True:
+        try:
+            if rung == "strict_lane":
+                # guard breach under GP_GUARD_ACTION=degrade: the same
+                # strict-lane re-fit the plain-fit ladder runs, over the
+                # unchanged (possibly sharded) stack
+                from spark_gp_tpu.ops.precision import set_precision_lane
+
+                prev_lane = set_precision_lane("strict")
+                try:
+                    model = est._fit_distributed_body(
+                        instr, data, active_set, prepare
+                    )
+                finally:
+                    set_precision_lane(prev_lane)
+            elif rung == "single_host":
+                import numpy as np
+
+                from spark_gp_tpu.parallel.experts import ExpertData
+
+                local = ExpertData(
+                    x=np.asarray(data.x),
+                    y=np.asarray(data.y),
+                    mask=np.asarray(data.mask),
+                )
+                mesh_prev = est._mesh
+                est._mesh = None
+                try:
+                    model = est._fit_distributed_body(
+                        instr, local, active_set, prepare
+                    )
+                finally:
+                    est._mesh = mesh_prev
+            elif rung == "dcn_fallback":
+                from spark_gp_tpu.parallel import coord
+
+                ctx_prev = getattr(est, "_dcn_ctx", None)
+                est._dcn_ctx = coord.dcn_context()
+                try:
+                    model = est._fit_distributed_body(
+                        instr, data, active_set, prepare
+                    )
+                finally:
+                    est._dcn_ctx = ctx_prev
+            else:
+                model = est._fit_distributed_body(
+                    instr, data, active_set, prepare
+                )
+        except Exception as exc:  # classified-failure-site: taxonomy dispatch
+            cls = record_failure(exc, entry="fit_sharded")
+            nxt = None
+            if cls == GUARD_BREACH and rung != "strict_lane":
+                from spark_gp_tpu.ops.precision import active_lane
+
+                if active_lane() != "strict":
+                    nxt = "strict_lane"
+            elif cls in _SHARDED_POLICY:
+                if rung == "sharded":
+                    from spark_gp_tpu.parallel import coord
+
+                    if coord.dcn_fallback_available(
+                        getattr(est, "_dcn_ctx", None)
+                    ):
+                        nxt = "dcn_fallback"
+                    elif fetchable():
+                        nxt = "single_host"
+                elif rung == "dcn_fallback" and fetchable():
+                    nxt = "single_host"
+            if nxt is None:
+                if degradations:
+                    from spark_gp_tpu.obs.runtime import telemetry
+
+                    telemetry.inc("fallback.exhausted", entry="fit_sharded")
+                    raise DegradationExhaustedError(
+                        "fit_sharded", cls, degradations, exc
+                    ) from exc
+                raise
+            degradations.append(
+                _record_transition("fit_sharded", cls, rung, nxt, exc, instr)
+            )
+            if cls == GUARD_BREACH:
+                # same scrub as the plain-fit ladder: the strict re-fit's
+                # metrics must describe the re-fit, not the breach
+                for key in [
+                    k for k in getattr(instr, "metrics", {})
+                    if k.startswith("mixed_precision_guard")
+                ]:
+                    del instr.metrics[key]
+            rung = nxt
+            continue
+        if degradations:
+            _stamp(instr, model, degradations)
+        return model
+
+
+def run_predict_ladder(
+    attempt_at_chunk: Callable[[int], object],
+    host_attempt: Callable[[], object],
+    chunk: int,
+):
+    """The predict entry point's ladder (``models/ppa.py``): an OOM on a
+    chunked dispatch halves the chunk (bounded —
+    :data:`MAX_PREDICT_HALVINGS`), re-dispatching the whole request at
+    the smaller shape; a chunk the halvings cannot shrink under the
+    allocator's ceiling — or a compile failure — falls to the eager
+    host-f64 solve.  Raw behavior with the ladder disabled."""
+    if not enabled():
+        return attempt_at_chunk(chunk)
+    degradations: List[dict] = []
+    halvings = 0
+    while True:
+        try:
+            return attempt_at_chunk(chunk)
+        except Exception as exc:  # classified-failure-site: taxonomy dispatch
+            cls = record_failure(exc, entry="predict")
+            if (
+                cls == OOM
+                and chunk > 1
+                and halvings < MAX_PREDICT_HALVINGS
+            ):
+                degradations.append(_record_transition(
+                    "predict", cls, f"chunk_{chunk}", f"chunk_{chunk // 2}",
+                    exc,
+                ))
+                chunk //= 2
+                halvings += 1
+                continue
+            if cls in (OOM, COMPILE):
+                degradations.append(_record_transition(
+                    "predict", cls,
+                    f"chunk_{chunk}" if halvings else "chunked",
+                    "host_solve", exc,
+                ))
+                try:
+                    return host_attempt()
+                except Exception as host_exc:  # classified-failure-site
+                    from spark_gp_tpu.obs.runtime import telemetry
+
+                    telemetry.inc("fallback.exhausted", entry="predict")
+                    raise DegradationExhaustedError(
+                        "predict", classify_failure(host_exc), degradations,
+                        host_exc,
+                    ) from host_exc
+            if degradations:
+                from spark_gp_tpu.obs.runtime import telemetry
+
+                telemetry.inc("fallback.exhausted", entry="predict")
+                raise DegradationExhaustedError(
+                    "predict", cls, degradations, exc
+                ) from exc
+            raise
+
+
+def run_ppa_solve_ladder(device_attempt: Callable, host_attempt: Callable):
+    """The magic-solve ladder (``models/ppa.magic_solve``): an OOM or
+    compile failure in the device/sharded f64 solve re-executes the SAME
+    solve on the host numpy path — slower O(m^3) single-thread work, but
+    an answer.  Numerical failures (``NotPositiveDefiniteException``)
+    stay raw on every branch: the ladder degrades execution environments,
+    never the jitter policy."""
+    if not enabled():
+        return device_attempt()
+    try:
+        return device_attempt()
+    except Exception as exc:  # classified-failure-site: taxonomy dispatch
+        cls = record_failure(exc, entry="ppa")
+        if cls not in (OOM, COMPILE):
+            raise
+        _record_transition("ppa", cls, "device_solve", "host_solve", exc)
+        return host_attempt()

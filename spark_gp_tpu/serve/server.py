@@ -344,7 +344,7 @@ class GPServeServer:
         )
         try:
             future = self._queue.submit(request)
-        except Exception as exc:
+        except Exception as exc:  # hygiene-ok: shed accounting only — re-raised
             self.metrics.inc("shed")
             if isinstance(exc, QueueFullError):
                 self.metrics.inc("queue.shed.backpressure")
@@ -430,7 +430,7 @@ class GPServeServer:
                 group[0].x if len(group) == 1
                 else np.concatenate([req.x for req in group], axis=0)
             )
-        except BaseException:
+        except BaseException:  # hygiene-ok: admission release only — re-raised
             # pre-dispatch failure (e.g. the pinned version was evicted):
             # not the model's predict misbehaving — release the admission
             # (a half-open probe permit would otherwise leak and reject
@@ -454,12 +454,22 @@ class GPServeServer:
                 isolation_retry=group[0].isolation_retry,
             ):
                 mean, var = entry.predict(x)
-        except BaseException:
+        except BaseException as exc:  # classified-failure-site: counted via classify_failure, re-raised
             if token is not None:
                 self._watchdog.end(token)
                 if token.fired:
                     return  # already adjudicated as hung; stale outcome
             self.metrics.inc("predict.failures")
+            if isinstance(exc, Exception):
+                # classify the raw failure into the closed taxonomy
+                # (fallback.failures.* counters): an operator can tell a
+                # fleet of OOMing predicts from a broken model without
+                # reading stack traces.  Counting only — the predict-side
+                # degradation ladder lives inside the predictor (ppa.py);
+                # what reaches here already exhausted or bypassed it.
+                from spark_gp_tpu.resilience import fallback
+
+                fallback.record_failure(exc, entry="serve")
             if is_canary:
                 self.canaries.observe_error(name, entry.version)
             if guarded:

@@ -58,7 +58,7 @@ class Instrumentation:
             # the fit's root span, a serve load/warmup under the batch's
             with _obs_trace.span(phase_name, instr=self.name):
                 yield
-        except BaseException:
+        except BaseException:  # hygiene-ok: failure-marker metric only — re-raised
             # a raising phase used to record only its timing — the metric
             # context vanished and an emitted metrics dict looked identical
             # to a healthy run's.  A "<phase>.failed" marker makes serve-path

@@ -242,7 +242,7 @@ def backends_already_initialized() -> bool:
         from jax._src import xla_bridge
 
         return bool(xla_bridge.backends_are_initialized())
-    except Exception:
+    except Exception:  # hygiene-ok: jax-internal probe; absence = not initialized
         return False
 
 

@@ -55,7 +55,13 @@ def save_model(path: str, model, kind: str) -> None:
     import jax
 
     extras["provenance_json"] = np.frombuffer(
-        json.dumps({"process_count": jax.process_count()}).encode(),
+        json.dumps({
+            "process_count": jax.process_count(),
+            # the degradation ladder's transition history (resilience/
+            # fallback.py): a model produced through fallback re-execution
+            # says so permanently — [] for a clean fit
+            "degradations": list(getattr(model, "degradations", None) or ()),
+        }).encode(),
         dtype=np.uint8,
     )
     np.savez(
@@ -128,4 +134,9 @@ def load_model(path: str):
     else:
         model = GaussianProcessRegressionModel(raw)
     model.provenance = provenance
+    if provenance and provenance.get("degradations"):
+        # restore the ladder's stamp onto the model object itself, so a
+        # save->load->save round trip keeps the degradation history
+        # permanent instead of silently laundering it to a clean fit
+        model.degradations = provenance["degradations"]
     return model

@@ -101,6 +101,19 @@ def test_bench_emits_one_parseable_result_line():
     assert res["experts_quarantined"] == 1
     assert res["faulted_fit_seconds"] > 0
     assert np.isfinite(res["faulted_final_nll_renormalized"])
+    # the degradation ladder rode along (ISSUE 9, resilience/fallback.py):
+    # a chaos-injected RESOURCE_EXHAUSTED on the one-dispatch device fit
+    # completes through the segmented rung within 3x the clean wall-clock
+    # with the identical fitted theta (same L-BFGS trajectory, smaller
+    # dispatches)
+    deg = detail["degraded_fit"]
+    assert "error" not in deg, deg
+    assert deg["engaged"] is True, deg
+    assert deg["injected_failures"] >= 1
+    assert "segmented" in deg["rungs"], deg
+    assert deg["failure_classes"] == ["oom"], deg
+    assert deg["wallclock_ratio"] < 3.0, deg
+    assert deg["theta_max_abs_delta"] <= 1e-6, deg
     # the mixed-precision lane contract: the lane the primary fit ran at
     # is recorded, the MFU estimate is non-null (the peak table carries a
     # CPU-proxy entry precisely so this plumbing is exercised off-TPU),

@@ -65,7 +65,9 @@ def test_model_save_load_roundtrip(tmp_path):
     np.testing.assert_allclose(restored.predict(x[:20]), model.predict(x[:20]), rtol=1e-12)
     # fit provenance rode along: the saved file records the process
     # topology that produced the BCM aggregate (utils/serialization.py)
-    assert restored.provenance == {"process_count": 1}
+    # a clean fit records an EMPTY degradation history (the ladder's
+    # provenance stamp, resilience/fallback.py)
+    assert restored.provenance == {"process_count": 1, "degradations": []}
 
 
 def test_duplicate_rows_survive_via_jitter(rng):

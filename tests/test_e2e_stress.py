@@ -59,7 +59,13 @@ def test_year_msd_standin_bound():
 
 
 def test_mnist_standin_bound():
-    """784-d RBF classifier path at the MNIST shape."""
+    """784-d RBF classifier path at the MNIST shape.
+
+    The stand-in plants a calibrated class overlap (Bayes accuracy 0.970,
+    datasets.py) so accuracy bars are falsifiable: this tiny config
+    (1500 rows, expert/active 50) lands 0.833 healthy — the 0.80 bar
+    trips a Laplace-path regression instead of the old always-1.0 pass
+    on the separable generator."""
     x, y = load_mnist_binary()
     rng = np.random.default_rng(3)
     sub = rng.choice(x.shape[0], size=1500, replace=False)
@@ -75,4 +81,4 @@ def test_mnist_standin_bound():
     score = train_validation_split(
         gp, x, y, train_ratio=0.8, metric=accuracy, seed=13
     )
-    assert score > 0.9, score
+    assert score > 0.80, score

@@ -1,0 +1,520 @@
+"""Degradation-ladder tests (``resilience/fallback.py``, ISSUE 9).
+
+Covers the closed failure taxonomy + classifier, every ladder entry
+point against chaos-injected execution faults (fit one-dispatch ->
+segmented, predict chunk-halving -> host solve, device magic solve ->
+host solve, sharded fit -> single host), the ``GP_GUARD_ACTION=degrade``
+strict-lane re-fit, provenance/journal stamping, the ``GP_FALLBACK=0``
+raw-propagation kill switch, and the exception-hygiene lint that keeps
+the taxonomy from rotting.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.data import make_benchmark_data
+from spark_gp_tpu.resilience import chaos, fallback
+
+
+def _gp(optimizer="device", max_iter=6, expert=50, **kw):
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(0.1))
+        .setDatasetSizeForExpert(expert)
+        .setActiveSetSize(expert)
+        .setSeed(13)
+        .setSigma2(1e-3)
+        .setMaxIter(max_iter)
+        .setOptimizer(optimizer)
+    )
+    return gp
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return make_benchmark_data(800)
+
+
+@pytest.fixture(scope="module")
+def clean_model(problem):
+    x, y = problem
+    return _gp().fit(x, y)
+
+
+# -- taxonomy / classifier --------------------------------------------------
+
+
+def test_taxonomy_is_closed_and_catalogued():
+    from spark_gp_tpu.obs import names
+
+    assert fallback.UNKNOWN in fallback.FAILURE_CLASSES
+    for cls in fallback.FAILURE_CLASSES:
+        # every class is representable in the fallback.failures.* pattern
+        assert names.is_registered(f"fallback.failures.{cls}")
+
+
+def test_classifier_maps_framework_exceptions():
+    from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+    from spark_gp_tpu.parallel.coord import CoordinationTimeoutError
+    from spark_gp_tpu.resilience.quarantine import (
+        ExpertQuarantineError,
+        NonFiniteFitError,
+    )
+    from spark_gp_tpu.resilience.retry import RetryBudgetExceededError
+
+    cf = fallback.classify_failure
+    assert cf(NotPositiveDefiniteException()) == fallback.NOT_PSD_EXHAUSTED
+    assert cf(NonFiniteFitError("x")) == fallback.NON_FINITE_EXHAUSTED
+    assert cf(ExpertQuarantineError("x")) == fallback.NON_FINITE_EXHAUSTED
+    assert cf(
+        CoordinationTimeoutError("barrier", 5.0, [1, 3])
+    ) == fallback.COORD_TIMEOUT
+    assert cf(fallback.GuardBreachError("mixed", 1.0, 0.01)) == (
+        fallback.GUARD_BREACH
+    )
+    assert cf(MemoryError()) == fallback.OOM
+    assert cf(ValueError("boom")) == fallback.UNKNOWN
+    assert cf(RuntimeError("some random runtime thing")) == fallback.UNKNOWN
+
+
+def test_classifier_maps_xla_runtime_errors_by_message():
+    from jaxlib.xla_extension import XlaRuntimeError
+
+    cf = fallback.classify_failure
+    assert cf(XlaRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 123 bytes"
+    )) == fallback.OOM
+    assert cf(XlaRuntimeError(
+        "INTERNAL: during compilation: Mosaic failed to lower"
+    )) == fallback.COMPILE
+    assert cf(XlaRuntimeError("UNIMPLEMENTED: whatever")) == fallback.UNKNOWN
+
+
+def test_classifier_follows_retry_budget_cause():
+    from spark_gp_tpu.resilience.retry import RetryBudgetExceededError
+
+    inner = MemoryError()
+    wrapped = RetryBudgetExceededError("fit failed")
+    wrapped.__cause__ = inner
+    assert fallback.classify_failure(wrapped) == fallback.OOM
+
+
+# -- fit ladder: injected OOM / compile -------------------------------------
+
+
+def test_injected_oom_completes_via_segmented_rung(problem, clean_model, tmp_path, monkeypatch):
+    """The acceptance contract: a RESOURCE_EXHAUSTED on the one-dispatch
+    device fit completes through the segmented rung with the IDENTICAL
+    fitted theta (same L-BFGS trajectory in halved segment batches),
+    fallback metrics emitted, and the classified failure + rung sequence
+    recorded in the run journal and the saved model's provenance_json."""
+    x, y = problem
+    monkeypatch.setenv("GP_RUN_JOURNAL_DIR", str(tmp_path))
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    before = telemetry.snapshot()["counters"]
+    with chaos.oom_after_calls(0, op="one_dispatch") as fired:
+        model = _gp().fit(x, y)
+    assert fired[0] == 1
+    np.testing.assert_allclose(
+        model.raw_predictor.theta, clean_model.raw_predictor.theta,
+        atol=1e-6,
+    )
+    # metrics
+    assert model.instr.metrics["fallback.engaged"] == 1.0
+    after = telemetry.snapshot()["counters"]
+    assert after.get("fallback.transitions", 0) > before.get(
+        "fallback.transitions", 0
+    )
+    assert after.get("fallback.failures.oom", 0) > before.get(
+        "fallback.failures.oom", 0
+    )
+    # degradation history: classified class + rung sequence
+    (transition,) = model.degradations
+    assert transition["failure_class"] == "oom"
+    assert transition["from"] == "native"
+    assert transition["to"] == "segmented"
+    # run journal carries it
+    assert model.run_journal["degradations"] == model.degradations
+    with open(model.run_journal["path"]) as fh:
+        persisted = json.load(fh)
+    assert persisted["degradations"] == model.degradations
+    # saved model provenance carries it
+    path = str(tmp_path / "degraded.npz")
+    model.save(path)
+    from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
+
+    loaded = GaussianProcessRegressionModel.load(path)
+    assert loaded.provenance["degradations"] == model.degradations
+
+
+def test_injected_compile_failure_walks_ladder(problem, clean_model):
+    x, y = problem
+    with chaos.failing_compile(times=1, op="fit.device") as fired:
+        model = _gp().fit(x, y)
+    assert fired[0] == 1
+    assert [d["failure_class"] for d in model.degradations] == ["compile"]
+    np.testing.assert_allclose(
+        model.raw_predictor.theta, clean_model.raw_predictor.theta,
+        atol=1e-6,
+    )
+
+
+def test_kill_switch_restores_raw_propagation(problem, monkeypatch):
+    """GP_FALLBACK=0: the raw injected XlaRuntimeError propagates — type,
+    message, no degradation metrics, no model."""
+    x, y = problem
+    monkeypatch.setenv("GP_FALLBACK", "0")
+    with chaos.oom_after_calls(0, op="one_dispatch"):
+        with pytest.raises(Exception) as excinfo:
+            _gp().fit(x, y)
+    assert type(excinfo.value).__name__ == "XlaRuntimeError"
+    assert "RESOURCE_EXHAUSTED" in str(excinfo.value)
+    assert not isinstance(excinfo.value, fallback.DegradationExhaustedError)
+
+
+def test_persistent_oom_raises_single_classified_error(problem):
+    """Every rung OOMs -> ONE DegradationExhaustedError naming the class
+    and the rung history (cause chained) — the soak invariant."""
+    x, y = problem
+    with chaos.oom_after_calls(0, op="fit."):  # matches EVERY rung's dispatch
+        with pytest.raises(fallback.DegradationExhaustedError) as excinfo:
+            _gp().fit(x, y)
+    err = excinfo.value
+    assert err.failure_class == fallback.OOM
+    assert [d["to"] for d in err.degradations] == ["segmented", "host_f64"]
+    assert err.__cause__ is not None
+    assert fallback.classify_failure(err) == fallback.OOM
+
+
+def test_unknown_failures_never_degrade(problem, monkeypatch):
+    """An unclassifiable exception re-raises raw — the ladder only
+    degrades what it can name."""
+    x, y = problem
+
+    calls = {"n": 0}
+    import spark_gp_tpu.models.likelihood as lk
+
+    original = lk.fit_gpr_device
+
+    def boom(*args, **kw):
+        calls["n"] += 1
+        raise ValueError("totally novel failure")
+
+    monkeypatch.setattr(lk, "fit_gpr_device", boom)
+    monkeypatch.setattr("spark_gp_tpu.models.gpr.fit_gpr_device", boom, raising=False)
+    with pytest.raises(ValueError, match="totally novel"):
+        _gp().fit(x, y)
+    assert calls["n"] == 1  # no re-execution
+
+
+def test_numeric_exhaustion_keeps_raw_error_on_f64_harness(problem):
+    """host_f64 applies to non_finite/not_psd exhaustion only when there
+    is precision headroom; on this x64 harness the pre-ladder advice-
+    bearing errors must propagate untouched (today's behavior)."""
+    import jax
+
+    assert jax.config.jax_enable_x64
+    gp = _gp()
+    assert not fallback._fit_rung_applies(
+        gp, "host_f64", fallback.NON_FINITE_EXHAUSTED, {"native"}
+    )
+    assert not fallback._fit_rung_applies(
+        gp, "host_f64", fallback.NOT_PSD_EXHAUSTED, {"native"}
+    )
+    # oom/compile DO get the host rung regardless of dtype headroom
+    assert fallback._fit_rung_applies(
+        gp, "host_f64", fallback.OOM, {"native", "segmented"}
+    )
+
+
+def test_segmented_rung_applicability_gates():
+    gp = _gp()
+    assert fallback._fit_rung_applies(gp, "segmented", fallback.OOM, {"native"})
+    # checkpointed fits are already segmented
+    gp_ck = _gp().setCheckpointDir("/tmp/nope")
+    assert not fallback._fit_rung_applies(
+        gp_ck, "segmented", fallback.OOM, {"native"}
+    )
+    # batched multi-start has no segment driver
+    gp_ms = _gp().setNumRestarts(3)
+    assert not fallback._fit_rung_applies(
+        gp_ms, "segmented", fallback.OOM, {"native"}
+    )
+    # host-optimizer fits have no one-dispatch program to segment
+    gp_host = _gp(optimizer="host")
+    assert not fallback._fit_rung_applies(
+        gp_host, "segmented", fallback.OOM, {"native"}
+    )
+
+
+# -- guard breach -----------------------------------------------------------
+
+
+@pytest.fixture
+def forced_guard_breach(monkeypatch):
+    from spark_gp_tpu.ops import precision
+
+    monkeypatch.setitem(precision.GUARD_BARS, "mixed", -1.0)
+    prev = precision.set_precision_lane("mixed")
+    yield
+    precision.set_precision_lane(prev)
+
+
+def test_guard_breach_degrades_to_strict_lane(problem, forced_guard_breach, monkeypatch):
+    """GP_GUARD_ACTION=degrade: a guard-breaching mixed-lane fit re-runs
+    on the strict lane, guard passing (strict emits no guard), with the
+    degradation flagged in provenance."""
+    x, y = problem
+    monkeypatch.setenv("GP_GUARD_ACTION", "degrade")
+    model = _gp().fit(x, y)
+    (transition,) = model.degradations
+    assert transition["failure_class"] == "guard_breach"
+    assert transition["to"] == "strict_lane"
+    # the re-fit ran strict: no breach metric, lane recorded strict
+    assert model.instr.metrics["precision_lane"] == "strict"
+    assert "mixed_precision_guard.breach" not in model.instr.metrics
+
+
+def test_guard_breach_default_stays_log_only(problem, forced_guard_breach):
+    """Default GP_GUARD_ACTION (log): breach warns + metrics, fit
+    completes on its lane — pre-ladder behavior bit-for-bit."""
+    x, y = problem
+    model = _gp().fit(x, y)
+    assert model.instr.metrics["mixed_precision_guard.breach"] == 1.0
+    assert model.instr.metrics["precision_lane"] == "mixed"
+    assert getattr(model, "degradations", None) is None
+
+
+def test_guard_breach_degrades_distributed_fit_too(
+    problem, forced_guard_breach, monkeypatch, eight_device_mesh
+):
+    """fit_distributed under GP_GUARD_ACTION=degrade: a breaching
+    mixed-lane fit re-runs strict through the sharded ladder instead of
+    crashing with a raw GuardBreachError."""
+    from spark_gp_tpu.parallel.experts import group_for_experts
+    from spark_gp_tpu.parallel.mesh import shard_experts
+
+    x, y = problem
+    monkeypatch.setenv("GP_GUARD_ACTION", "degrade")
+    data = shard_experts(group_for_experts(x, y, 50), eight_device_mesh)
+    model = _gp().setMesh(eight_device_mesh).fit_distributed(data)
+    (transition,) = model.degradations
+    assert transition["entry"] == "fit_sharded"
+    assert transition["failure_class"] == "guard_breach"
+    assert transition["to"] == "strict_lane"
+    assert model.instr.metrics["precision_lane"] == "strict"
+    assert "mixed_precision_guard.breach" not in model.instr.metrics
+
+
+def test_degradations_survive_save_load_save(problem, tmp_path):
+    """The provenance stamp is PERMANENT: a save -> load -> save round
+    trip must not launder a degraded fit into a clean one."""
+    x, y = problem
+    with chaos.oom_after_calls(0, op="one_dispatch"):
+        model = _gp().fit(x, y)
+    from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
+
+    first = str(tmp_path / "first.npz")
+    model.save(first)
+    loaded = GaussianProcessRegressionModel.load(first)
+    second = str(tmp_path / "second.npz")
+    loaded.save(second)
+    reloaded = GaussianProcessRegressionModel.load(second)
+    assert reloaded.provenance["degradations"] == model.degradations
+
+
+def test_guard_action_env_validation(monkeypatch):
+    from spark_gp_tpu.ops.precision import guard_action
+
+    assert guard_action() == "log"
+    monkeypatch.setenv("GP_GUARD_ACTION", "degrade")
+    assert guard_action() == "degrade"
+    monkeypatch.setenv("GP_GUARD_ACTION", "explode")
+    with pytest.raises(ValueError, match="GP_GUARD_ACTION"):
+        guard_action()
+
+
+# -- predict ladder ---------------------------------------------------------
+
+
+def test_predict_oom_halves_chunk_to_fit(problem, clean_model):
+    """An allocator ceiling the initial chunk exceeds: halvings get the
+    dispatch under it and the answer matches the clean path."""
+    x, _ = problem
+    want = clean_model.predict(x[:500])
+    with chaos.oom_after_calls(0, op="predict.chunk", rows_above=130) as fired:
+        got = clean_model.predict(x[:500])
+    assert fired[0] >= 1
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+
+def test_predict_oom_falls_to_host_solve(problem, clean_model):
+    """Every chunk OOMs: the eager host-f64 rung answers (variance path
+    included)."""
+    x, _ = problem
+    mean_ref, var_ref = clean_model.predict_with_var(x[:200])
+    with chaos.oom_after_calls(0, op="predict.chunk") as fired:
+        mean, var = clean_model.predict_with_var(x[:200])
+    assert fired[0] >= 1
+    np.testing.assert_allclose(mean, mean_ref, rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(var, var_ref, rtol=1e-9, atol=1e-12)
+
+
+def test_predict_kill_switch(problem, clean_model, monkeypatch):
+    x, _ = problem
+    monkeypatch.setenv("GP_FALLBACK", "off")
+    with chaos.oom_after_calls(0, op="predict.chunk"):
+        with pytest.raises(Exception) as excinfo:
+            clean_model.predict(x[:64])
+    assert "RESOURCE_EXHAUSTED" in str(excinfo.value)
+
+
+# -- magic-solve ladder -----------------------------------------------------
+
+
+def test_magic_solve_oom_falls_to_host(monkeypatch):
+    from spark_gp_tpu.kernels.base import Const, EyeKernel
+    import spark_gp_tpu.models.ppa as ppa
+
+    rng = np.random.default_rng(0)
+    kernel = RBFKernel(1.5) + Const(1e-3) * EyeKernel()
+    m = 128
+    active = rng.normal(size=(m, 3))
+    b = rng.normal(size=(m, m)) / np.sqrt(m)
+    u1 = b @ b.T * m * 0.01
+    u2 = rng.normal(size=m)
+    theta = kernel.init_theta()
+    mv_ref, mm_ref = ppa.magic_solve(kernel, theta, active, u1, u2)
+    monkeypatch.setattr(ppa, "_DEVICE_SOLVE_MIN_M", 64)
+    with chaos.oom_after_calls(0, op="ppa.magic_solve") as fired:
+        mv, mm = ppa.magic_solve(kernel, theta, active, u1, u2)
+    assert fired[0] == 1
+    np.testing.assert_allclose(mv, mv_ref, rtol=1e-12)
+    np.testing.assert_allclose(mm, mm_ref, rtol=1e-12)
+
+
+def test_magic_solve_not_psd_stays_raw(monkeypatch):
+    """Numerical failure: the ladder must NOT mask the advice-bearing
+    error with a host re-run."""
+    from spark_gp_tpu.kernels.base import Const, EyeKernel
+    from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
+    import spark_gp_tpu.models.ppa as ppa
+
+    rng = np.random.default_rng(0)
+    kernel = RBFKernel(1.5) + Const(1e-3) * EyeKernel()
+    active = rng.normal(size=(64, 3))
+    with pytest.raises(NotPositiveDefiniteException):
+        ppa.magic_solve(
+            kernel, kernel.init_theta(), active,
+            -np.eye(64), np.zeros(64),
+        )
+
+
+# -- sharded fit ladder -----------------------------------------------------
+
+
+def test_sharded_fit_degrades_to_single_host(problem, eight_device_mesh):
+    from spark_gp_tpu.parallel.experts import group_for_experts
+    from spark_gp_tpu.parallel.mesh import shard_experts
+
+    x, y = problem
+    mesh = eight_device_mesh
+    data = shard_experts(group_for_experts(x, y, 50), mesh)
+    clean = _gp().setMesh(mesh).fit_distributed(data)
+    with chaos.oom_after_calls(0, op="sharded") as fired:
+        degraded = _gp().setMesh(mesh).fit_distributed(data)
+    assert fired[0] == 1
+    (transition,) = degraded.degradations
+    assert transition["entry"] == "fit_sharded"
+    assert transition["to"] == "single_host"
+    np.testing.assert_allclose(
+        degraded.raw_predictor.theta, clean.raw_predictor.theta, atol=1e-6
+    )
+
+
+def test_dcn_fallback_rung_unavailable_single_process():
+    from spark_gp_tpu.parallel import coord
+
+    assert coord.dcn_fallback_available(None) is False
+    # an already-bound DCN context rules the rung out too
+    assert coord.dcn_fallback_available(object()) is False
+
+
+# -- chaos injectors --------------------------------------------------------
+
+
+def test_oom_injector_env_channel(monkeypatch):
+    monkeypatch.setenv("GP_CHAOS_OOM_AFTER_CALLS", "1")
+    monkeypatch.setenv("GP_CHAOS_OOM_OP", "fit.device")
+    chaos.maybe_injected_failure("fit.device.one_dispatch")  # call 1 allowed
+    with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+        chaos.maybe_injected_failure("fit.device.one_dispatch")
+    # non-matching op untouched
+    chaos.maybe_injected_failure("predict.chunk")
+    # reset the consumed env state for other tests
+    chaos._mp_state.update(
+        oom_after=None, oom_op=None, oom_rows_above=None, oom_calls=0,
+        oom_fired=None,
+    )
+
+
+def test_compile_injector_is_bounded():
+    with chaos.failing_compile(times=2) as fired:
+        for _ in range(2):
+            with pytest.raises(Exception, match="compilation"):
+                chaos.maybe_injected_failure("fit.device.one_dispatch")
+        chaos.maybe_injected_failure("fit.device.one_dispatch")  # clean
+    assert fired[0] == 2
+
+
+def test_oom_injector_rows_filter():
+    with chaos.oom_after_calls(0, op="predict", rows_above=100) as fired:
+        chaos.maybe_injected_failure("predict.chunk", rows=64)  # under
+        with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+            chaos.maybe_injected_failure("predict.chunk", rows=256)
+    assert fired[0] == 1
+
+
+# -- exception hygiene lint -------------------------------------------------
+
+
+def test_exception_hygiene_lint_is_clean():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import check_exception_hygiene
+
+    violations = check_exception_hygiene.find_violations(
+        os.path.join(ROOT, "spark_gp_tpu")
+    )
+    assert violations == [], violations
+
+
+def test_exception_hygiene_lint_catches_unmarked_broad_except(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import check_exception_hygiene
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n"
+        "try:\n    pass\nexcept (ValueError, BaseException):\n    pass\n"
+        "try:\n    pass\nexcept:\n    pass\n"
+        "try:\n    pass\nexcept ValueError:\n    pass\n"  # fine
+        "try:\n    pass\n"
+        "except Exception:  # classified-failure-site: test\n    pass\n"
+    )
+    violations = check_exception_hygiene.find_violations(str(tmp_path))
+    assert len(violations) == 3
+    kinds = {v[2] for v in violations}
+    assert kinds == {"except Exception", "except BaseException", "bare except"}
+    assert check_exception_hygiene.main([str(tmp_path)]) == 1
+    assert check_exception_hygiene.main(
+        [os.path.join(ROOT, "spark_gp_tpu")]
+    ) == 0

@@ -53,6 +53,38 @@ def test_iris_accuracy_bar_on_chip():
     assert score >= 0.9, f"on-chip iris OvR accuracy {score} below the 0.9 bar"
 
 
+def test_airfoil_rmse_bar_on_chip():
+    """The reference's HEADLINE quality contract on hardware (VERDICT
+    next #6): airfoil 5-feature ARD config (Airfoil.scala:9-33, the
+    examples/airfoil.py setup verbatim) must hold its RMSE < 2.1 bar on
+    the f32 chip path — 3 folds instead of the example's 10 for window
+    budget (the bar is a per-fold-mean; the CPU f64 10-fold twin lives in
+    bench.py's airfoil extra and examples/airfoil.py)."""
+    from spark_gp_tpu import (
+        ARDRBFKernel,
+        Const,
+        EyeKernel,
+        GaussianProcessRegression,
+    )
+    from spark_gp_tpu.data import load_airfoil
+    from spark_gp_tpu.ops.scaling import scale
+    from spark_gp_tpu.utils.validation import cross_validate, rmse
+
+    x, y = load_airfoil()
+    x = np.asarray(scale(x))
+    gp = (
+        GaussianProcessRegression()
+        .setDatasetSizeForExpert(100)
+        .setActiveSetSize(1000)
+        .setSigma2(1e-4)
+        .setKernel(lambda: 1.0 * ARDRBFKernel(5) + Const(1.0) * EyeKernel())
+        .setSeed(13)
+    )
+    score = cross_validate(gp, x, y, num_folds=3, metric=rmse, seed=13)
+    assert np.isfinite(score)
+    assert score < 2.1, f"on-chip airfoil RMSE {score} breaches the 2.1 bar"
+
+
 def test_poisson_rate_recovery_on_chip():
     """Generic-likelihood Laplace on hardware: the Poisson regressor must
     recover a known rate surface within the example's own 0.1 bar

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Tier-1 lint: broad exception handlers are registered, or they're bugs.
+
+The degradation ladder (``resilience/fallback.py``) rests on a CLOSED
+failure taxonomy: every execution failure is either classified — and then
+deliberately degraded, counted, and stamped into provenance — or re-raised
+raw.  A stray ``except Exception:`` anywhere else silently swallows
+exactly the evidence the classifier needs, and the taxonomy rots without
+anyone noticing.  This checker walks the package AST and flags every
+broad handler — ``except Exception``, ``except BaseException``, a bare
+``except:``, or a tuple containing either — unless the ``except`` line
+carries one of the registered markers:
+
+* ``# classified-failure-site`` — a degradation-ladder catch point whose
+  body routes the exception through ``classify_failure`` (the taxonomy's
+  own dispatch sites);
+* ``# noqa: BLE001`` — the repo's long-standing audited-escape
+  convention for never-fail telemetry/housekeeping paths (every such
+  site carries a rationale comment);
+* ``# hygiene-ok`` — other reviewed escapes (same auditability contract
+  as the metric checker's ``# metric-name-ok``).
+
+Run standalone (``python tools/check_exception_hygiene.py``; exit 1 on
+violations) or through the tier-1 wrapper
+(``tests/test_fallback.py::test_exception_hygiene_lint_is_clean``) —
+the same wiring as the metric/pin/collective checkers.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_BROAD = {"Exception", "BaseException"}
+_MARKERS = ("classified-failure-site", "noqa: BLE001", "hygiene-ok")
+
+
+def _names(node: Optional[ast.expr]) -> List[str]:
+    """Exception-class names a handler catches: bare handlers yield the
+    sentinel ``<bare>``; tuples flatten; attribute lookups keep the last
+    component (``np.linalg.LinAlgError`` -> ``LinAlgError``)."""
+    if node is None:
+        return ["<bare>"]
+    if isinstance(node, ast.Tuple):
+        out: List[str] = []
+        for element in node.elts:
+            out.extend(_names(element))
+        return out
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    return []
+
+
+def check_file(path: str) -> List[Tuple[str, int, str, str]]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [(path, exc.lineno or 0, "<unparseable>", str(exc))]
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        caught = _names(node.type)
+        broad = [n for n in caught if n in _BROAD or n == "<bare>"]
+        if not broad:
+            continue
+        line_text = lines[node.lineno - 1] if 0 < node.lineno <= len(lines) else ""
+        if any(marker in line_text for marker in _MARKERS):
+            continue
+        what = "bare except" if "<bare>" in broad else f"except {broad[0]}"
+        violations.append((
+            path, node.lineno, what,
+            "broad handler outside a registered classified-failure site",
+        ))
+    return violations
+
+
+def find_violations(package_root: str) -> List[Tuple[str, int, str, str]]:
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(os.path.abspath(package_root)):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                violations.extend(check_file(os.path.join(dirpath, name)))
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = (argv if argv is not None else sys.argv[1:]) or [
+        os.path.join(repo_root, "spark_gp_tpu")
+    ]
+    violations = find_violations(args[0])
+    if violations:
+        print(
+            "broad exception handlers outside registered classified-failure "
+            "sites — route the failure through resilience/fallback."
+            "classify_failure (marker '# classified-failure-site'), or "
+            "register a reviewed escape ('# noqa: BLE001' with a rationale, "
+            "or '# hygiene-ok'):",
+            file=sys.stderr,
+        )
+        for path, lineno, what, why in violations:
+            rel = os.path.relpath(path, repo_root)
+            print(f"  {rel}:{lineno}: {what}: {why}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
