@@ -463,21 +463,61 @@ class GaussianProcessCommons(GaussianProcessParams):
         ``run()`` is the whole fit (restarts, recovery, everything); with
         tracing off (``GP_TRACING=0``) this is a straight call — the
         bench's observability section measures exactly that difference.
+
+        This shell is also the forensics plane's fit-side anchor
+        (obs/recorder.py): the fit's trace id is minted here — stitched
+        over the coordination KV plane on multi-host fits, so every
+        host's journal shares one id — and a TERMINAL classified failure
+        escaping the fit dumps exactly one incident bundle (failing span
+        tree, recorder events, rung history, compile/memory deltas)
+        before re-raising.  Successfully-degraded fits journal normally.
         """
+        from spark_gp_tpu.obs import recorder as obs_recorder
         from spark_gp_tpu.obs import runtime as obs_runtime
         from spark_gp_tpu.obs import trace as obs_trace
 
         if not obs_trace.tracing_enabled():
-            return run()
-        with obs_runtime.fit_capture(instr.name) as cap:
-            with obs_trace.span(
-                f"fit.{instr.name}", family=type(self).__name__
-            ) as root:
-                model = run()
+            # tracing off: no spans, no capture, no journal — but the
+            # forensics contract (one bundle per terminal classified
+            # failure) rides the INDEPENDENT recorder gate, so the
+            # failure shell stays active (its bundle just has no span
+            # tree).  GP_RECORDER=0 is the recorder's own kill switch.
+            try:
+                return run()
+            except Exception as exc:  # classified-failure-site: bundle + re-raise
+                obs_recorder.record_fit_failure(
+                    exc, entry=f"fit.{instr.name}", instr=instr,
+                    directory=self._checkpoint_dir,
+                )
+                raise
+        from spark_gp_tpu.parallel import coord
+
+        stitch_ctx = (
+            self._coord_ctx_for_checkpoint()
+            if getattr(self, "_fit_is_distributed", False) else None
+        )
+        token = coord.stitch_trace_token(stitch_ctx)
+        with obs_runtime.trace_token_scope(token):
+            with obs_runtime.fit_capture(instr.name) as cap:
+                root = None
+                try:
+                    with obs_trace.span(
+                        f"fit.{instr.name}", family=type(self).__name__,
+                        trace_token=token,
+                    ) as root:
+                        model = run()
+                except Exception as exc:  # classified-failure-site: bundle + re-raise
+                    obs_recorder.record_fit_failure(
+                        exc, entry=f"fit.{instr.name}", instr=instr,
+                        root=root, capture=cap,
+                        directory=self._checkpoint_dir,
+                    )
+                    raise
         journal_instr = getattr(model, "instr", None) or instr
         model.run_journal = obs_runtime.write_run_journal(
             journal_instr, root, cap,
             mesh=self._mesh, journal_dir=self._checkpoint_dir,
+            trace_token=token,
         )
         return model
 

@@ -317,13 +317,16 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                     )
                 )
             else:
+                from spark_gp_tpu.obs import cost as obs_cost
+
+                # measured cost of the one-dispatch program (obs/cost.py)
                 theta, f_final, nll, n_iter, n_fev, stalled = (
-                    fit_generic_device(
-                        self._likelihood, kernel, float(self._tol), log_space,
-                        theta0, lower, upper,
-                        data.x, data.y, data.mask,
-                        jnp.asarray(self._max_iter, dtype=jnp.int32),
-                        cache,
+                    obs_cost.observed_call(
+                        "fit.device", fit_generic_device,
+                        self._likelihood, kernel, float(self._tol),
+                        log_space, theta0, lower, upper, data.x, data.y,
+                        data.mask,
+                        jnp.asarray(self._max_iter, dtype=jnp.int32), cache,
                     )
                 )
             phase_sync(theta, nll)

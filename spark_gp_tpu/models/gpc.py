@@ -351,9 +351,15 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                     )
                 )
             else:
-                theta, f_final, f, n_iter, n_fev, stalled = fit_gpc_device(
-                    kernel, float(self._tol), log_space, theta0, lower, upper,
-                    data.x, data.y, data.mask, max_iter, cache,
+                from spark_gp_tpu.obs import cost as obs_cost
+
+                # measured cost of the one-dispatch program (obs/cost.py)
+                theta, f_final, f, n_iter, n_fev, stalled = (
+                    obs_cost.observed_call(
+                        "fit.device", fit_gpc_device,
+                        kernel, float(self._tol), log_space, theta0, lower,
+                        upper, data.x, data.y, data.mask, max_iter, cache,
+                    )
                 )
             phase_sync(theta, f)
         pending = {

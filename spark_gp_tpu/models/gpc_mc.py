@@ -323,11 +323,16 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                     )
                 )
             else:
-                theta, f_final, nll, n_iter, n_fev, stalled = fit_gpc_mc_device(
-                    kernel, float(self._tol), log_space, theta0, lower, upper,
-                    data.x, y1h, data.mask,
-                    jnp.asarray(self._max_iter, dtype=jnp.int32),
-                    cache,
+                from spark_gp_tpu.obs import cost as obs_cost
+
+                # measured cost of the one-dispatch program (obs/cost.py)
+                theta, f_final, nll, n_iter, n_fev, stalled = (
+                    obs_cost.observed_call(
+                        "fit.device", fit_gpc_mc_device,
+                        kernel, float(self._tol), log_space, theta0, lower,
+                        upper, data.x, y1h, data.mask,
+                        jnp.asarray(self._max_iter, dtype=jnp.int32), cache,
+                    )
                 )
             phase_sync(theta, nll)
         theta_host = np.asarray(theta, dtype=np.float64)

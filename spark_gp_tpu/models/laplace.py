@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
+from spark_gp_tpu.obs import cost as obs_cost
 from spark_gp_tpu.ops.linalg import masked_kernel_matrix
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 from spark_gp_tpu.parallel.experts import ExpertData
@@ -297,8 +298,10 @@ def make_laplace_objective(kernel: Kernel, data: ExpertData, tol, cache=None):
 
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
-        return _laplace_impl(
-            kernel, float(tol), theta, data.x, data.y, data.mask, f0, cache
+        # measured flops/bytes per evaluation (obs/cost.py, GP_XLA_COST)
+        return obs_cost.observed_call(
+            "fit.host_objective", _laplace_impl,
+            kernel, float(tol), theta, data.x, data.y, data.mask, f0, cache,
         )
 
     return obj

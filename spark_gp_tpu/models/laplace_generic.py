@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
+from spark_gp_tpu.obs import cost as obs_cost
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 
 
@@ -318,8 +319,10 @@ def make_generic_objective(
 
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=x.dtype)
-        return _generic_vag_impl(
-            lik, kernel, float(tol), theta, x, y, mask, f0, cache
+        # measured flops/bytes per evaluation (obs/cost.py, GP_XLA_COST)
+        return obs_cost.observed_call(
+            "fit.host_objective", _generic_vag_impl,
+            lik, kernel, float(tol), theta, x, y, mask, f0, cache,
         )
 
     return obj

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
+from spark_gp_tpu.obs import cost as obs_cost
 from spark_gp_tpu.ops.linalg import chol_logdet, chol_solve, cholesky
 from spark_gp_tpu.ops.precision import active_lane, precision_lane_scope
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
@@ -183,7 +184,11 @@ def make_value_and_grad(
 
     def vag(theta):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
-        return _vag_impl(
+        # measured flops/bytes per evaluation (obs/cost.py, GP_XLA_COST):
+        # signature-cached, so the host optimizer's ~40 calls pay one
+        # lowering and the counters accumulate true executed totals
+        return obs_cost.observed_call(
+            "fit.host_objective", _vag_impl,
             kernel, theta, data.x, data.y, data.mask, extra, cache,
             objective=objective, lane=active_lane(),
         )
@@ -289,7 +294,8 @@ def make_sharded_value_and_grad(
 
     def vag(theta):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
-        return _sharded_vag_impl(
+        return obs_cost.observed_call(
+            "fit.sharded_objective", _sharded_vag_impl,
             kernel, mesh, theta, data.x, data.y, data.mask, cache,
             objective=objective, lane=active_lane(),
         )
@@ -346,7 +352,11 @@ def fit_gpr_device(
     the jit key (module note above).  ``cache`` (the theta-invariant gram
     cache) enters the program as a constant operand OUTSIDE the L-BFGS
     while_loop, so every iteration's evaluation reuses it."""
-    return _fit_gpr_device_impl(
+    # measured cost of the whole one-dispatch program (the while body is
+    # counted once by XLA's cost model — per-dispatch semantics, like the
+    # compile counters)
+    return obs_cost.observed_call(
+        "fit.device", _fit_gpr_device_impl,
         kernel, log_space, theta0, lower, upper, x, y, mask, max_iter, tol,
         extra, cache, objective=objective,
         lane=active_lane() if lane is None else lane,

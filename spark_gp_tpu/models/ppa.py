@@ -788,10 +788,17 @@ class ProjectedProcessRawPredictor:
     ):
         from spark_gp_tpu.resilience import chaos
 
+        from spark_gp_tpu.obs import cost as obs_cost
+
         t = x_test.shape[0]
         if t <= chunk:
             chaos.maybe_injected_failure("predict.chunk", rows=t)
-            out = predict(*args, jnp.asarray(x_test, dtype=dtype), lane=lane)
+            # measured flops/bytes per predict dispatch (obs/cost.py,
+            # GP_XLA_COST) — the gp_xla_*_total{entry="predict.ppa"} series
+            out = obs_cost.observed_call(
+                "predict.ppa", predict,
+                *args, jnp.asarray(x_test, dtype=dtype), lane=lane,
+            )
             return (out, None) if mean_only else out
         # fixed chunk shape (last chunk padded) -> one compiled executable
         means, vars_ = [], []
@@ -803,7 +810,10 @@ class ProjectedProcessRawPredictor:
                     [part, jnp.broadcast_to(part[:1], (pad, part.shape[1]))]
                 )
             chaos.maybe_injected_failure("predict.chunk", rows=chunk)
-            out = predict(*args, jnp.asarray(part, dtype=dtype), lane=lane)
+            out = obs_cost.observed_call(
+                "predict.ppa", predict,
+                *args, jnp.asarray(part, dtype=dtype), lane=lane,
+            )
             mean, var = (out, None) if mean_only else out
             means.append(mean[: chunk - pad] if pad else mean)
             if var is not None:

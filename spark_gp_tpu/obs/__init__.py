@@ -16,6 +16,12 @@ Three modules, one system (docs/OBSERVABILITY.md):
   ``jax.monitoring`` compile/retrace counting per entry point,
   ``device.memory_stats()`` gauges sampled on phase boundaries, and the
   per-fit ``run_journal`` artifact.
+* :mod:`spark_gp_tpu.obs.recorder` — the flight recorder (bounded event
+  ring fed by span events, failures and the serve metric watchlist) and
+  the incident bundles dumped on terminal classified failures.
+* :mod:`spark_gp_tpu.obs.cost` — XLA ``cost_analysis`` attribution:
+  measured flops/bytes per compiled entry point, and the measured
+  optimize-phase MFU stamped into run journals (``GP_XLA_COST=1``).
 
 Every metric key any of this emits is registered in
 :mod:`spark_gp_tpu.obs.names` — the one catalog
@@ -30,4 +36,14 @@ from spark_gp_tpu.obs.trace import (  # noqa: F401
     tracing_enabled,
 )
 from spark_gp_tpu.obs.expo import render_openmetrics  # noqa: F401
-from spark_gp_tpu.obs.runtime import telemetry, write_run_journal  # noqa: F401
+from spark_gp_tpu.obs.recorder import (  # noqa: F401
+    RECORDER,
+    dump_incident,
+    recording_enabled,
+    set_recording,
+)
+from spark_gp_tpu.obs.runtime import (  # noqa: F401
+    build_info,
+    telemetry,
+    write_run_journal,
+)

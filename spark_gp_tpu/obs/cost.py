@@ -1,0 +1,208 @@
+"""XLA cost attribution: measured flops/bytes per compiled entry point.
+
+The roofline work (docs/ROOFLINE.md) estimates MFU from analytic flop
+counts; "Memory Safe Computations with XLA Compiler" argues the unit of
+performance truth is the compiled executable.  This module closes the
+gap: for each jitted fit/predict entry point, the XLA compiler's own
+``compiled.cost_analysis()`` (flops + bytes accessed of the optimized
+module) is extracted ONCE per (entry point, operand signature) and then
+accumulated per execution into the runtime telemetry —
+
+* ``xla.flops.<entry>`` / ``xla.bytes.<entry>`` counters, exposed as
+  ``gp_xla_flops_total{entry=...}`` / ``gp_xla_bytes_total{entry=...}``
+  (``obs/expo.py`` pattern-label collapse), where ``<entry>`` is the
+  active trace root (``fit.GaussianProcessRegression``,
+  ``serve.batch``) or the call site's fallback label
+  (``predict.ppa``);
+* a per-fit table on the active :class:`~spark_gp_tpu.obs.runtime.
+  FitCapture`, from which the run journal stamps measured per-phase MFU
+  against :func:`spark_gp_tpu.ops.precision.chip_peaks` — measured, not
+  estimated.
+
+Cost: one extra trace+lowering per NEW signature (the backend compile is
+cache-served for an already-executed program); the process-wide
+signature cache makes every later call a dict lookup.  Off by default —
+``GP_XLA_COST=1`` (or :func:`set_cost_metering`) opts in; the bench and
+the tier-1 acceptance tests enable it explicitly.  Measurement never
+raises into the measured path: a failing lowering counts
+``xla.cost_failures`` and the entry point proceeds untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+_forced: Optional[bool] = None
+
+_CACHE: Dict[Tuple, Optional[Dict[str, float]]] = {}
+_LOCK = threading.Lock()
+#: signature-cache bound: far above any steady-state entry-point count
+#: (a serve process has a handful of bucket shapes; a fit a handful of
+#: programs), but a hard ceiling so a pathological shape churn cannot
+#: grow the dict for the process lifetime.  FIFO eviction — an evicted
+#: signature just re-measures.
+_CACHE_MAX = 512
+
+
+def cost_metering_enabled() -> bool:
+    """The gate, read at call time: ``set_cost_metering`` wins, else
+    ``GP_XLA_COST`` (default OFF — measurement pays one lowering per new
+    signature); always off while tracing is disabled so the bench's
+    tracer-off baseline stays a true zero."""
+    from spark_gp_tpu.obs import trace as obs_trace
+
+    if not obs_trace.tracing_enabled():
+        return False
+    if _forced is not None:
+        return _forced
+    return os.environ.get("GP_XLA_COST", "").strip().lower() in (
+        "1", "on", "true",
+    )
+
+
+def set_cost_metering(enabled: Optional[bool]) -> None:
+    """Force cost metering on/off for this process (None = back to env)."""
+    global _forced
+    _forced = enabled
+
+
+def clear_cache() -> None:
+    """Drop the signature cache (tests; ``jax.clear_caches`` parity)."""
+    with _LOCK:
+        _CACHE.clear()
+
+
+def _sig_of(value: Any):
+    """Hashable signature of one operand: arrays by (shape, dtype) —
+    cost depends on avals, never on values — containers structurally,
+    statics (kernels, meshes) by identity-stable hash."""
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("a", tuple(shape), str(dtype))
+    if isinstance(value, (tuple, list)):
+        return ("t", tuple(_sig_of(v) for v in value))
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    try:
+        return ("h", type(value).__name__, hash(value))
+    except TypeError:
+        return ("u", type(value).__name__)
+
+
+def _extract(compiled) -> Optional[Dict[str, float]]:
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not analysis:
+        return None
+    flops = float(analysis.get("flops", 0.0) or 0.0)
+    bytes_accessed = float(analysis.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0.0 and bytes_accessed <= 0.0:
+        return None
+    return {"flops": flops, "bytes": bytes_accessed}
+
+
+def measure(jitted, args: tuple, kwargs: Optional[dict] = None
+            ) -> Optional[Dict[str, float]]:
+    """``{"flops", "bytes"}`` of one execution of ``jitted(*args,
+    **kwargs)``, from the compiler's cost model; cached per signature;
+    None when the backend offers no analysis (then cached as None so the
+    lowering is never retried per call)."""
+    kwargs = kwargs or {}
+    key = (id(jitted), _sig_of(args), _sig_of(tuple(sorted(kwargs.items()))))
+    with _LOCK:
+        if key in _CACHE:
+            return _CACHE[key]
+    try:
+        cost = _extract(jitted.lower(*args, **kwargs).compile())
+    except Exception:  # noqa: BLE001 — metering must never fail the
+        # measured entry point (chaos-staged compile failures land here too)
+        from spark_gp_tpu.obs.runtime import telemetry
+
+        telemetry.inc("xla.cost_failures")
+        cost = None
+    with _LOCK:
+        while len(_CACHE) >= _CACHE_MAX:
+            _CACHE.pop(next(iter(_CACHE)))
+        _CACHE[key] = cost
+    return cost
+
+
+def observe_call(
+    entry_fallback: str, jitted, args: tuple,
+    kwargs: Optional[dict] = None, weight: float = 1.0,
+) -> Optional[Dict[str, float]]:
+    """Attribute ``weight`` executions of a jitted entry point: measure
+    (cached), then accumulate into the telemetry counters under the
+    active trace root (the compile-counter attribution convention) and
+    into the active fit capture's per-entry table.  A no-op returning
+    None when metering is off."""
+    if not cost_metering_enabled():
+        return None
+    cost = measure(jitted, args, kwargs)
+    if cost is None:
+        return None
+    from spark_gp_tpu.obs import runtime as obs_runtime
+    from spark_gp_tpu.obs import trace as obs_trace
+
+    entry = obs_trace.current_root_name() or entry_fallback
+    obs_runtime.telemetry.inc(
+        f"xla.flops.{entry}", entry=entry, n=cost["flops"] * weight
+    )
+    obs_runtime.telemetry.inc(
+        f"xla.bytes.{entry}", entry=entry, n=cost["bytes"] * weight
+    )
+    obs_runtime.note_xla_cost(entry, cost, weight)
+    return cost
+
+
+def observed_call(entry_fallback: str, jitted, *args, **kwargs):
+    """Meter AND invoke in one step: ``observed_call(entry, fn, *a,
+    **kw)`` returns ``fn(*a, **kw)`` and attributes one execution (when
+    metering is on).  THE call-site form — the measured args and the
+    executed args are one tuple by construction, so they cannot drift.
+    The call runs FIRST: a raising dispatch (an injected OOM, a compile
+    failure the degradation ladder will classify) is never counted as an
+    executed program, and the measurement's lowering happens against an
+    already-warm compile."""
+    out = jitted(*args, **kwargs)
+    observe_call(entry_fallback, jitted, args, kwargs)
+    return out
+
+
+def measured_flops(entry: str) -> float:
+    """Total measured flops attributed to ``entry`` so far (the
+    ``gp_xla_flops_total{entry=}`` series, host-side read)."""
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    return telemetry.snapshot()["counters"].get(f"xla.flops.{entry}", 0.0)
+
+
+def mfu_against_peak(flops_total: float, seconds: float
+                     ) -> Optional[Dict[str, float]]:
+    """Measured MFU of ``flops_total`` executed flops over ``seconds``
+    against the running chip's nominal bf16 peak
+    (:func:`~spark_gp_tpu.ops.precision.chip_peaks`); None when the
+    generation is unknown or the denominator is degenerate."""
+    if not flops_total or not seconds or seconds <= 0.0:
+        return None
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — no backend, no MFU
+        return None
+    from spark_gp_tpu.ops.precision import chip_peaks
+
+    peak_tflops, _ = chip_peaks(kind)
+    if not peak_tflops:
+        return None
+    return {
+        "device_kind": kind,
+        "peak_tflops": peak_tflops,
+        "achieved_tflops": flops_total / seconds / 1e12,
+        "mfu": flops_total / seconds / (peak_tflops * 1e12),
+    }

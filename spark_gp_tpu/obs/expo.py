@@ -151,6 +151,17 @@ def render_openmetrics(metrics, runtime_snapshot: Optional[dict] = None) -> str:
     the runtime telemetry snapshot merged in when given."""
     page = _Page()
 
+    # build/runtime identity on EVERY page (OpenMetrics info type: family
+    # gp_build, sample gp_build_info{...} 1) — the satellite that lets a
+    # scrape answer "which package/jax/backend produced these series"
+    from spark_gp_tpu.obs.runtime import build_info
+
+    page.add(
+        "gp_build", "info",
+        _help_for("build", "build/runtime identity"), None, "_info",
+        {k: str(v) for k, v in build_info().items()}, 1.0,
+    )
+
     # copy ALL instance state under its lock (the snapshot() discipline):
     # emitters insert first-time keys concurrently, and iterating the live
     # dicts would raise "dictionary changed size during iteration" mid-scrape

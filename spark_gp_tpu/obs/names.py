@@ -38,6 +38,7 @@ class MetricName:
 
     key: str
     #: counter | gauge | histogram | metric (fit scalar) | phase (timing)
+    #: | event (span/recorder event name) | info (label-only identity)
     kind: str
     help: str
     #: for patterns: the exposition label the wildcard part becomes
@@ -149,6 +150,41 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("coord.checkpoints", "counter", "coordinated checkpoint saves completed"),
     MetricName("coord.elastic_resumes", "counter", "resumes under a different process count than the save"),
     MetricName("coord.preemptions", "counter", "SIGTERM preemption signals observed by the watcher"),
+    # -- forensics plane (obs/recorder.py, obs/cost.py) --------------------
+    MetricName("incident.bundles", "counter", "incident bundles assembled on terminal classified failures"),
+    MetricName("incident.bundle_failures", "counter", "incident bundles that could not be persisted"),
+    MetricName("xla.flops.*", "counter", "measured XLA flops executed per entry point (compiled.cost_analysis)", label="entry"),
+    MetricName("xla.bytes.*", "counter", "measured XLA bytes accessed per entry point (compiled.cost_analysis)", label="entry"),
+    MetricName("xla.cost_failures", "counter", "cost_analysis lowerings that failed (metering skipped)"),
+    MetricName("build", "info", "build/runtime identity (package, jax, backend, lane, process count)"),
+    # -- span/recorder event names (trace.add_event / RECORDER.record) -----
+    # registered so tools/check_metric_names.py pins every emitted event
+    # name, exactly like metric keys: a renamed event silently empties the
+    # journal/bundle queries that grep for it
+    MetricName("error", "event", "span closed with an escaping exception"),
+    MetricName("experts.quarantined", "event", "experts dropped by screen/recovery"),
+    MetricName("experts.jittered", "event", "experts repaired by adaptive jitter"),
+    MetricName("fit.retry", "event", "recovery re-dispatch of a fit attempt"),
+    MetricName("fallback.failure", "event", "classified execution failure observed"),
+    MetricName("compile.trace", "event", "jaxpr trace observed on the current span"),
+    MetricName("breaker.open", "event", "circuit breaker opened"),
+    MetricName("breaker.close", "event", "circuit breaker closed"),
+    MetricName("breaker.reject", "event", "dispatch rejected by an open breaker"),
+    MetricName("queue.isolation", "event", "poisoned batch re-executed singly"),
+    MetricName("canary.start", "event", "canary rollout begun"),
+    MetricName("canary.rollback", "event", "canary rolled back and quarantined"),
+    MetricName("canary.promote", "event", "canary promoted to latest"),
+    MetricName("lifecycle.drain_begin", "event", "graceful drain begun"),
+    MetricName("lifecycle.drain_end", "event", "graceful drain finished"),
+    MetricName("coord.elastic_resume", "event", "resume under a different process count"),
+    MetricName("coord.barrier_timeout", "event", "deadline-guarded coordination step timed out"),
+    MetricName("coord.recovered", "event", "straggling peer resumed heartbeating"),
+    MetricName("coord.dead_host", "event", "peer declared dead by the heartbeat registry"),
+    MetricName("coord.straggler", "event", "peer flagged straggling"),
+    MetricName("coord.checkpoint", "event", "coordinated checkpoint save completed"),
+    MetricName("coord.preempted", "event", "SIGTERM preemption observed"),
+    MetricName("incident.bundle", "event", "incident bundle dumped"),
+    MetricName("metric.*", "event", "watchlisted serve-metric increment relayed to the flight recorder", label="key"),
 )
 
 _EXACT = {spec.key: spec for spec in CATALOG if "*" not in spec.key}

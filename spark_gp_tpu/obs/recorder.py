@@ -1,0 +1,385 @@
+"""Flight recorder + incident bundles: the forensics plane.
+
+The span ring evicts, run journals are written only on successful fit
+completion, and serve failures leave nothing but counters — so when a
+classified failure finally fires, the evidence of *what led up to it* is
+gone.  This module keeps that evidence:
+
+* a **flight recorder** — a bounded, lock-cheap ring of structured
+  events, fed automatically by every span event
+  (:func:`spark_gp_tpu.obs.trace.add_event` relays here even when no
+  span is open), erroring spans, classified-failure observations
+  (``resilience/fallback.record_failure``), and the serve metric
+  watchlist (shed/breaker/watchdog counters —
+  ``serve/metrics.ServingMetrics.inc``).  ``GP_RECORDER=0`` (or
+  :func:`set_recording`) turns the feed into a no-op; the bench's
+  ``observability.recorder`` section prices the on/off difference and
+  ``test_bench_contract`` holds it under 2%;
+* **incident bundles** — on a *terminal* classified failure (a fit
+  raising out of ``models/common._observed_fit``, a predict ladder
+  raising its classified error, a hang-watchdog trip) ONE JSON artifact
+  (tmp + atomic rename, the checkpoint writers' convention) is dumped
+  into ``GP_INCIDENT_DIR`` / the fit's checkpoint dir /
+  ``GP_RUN_JOURNAL_DIR``: the failing span tree, the last-N recorder
+  events, the degradation-rung history, compile/memory deltas, build
+  provenance and the staged chaos environment — everything a post-mortem
+  needs, written at the moment of failure.  Bundles ride the existing
+  ``GP_ARTIFACT_RETENTION`` pruning (``obs/runtime.prune_artifacts``).
+
+Successfully-degraded work (a fit that completed through a fallback
+rung) does NOT bundle — the run journal already carries its
+``degradations`` — and :data:`~spark_gp_tpu.resilience.fallback.UNKNOWN`
+failures never bundle: the forensics plane documents what the taxonomy
+can name.  Exactly-one-bundle-per-terminal-failure is the invariant
+``tools/soak.py`` asserts across seeded chaos campaigns.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: schema version of the incident-bundle JSON (docs/OBSERVABILITY.md)
+BUNDLE_FORMAT = "spark_gp_tpu.incident_bundle/v1"
+
+#: keys every schema-valid bundle carries (golden-schema test +
+#: tools/gpctl validation read this, so the contract lives in one place)
+BUNDLE_REQUIRED_KEYS = (
+    "format", "reason", "created_unix", "pid", "trace_id", "failure_class",
+    "error", "degradations", "spans", "events", "compiles", "memory",
+    "build_info", "chaos", "recorder",
+)
+
+#: serve-metric keys relayed into the recorder when they increment (the
+#: "metric deltas" feed): the admission/failure story of the minutes
+#: before an incident, without recording every request counter
+METRIC_WATCH_PREFIXES = (
+    "shed", "queue.shed", "queue.poisoned", "timeouts", "breaker.trips",
+    "exec.hung", "predict.failures", "lifecycle.", "canary.",
+    "registry.evictions",
+)
+
+_seq = itertools.count(1)  # CPython-atomic, like trace._ids
+
+_forced: Optional[bool] = None
+
+
+def recording_enabled() -> bool:
+    """ONE definition of the recorder gate, read at call time (the
+    ``tracing_enabled`` convention): ``set_recording`` wins, else
+    ``GP_RECORDER`` (default on)."""
+    if _forced is not None:
+        return _forced
+    return os.environ.get("GP_RECORDER", "").strip().lower() not in (
+        "0", "off", "false",
+    )
+
+
+def set_recording(enabled: Optional[bool]) -> None:
+    """Force the recorder on/off for this process (None = back to env)."""
+    global _forced
+    _forced = enabled
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of structured events (oldest evicted).
+
+    An event is one small dict — monotonic ``seq``, wall-clock
+    ``t_unix``, emitting ``thread``, ``name``, and the emitter's
+    attributes.  Appends are one lock + one deque push; the ring never
+    allocates past its bound, so the recorder can run always-on in
+    production."""
+
+    def __init__(self, capacity: int = 2048):
+        self._buf: deque = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self.dropped = 0  # events evicted by the bound (monotonic)
+
+    def record(self, name: str, **attrs) -> None:
+        if not recording_enabled():
+            return
+        event = {
+            "seq": next(_seq),
+            "t_unix": time.time(),
+            "thread": threading.current_thread().name,
+            "name": name,
+            **attrs,
+        }
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(event)
+
+    def note_metric(self, key: str, value: float) -> None:
+        """Watchlist relay for metric increments (``ServingMetrics.inc``):
+        only the admission/failure keys land in the ring — recording
+        every request counter would evict the events that matter."""
+        if not recording_enabled():
+            return
+        if key.startswith(METRIC_WATCH_PREFIXES):
+            self.record(f"metric.{key}", value=float(value))
+
+    def snapshot(self, last: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            events = list(self._buf)
+        if last is not None and last >= 0:
+            events = events[-last:]
+        return events
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+def _ring_capacity() -> int:
+    # lenient like GP_TRACE_RING: a malformed value must not crash import
+    try:
+        return int(os.environ.get("GP_RECORDER_RING", "") or 2048)
+    except ValueError:
+        return 2048
+
+
+#: THE process-global recorder every feed lands in
+RECORDER = FlightRecorder(_ring_capacity())
+
+#: events included in a bundle (the ring may be larger)
+BUNDLE_LAST_EVENTS = 256
+
+_INCIDENT_MARK = "_gp_incident_path"
+
+
+def incident_dir(explicit: Optional[str] = None) -> Optional[str]:
+    """Where bundles land: ``GP_INCIDENT_DIR`` (operator redirect) wins,
+    then the caller's directory (a fit's checkpoint dir), then
+    ``GP_RUN_JOURNAL_DIR``; None disables persistence entirely."""
+    for candidate in (
+        os.environ.get("GP_INCIDENT_DIR", "").strip() or None,
+        explicit,
+        os.environ.get("GP_RUN_JOURNAL_DIR", "").strip() or None,
+    ):
+        if candidate:
+            return candidate
+    return None
+
+
+def _chaos_environment() -> Dict[str, str]:
+    """The staged chaos knobs at failure time: a seeded soak campaign's
+    repro recipe rides the bundle."""
+    return {
+        key: value
+        for key, value in sorted(os.environ.items())
+        if key.startswith("GP_CHAOS_") or key == "GP_SEED"
+    }
+
+
+def _span_tree_of(root) -> List[dict]:
+    """The failing trace's span tree, sourced from the ROOT span's own
+    ``trace_spans`` collection — immune to span-ring eviction, so a
+    bundle written after a long fit still contains the failure's own
+    span path (the ring-eviction test pins this)."""
+    from spark_gp_tpu.obs import trace as obs_trace
+
+    if root is None or not getattr(root, "trace_id", 0):
+        return []
+    spans = obs_trace.spans_of_root(root)
+    tree = obs_trace.span_tree(spans)
+    if not tree or tree[0].get("name") != getattr(root, "name", None):
+        # the root itself is still open (we are inside its except clause):
+        # synthesize it at the head so the tree is rooted correctly
+        tree = [{**root.to_dict(), "children": tree}]
+    return tree
+
+
+def already_bundled(exc: Optional[BaseException]) -> Optional[str]:
+    """Bundle path a propagating exception was already dumped for, or
+    None — the debounce that keeps nested trigger points (a predict
+    ladder inside a fit, a ladder error crossing ``_observed_fit``) from
+    double-dumping one incident."""
+    return getattr(exc, _INCIDENT_MARK, None) if exc is not None else None
+
+
+def dump_incident(
+    reason: str,
+    exc: Optional[BaseException] = None,
+    failure_class: Optional[str] = None,
+    root=None,
+    instr=None,
+    capture=None,
+    directory: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    extra: Optional[dict] = None,
+) -> Optional[dict]:
+    """Assemble (and persist, when a directory resolves) ONE incident
+    bundle; returns the bundle dict or None when debounced.
+
+    Never raises: forensics must not replace the failure it documents —
+    an unwritable directory degrades to an in-memory bundle plus an
+    ``incident.bundle_failures`` count, and ANY other assembly failure
+    (a span attr whose ``str()`` raises while the runtime is wedged, a
+    pathological structure ``json.dump`` rejects) is logged and
+    swallowed: the callers are exception shells and the hang-watchdog
+    verdict, where an escaping error would replace the classified
+    failure or leave the hung batch's futures unanswered.
+    """
+    try:
+        return _dump_incident_inner(
+            reason, exc, failure_class, root, instr, capture, directory,
+            trace_id, extra,
+        )
+    except Exception:  # noqa: BLE001 — see docstring: never raises
+        import logging
+
+        logging.getLogger("spark_gp_tpu").warning(
+            "incident bundle assembly failed for %r", reason, exc_info=True
+        )
+        try:
+            from spark_gp_tpu.obs.runtime import telemetry
+
+            telemetry.inc("incident.bundle_failures")
+        except Exception:  # noqa: BLE001 — counting is best-effort too
+            pass
+        return None
+
+
+def _dump_incident_inner(
+    reason, exc, failure_class, root, instr, capture, directory, trace_id,
+    extra,
+) -> Optional[dict]:
+    if already_bundled(exc) is not None:
+        return None
+    from spark_gp_tpu.obs import runtime as obs_runtime
+    from spark_gp_tpu.obs import trace as obs_trace
+
+    if capture is not None:
+        capture.finish()  # idempotent: the bundle needs the deltas NOW
+    if trace_id is None:
+        trace_id = obs_runtime.active_trace_token()
+    degradations = []
+    for source in (exc, instr):
+        got = list(getattr(source, "degradations", []) or [])
+        if got:
+            degradations = got
+            break
+    telemetry_snap = obs_runtime.telemetry.snapshot()
+    bundle = {
+        "format": BUNDLE_FORMAT,
+        "reason": reason,
+        "created_unix": time.time(),
+        "pid": os.getpid(),
+        "trace_id": trace_id,
+        "failure_class": failure_class,
+        "error": (
+            None if exc is None
+            else f"{type(exc).__name__}: {exc}"[:500]
+        ),
+        "degradations": degradations,
+        "spans": _span_tree_of(root),
+        "events": RECORDER.snapshot(last=BUNDLE_LAST_EVENTS),
+        "compiles": (
+            dict(capture.compiles) if capture is not None
+            else dict(telemetry_snap["counters"])
+        ),
+        "memory": {
+            "samples": (
+                list(capture.memory_samples) if capture is not None else []
+            ),
+            "gauges": dict(telemetry_snap["gauges"]),
+        },
+        "timings": dict(getattr(instr, "timings", {}) or {}),
+        "metrics": {
+            k: v for k, v in (getattr(instr, "metrics", {}) or {}).items()
+            if isinstance(v, (int, float, str, bool))
+        },
+        "build_info": obs_runtime.build_info(),
+        "chaos": _chaos_environment(),
+        "recorder": {
+            "dropped": RECORDER.dropped,
+            "capacity": RECORDER._buf.maxlen,
+        },
+        "path": None,
+        **(extra or {}),
+    }
+    # one emission: add_event relays into THIS recorder too (trace.py),
+    # so a second explicit record would double-log every incident
+    obs_trace.add_event(
+        "incident.bundle", reason=reason, failure_class=failure_class
+    )
+    target = incident_dir(directory)
+    if target is not None:
+        try:
+            os.makedirs(target, exist_ok=True)
+            tag = f"{int(bundle['created_unix'] * 1000):d}-p{os.getpid()}"
+            path = os.path.join(
+                target, f"incident_{reason.replace('.', '_')}-{tag}.json"
+            )
+            from spark_gp_tpu.utils.checkpoint import _fsync_replace
+
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(bundle, fh, default=str)
+            _fsync_replace(tmp, path)
+            bundle["path"] = path
+            obs_runtime.prune_artifacts(target, protect=path)
+        except OSError:
+            obs_runtime.telemetry.inc("incident.bundle_failures")
+            import logging
+
+            logging.getLogger("spark_gp_tpu").warning(
+                "incident bundle not persisted to %r", target, exc_info=True
+            )
+    obs_runtime.telemetry.inc("incident.bundles")
+    if exc is not None:
+        try:
+            setattr(exc, _INCIDENT_MARK, bundle["path"] or "<unpersisted>")
+        except (AttributeError, TypeError):
+            pass  # slotted/frozen exception: worst case is a second bundle
+    return bundle
+
+
+def record_fit_failure(
+    exc: BaseException,
+    entry: str,
+    instr=None,
+    root=None,
+    capture=None,
+    directory: Optional[str] = None,
+) -> Optional[dict]:
+    """The fit entry points' bundle trigger (``common._observed_fit``):
+    dump for terminal CLASSIFIED failures and for
+    ``DegradationExhaustedError`` (whose class may be ``unknown`` when a
+    rung itself broke — the history is the evidence); anything the
+    taxonomy cannot name stays bundle-free."""
+    from spark_gp_tpu.resilience import fallback
+
+    cls = fallback.classify_failure(exc)
+    if cls == fallback.UNKNOWN and not isinstance(
+        exc, fallback.DegradationExhaustedError
+    ):
+        return None
+    return dump_incident(
+        reason=entry, exc=exc, failure_class=cls, root=root, instr=instr,
+        capture=capture, directory=directory,
+    )
+
+
+def validate_bundle(bundle: dict) -> List[str]:
+    """Schema check shared by tests, ``tools/gpctl`` and the soak
+    invariant: returns the list of problems (empty = valid)."""
+    problems = []
+    if bundle.get("format") != BUNDLE_FORMAT:
+        problems.append(f"format is {bundle.get('format')!r}")
+    for key in BUNDLE_REQUIRED_KEYS:
+        if key not in bundle:
+            problems.append(f"missing required key {key!r}")
+    if not isinstance(bundle.get("events"), list):
+        problems.append("events is not a list")
+    if not isinstance(bundle.get("spans"), list):
+        problems.append("spans is not a list")
+    if not isinstance(bundle.get("degradations"), list):
+        problems.append("degradations is not a list")
+    return problems

@@ -170,6 +170,64 @@ class RuntimeTelemetry:
 telemetry = RuntimeTelemetry()
 
 
+def build_info() -> Dict[str, object]:
+    """The ``gp_build_info`` identity: package + jax/jaxlib versions,
+    backend, precision lane and process count — the labels that answer
+    "what exactly produced this page/journal/bundle" without ssh.
+    Collected lazily and failure-tolerant (a broken backend must not
+    break a scrape)."""
+    info: Dict[str, object] = {}
+    try:
+        import spark_gp_tpu
+
+        info["version"] = getattr(spark_gp_tpu, "__version__", "unknown")
+    except Exception:  # noqa: BLE001 — identity is best-effort
+        info["version"] = "unknown"
+    try:
+        import jax
+        import jaxlib
+
+        info["jax"] = jax.__version__
+        info["jaxlib"] = jaxlib.__version__
+        info["backend"] = jax.default_backend()
+        info["process_count"] = int(jax.process_count())
+    except Exception:  # noqa: BLE001 — no backend, still an identity
+        info.setdefault("backend", "unknown")
+        info.setdefault("process_count", 1)
+    try:
+        from spark_gp_tpu.ops.precision import active_lane
+
+        info["precision_lane"] = active_lane()
+    except Exception:  # noqa: BLE001
+        info["precision_lane"] = "unknown"
+    return info
+
+
+# -- cross-process trace stitching ------------------------------------------
+
+_trace_token: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "gp_obs_trace_token", default=None
+)
+
+
+def active_trace_token() -> Optional[str]:
+    """The stitched trace id of the enclosing fit (None outside one):
+    minted on process 0 and propagated over the coordination KV plane
+    (``parallel/coord.stitch_trace_token``), so every host's journal and
+    incident bundle carries the SAME id for one distributed fit."""
+    return _trace_token.get()
+
+
+@contextlib.contextmanager
+def trace_token_scope(token: Optional[str]):
+    """Bind the stitched trace id for the span of one fit."""
+    ctx_token = _trace_token.set(token)
+    try:
+        yield token
+    finally:
+        _trace_token.reset(ctx_token)
+
+
 # -- per-fit capture --------------------------------------------------------
 
 _active_capture: contextvars.ContextVar[Optional["FitCapture"]] = (
@@ -197,13 +255,46 @@ class FitCapture:
         self.memory_samples: List[dict] = []
         self.compiles: Dict[str, float] = {}
         self.compiles_by_entry: Dict[str, Dict[str, float]] = {}
+        # entry -> {flops_per_execution, bytes_per_execution, executions}
+        # fed by obs/cost.observe_call while this capture is active
+        self.xla_costs: Dict[str, Dict[str, float]] = {}
+        self._finished = False
 
     def add_memory_sample(self, tag: str) -> None:
         sample = telemetry.sample_memory()
         if sample:
             self.memory_samples.append({"phase": tag, **sample})
 
+    def note_xla_cost(self, entry: str, cost: Dict[str, float],
+                      weight: float = 1.0) -> None:
+        # DISTINCT compiled programs can share one trace-root entry (a
+        # degraded fit re-executes on another rung; host + device paths
+        # in one fit): keep one row per (entry, per-execution cost) —
+        # suffixing "#2", "#3" — so flops_total sums the programs that
+        # actually ran instead of multiplying one program's cost by every
+        # other program's executions
+        key = entry
+        suffix = 2
+        while True:
+            row = self.xla_costs.get(key)
+            if row is None or (
+                row["flops_per_execution"] == cost["flops"]
+                and row["bytes_per_execution"] == cost["bytes"]
+            ):
+                break
+            key = f"{entry}#{suffix}"
+            suffix += 1
+        row = self.xla_costs.setdefault(key, {
+            "flops_per_execution": cost["flops"],
+            "bytes_per_execution": cost["bytes"],
+            "executions": 0.0,
+        })
+        row["executions"] += weight
+
     def finish(self) -> None:
+        if self._finished:
+            return  # a failure-path bundle may have finished us already
+        self._finished = True
         self.add_memory_sample("end")
         snap = telemetry.snapshot()
         self.compiles = {
@@ -260,14 +351,26 @@ def on_phase_boundary(instr_name: str, phase_name: str) -> None:
         cap.add_memory_sample(phase_name)
 
 
+def note_xla_cost(entry: str, cost: Dict[str, float],
+                  weight: float = 1.0) -> None:
+    """Relay one cost-metered execution into the active fit capture (the
+    run journal's per-fit MFU table); dropped outside a capture — the
+    process-wide totals live in the telemetry counters regardless."""
+    cap = _active_capture.get()
+    if cap is not None:
+        cap.note_xla_cost(entry, cost, weight)
+
+
 # -- run journal ------------------------------------------------------------
 
 JOURNAL_FORMAT = "spark_gp_tpu.run_journal/v1"
 
 #: per-fit artifacts that accumulate in a long-lived checkpoint/journal
 #: directory (journals are stamped unique per fit; host-optimizer
-#: checkpoints are per-tag) — the retention GC's prune targets
-_RETENTION_PATTERNS = ("run_journal_*.json", "lbfgs_state_*")
+#: checkpoints are per-tag; incident bundles per failure) — the
+#: retention GC's prune targets
+_RETENTION_PATTERNS = ("run_journal_*.json", "lbfgs_state_*",
+                       "incident_*.json")
 
 
 def artifact_retention() -> Optional[int]:
@@ -332,12 +435,40 @@ def prune_artifacts(
     return removed
 
 
+def _xla_cost_summary(capture: Optional[FitCapture],
+                      timings: Dict[str, float]) -> Optional[dict]:
+    """The journal's measured-cost block: per-entry flops/bytes tables
+    from the capture plus the measured optimize-phase MFU against the
+    running chip's nominal peak (``obs/cost.mfu_against_peak``).  None
+    when cost metering was off for the fit."""
+    if capture is None or not capture.xla_costs:
+        return None
+    entries = {}
+    flops_total = 0.0
+    for entry, row in capture.xla_costs.items():
+        total = row["flops_per_execution"] * row["executions"]
+        entries[entry] = {**row, "flops_total": total}
+        flops_total += total
+    from spark_gp_tpu.obs import cost as obs_cost
+
+    opt_s = timings.get("optimize_hypers")
+    return {
+        "entries": entries,
+        "flops_total": flops_total,
+        "optimize_seconds": opt_s,
+        "measured_mfu_optimize": obs_cost.mfu_against_peak(
+            flops_total, opt_s or 0.0
+        ),
+    }
+
+
 def write_run_journal(
     instr,
     root,
     capture: Optional[FitCapture],
     mesh=None,
     journal_dir: Optional[str] = None,
+    trace_token: Optional[str] = None,
 ) -> dict:
     """Assemble (and optionally persist) one fit's run journal.
 
@@ -364,10 +495,19 @@ def write_run_journal(
             ("experts.", "fit.retry", "breaker.", "fallback.")
         )
     ]
+    if trace_token is None:
+        trace_token = active_trace_token()
     journal = {
         "format": JOURNAL_FORMAT,
         "name": getattr(instr, "name", "gp"),
         "created_unix": time.time(),
+        # the STITCHED trace id: one value across every host's journal
+        # (and any incident bundle) of one distributed fit — the key
+        # tools/gpctl merges on.  None only for direct writer calls
+        # outside a fit scope.
+        "trace_id": trace_token,
+        "pid": os.getpid(),
+        "build_info": build_info(),
         "precision_lane": active_lane(),
         "mesh": (
             None if mesh is None
@@ -400,6 +540,11 @@ def write_run_journal(
         } if capture is not None else {"samples": [], "peak": {}},
         "span_count": len(spans),
         "spans": _trace.span_tree(spans),
+        # measured flops/bytes + optimize-phase MFU (obs/cost.py); None
+        # when GP_XLA_COST was off for this fit
+        "xla_cost": _xla_cost_summary(
+            capture, dict(getattr(instr, "timings", {}))
+        ),
         "path": None,
     }
     if journal_dir is None:

@@ -32,6 +32,10 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+# the flight recorder is the span events' second sink (obs/recorder.py);
+# it has no top-level obs imports, so this cannot cycle
+from spark_gp_tpu.obs.recorder import RECORDER as _RECORDER
+
 _ids = itertools.count(1)  # CPython-atomic; no lock needed
 
 _current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
@@ -225,6 +229,10 @@ class span:
         if exc_type is not None:
             s.status = "error"
             s.add_event("error", type=exc_type.__name__)
+            # erroring spans feed the flight recorder: failure-path-only
+            # cost, and the incident bundle's event log then shows WHICH
+            # unit of work broke even after the span ring evicts
+            _RECORDER.record("error", span=s.name, type=exc_type.__name__)
         s.duration_s = time.perf_counter() - s.start
         _current.reset(self._token)
         root_list = s.root_span.trace_spans
@@ -240,8 +248,13 @@ def current_span() -> Optional[Span]:
 
 
 def add_event(name: str, **attrs) -> bool:
-    """Attach a timestamped event to the current span; False (dropped)
-    when no span is open — event emitters never need their own guard."""
+    """Attach a timestamped event to the current span; False (dropped
+    from the SPAN) when no span is open — event emitters never need
+    their own guard.  Every event is additionally relayed into the
+    flight recorder (:mod:`spark_gp_tpu.obs.recorder`) whether or not a
+    span is open: the recorder is the incident bundle's event log, and a
+    breaker trip on a span-less thread must still leave evidence."""
+    _RECORDER.record(name, **attrs)
     s = _current.get()
     if s is None:
         return False
@@ -300,7 +313,10 @@ def export_jsonl(path: str, spans: Optional[List[Span]] = None) -> int:
 
 def chrome_trace(spans: Optional[List[Span]] = None) -> dict:
     """Chrome/Perfetto ``trace_event`` document: spans as complete
-    (``"ph": "X"``) events, span events as instants (``"ph": "i"``)."""
+    (``"ph": "X"``) events, span events as instants (``"ph": "i"``),
+    plus ``process_name``/``thread_name`` metadata (``"ph": "M"``) so
+    Perfetto renders named lanes — the fit driver, the serve batcher,
+    the watchdog — instead of bare tids."""
     spans = RING.snapshot() if spans is None else spans
     pid = os.getpid()
     tids = {}
@@ -331,8 +347,20 @@ def chrome_trace(spans: Optional[List[Span]] = None) -> dict:
                     if k not in ("name", "t_unix")
                 },
             })
+    # metadata events FIRST (the trace_event spec allows any position,
+    # but naming the lanes up front renders correctly in every viewer):
+    # one process_name carrying the pid, one thread_name per lane
+    meta = [{
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": f"spark_gp_tpu p{pid}"},
+    }]
+    for thread_name, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": thread_name},
+        })
     return {
-        "traceEvents": events,
+        "traceEvents": meta + events,
         "metadata": {
             "threads": {str(v): k for k, v in tids.items()},
             "spans_dropped": RING.dropped,

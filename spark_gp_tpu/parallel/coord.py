@@ -1070,6 +1070,43 @@ def sample_active_dcn(ctx: DcnContext, data, m: int, seed: int) -> np.ndarray:
 
 
 # --------------------------------------------------------------------------
+# cross-process trace stitching
+# --------------------------------------------------------------------------
+
+
+def stitch_trace_token(ctx=None) -> str:
+    """ONE trace id per (possibly multi-host) fit: every process mints a
+    local candidate and, when a coordination context spans processes,
+    adopts process 0's over the KV plane — so all hosts' run journals and
+    incident bundles of one distributed fit share a single stitched
+    ``trace_id`` (``obs/runtime.write_run_journal`` / ``obs/recorder``).
+
+    Deliberately best-effort: a coordination failure HERE falls back to
+    the local token instead of failing the fit before it starts — the
+    fit's own guarded collectives will surface the real, named error.
+    Plain per-host ``fit()`` calls pass ``ctx=None`` and never rendezvous
+    (the PR 5 independent-fits invariant).
+    """
+    import uuid
+
+    local = f"t-{uuid.uuid4().hex[:16]}"
+    if ctx is None or getattr(ctx, "num_processes", 1) <= 1:
+        return local
+    try:
+        parts = ctx.allgather_bytes("trace_id", local.encode("ascii"))
+        return parts[0].decode("ascii")
+    except Exception:  # hygiene-ok: telemetry stitch only — the fit's own
+        # collectives re-raise the genuine coordination failure, named
+        import logging
+
+        logging.getLogger("spark_gp_tpu").warning(
+            "trace-id stitch failed; journals keep per-host trace ids",
+            exc_info=True,
+        )
+        return local
+
+
+# --------------------------------------------------------------------------
 # elastic-resume metadata
 # --------------------------------------------------------------------------
 

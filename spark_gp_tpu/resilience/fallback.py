@@ -185,11 +185,20 @@ def classify_failure(exc: BaseException) -> str:
 def record_failure(exc: BaseException, entry: str) -> str:
     """Classify + count one observed failure (``fallback.failures.*``);
     returns the class.  Usable standalone (the serve layer annotates its
-    predict failures with it) — counting never implies degradation."""
+    predict failures with it) — counting never implies degradation.
+    Every observation also lands in the flight recorder
+    (``obs/recorder.py``): the incident bundle's event log shows the
+    failure sequence that LED to the terminal error, not just the
+    terminal error."""
     cls = classify_failure(exc)
+    from spark_gp_tpu.obs.recorder import RECORDER
     from spark_gp_tpu.obs.runtime import telemetry
 
     telemetry.inc(f"fallback.failures.{cls}", entry=entry)
+    RECORDER.record(
+        "fallback.failure", entry=entry, failure_class=cls,
+        error=f"{type(exc).__name__}: {exc}"[:200],
+    )
     return cls
 
 
@@ -542,6 +551,26 @@ def run_distributed_ladder(est, instr, data, active_set, prepare):
         return model
 
 
+def _dump_predict_incident(exc: BaseException, cls: str,
+                           degradations: List[dict]) -> None:
+    """Terminal predict failures bundle HERE (fits bundle in
+    ``common._observed_fit``; predict has no observation shell): one
+    incident artifact per terminal classified failure, debounced on the
+    exception so a predict raising inside a larger wrapped scope never
+    double-dumps."""
+    if cls == UNKNOWN and not isinstance(exc, DegradationExhaustedError):
+        return
+    from spark_gp_tpu.obs import recorder as obs_recorder
+    from spark_gp_tpu.obs import trace as obs_trace
+
+    current = obs_trace.current_span()
+    obs_recorder.dump_incident(
+        reason="predict", exc=exc, failure_class=cls,
+        root=getattr(current, "root_span", None),
+        extra={"degradations": list(degradations)},
+    )
+
+
 def run_predict_ladder(
     attempt_at_chunk: Callable[[int], object],
     host_attempt: Callable[[], object],
@@ -586,17 +615,22 @@ def run_predict_ladder(
                     from spark_gp_tpu.obs.runtime import telemetry
 
                     telemetry.inc("fallback.exhausted", entry="predict")
-                    raise DegradationExhaustedError(
+                    err = DegradationExhaustedError(
                         "predict", classify_failure(host_exc), degradations,
                         host_exc,
-                    ) from host_exc
+                    )
+                    _dump_predict_incident(err, err.failure_class, degradations)
+                    raise err from host_exc
             if degradations:
                 from spark_gp_tpu.obs.runtime import telemetry
 
                 telemetry.inc("fallback.exhausted", entry="predict")
-                raise DegradationExhaustedError(
+                err = DegradationExhaustedError(
                     "predict", cls, degradations, exc
-                ) from exc
+                )
+                _dump_predict_incident(err, cls, degradations)
+                raise err from exc
+            _dump_predict_incident(exc, cls, degradations)
             raise
 
 

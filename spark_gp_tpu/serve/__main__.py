@@ -14,6 +14,10 @@ Startup sequence (the ready contract):
 Protocol (one JSON object per line, in either direction):
 
     {"id": 1, "model": "m", "x": [[...], ...]}      -> {"id": 1, "mean": [...], "var": [...]}
+    {"id": 2, "model": "m", "x": [...], "request_id": "abc"}
+        -> same, plus "request_id": "abc" echoed; the id is stamped on the
+           server-side serve.predict span and any incident bundle a hang
+           verdict dumps (cross-process trace stitching, docs/OBSERVABILITY.md)
     {"cmd": "metrics"}                               -> {"event": "metrics", ...}
     {"cmd": "health"}   (alias: {"op": "health"})    -> {"event": "health", "status": "ok"|"degraded"|"unready", ...}
     {"cmd": "reload", "model": "m"}                  -> {"event": "reloaded", ...}
@@ -135,7 +139,7 @@ def _writer_loop(pending: "_queue.Queue", lock, stream, result_wait_s) -> None:
                 reply = {"error": f"{type(exc).__name__}: {exc}"[:500]}
             _out(lock, stream, reply)
             continue
-        req_id, future, wait_s = item
+        req_id, future, wait_s, request_id = item
         try:
             # every enqueued request IS eventually completed (answered,
             # deadline-expired, or shutdown-errored), so with deadlines
@@ -158,6 +162,11 @@ def _writer_loop(pending: "_queue.Queue", lock, stream, result_wait_s) -> None:
             code = getattr(exc, "code", None)
             if code is not None:
                 response["code"] = code
+        if request_id is not None:
+            # echo the client's correlation id: the reply carries the same
+            # handle the server-side predict span (and any incident
+            # bundle) was stamped with — cross-process trace stitching
+            response["request_id"] = request_id
         _out(lock, stream, response)
 
 
@@ -261,6 +270,9 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                 pending.put({"error": f"unknown cmd {cmd!r}"})
                 continue
             req_id = msg.get("id")
+            # optional client correlation id: becomes the predict span's
+            # request_id attribute server-side and is echoed in the reply
+            request_id = msg.get("request_id")
             try:
                 future = server.submit(
                     msg["model"], msg["x"],
@@ -270,6 +282,7 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                     # gate's floor keeps being admitted while low-priority
                     # work is shed with code=queue.shed.memory
                     priority=int(msg.get("priority", 0)),
+                    request_id=request_id,
                 )
             except Exception as exc:  # noqa: BLE001 — shed/shape errors
                 # through the writer queue, not directly: error replies
@@ -282,6 +295,8 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                 code = getattr(exc, "code", None)
                 if code is not None:
                     reply["code"] = code
+                if request_id is not None:
+                    reply["request_id"] = request_id
                 pending.put(reply)
                 continue
             # a per-request timeout_ms override also stretches the writer's
@@ -291,6 +306,7 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
             pending.put((
                 req_id, future,
                 None if override is None else override / 1e3 + 30.0,
+                request_id,
             ))
         if shutdown:
             # the documented reply to {"cmd": "shutdown"}, on THIS

@@ -126,7 +126,7 @@ def install_drain_signals(
 class DispatchToken:
     """One in-flight ``_execute`` dispatch under watchdog observation."""
 
-    __slots__ = ("model", "group", "deadline", "fired", "phase")
+    __slots__ = ("model", "group", "deadline", "fired", "phase", "span")
 
     def __init__(
         self, model: str, group: list, deadline: float,
@@ -135,6 +135,10 @@ class DispatchToken:
         self.model = model
         self.group = group
         self.deadline = deadline
+        #: the live serve.predict span of the dispatch, attached by the
+        #: executor once it opens — a hang verdict's incident bundle
+        #: renders it (still open: the wedged thread cannot close it)
+        self.span = None
         #: "predict" for the candidate/stable dispatch itself, "shadow"
         #: for the INCUMBENT's scoring predict during a canary — the hang
         #: handler attributes the wedge to the right party

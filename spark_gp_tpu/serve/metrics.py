@@ -16,6 +16,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from spark_gp_tpu.obs.recorder import RECORDER as _RECORDER
 from spark_gp_tpu.utils.instrumentation import Instrumentation
 
 
@@ -117,6 +118,11 @@ class ServingMetrics(Instrumentation):
     def inc(self, key: str, value: float = 1.0) -> None:
         with self._lock:
             self.counters[key] = self.counters.get(key, 0.0) + value
+        # watchlisted increments (shed/breaker/watchdog/lifecycle keys)
+        # feed the flight recorder OUTSIDE the lock — the incident
+        # bundle's admission story; a one-prefix-check no-op for the
+        # request/batch counters on the hot path
+        _RECORDER.note_metric(key, value)
 
     def set_gauge(self, key: str, value: float) -> None:
         with self._lock:

@@ -95,6 +95,11 @@ class PredictRequest:
     # the stable latest after a rollback — a client-pinned version is a
     # contract (serve THAT one or fail)
     routed: bool = False
+    # client-supplied correlation id (the serve CLI's "request_id" field):
+    # echoed in the reply, stamped on the serve.predict span, and carried
+    # into any incident bundle a hang verdict dumps — the client's handle
+    # for cross-process trace stitching
+    request_id: Optional[str] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and (

@@ -267,6 +267,7 @@ class GPServeServer:
         version: Optional[int] = None,
         timeout_ms: Optional[float] = None,
         priority: int = 0,
+        request_id: Optional[str] = None,
     ) -> ServeFuture:
         """Enqueue a predict; returns immediately with a future.
 
@@ -341,6 +342,7 @@ class GPServeServer:
                 None if timeout_s is None else time.monotonic() + timeout_s
             ),
             routed=routed is not None and entry.version == routed,
+            request_id=None if request_id is None else str(request_id),
         )
         try:
             future = self._queue.submit(request)
@@ -447,12 +449,22 @@ class GPServeServer:
             self._watchdog.begin(name, group)
             if self._watchdog is not None else None
         )
+        request_ids = [
+            req.request_id for req in group if req.request_id is not None
+        ]
         try:
             with obs_trace.span(
                 "serve.predict", model=name, version=group[0].model_key[1],
                 rows=total, requests=len(group),
                 isolation_retry=group[0].isolation_retry,
-            ):
+                **({"request_ids": request_ids} if request_ids else {}),
+            ) as predict_span:
+                if token is not None and getattr(
+                    predict_span, "span_id", 0
+                ):  # real span only (tracing off yields the noop stub)
+                    # a hang verdict renders this (still-open) span in its
+                    # incident bundle — the wedged dispatch's own evidence
+                    token.span = predict_span
                 mean, var = entry.predict(x)
         except BaseException as exc:  # classified-failure-site: counted via classify_failure, re-raised
             if token is not None:
@@ -561,6 +573,31 @@ class GPServeServer:
                 self.metrics.inc("breaker.trips")
                 self.metrics.set_gauge(f"breaker.open.{name}", 1.0)
         error = ExecHungError(name, self._watchdog.hang_timeout_s)
+        # the hang's incident bundle (obs/recorder.py): the wedged
+        # dispatch's still-open serve.predict span, the request ids it
+        # was serving, and the recorder's event history — dumped from the
+        # watchdog thread, the only one guaranteed to still be moving
+        from spark_gp_tpu.obs import recorder as obs_recorder
+
+        obs_recorder.dump_incident(
+            reason="exec.hung", exc=error, failure_class="exec.hung",
+            root=getattr(token.span, "root_span", None),
+            extra={
+                "model": name,
+                "version": version,
+                "phase": token.phase,
+                # the wedged dispatch's own (still-open) span, verbatim —
+                # it cannot be in the closed-span tree, by definition
+                "hung_span": (
+                    None if token.span is None else token.span.to_dict()
+                ),
+                "request_ids": [
+                    req.request_id for req in token.group
+                    if req.request_id is not None
+                ],
+                "rows": int(sum(req.x.shape[0] for req in token.group)),
+            },
+        )
         for req in token.group:
             if not req.future.done():
                 req.future.set_error(error)

@@ -616,7 +616,10 @@ def test_catalog_is_self_consistent():
     seen = set()
     for spec in names.CATALOG:
         assert names.grammar_ok(spec.key), spec.key
-        assert spec.kind in ("counter", "gauge", "histogram", "metric", "phase")
+        assert spec.kind in (
+            "counter", "gauge", "histogram", "metric", "phase",
+            "event", "info",
+        )
         assert spec.key not in seen, f"duplicate catalog entry {spec.key}"
         seen.add(spec.key)
     assert names.lookup("breaker.open.anything").label == "model"

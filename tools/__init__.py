@@ -1,0 +1,6 @@
+"""Repo tooling: CI lints (check_*.py), the chaos soak driver (soak.py)
+and the observability CLI (``python -m tools.gpctl``).
+
+A package only so ``-m tools.gpctl`` resolves from a repo checkout; the
+lint scripts keep working as plain path-imported modules too.
+"""

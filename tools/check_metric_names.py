@@ -7,7 +7,9 @@ every emission call —
 
 * ``.inc(key, ...)`` / ``.set_gauge(key, ...)`` / ``.observe(key, ...)``
   (:class:`ServingMetrics`), ``.log_metric(key, ...)`` / ``.phase(key)``
-  (:class:`Instrumentation`), and direct subscript writes to a
+  (:class:`Instrumentation`), ``.add_event(key, ...)`` (span events —
+  ``obs/trace.py``), ``.record(key, ...)`` (flight-recorder events —
+  ``obs/recorder.py``), and direct subscript writes to a
   ``.metrics[...]`` / ``.counters[...]`` / ``.gauges[...]`` /
   ``.timings[...]`` dict —
 
@@ -33,7 +35,13 @@ import os
 import sys
 from typing import List, Optional, Tuple
 
-_EMITTERS = {"inc", "set_gauge", "observe", "log_metric", "phase"}
+_EMITTERS = {
+    "inc", "set_gauge", "observe", "log_metric", "phase",
+    # event emitters: span events and flight-recorder events are queried
+    # by name from journals/bundles exactly like metric keys — a renamed
+    # event silently empties those queries
+    "add_event", "record",
+}
 _METRIC_DICTS = {"metrics", "counters", "gauges", "timings"}
 _ALLOW = "metric-name-ok"
 

@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""gpctl — the run-journal / incident-bundle CLI (``python -m tools.gpctl``).
+
+Journals (``run_journal_*.json``, obs/runtime.py) and incident bundles
+(``incident_*.json``, obs/recorder.py) are per-process artifacts; this
+tool is how an operator reads them as ONE story:
+
+    gpctl list DIR [DIR ...]         # inventory: kind, time, name, trace id
+    gpctl show PATH                  # one artifact: summary + span tree
+    gpctl merge DIR [...] [--trace T]  # stitch per-process artifacts by
+                                       # trace id into one document
+    gpctl diff A B                   # two journals: phase timings, compile
+                                     # counts, metrics, degradation rungs
+
+``merge`` groups artifacts by the stitched ``trace_id`` every journal and
+bundle carries (minted on process 0 and propagated over the coordination
+KV plane — ``parallel/coord.stitch_trace_token``), so a 2-host fit's two
+journals render as one trace.  All subcommands exit 0 on success, 2 on
+bad input; ``show`` exits 1 when a bundle fails schema validation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def _load(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    return doc
+
+
+def _kind_of(doc: dict) -> str:
+    fmt = str(doc.get("format", ""))
+    if "incident_bundle" in fmt:
+        return "bundle"
+    if "run_journal" in fmt:
+        return "journal"
+    return "unknown"
+
+
+def _collect(paths: List[str]) -> List[dict]:
+    """Expand files/directories into loaded artifacts (sorted by time).
+    Unreadable files are reported to stderr and skipped — an inventory
+    sweep over a live checkpoint dir must not die on a half-written tmp."""
+    found: List[dict] = []
+    for path in paths:
+        if os.path.isdir(path):
+            names = sorted(
+                glob.glob(os.path.join(path, "run_journal_*.json"))
+                + glob.glob(os.path.join(path, "incident_*.json"))
+            )
+        else:
+            names = [path]
+        for name in names:
+            try:
+                doc = _load(name)
+            except (OSError, ValueError) as exc:
+                print(f"skipping {name}: {exc}", file=sys.stderr)
+                continue
+            doc["_path"] = name
+            found.append(doc)
+    found.sort(key=lambda d: d.get("created_unix", 0.0))
+    return found
+
+
+def _fmt_time(unix: Optional[float]) -> str:
+    if not unix:
+        return "-"
+    import datetime
+
+    return datetime.datetime.fromtimestamp(unix).strftime("%Y-%m-%d %H:%M:%S")
+
+
+def _one_line(doc: dict) -> str:
+    kind = _kind_of(doc)
+    name = doc.get("name") or doc.get("reason") or "?"
+    trace = doc.get("trace_id") or "-"
+    pid = doc.get("pid", "-")
+    tail = ""
+    if kind == "bundle":
+        tail = f" class={doc.get('failure_class')}"
+    degr = doc.get("degradations") or []
+    if degr:
+        rungs = "->".join(d.get("to", "?") for d in degr)
+        tail += f" rungs={rungs}"
+    return (
+        f"{kind:7s} {_fmt_time(doc.get('created_unix'))}  {name:<32s} "
+        f"trace={trace} pid={pid}{tail}  {doc['_path']}"
+    )
+
+
+def _render_tree(nodes: List[dict], indent: str = "", out=None) -> None:
+    out = out if out is not None else sys.stdout
+    for node in nodes:
+        dur = node.get("duration_s")
+        dur_s = "open" if dur is None else f"{dur * 1e3:.1f}ms"
+        events = node.get("events") or []
+        ev = f" [{len(events)} ev]" if events else ""
+        print(f"{indent}{node.get('name', '?')} ({dur_s}){ev}", file=out)
+        _render_tree(node.get("children") or [], indent + "  ", out=out)
+
+
+def cmd_list(args) -> int:
+    docs = _collect(args.paths)
+    if not docs:
+        print("no journals or bundles found", file=sys.stderr)
+        return 2
+    for doc in docs:
+        print(_one_line(doc))
+    return 0
+
+
+def cmd_show(args) -> int:
+    try:
+        doc = _load(args.path)
+    except (OSError, ValueError) as exc:
+        print(f"cannot read {args.path}: {exc}", file=sys.stderr)
+        return 2
+    doc["_path"] = args.path
+    kind = _kind_of(doc)
+    print(_one_line(doc))
+    for key in ("precision_lane", "failure_class", "error", "reason"):
+        if doc.get(key) is not None:
+            print(f"  {key}: {doc[key]}")
+    build = doc.get("build_info") or {}
+    if build:
+        pairs = " ".join(f"{k}={v}" for k, v in sorted(build.items()))
+        print(f"  build: {pairs}")
+    for row in doc.get("degradations") or []:
+        print(
+            f"  degradation: [{row.get('entry')}] {row.get('failure_class')}"
+            f" {row.get('from')} -> {row.get('to')}"
+        )
+    timings = doc.get("timings") or {}
+    for phase, seconds in sorted(timings.items()):
+        print(f"  phase {phase}: {seconds:.3f}s")
+    compiles = doc.get("compiles") or {}
+    if compiles:
+        print("  compiles: " + ", ".join(
+            f"{k}={v:g}" for k, v in sorted(compiles.items())
+            if k.startswith("compile.")
+        ))
+    xla = doc.get("xla_cost") or {}
+    if xla:
+        mfu = (xla.get("measured_mfu_optimize") or {}).get("mfu")
+        print(
+            f"  xla: flops_total={xla.get('flops_total', 0):.3e}"
+            + (f" measured_mfu={mfu:.4f}" if mfu is not None else "")
+        )
+    if kind == "bundle":
+        events = doc.get("events") or []
+        print(f"  recorder events: {len(events)} (last {min(len(events), 10)} shown)")
+        for event in events[-10:]:
+            attrs = {
+                k: v for k, v in event.items()
+                if k not in ("seq", "t_unix", "thread", "name")
+            }
+            print(f"    {event.get('name')} {attrs}")
+        from spark_gp_tpu.obs.recorder import validate_bundle
+
+        problems = validate_bundle(doc)
+        if problems:
+            for problem in problems:
+                print(f"  SCHEMA: {problem}", file=sys.stderr)
+            return 1
+    spans = doc.get("spans") or []
+    if spans:
+        print("  span tree:")
+        _render_tree(spans, indent="    ")
+    hung = doc.get("hung_span")
+    if hung:
+        print(f"  hung span: {hung.get('name')} attrs={hung.get('attrs')}")
+    return 0
+
+
+def cmd_merge(args) -> int:
+    docs = _collect(args.paths)
+    if not docs:
+        print("no journals or bundles found", file=sys.stderr)
+        return 2
+    by_trace: Dict[str, List[dict]] = {}
+    for doc in docs:
+        trace = doc.get("trace_id") or f"(untraced:{doc['_path']})"
+        by_trace.setdefault(trace, []).append(doc)
+    if args.trace is not None:
+        if args.trace not in by_trace:
+            print(f"trace {args.trace!r} not found; have: "
+                  + ", ".join(sorted(by_trace)), file=sys.stderr)
+            return 2
+        by_trace = {args.trace: by_trace[args.trace]}
+    merged = {
+        "format": "spark_gp_tpu.gpctl_merge/v1",
+        "traces": {
+            trace: {
+                "processes": sorted(
+                    {doc.get("pid") for doc in group if doc.get("pid")}
+                ),
+                "journals": [
+                    {k: v for k, v in doc.items() if k != "_path"}
+                    for doc in group if _kind_of(doc) == "journal"
+                ],
+                "bundles": [
+                    {k: v for k, v in doc.items() if k != "_path"}
+                    for doc in group if _kind_of(doc) == "bundle"
+                ],
+            }
+            for trace, group in sorted(by_trace.items())
+        },
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(merged, fh, default=str)
+        print(f"wrote {args.out} ({len(by_trace)} trace(s))")
+    else:
+        json.dump(merged, sys.stdout, default=str)
+        print()
+    return 0
+
+
+def _diff_numeric(label: str, a: Dict[str, float], b: Dict[str, float]) -> None:
+    keys = sorted(set(a) | set(b))
+    shown = False
+    for key in keys:
+        va, vb = a.get(key), b.get(key)
+        if not isinstance(va, (int, float)) and not isinstance(vb, (int, float)):
+            continue
+        va = float(va) if isinstance(va, (int, float)) else float("nan")
+        vb = float(vb) if isinstance(vb, (int, float)) else float("nan")
+        if not shown:
+            print(f"  {label}:")
+            shown = True
+        delta = vb - va
+        print(f"    {key:<36s} {va:>14.6g} -> {vb:>14.6g}  ({delta:+.6g})")
+
+
+def cmd_diff(args) -> int:
+    try:
+        a, b = _load(args.a), _load(args.b)
+    except (OSError, ValueError) as exc:
+        print(f"cannot read inputs: {exc}", file=sys.stderr)
+        return 2
+    print(f"A: {args.a} ({a.get('name')}, {_fmt_time(a.get('created_unix'))})")
+    print(f"B: {args.b} ({b.get('name')}, {_fmt_time(b.get('created_unix'))})")
+    _diff_numeric("phase timings (s)", a.get("timings") or {},
+                  b.get("timings") or {})
+    _diff_numeric("compiles", a.get("compiles") or {}, b.get("compiles") or {})
+    _diff_numeric(
+        "metrics",
+        {k: v for k, v in (a.get("metrics") or {}).items()
+         if isinstance(v, (int, float))},
+        {k: v for k, v in (b.get("metrics") or {}).items()
+         if isinstance(v, (int, float))},
+    )
+
+    def rungs(doc):
+        return [d.get("to") for d in (doc.get("degradations") or [])]
+
+    ra, rb = rungs(a), rungs(b)
+    if ra or rb:
+        print(f"  degradation rungs: {ra or '(none)'} -> {rb or '(none)'}")
+    xa = (a.get("xla_cost") or {}).get("flops_total")
+    xb = (b.get("xla_cost") or {}).get("flops_total")
+    if xa is not None or xb is not None:
+        print(f"  xla flops_total: {xa} -> {xb}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.gpctl",
+        description=__doc__.splitlines()[0],
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_list = sub.add_parser("list", help="inventory journals + bundles")
+    p_list.add_argument("paths", nargs="+", help="files or directories")
+    p_list.set_defaults(fn=cmd_list)
+
+    p_show = sub.add_parser("show", help="one artifact: summary + span tree")
+    p_show.add_argument("path")
+    p_show.set_defaults(fn=cmd_show)
+
+    p_merge = sub.add_parser(
+        "merge", help="stitch per-process artifacts by trace id"
+    )
+    p_merge.add_argument("paths", nargs="+", help="files or directories")
+    p_merge.add_argument("--trace", default=None, help="one trace id only")
+    p_merge.add_argument("--out", default=None, help="write JSON here")
+    p_merge.set_defaults(fn=cmd_merge)
+
+    p_diff = sub.add_parser("diff", help="compare two run journals")
+    p_diff.add_argument("a")
+    p_diff.add_argument("b")
+    p_diff.set_defaults(fn=cmd_diff)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # `gpctl list ... | head` closes the pipe mid-print — the Unix
+        # convention is a quiet exit, not a traceback.  Point stdout at
+        # devnull so interpreter shutdown's flush doesn't re-raise.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

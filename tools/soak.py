@@ -52,6 +52,11 @@ SCENARIOS = (
     "chol_fault",
     "serve_flaky",
     "guard_degrade",
+    # OOM injected at EVERY dispatch choke point (op filter unset): the
+    # fit ladder's native, segmented AND host rungs all fail, so the run
+    # must terminate in ONE DegradationExhaustedError — and, per the
+    # incident invariant below, exactly one schema-valid incident bundle
+    "oom_exhausted_fit",
 )
 
 #: per-scenario tolerance on |pred - clean_pred|: execution-environment
@@ -162,9 +167,93 @@ def _run_serve_campaign(rng, x, model) -> None:
         server.stop()
 
 
+def _assert_incident_invariant(incident_tmp: str, outcome: str) -> None:
+    """The forensics invariant (obs/recorder.py): a campaign that ended in
+    a single classified error produced EXACTLY ONE schema-valid incident
+    bundle; a clean (or successfully-degraded) campaign produced none."""
+    import glob as _glob
+
+    from spark_gp_tpu.obs.recorder import validate_bundle
+
+    bundles = sorted(_glob.glob(os.path.join(incident_tmp, "incident_*.json")))
+    expected = 1 if outcome.startswith("classified") else 0
+    if len(bundles) != expected:
+        raise Violation(
+            f"incident invariant: outcome {outcome!r} must yield "
+            f"{expected} bundle(s), found {len(bundles)}: "
+            f"{[os.path.basename(b) for b in bundles]}"
+        )
+    for path in bundles:
+        with open(path, encoding="utf-8") as fh:
+            problems = validate_bundle(json.load(fh))
+        if problems:
+            raise Violation(
+                f"incident bundle {os.path.basename(path)} fails schema: "
+                f"{problems}"
+            )
+
+
 def run_campaign(seed: int, deadline_s: float = 120.0, deep: bool = False) -> dict:
     """One deterministic campaign; returns its summary dict, raises
     :class:`Violation` on an invariant breach."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    scenario = SCENARIOS[int(rng.integers(0, len(SCENARIOS)))]
+    x, y, expert = _build_problem(deep)
+    optimizer = "device" if scenario in (
+        "oom_fit", "compile_fit", "guard_degrade", "oom_exhausted_fit"
+    ) or bool(rng.integers(0, 2)) else "host"
+
+    threads_before = threading.active_count()
+    cwd_before = set(os.listdir(os.getcwd()))
+    start = time.perf_counter()
+    ref_model, ref_pred = _reference(expert, optimizer, x, y)
+
+    # bundles are part of the campaign contract: redirect them to a
+    # scratch dir (the artifact-leak check demands a clean cwd) and
+    # assert the exactly-one-per-classified-failure invariant at the end.
+    # Context-managed so a Violation on ANY path cleans the scratch up.
+    with tempfile.TemporaryDirectory(prefix="soak_incidents_") as incident_tmp:
+        outcome = _run_campaign_body(
+            rng, scenario, optimizer, x, y, expert,
+            ref_model, ref_pred, seed, incident_tmp,
+        )
+        _assert_incident_invariant(incident_tmp, outcome)
+
+    elapsed = time.perf_counter() - start
+    if elapsed > deadline_s:
+        raise Violation(f"deadline breached: {elapsed:.1f}s > {deadline_s}s")
+    # leak checks: the campaign must leave no threads or working-dir
+    # artifacts behind (serve stops join their workers; nothing journals)
+    for _ in range(20):
+        if threading.active_count() <= threads_before:
+            break
+        time.sleep(0.05)
+    if threading.active_count() > threads_before:
+        raise Violation(
+            f"thread leak: {threading.active_count()} > {threads_before}"
+        )
+    leaked = set(os.listdir(os.getcwd())) - cwd_before
+    if leaked:
+        raise Violation(f"artifact leak in cwd: {sorted(leaked)}")
+    return {
+        "seed": seed,
+        "scenario": scenario,
+        "optimizer": optimizer,
+        "outcome": outcome,
+        "seconds": round(elapsed, 2),
+    }
+
+
+def _run_campaign_body(
+    rng, scenario, optimizer, x, y, expert, ref_model, ref_pred, seed,
+    incident_tmp,
+) -> str:
+    """The fault-composition body of one campaign: returns the outcome
+    string (``"ok"`` / ``"classified:<class>"``), raises
+    :class:`Violation` on a breach.  ``GP_INCIDENT_DIR`` is bound to the
+    campaign's scratch dir for exactly this scope."""
     import numpy as np
 
     from spark_gp_tpu.parallel.experts import num_experts_for
@@ -174,17 +263,8 @@ def run_campaign(seed: int, deadline_s: float = 120.0, deep: bool = False) -> di
         NonFiniteFitError,
     )
 
-    rng = np.random.default_rng(seed)
-    scenario = SCENARIOS[int(rng.integers(0, len(SCENARIOS)))]
-    x, y, expert = _build_problem(deep)
-    optimizer = "device" if scenario in (
-        "oom_fit", "compile_fit", "guard_degrade"
-    ) or bool(rng.integers(0, 2)) else "host"
-
-    threads_before = threading.active_count()
-    cwd_before = set(os.listdir(os.getcwd()))
-    start = time.perf_counter()
-    ref_model, ref_pred = _reference(expert, optimizer, x, y)
+    incident_prev = os.environ.get("GP_INCIDENT_DIR")
+    os.environ["GP_INCIDENT_DIR"] = incident_tmp
 
     outcome = "ok"
     try:
@@ -206,6 +286,15 @@ def run_campaign(seed: int, deadline_s: float = 120.0, deep: bool = False) -> di
             if not fired[0]:
                 raise Violation("oom fault never fired")
             pred = model.predict(x[:64])
+        elif scenario == "oom_exhausted_fit":
+            # no op filter: every rung's dispatch (one_dispatch, segment,
+            # fit.host) OOMs — the ladder must exhaust into ONE classified
+            # DegradationExhaustedError, never a hang or raw propagation
+            with chaos.oom_after_calls(0):
+                model = _make_gp(expert, optimizer).fit(x, y)
+            raise Violation(
+                "oom_exhausted_fit completed despite OOM at every rung"
+            )
         elif scenario == "compile_fit":
             with chaos.failing_compile(times=1, op="fit.device") as fired:
                 model = _make_gp(expert, optimizer).fit(x, y)
@@ -279,30 +368,12 @@ def run_campaign(seed: int, deadline_s: float = 120.0, deep: bool = False) -> di
                 f"unclassified failure {type(exc).__name__}: {exc}"
             ) from exc
         outcome = f"classified:{cls}"
-
-    elapsed = time.perf_counter() - start
-    if elapsed > deadline_s:
-        raise Violation(f"deadline breached: {elapsed:.1f}s > {deadline_s}s")
-    # leak checks: the campaign must leave no threads or working-dir
-    # artifacts behind (serve stops join their workers; nothing journals)
-    for _ in range(20):
-        if threading.active_count() <= threads_before:
-            break
-        time.sleep(0.05)
-    if threading.active_count() > threads_before:
-        raise Violation(
-            f"thread leak: {threading.active_count()} > {threads_before}"
-        )
-    leaked = set(os.listdir(os.getcwd())) - cwd_before
-    if leaked:
-        raise Violation(f"artifact leak in cwd: {sorted(leaked)}")
-    return {
-        "seed": seed,
-        "scenario": scenario,
-        "optimizer": optimizer,
-        "outcome": outcome,
-        "seconds": round(elapsed, 2),
-    }
+    finally:
+        if incident_prev is None:
+            os.environ.pop("GP_INCIDENT_DIR", None)
+        else:
+            os.environ["GP_INCIDENT_DIR"] = incident_prev
+    return outcome
 
 
 def main(argv=None) -> int:
