@@ -306,6 +306,39 @@ class GaussianProcessParams:
             return "fit.device.sharded"
         return "fit.device.one_dispatch"
 
+    def _dispatch_raw_bytes(self, data):
+        """Modeled RAW peak bytes of the device-fit dispatch about to run
+        (``resilience/memplan.fit_dispatch_bytes`` at the CURRENT rung) —
+        the 'allocation size' the chaos memory-budget injector compares
+        against its staged limit, and the quantity the memory plan
+        guarantees ``predicted >= raw`` for.  None for sharded dispatches
+        (per-chip footprints are not modeled yet — ROADMAP item 3 needs
+        the sharded-tile model)."""
+        if self._mesh is not None:
+            return None
+        from spark_gp_tpu.resilience import memplan
+
+        rung = (
+            "segmented"
+            if self._checkpoint_dir is not None or self._fallback_segmented()
+            else "native"
+        )
+        n_targets = (
+            int(data.y.shape[2]) if getattr(data.y, "ndim", 2) == 3 else 1
+        )
+        family = type(self).__name__
+        raw = memplan.fit_dispatch_bytes(
+            int(data.x.shape[0]), int(data.x.shape[1]),
+            int(data.x.shape[2]), int(np.dtype(data.x.dtype).itemsize),
+            rung, n_targets, family,
+        )
+        # arm the calibration loop: the dispatch about to run is the one
+        # whose metered compiled peak should judge this model estimate
+        memplan.note_expected_dispatch(
+            memplan.fit_model_key(family, rung), raw
+        )
+        return raw
+
     def setHyperSpace(self, value: str):
         """Coordinate system for hyperparameter optimization.
 

@@ -91,7 +91,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         from spark_gp_tpu.resilience import fallback
 
         # degradation ladder around the complete attempt (gpr.py wrap)
-        return fallback.run_fit_ladder(self, instr, attempt)
+        return fallback.run_fit_ladder(self, instr, attempt, data=data)
 
     def _fit_device_multistart(
         self, instr, data, x, cache=None
@@ -275,7 +275,10 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
         from spark_gp_tpu.resilience import chaos
 
         # chaos choke point for staged execution faults (fallback ladder)
-        chaos.maybe_injected_failure(self._device_fit_op())
+        # + the memory-budget allocator model (memplan/chaos)
+        chaos.maybe_injected_failure(
+            self._device_fit_op(), nbytes=self._dispatch_raw_bytes(data)
+        )
         with instr.phase("optimize_hypers"):
             if self._checkpoint_dir is not None or self._fallback_segmented():
                 from spark_gp_tpu.models.laplace_generic import (

@@ -119,7 +119,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         # degradation ladder around the complete attempt (the same wrap as
         # gpr._fit_body): classified execution failures re-execute one
         # rung down; GP_FALLBACK=0 restores raw propagation
-        return fallback.run_fit_ladder(self, instr, attempt)
+        return fallback.run_fit_ladder(self, instr, attempt, data=data)
 
     # human-readable engine tag for the multistart log line; the EP
     # subclass overrides both this and _multistart_device_call
@@ -327,7 +327,10 @@ class GaussianProcessClassifier(GaussianProcessCommons):
         from spark_gp_tpu.resilience import chaos
 
         # chaos choke point for staged execution faults (fallback ladder)
-        chaos.maybe_injected_failure(self._device_fit_op())
+        # + the memory-budget allocator model (memplan/chaos)
+        chaos.maybe_injected_failure(
+            self._device_fit_op(), nbytes=self._dispatch_raw_bytes(data)
+        )
         with instr.phase("optimize_hypers"):
             if self._checkpoint_dir is not None or self._fallback_segmented():
                 from spark_gp_tpu.models.laplace import (

@@ -133,7 +133,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         from spark_gp_tpu.resilience import fallback
 
         # degradation ladder around the complete attempt (gpr.py wrap)
-        return fallback.run_fit_ladder(self, instr, attempt)
+        return fallback.run_fit_ladder(self, instr, attempt, data=data)
 
     def _fit_device_multistart(
         self, instr, data, y1h, x, cache=None
@@ -302,7 +302,10 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
         from spark_gp_tpu.resilience import chaos
 
         # chaos choke point for staged execution faults (fallback ladder)
-        chaos.maybe_injected_failure(self._device_fit_op())
+        # + the memory-budget allocator model (memplan/chaos)
+        chaos.maybe_injected_failure(
+            self._device_fit_op(), nbytes=self._dispatch_raw_bytes(data)
+        )
         with instr.phase("optimize_hypers"):
             if self._checkpoint_dir is not None or self._fallback_segmented():
                 saver, chunk = self._segment_saver_and_chunk("gpc_mc", data)

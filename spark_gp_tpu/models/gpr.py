@@ -200,10 +200,12 @@ class GaussianProcessRegression(GaussianProcessCommons):
         # resilience included): a classified execution failure — OOM,
         # compile, exhausted numerics, guard breach — re-executes the fit
         # one rung down instead of propagating raw (GP_FALLBACK=0 restores
-        # raw propagation)
+        # raw propagation).  ``data`` lets the memory planner pre-size
+        # the starting rung against the device budget (memplan.py).
         return fallback.run_fit_ladder(
             self, instr,
             lambda: self._run_with_expert_resilience(instr, data, run_fit),
+            data=data,
         )
 
     def loo(
@@ -479,8 +481,11 @@ class GaussianProcessRegression(GaussianProcessCommons):
         from spark_gp_tpu.resilience import chaos
 
         # chaos choke point: a staged execution fault (injected OOM /
-        # compile failure) surfaces here, scoped to this dispatch shape
-        chaos.maybe_injected_failure(self._device_fit_op())
+        # compile failure / memory-budget OOM) surfaces here, scoped to
+        # this dispatch shape and its modeled byte cost
+        chaos.maybe_injected_failure(
+            self._device_fit_op(), nbytes=self._dispatch_raw_bytes(data)
+        )
         with instr.phase("optimize_hypers"):
             if self._checkpoint_dir is not None or self._fallback_segmented():
                 # segmented fit: one host sync per checkpointInterval

@@ -768,7 +768,33 @@ class ProjectedProcessRawPredictor:
         # clamped to the request: a dispatch never exceeds t rows, so the
         # ladder's halvings walk down from the size that actually OOMed
         chunk = max(1, min(self._PREDICT_CHUNK_ELEMS // m, x_test.shape[0]))
+        from spark_gp_tpu.resilience import memplan
         from spark_gp_tpu.resilience.fallback import run_predict_ladder
+
+        itemsize = int(jnp.dtype(dtype).itemsize)
+        # memory plan (resilience/memplan.py): with a resolvable device
+        # budget the chunk is PRE-SIZED to the largest predicted-safe
+        # dispatch — the reactive halving ladder's rungs as first
+        # choices.  No budget / GP_MEMPLAN=0: None — the default chunk,
+        # today's path bit-for-bit.
+        planned_chunk = memplan.plan_predict_chunk(
+            chunk, m, self.active.shape[1], itemsize, mean_only
+        )
+        if planned_chunk is not None:
+            chunk = planned_chunk
+
+        def dispatch_bytes(rows: int) -> float:
+            # the chaos allocator model's 'allocation size' for one chunk
+            # dispatch — the same raw model the plan budgeted with; also
+            # arms the calibration loop (the metered compiled peak of
+            # this dispatch judges the model)
+            raw = memplan.predict_dispatch_bytes(
+                rows, m, self.active.shape[1], itemsize, mean_only
+            )
+            memplan.note_expected_dispatch(
+                memplan.predict_model_key(mean_only), raw
+            )
+            return raw
 
         # degradation ladder (resilience/fallback.py): an OOM on a chunk
         # dispatch halves the chunk — re-dispatching the request at a
@@ -777,22 +803,30 @@ class ProjectedProcessRawPredictor:
         # exactly the pre-ladder path.
         return run_predict_ladder(
             lambda c: self._run_at_chunk(
-                x_test, args, predict, lane, dtype, mean_only, c
+                x_test, args, predict, lane, dtype, mean_only, c,
+                dispatch_bytes,
             ),
             lambda: self._host_predict(x_test, mean_only),
             chunk,
+            planned=planned_chunk is not None,
         )
 
     def _run_at_chunk(
-        self, x_test, args, predict, lane, dtype, mean_only: bool, chunk: int
+        self, x_test, args, predict, lane, dtype, mean_only: bool, chunk: int,
+        dispatch_bytes=None,
     ):
         from spark_gp_tpu.resilience import chaos
 
         from spark_gp_tpu.obs import cost as obs_cost
 
+        bytes_of = dispatch_bytes if dispatch_bytes is not None else (
+            lambda rows: None
+        )
         t = x_test.shape[0]
         if t <= chunk:
-            chaos.maybe_injected_failure("predict.chunk", rows=t)
+            chaos.maybe_injected_failure(
+                "predict.chunk", rows=t, nbytes=bytes_of(t)
+            )
             # measured flops/bytes per predict dispatch (obs/cost.py,
             # GP_XLA_COST) — the gp_xla_*_total{entry="predict.ppa"} series
             out = obs_cost.observed_call(
@@ -809,7 +843,9 @@ class ProjectedProcessRawPredictor:
                 part = jnp.concatenate(
                     [part, jnp.broadcast_to(part[:1], (pad, part.shape[1]))]
                 )
-            chaos.maybe_injected_failure("predict.chunk", rows=chunk)
+            chaos.maybe_injected_failure(
+                "predict.chunk", rows=chunk, nbytes=bytes_of(chunk)
+            )
             out = obs_cost.observed_call(
                 "predict.ppa", predict,
                 *args, jnp.asarray(part, dtype=dtype), lane=lane,

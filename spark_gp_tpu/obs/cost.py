@@ -96,13 +96,43 @@ def _extract(compiled) -> Optional[Dict[str, float]]:
     analysis = compiled.cost_analysis()
     if isinstance(analysis, (list, tuple)):
         analysis = analysis[0] if analysis else None
-    if not analysis:
+    flops = bytes_accessed = 0.0
+    if analysis:
+        flops = float(analysis.get("flops", 0.0) or 0.0)
+        bytes_accessed = float(analysis.get("bytes accessed", 0.0) or 0.0)
+    peak = _extract_memory(compiled)
+    if flops <= 0.0 and bytes_accessed <= 0.0 and peak is None:
         return None
-    flops = float(analysis.get("flops", 0.0) or 0.0)
-    bytes_accessed = float(analysis.get("bytes accessed", 0.0) or 0.0)
-    if flops <= 0.0 and bytes_accessed <= 0.0:
+    cost = {"flops": flops, "bytes": bytes_accessed}
+    if peak is not None:
+        cost["peak_bytes"] = peak
+    return cost
+
+
+def _extract_memory(compiled) -> Optional[float]:
+    """The compiler's own predicted peak bytes of one execution:
+    ``compiled.memory_analysis()`` — arguments + outputs + temps +
+    generated code, aliased buffers counted once.  The number the memory
+    planner (``resilience/memplan.py``) treats as ground truth for an
+    already-compiled entry point.  None when the backend offers no
+    analysis (then only the planner's analytic model covers the entry)."""
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — optional API, absence = no signal
         return None
-    return {"flops": flops, "bytes": bytes_accessed}
+    if stats is None:
+        return None
+    try:
+        peak = (
+            float(stats.argument_size_in_bytes)
+            + float(stats.output_size_in_bytes)
+            + float(stats.temp_size_in_bytes)
+            + float(stats.generated_code_size_in_bytes)
+            - float(stats.alias_size_in_bytes)
+        )
+    except AttributeError:
+        return None
+    return peak if peak > 0.0 else None
 
 
 def measure(jitted, args: tuple, kwargs: Optional[dict] = None
@@ -156,6 +186,13 @@ def observe_call(
         f"xla.bytes.{entry}", entry=entry, n=cost["bytes"] * weight
     )
     obs_runtime.note_xla_cost(entry, cost, weight)
+    if cost.get("peak_bytes"):
+        # the memory planner's compiled-path prediction source
+        # (resilience/memplan.py): the signature-cached lower+compile
+        # above IS the extraction, this is just the relay
+        from spark_gp_tpu.resilience import memplan
+
+        memplan.note_compiled_peak(entry, cost["peak_bytes"])
     return cost
 
 

@@ -117,6 +117,11 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("mixed_precision_guard.delta_predict_rel", "metric", "guard: relative predict delta vs strict"),
     MetricName("mixed_precision_guard.breach", "metric", "guard: 1 when a delta exceeded the lane bar"),
     MetricName("*.failed", "metric", "a phase of this name raised", label="phase"),
+    # -- memory planning (resilience/memplan.py) ---------------------------
+    MetricName("plan.hit", "counter", "plan decisions whose chosen configuration was predicted-safe"),
+    MetricName("plan.miss", "counter", "reactive recovery engaged despite (or no config fit) a plan decision"),
+    MetricName("plan.shed", "counter", "serve submits shed on predicted-per-request bytes vs headroom"),
+    MetricName("plan.margin_breach", "counter", "measured peaks that exceeded the margined prediction"),
     # -- degradation ladder (resilience/fallback.py) -----------------------
     MetricName("fallback.engaged", "metric", "1 when the fit completed through at least one degradation rung"),
     MetricName("fallback.transitions", "counter", "degradation-ladder rung transitions executed"),
@@ -166,6 +171,7 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("experts.jittered", "event", "experts repaired by adaptive jitter"),
     MetricName("fit.retry", "event", "recovery re-dispatch of a fit attempt"),
     MetricName("fallback.failure", "event", "classified execution failure observed"),
+    MetricName("plan.decision", "event", "memory-plan admission decision (chosen config, predicted bytes, budget)"),
     MetricName("compile.trace", "event", "jaxpr trace observed on the current span"),
     MetricName("breaker.open", "event", "circuit breaker opened"),
     MetricName("breaker.close", "event", "circuit breaker closed"),

@@ -278,6 +278,11 @@ def _dump_incident_inner(
             else f"{type(exc).__name__}: {exc}"[:500]
         ),
         "degradations": degradations,
+        # predicted-vs-actual on OOM: the memory planner's decision rows
+        # (predicted bytes, budget, chosen config — memplan.py) next to
+        # the measured memory gauges below, so a plan that admitted a
+        # dispatch the runtime then killed is readable evidence
+        "memory_plan": list(getattr(instr, "memory_plan", []) or []),
         "spans": _span_tree_of(root),
         "events": RECORDER.snapshot(last=BUNDLE_LAST_EVENTS),
         "compiles": (

@@ -289,6 +289,10 @@ class FitCapture:
             "bytes_per_execution": cost["bytes"],
             "executions": 0.0,
         })
+        if cost.get("peak_bytes"):
+            # the compiler's memory_analysis peak (obs/cost.py), the
+            # per-entry actual the journal's plan table compares against
+            row["peak_bytes_per_execution"] = cost["peak_bytes"]
         row["executions"] += weight
 
     def finish(self) -> None:
@@ -462,6 +466,49 @@ def _xla_cost_summary(capture: Optional[FitCapture],
     }
 
 
+def _memory_plan_rows(instr, capture: Optional[FitCapture]) -> List[dict]:
+    """The journal's ``memory_plan`` block: every plan decision stamped
+    on the fit (``resilience/memplan.stamp_decision``), annotated with
+    the ACTUALS known at journal time — the measured device peak of the
+    fit (like-for-like only: the host-RSS fallback is a process-lifetime
+    proxy, not a dispatch peak) and the compiler's own per-entry
+    ``memory_analysis`` peak when cost metering ran.  An actual above
+    the margined prediction counts ``plan.margin_breach``: the exact
+    alert a wrong cost model should raise BEFORE it becomes an OOM."""
+    rows = [dict(r) for r in (getattr(instr, "memory_plan", []) or [])]
+    if not rows:
+        return rows
+    actual = None
+    compiled = None
+    if capture is not None:
+        actual = capture.peak_memory.get("memory.peak_bytes_in_use")
+        peaks = [
+            row.get("peak_bytes_per_execution")
+            for row in capture.xla_costs.values()
+            if row.get("peak_bytes_per_execution")
+        ]
+        compiled = max(peaks) if peaks else None
+    for row in rows:
+        row["actual_peak_bytes"] = actual
+        row["compiled_peak_bytes"] = compiled
+        predicted = row.get("predicted_bytes")
+        # breach compares LIKE-FOR-LIKE: the compiler's per-program peak
+        # when metering ran (the prediction's own granularity), else the
+        # whole-fit device peak (conservative — it includes every
+        # resident buffer across every phase, documented as such).  A
+        # fits=False row never breaches: the plan already priced the
+        # overrun, the alert would page on the expected outcome.
+        measured = compiled if compiled is not None else actual
+        breach = bool(
+            predicted and row.get("fits")
+            and measured is not None and measured > predicted
+        )
+        row["margin_breach"] = breach
+        if breach:
+            telemetry.inc("plan.margin_breach", entry=row.get("entry"))
+    return rows
+
+
 def write_run_journal(
     instr,
     root,
@@ -520,6 +567,10 @@ def write_run_journal(
         # which rung — the journal-side twin of the saved model's
         # provenance_json degradations
         "degradations": list(getattr(instr, "degradations", [])),
+        # the memory planner's decisions (resilience/memplan.py) with
+        # predicted-vs-actual peaks — the provenance that makes a wrong
+        # prediction a debuggable artifact instead of a mystery crash
+        "memory_plan": _memory_plan_rows(instr, capture),
         "quarantine": {
             "experts_quarantined": getattr(instr, "metrics", {}).get(
                 "experts_quarantined", 0.0

@@ -29,6 +29,16 @@ Fault classes:
   CPU.  Env channel: ``GP_CHAOS_OOM_AFTER_CALLS`` (+ ``GP_CHAOS_OOM_OP``,
   ``GP_CHAOS_OOM_ROWS_ABOVE``) and ``GP_CHAOS_FAILING_COMPILE`` (+
   ``GP_CHAOS_COMPILE_OP``);
+* :func:`memory_limit_bytes` — the SHRUNKEN-RUNTIME fault for the
+  predictive memory planner (``resilience/memplan.py``): stages a device
+  memory budget of ``n`` bytes.  The planner reads it as its budget
+  (``memplan.memory_budget_bytes``), and the dispatch choke points model
+  the allocator against it — a dispatch whose modeled byte cost exceeds
+  the limit raises a genuine ``RESOURCE_EXHAUSTED``, exactly what a real
+  runtime with that much HBM would do.  Planning ON pre-sizes every
+  dispatch under the limit (zero OOM); ``GP_MEMPLAN=0`` restores the
+  reactive crash-then-degrade behavior — both branches provable on CPU.
+  Env channel: ``GP_CHAOS_MEMORY_LIMIT_BYTES``;
 * **multi-host faults** (consumed by ``parallel/coord.py``'s guarded
   collectives and coordinated checkpointers):
   :class:`StragglerHost` — inject a fixed delay before a named
@@ -288,7 +298,21 @@ _mp_state = {
     "compile_fail": None,     # int | None: remaining injected compile failures
     "compile_op": None,       # substring filter | None
     "compile_fired": None,    # one-element list: injected-failure count
+    "memory_limit": None,     # float | None: staged device memory budget
+    "memory_fired": None,     # one-element list: budget-OOM count
 }
+
+
+def staged_memory_limit() -> Optional[float]:
+    """The staged chaos memory budget in bytes, or None: the in-process
+    stage (:func:`memory_limit_bytes`) wins, else the subprocess channel
+    ``GP_CHAOS_MEMORY_LIMIT_BYTES``.  Read by the memory planner as its
+    budget AND by the choke-point allocator model below — one number, so
+    the plan and the 'runtime' can never disagree about the ceiling."""
+    staged = _mp_state["memory_limit"]
+    if staged is not None:
+        return float(staged)
+    return _env_chaos_float("GP_CHAOS_MEMORY_LIMIT_BYTES")
 
 
 def _xla_runtime_error(message: str) -> BaseException:
@@ -305,11 +329,13 @@ def _xla_runtime_error(message: str) -> BaseException:
         return RuntimeError(message)
 
 
-def maybe_injected_failure(op: str, rows: Optional[int] = None) -> None:
+def maybe_injected_failure(
+    op: str, rows: Optional[int] = None, nbytes: Optional[float] = None,
+) -> None:
     """The execution-failure trigger point: the device-fit dispatchers
     (each family's ``_fit_device``), the chunked PPA predict and the
     device magic solve call this before dispatching, so a staged fault
-    surfaces exactly where the real runtime would raise.  Two faults:
+    surfaces exactly where the real runtime would raise.  Three faults:
 
     * **OOM** (:func:`oom_after_calls` / ``GP_CHAOS_OOM_AFTER_CALLS``):
       after ``n`` matching calls, every further matching call raises a
@@ -321,8 +347,27 @@ def maybe_injected_failure(op: str, rows: Optional[int] = None) -> None:
       can get under by halving its chunk;
     * **compile failure** (:func:`failing_compile` /
       ``GP_CHAOS_FAILING_COMPILE``): the next ``times`` matching calls
-      raise a compilation-shaped ``XlaRuntimeError``.
+      raise a compilation-shaped ``XlaRuntimeError``;
+    * **memory budget** (:func:`memory_limit_bytes` /
+      ``GP_CHAOS_MEMORY_LIMIT_BYTES``): a dispatch whose modeled byte
+      cost ``nbytes`` (the planner's RAW model of the config about to
+      run — ``resilience/memplan.py``) exceeds the staged limit raises
+      ``RESOURCE_EXHAUSTED``, modeling an allocator with that ceiling.
+      Callers that pass no ``nbytes`` are outside the modeled-allocator
+      scope and never trip this fault.
     """
+    # -- injected memory-budget OOM (memplan's shrunken runtime) -----------
+    if nbytes is not None:
+        limit = staged_memory_limit()
+        if limit is not None and float(nbytes) > limit:
+            fired = _mp_state["memory_fired"]
+            if fired is not None:
+                fired[0] += 1
+            raise _xla_runtime_error(
+                f"RESOURCE_EXHAUSTED: chaos: attempting to allocate "
+                f"{int(nbytes)} bytes over the {int(limit)}-byte staged "
+                f"device budget at {op!r}"
+            )
     # -- injected OOM ------------------------------------------------------
     allow = _mp_state["oom_after"]
     op_filter = _mp_state["oom_op"]
@@ -401,6 +446,27 @@ def oom_after_calls(
         yield fired
     finally:
         _mp_state.update(prev)
+
+
+@contextlib.contextmanager
+def memory_limit_bytes(n: float):
+    """Stage a shrunken device memory budget of ``n`` bytes: the memory
+    planner (``resilience/memplan.py``) reads it as its budget, and any
+    choke-point dispatch whose modeled byte cost exceeds it raises a
+    genuine ``RESOURCE_EXHAUSTED`` — so planner pre-sizing and admission
+    are provable on CPU with no real allocator involved.  Yields the
+    one-element injected-OOM counter (0 under a working plan — that IS
+    the acceptance assertion).  Subprocess channel:
+    ``GP_CHAOS_MEMORY_LIMIT_BYTES``."""
+    if float(n) <= 0:
+        raise ValueError("memory limit must be > 0 bytes")
+    prev = (_mp_state["memory_limit"], _mp_state["memory_fired"])
+    fired = [0]
+    _mp_state.update(memory_limit=float(n), memory_fired=fired)
+    try:
+        yield fired
+    finally:
+        _mp_state["memory_limit"], _mp_state["memory_fired"] = prev
 
 
 @contextlib.contextmanager

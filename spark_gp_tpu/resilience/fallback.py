@@ -380,24 +380,54 @@ def _fit_rung_scope(est, rung: str):
         est._fallback_mode = prev_mode
 
 
-def run_fit_ladder(est, instr, attempt: Callable):
+def run_fit_ladder(est, instr, attempt: Callable, data=None):
     """The fit entry point's ladder driver, wrapped around the complete
     per-family fit body (which itself wraps
     ``_run_with_expert_resilience`` — the per-expert numerical recovery
     runs INSIDE each rung; the ladder only sees what that layer could not
-    repair).  ``attempt()`` must honor ``est._fallback_mode``."""
+    repair).  ``attempt()`` must honor ``est._fallback_mode``.
+
+    With ``data`` (the grouped expert stack) and a resolvable memory
+    budget, the memory planner (``resilience/memplan.py``) picks the
+    STARTING rung before the first dispatch: the largest predicted-safe
+    configuration — the reactive ladder's OOM rungs as pre-sized first
+    choices instead of crash-discovered fallbacks.  The ladder itself is
+    unchanged underneath and stays the backstop: a failure despite a
+    plan counts ``plan.miss`` and degrades exactly as before."""
     if not enabled():
         return attempt()
+    from spark_gp_tpu.resilience import memplan
+
+    plan = memplan.plan_fit_dispatch(est, instr, data)
     rung = "native"
-    visited = {rung}
+    if plan is not None and plan.chosen != "native":
+        # predicted-safe smaller config: start THERE (native was
+        # predicted over budget, so it is never fallen back up to).
+        # Even a fits=False decision starts at the SMALLEST candidate:
+        # the model may over-predict, and dispatching the doomed larger
+        # config first would only buy a crash the plan already priced.
+        rung = plan.chosen
+    visited = {"native", rung}
     degradations: List[dict] = []
     last_cls = UNKNOWN
+    plan_missed = False
     while True:
         try:
             with _fit_rung_scope(est, rung):
                 model = attempt()
         except Exception as exc:  # classified-failure-site: taxonomy dispatch
             last_cls = record_failure(exc, entry="fit")
+            if (
+                plan is not None and plan.fits and not plan_missed
+                and last_cls == OOM
+            ):
+                # the plan ADMITTED this config and the allocator still
+                # killed it — the miss the operator alerts on.  Counted
+                # once per fit, for the OOM class only (the memory plan
+                # predicts memory, not numerics); a fits=False decision
+                # already counted its miss at plan time.
+                plan_missed = True
+                memplan.record_plan_miss("fit")
             nxt = _next_fit_rung(est, last_cls, visited)
             if nxt is None:
                 if degradations:
@@ -425,6 +455,15 @@ def run_fit_ladder(est, instr, attempt: Callable):
             continue
         if degradations:
             _stamp(instr, model, degradations)
+        if plan is not None:
+            # the journal is assembled from model.instr (a restart's own
+            # instr may differ from the outer one the plan stamped) —
+            # mirror the rows the same way _stamp mirrors degradations
+            model_instr = getattr(model, "instr", None)
+            if model_instr is not None and model_instr is not instr:
+                model_instr.memory_plan = list(
+                    getattr(instr, "memory_plan", []) or []
+                )
         return model
 
 
@@ -575,22 +614,31 @@ def run_predict_ladder(
     attempt_at_chunk: Callable[[int], object],
     host_attempt: Callable[[], object],
     chunk: int,
+    planned: bool = False,
 ):
     """The predict entry point's ladder (``models/ppa.py``): an OOM on a
     chunked dispatch halves the chunk (bounded —
     :data:`MAX_PREDICT_HALVINGS`), re-dispatching the whole request at
     the smaller shape; a chunk the halvings cannot shrink under the
     allocator's ceiling — or a compile failure — falls to the eager
-    host-f64 solve.  Raw behavior with the ladder disabled."""
+    host-f64 solve.  Raw behavior with the ladder disabled.  ``planned``
+    marks a chunk the memory plan admitted: an OOM despite it counts
+    ``plan.miss`` (once), the same contract as the fit ladder."""
     if not enabled():
         return attempt_at_chunk(chunk)
     degradations: List[dict] = []
     halvings = 0
+    plan_missed = False
     while True:
         try:
             return attempt_at_chunk(chunk)
         except Exception as exc:  # classified-failure-site: taxonomy dispatch
             cls = record_failure(exc, entry="predict")
+            if planned and not plan_missed and cls == OOM:
+                from spark_gp_tpu.resilience import memplan
+
+                plan_missed = True
+                memplan.record_plan_miss("predict")
             if (
                 cls == OOM
                 and chunk > 1

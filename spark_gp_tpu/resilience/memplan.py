@@ -1,0 +1,579 @@
+"""Predictive memory planning: static admission replaces the reactive OOM ladder.
+
+Until now the system learned a dispatch does not fit by crashing: the
+degradation ladder (``resilience/fallback.py``) halves chunks/segments
+*after* an ``XlaRuntimeError: RESOURCE_EXHAUSTED``, and the serve gate
+(``serve/lifecycle.MemoryAdmissionGate``) shed on an *observed* global
+memory high-water mark.  Following *Memory Safe Computations with XLA
+Compiler* (PAPERS.md, arxiv 2206.14148), the compiler already knows the
+peak bytes of every program before execution — this module turns that
+knowledge into decisions made BEFORE the first dispatch:
+
+* **budget** — :func:`memory_budget_bytes` resolves the device memory
+  ceiling: a staged chaos limit (``chaos.memory_limit_bytes`` — the
+  CPU-provable shrunken-runtime injector) > ``GP_MEMPLAN_LIMIT_BYTES`` >
+  the backend's own ``memory_stats()['bytes_limit']``.  No budget means
+  no plan constraint: every decision degrades to today's behavior.
+* **prediction** — two sources.  (1) *Compiled*: ``obs/cost.py``'s
+  signature-cached lower+compile path extracts
+  ``compiled.memory_analysis()`` next to ``cost_analysis()``; every
+  metered entry point's measured peak lands here via
+  :func:`note_compiled_peak`.  (2) *Analytic*: shapes never compiled
+  before are predicted by a small cost model keyed on
+  ``(entry, family, E, s, m, lane/dtype, backend, rung)`` —
+  :func:`fit_dispatch_bytes` / :func:`predict_dispatch_bytes` — and
+  CALIBRATED upward whenever a compiled or gauge-measured peak exceeds
+  the model (:func:`observe_measured`).  Predictions carry a
+  configurable safety margin (``GP_MEMPLAN_MARGIN``, default 1.25), so
+  ``predicted >= modeled-actual`` holds by construction.
+* **decision** — ONE API, :func:`plan_dispatch`: candidates
+  preferred-first, the largest predicted-safe configuration wins.
+  Consumers: the fit ladder driver picks one-dispatch vs the (pre-sized)
+  segmented rung up front (``fallback.run_fit_ladder``), the PPA predict
+  sizes its chunk from the plan instead of halving after a crash
+  (``models/ppa.py``), and the serve admission gate admits on
+  predicted-per-request bytes against remaining headroom
+  (``serve/lifecycle.py``).  The reactive ladder stays as the BACKSTOP:
+  a wrong prediction re-engages it and counts ``plan.miss``.
+
+Every decision is provenance-stamped (``instr.memory_plan`` →
+run-journal ``memory_plan`` key; incident bundles carry the rows next to
+the measured gauges) so a wrong prediction is a debuggable artifact, not
+a mystery crash.  ``GP_MEMPLAN=0`` is the kill switch: planning off,
+today's reactive behavior bit-for-bit.  Metrics: ``plan.hit`` /
+``plan.miss`` / ``plan.shed`` / ``plan.margin_breach`` (obs/names.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_forced: Optional[bool] = None
+
+#: analytic-model dispatch-liveness factors per fit rung: how many
+#: [E, s, s] gram-sized buffers are live at once inside the dispatched
+#: program (per squared latent head — the multiclass Laplace jacfwd
+#: crosses every head pair).  ``native`` (the whole L-BFGS loop as one
+#: program) carries the gram, its factorization, the fused-inverse VJP
+#: intermediates and the line-search pipeline — the CPU XLA programs
+#: measure 9.5–12 gram-stacks live (memory_analysis; multiclass ~5.3 per
+#: head pair), so 16 brackets them with headroom BEFORE the margin; the
+#: ``segmented`` rung's smaller dispatches halve the in-flight depth
+#: (the same axis the reactive ladder already degrades along);
+#: ``host_f64`` re-materializes in f64 (the itemsize doubling is applied
+#: by the caller via ``itemsize=8``).  Calibration ratchets these up
+#: whenever reality measures bigger.
+_FIT_RUNG_WORK_FACTOR = {
+    "native": 16.0,
+    "segmented": 8.0,
+    "host_f64": 12.0,
+}
+
+
+def enabled() -> bool:
+    """The kill switch, read at call time: ``set_memory_planning`` wins,
+    else ``GP_MEMPLAN`` (default ON — planning is inert without a budget,
+    so the default costs nothing on unconstrained runtimes)."""
+    if _forced is not None:
+        return _forced
+    return os.environ.get("GP_MEMPLAN", "").strip().lower() not in (
+        "0", "false", "off",
+    )
+
+
+def set_memory_planning(value: Optional[bool]) -> None:
+    """Force planning on/off for this process (None = back to the env)."""
+    global _forced
+    _forced = value
+
+
+def margin() -> float:
+    """The safety margin multiplied into every prediction
+    (``GP_MEMPLAN_MARGIN``, default 1.25, floored at 1.0): the headroom
+    that keeps ``predicted >= actual`` true against model error."""
+    raw = os.environ.get("GP_MEMPLAN_MARGIN", "").strip()
+    try:
+        value = float(raw) if raw else 1.25
+    except ValueError:
+        value = 1.25
+    return max(1.0, value)
+
+
+#: device-stats budget cache TTL: the budget is consulted on hot paths
+#: (a plan per predict dispatch), and a ``memory_stats()`` device query
+#: per request is the exact cost the admission gate's own throttle
+#: exists to avoid.  The ceiling moves essentially never; chaos/env
+#: overrides are read fresh (dict lookups).
+_BUDGET_TTL_S = 0.25
+_budget_cache: Tuple[float, Optional[float]] = (-float("inf"), None)
+
+
+def memory_budget_bytes() -> Optional[float]:
+    """The device memory ceiling the planner budgets against, or None
+    (no budget — planning imposes no constraint).  Resolution order:
+    staged chaos limit (the CPU-provable shrunken runtime) >
+    ``GP_MEMPLAN_LIMIT_BYTES`` > the backend's reported ``bytes_limit``
+    (cached for :data:`_BUDGET_TTL_S` — hot paths pay a clock read, not
+    a device query)."""
+    from spark_gp_tpu.resilience import chaos
+
+    staged = chaos.staged_memory_limit()
+    if staged is not None:
+        return float(staged)
+    raw = os.environ.get("GP_MEMPLAN_LIMIT_BYTES", "").strip()
+    if raw:
+        try:
+            value = float(raw)
+            return value if value > 0 else None
+        except ValueError:
+            pass
+    global _budget_cache
+    now = time.monotonic()
+    sampled_at, cached = _budget_cache
+    if now - sampled_at < _BUDGET_TTL_S:
+        return cached
+    value = None
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            value = float(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — no backend stats, no budget
+        pass
+    _budget_cache = (now, value)
+    return value
+
+
+def memory_in_use_bytes() -> Optional[float]:
+    """Bytes in use RIGHT NOW — the per-request-scoped usage read the
+    serve admission gate compares headroom against: device
+    ``bytes_in_use`` when the backend reports it, else the CURRENT host
+    RSS (``/proc/self/statm``; the old gate read the lifetime peak
+    ``ru_maxrss``, which latched shed mode forever on the CPU fallback),
+    else that peak as the last resort."""
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return float(stats["bytes_in_use"])
+    except Exception:  # noqa: BLE001 — fall through to the host reads
+        pass
+    try:
+        with open("/proc/self/statm", encoding="ascii") as fh:
+            rss_pages = int(fh.read().split()[1])
+        return float(rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except Exception:  # noqa: BLE001 — non-Linux fallback
+        pass
+    try:
+        import resource
+
+        return float(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        )
+    except Exception:  # noqa: BLE001 — no signal at all
+        return None
+
+
+# --------------------------------------------------------------------------
+# the analytic byte models (raw — no margin; callers apply predicted_bytes)
+# --------------------------------------------------------------------------
+
+
+def fit_model_key(family: Optional[str], rung: str) -> Tuple:
+    """The calibration key of one fit dispatch model: per family AND per
+    rung, so a measured miss on (say) the multiclass native program never
+    over-predicts every other family's fits."""
+    return ("fit", family, rung)
+
+
+def predict_model_key(mean_only: bool) -> Tuple:
+    return ("predict", bool(mean_only))
+
+
+def fit_dispatch_bytes(
+    num_experts: int,
+    expert_size: int,
+    n_features: int,
+    itemsize: int,
+    rung: str = "native",
+    n_targets: int = 1,
+    family: Optional[str] = None,
+) -> float:
+    """Modeled RAW peak bytes of one fit dispatch at ``rung``.
+
+    The dominant residents of a fit program are the expert stack
+    (``[E, s, p]`` features + targets + mask), the theta-invariant gram
+    cache, and ``k`` gram-sized ``[E, s, s]`` work buffers live at once
+    (factorization, VJP intermediates, line-search pipeline) — ``k`` per
+    rung from :data:`_FIT_RUNG_WORK_FACTOR`.  ``host_f64`` callers pass
+    ``itemsize=8`` (the rung re-materializes the stack in f64).  This is
+    a COST MODEL, not an accounting identity: calibration
+    (:func:`observe_measured`) raises it wherever a compiled or measured
+    peak proves it low, and the margin covers the rest.
+    """
+    e = float(max(1, num_experts))
+    s = float(max(1, expert_size))
+    p = float(max(1, n_features))
+    stack = e * s * (p + 2.0 * max(1, n_targets)) * itemsize
+    gram = e * s * s * itemsize
+    heads = float(max(1, n_targets))
+    k = _FIT_RUNG_WORK_FACTOR.get(rung, _FIT_RUNG_WORK_FACTOR["native"])
+    # +1 gram for the theta-invariant cache (kernels/base.py) — counted
+    # unconditionally: when the kernel opts out the model is merely
+    # conservative, which is the safe direction.  The work term scales
+    # with heads^2: the multiclass Laplace dK-stack jacobians cross every
+    # latent-head pair.
+    raw = stack + (1.0 + k * heads * heads) * gram
+    return _calibrated(fit_model_key(family, rung), raw)
+
+
+def predict_dispatch_bytes(
+    rows: int,
+    m: int,
+    n_features: int,
+    itemsize: int,
+    mean_only: bool = False,
+) -> float:
+    """Modeled RAW peak bytes of one PPA predict dispatch of ``rows``
+    test points against an ``m``-point active set: the ``[rows, m]``
+    cross kernel (plus one einsum intermediate of the same shape), the
+    ``[m, m]`` magic matrix (variance models), operands and outputs."""
+    r = float(max(1, rows))
+    m_f = float(max(1, m))
+    p = float(max(1, n_features))
+    # 4 cross-sized buffers live at once: the [rows, m] cross kernel, the
+    # distance intermediate it is built from, and the einsum/product
+    # temps (the CPU XLA predict programs measure ~13 cross-sizes of
+    # TOTAL footprint at small m where operands dominate; 4 crosses +
+    # operands brackets them with the margin on top)
+    cross = 4.0 * r * m_f
+    operators = m_f + (0.0 if mean_only else m_f * m_f)
+    io = r * p + m_f * p + (1.0 if mean_only else 2.0) * r
+    raw = (cross + operators + io) * itemsize
+    return _calibrated(predict_model_key(mean_only), raw)
+
+
+def predicted_bytes(raw: float) -> float:
+    """A raw model estimate with the safety margin applied — THE number
+    compared against budgets (so ``predicted >= raw-modeled actual``
+    holds by construction)."""
+    return float(raw) * margin()
+
+
+# --------------------------------------------------------------------------
+# calibration + compiled peaks (memory_analysis via obs/cost.py)
+# --------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+#: model key -> multiplicative scale (>1 only): measured/compiled peaks
+#: that exceeded the analytic model ratchet it up for the process life
+_CALIBRATION: Dict[Tuple, float] = {}
+#: entry name -> max compiled peak bytes observed (memory_analysis,
+#: relayed by obs/cost.observe_call through the signature-cached
+#: lower+compile path)
+_COMPILED_PEAKS: Dict[str, float] = {}
+
+
+def _calibrated(key: Tuple, raw: float) -> float:
+    with _LOCK:
+        scale = _CALIBRATION.get(key, 1.0)
+    return raw * scale
+
+
+def observe_measured(key: Tuple, raw_model_bytes: float,
+                     measured_bytes: float) -> None:
+    """Calibrate the analytic model from a measured peak (device gauges
+    or a compiled ``memory_analysis``): when reality exceeds the model,
+    the key's scale ratchets up so the NEXT prediction brackets it.
+    Never ratchets down — under-prediction is the failure mode this
+    plane exists to remove."""
+    if raw_model_bytes <= 0 or measured_bytes <= 0:
+        return
+    scale = measured_bytes / raw_model_bytes
+    if scale <= 1.0:
+        return
+    with _LOCK:
+        if scale > _CALIBRATION.get(key, 1.0):
+            _CALIBRATION[key] = scale
+
+
+#: the calibration feedback slot: the dispatch sites
+#: (``common._dispatch_raw_bytes``, the PPA chunk dispatcher) deposit
+#: (model key, raw model bytes) just before dispatching; the compiled
+#: peak relayed from the SAME thread's ``observe_call`` right after the
+#: dispatch closes the loop.  Thread-local: dispatch and metering run on
+#: the same thread by construction.
+_EXPECT = threading.local()
+
+
+def note_expected_dispatch(key: Tuple, raw_bytes: float) -> None:
+    """Arm the calibration loop for the dispatch about to run: when cost
+    metering relays its compiled ``memory_analysis`` peak, the analytic
+    model under ``key`` is judged against it (:func:`observe_measured`).
+    Overwritten by the next dispatch; consumed at most once."""
+    _EXPECT.pending = (key, float(raw_bytes))
+
+
+def note_compiled_peak(entry: str, peak_bytes: float) -> None:
+    """Record one compiled entry point's ``memory_analysis`` peak (fed by
+    ``obs/cost.observe_call`` whenever cost metering is on) — the
+    compiler's own number, the ground truth the analytic model is judged
+    against — and close the calibration loop against the armed dispatch
+    expectation when one matches this entry's kind."""
+    if not peak_bytes or peak_bytes <= 0:
+        return
+    with _LOCK:
+        if peak_bytes > _COMPILED_PEAKS.get(entry, 0.0):
+            _COMPILED_PEAKS[entry] = float(peak_bytes)
+    pending = getattr(_EXPECT, "pending", None)
+    if pending is None:
+        return
+    key, raw = pending
+    kind = key[0]
+    # kind guard: a stale fit expectation (metering was off for that
+    # dispatch) must not be consumed by a later predict's relay
+    if (kind == "fit" and entry.startswith("fit.")) or (
+        kind == "predict" and entry.startswith(("predict.", "serve."))
+    ):
+        _EXPECT.pending = None
+        observe_measured(key, raw, float(peak_bytes))
+
+
+def compiled_peak(entry: str) -> Optional[float]:
+    """Max compiled (memory_analysis) peak observed for ``entry``, or
+    None when the entry was never metered."""
+    with _LOCK:
+        return _COMPILED_PEAKS.get(entry)
+
+
+def compiled_peaks() -> Dict[str, float]:
+    with _LOCK:
+        return dict(_COMPILED_PEAKS)
+
+
+def reset_calibration() -> None:
+    """Drop calibration + compiled-peak state (tests)."""
+    with _LOCK:
+        _CALIBRATION.clear()
+        _COMPILED_PEAKS.clear()
+
+
+# --------------------------------------------------------------------------
+# plan_dispatch — THE decision API
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class PlanDecision:
+    """One admission decision: the largest predicted-safe candidate."""
+
+    entry: str
+    chosen: str
+    raw_bytes: float            # modeled actual of the chosen config
+    predicted_bytes: float      # raw * margin — the budgeted number
+    budget_bytes: Optional[float]
+    fits: bool                  # False: NOTHING fit; chosen = smallest
+    margin: float = field(default_factory=margin)
+    candidates: List[dict] = field(default_factory=list)
+
+    def row(self) -> dict:
+        """The provenance row journals/bundles carry (json-safe)."""
+        return {
+            "entry": self.entry,
+            "chosen": self.chosen,
+            "raw_bytes": self.raw_bytes,
+            "predicted_bytes": self.predicted_bytes,
+            "budget_bytes": self.budget_bytes,
+            "fits": self.fits,
+            "margin": self.margin,
+            "candidates": list(self.candidates),
+        }
+
+
+def plan_dispatch(
+    entry: str,
+    candidates: Sequence[Tuple[str, float]],
+    budget: Optional[float] = None,
+) -> Optional[PlanDecision]:
+    """Pick the largest predicted-safe configuration.
+
+    ``candidates`` are ``(name, raw_model_bytes)`` preferred-first (the
+    fastest / largest config first); the first whose margined prediction
+    fits the budget wins.  Returns None when planning is off or no
+    budget resolves (no constraint — callers keep today's behavior
+    exactly), and a ``fits=False`` decision on the LAST (smallest)
+    candidate when nothing fits — the caller dispatches it anyway and
+    the reactive ladder stays the backstop."""
+    if not enabled() or not candidates:
+        return None
+    if budget is None:
+        budget = memory_budget_bytes()
+    if budget is None:
+        return None
+    rows = [
+        {
+            "name": name,
+            "raw_bytes": float(raw),
+            "predicted_bytes": predicted_bytes(raw),
+            "fits": predicted_bytes(raw) <= budget,
+        }
+        for name, raw in candidates
+    ]
+    chosen = next((r for r in rows if r["fits"]), rows[-1])
+    decision = PlanDecision(
+        entry=entry,
+        chosen=chosen["name"],
+        raw_bytes=chosen["raw_bytes"],
+        predicted_bytes=chosen["predicted_bytes"],
+        budget_bytes=float(budget),
+        fits=bool(chosen["fits"]),
+        candidates=rows,
+    )
+    from spark_gp_tpu.obs import trace as obs_trace
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    telemetry.inc("plan.hit" if decision.fits else "plan.miss", entry=entry)
+    obs_trace.add_event(
+        "plan.decision",
+        entry=entry, chosen=decision.chosen, fits=decision.fits,
+        predicted_bytes=decision.predicted_bytes,
+        budget_bytes=decision.budget_bytes,
+    )
+    return decision
+
+
+def record_plan_miss(entry: str) -> None:
+    """A reactive recovery engaged DESPITE a plan decision — the
+    prediction was wrong in the dangerous direction.  Counted so an
+    operator can alert on it; the journal/bundle rows show which."""
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    telemetry.inc("plan.miss", entry=entry)
+
+
+def stamp_decision(instr, decision: Optional[PlanDecision]) -> None:
+    """Attach a decision row to the instr the run journal (and any
+    incident bundle) is assembled from — the ``memory_plan`` key."""
+    if decision is None or instr is None:
+        return
+    rows = list(getattr(instr, "memory_plan", []) or [])
+    rows.append(decision.row())
+    instr.memory_plan = rows
+
+
+# --------------------------------------------------------------------------
+# consumers
+# --------------------------------------------------------------------------
+
+
+def plan_fit_dispatch(est, instr, data) -> Optional[PlanDecision]:
+    """The fit entry point's plan (called by ``fallback.run_fit_ladder``
+    before the first attempt): choose the largest predicted-safe
+    starting rung — ``native`` (one-dispatch) preferred, the ladder's
+    ``segmented`` rung as the pre-sized smaller configuration when it
+    applies to this estimator (same gates as the reactive rung:
+    ``fallback._fit_rung_applies``).  Applies only to the on-device
+    dispatch path (the host optimizer's per-evaluation programs are
+    small); None = no constraint, run exactly today's path."""
+    if not enabled() or data is None:
+        return None
+    try:
+        if est._resolved_optimizer() != "device" or est._mesh is not None:
+            return None
+    except Exception:  # noqa: BLE001 — an unresolvable optimizer plans nothing
+        return None
+    budget = memory_budget_bytes()
+    if budget is None:
+        return None
+    import numpy as np
+
+    e, s = int(data.x.shape[0]), int(data.x.shape[1])
+    p = int(data.x.shape[2])
+    itemsize = int(np.dtype(data.x.dtype).itemsize)
+    n_targets = int(data.y.shape[2]) if getattr(data.y, "ndim", 2) == 3 else 1
+    family = type(est).__name__
+
+    candidates = [
+        ("native",
+         fit_dispatch_bytes(e, s, p, itemsize, "native", n_targets, family))
+    ]
+    from spark_gp_tpu.resilience import fallback
+
+    if fallback._fit_rung_applies(est, "segmented", fallback.OOM, set()):
+        candidates.append((
+            "segmented",
+            fit_dispatch_bytes(e, s, p, itemsize, "segmented", n_targets,
+                               family),
+        ))
+    decision = plan_dispatch("fit", candidates, budget)
+    stamp_decision(instr, decision)
+    return decision
+
+
+def plan_predict_chunk(
+    chunk: int,
+    m: int,
+    n_features: int,
+    itemsize: int,
+    mean_only: bool,
+) -> Optional[int]:
+    """The PPA predict chunk, pre-sized: the largest chunk (halving down
+    from the caller's default, bounded like the reactive ladder's
+    halvings) whose margined prediction fits the budget.  Returns None
+    when planning is off or no budget resolves (the caller keeps its
+    default chunk — today's path bit-for-bit — and knows no plan is in
+    force); returns 1 when even the smallest dispatch does not fit (it
+    proceeds; the reactive ladder backstops)."""
+    if not enabled() or chunk <= 1:
+        return None
+    budget = memory_budget_bytes()
+    if budget is None:
+        return None
+    from spark_gp_tpu.resilience.fallback import MAX_PREDICT_HALVINGS
+
+    candidates = []
+    c = int(chunk)
+    for _ in range(MAX_PREDICT_HALVINGS + 1):
+        candidates.append(
+            (str(c), predict_dispatch_bytes(c, m, n_features, itemsize,
+                                            mean_only))
+        )
+        if c <= 1:
+            break
+        c //= 2
+    decision = plan_dispatch("predict", candidates, budget)
+    if decision is None:
+        return None
+    planned = int(decision.chosen) if decision.fits else 1
+    return max(1, min(chunk, planned))
+
+
+def predict_request_bytes(predictor, rows: int) -> Optional[float]:
+    """Margined predicted bytes of one serve request of ``rows`` against
+    a warmed :class:`~spark_gp_tpu.serve.batcher.BucketedPredictor` —
+    the per-request cost the admission gate compares against remaining
+    headroom.  Sized at the PADDED bucket shape (the dispatch that will
+    actually run).  None when planning is off or the predictor does not
+    expose its shape (duck-typed chaos wrappers delegate, so they do)."""
+    if not enabled():
+        return None
+    try:
+        import numpy as np
+
+        padded = int(predictor.padded_rows(int(rows)))
+        m = int(predictor.active_rows)
+        p = int(predictor.n_features)
+        itemsize = int(np.dtype(predictor.dtype).itemsize)
+        mean_only = bool(predictor.mean_only)
+    except Exception:  # noqa: BLE001 — no shape, no prediction (gate
+        # falls back to its watermark hysteresis path)
+        return None
+    return predicted_bytes(
+        predict_dispatch_bytes(padded, m, p, itemsize, mean_only)
+    )

@@ -102,6 +102,10 @@ class BucketedPredictor:
         if any(b < 1 for b in self.buckets):
             raise ValueError(f"buckets must be >= 1, got {self.buckets}")
         self.n_features = int(raw.active.shape[1])
+        #: active-set size — with n_features/dtype/mean_only, the shape
+        #: tuple the memory planner predicts per-request bytes from
+        #: (memplan.predict_request_bytes); plain ints, safe post-release
+        self.active_rows = int(raw.active.shape[0])
         # one dtype for the whole compiled surface: f64 under the x64
         # harness, f32 in production — requests are cast on entry so a
         # float32 payload can never force a second set of executables.

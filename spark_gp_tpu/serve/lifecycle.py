@@ -65,20 +65,31 @@ class DrainingError(RuntimeError):
 
 
 class MemoryPressureError(RuntimeError):
-    """Submit shed by the memory-pressure admission gate: accelerator/host
-    memory is above the high watermark and this request's priority is
-    below the floor.  Hysteresis re-admits automatically once usage falls
-    under the low watermark."""
+    """Submit shed by the memory-pressure admission gate: either this
+    request's PREDICTED bytes (``resilience/memplan.py``) exceed the
+    remaining headroom, or usage is above the high watermark — and the
+    request's priority is below the floor.  Watermark sheds recover by
+    hysteresis; predicted sheds re-admit as soon as headroom covers the
+    request again."""
 
     code = "queue.shed.memory"
 
-    def __init__(self, usage_bytes: float, limit_bytes: float) -> None:
+    def __init__(self, usage_bytes: float, limit_bytes: float,
+                 predicted_bytes: Optional[float] = None) -> None:
         self.usage_bytes = float(usage_bytes)
         self.limit_bytes = float(limit_bytes)
+        self.predicted_bytes = (
+            None if predicted_bytes is None else float(predicted_bytes)
+        )
+        detail = (
+            "low-priority work is shed until usage recovers"
+            if predicted_bytes is None else
+            f"this request's predicted {predicted_bytes / 1e6:.1f}MB "
+            "exceeds the remaining headroom"
+        )
         super().__init__(
             f"memory pressure: {usage_bytes / 1e6:.0f}MB in use against a "
-            f"{limit_bytes / 1e6:.0f}MB limit; low-priority work is shed "
-            "until usage recovers"
+            f"{limit_bytes / 1e6:.0f}MB limit; {detail}"
         )
 
 
@@ -247,29 +258,42 @@ class HangWatchdog:
 
 
 def _default_memory_sampler() -> Optional[float]:
-    """Bytes in use right now: device HBM when the backend reports it,
-    host peak RSS as the CPU fallback (a lifetime high-water mark — on
-    that fallback the gate can latch shed mode until restart, which is
-    still the right call: host RSS that crossed the bar once IS the OOM
-    precursor the gate exists for)."""
-    from spark_gp_tpu.obs.runtime import telemetry
+    """Bytes in use right now, PER-REQUEST-SCOPED: device HBM
+    ``bytes_in_use`` when the backend reports it, the CURRENT host RSS
+    as the CPU fallback (``resilience/memplan.memory_in_use_bytes``).
+    The pre-plan gate read the lifetime peak RSS here — a high-water
+    mark sampled on phase boundaries that, once crossed, latched shed
+    mode until restart; the headroom admission below needs what is in
+    use NOW, so the fallback reads the live RSS instead (docs/SERVING.md
+    'Memory-pressure admission')."""
+    from spark_gp_tpu.resilience import memplan
 
-    sample = telemetry.sample_memory()
-    value = sample.get("memory.bytes_in_use")
-    if value is None:
-        value = sample.get("memory.host_peak_rss_bytes")
-    return value
+    return memplan.memory_in_use_bytes()
 
 
 class MemoryAdmissionGate:
     """Shed lowest-priority submits before the runtime OOMs.
 
-    ``check(priority)`` raises :class:`MemoryPressureError` for requests
-    below ``priority_floor`` while the gate is shedding.  Shedding starts
-    when sampled usage crosses ``high_watermark * limit`` and stops only
-    under ``low_watermark * limit`` — hysteresis, so the gate neither
-    flaps at the bar nor needs an operator to un-stick it.  Sampling is
-    time-throttled (the hot path pays a clock read, not a device query).
+    Two constraints, both scoped to requests below ``priority_floor``:
+
+    * **predicted headroom** (the memory plan, ``resilience/memplan.py``):
+      ``check(priority, predicted_bytes=...)`` sheds when this request's
+      predicted bytes exceed ``limit - usage`` — per-request admission
+      against remaining headroom, recovering the moment headroom covers
+      the next request (no latch to un-stick);
+    * **watermark hysteresis** (the pre-plan behavior, and the fallback
+      when no prediction is available): shedding starts when sampled
+      usage crosses ``high_watermark * limit`` and stops only under
+      ``low_watermark * limit`` — so the gate neither flaps at the bar
+      nor needs an operator.  The two compose as a union: hysteresis
+      guards against unattributed growth the per-request model cannot
+      see, the prediction sheds the one oversized request before it
+      lands (interaction table: docs/SERVING.md).
+
+    Usage is sampled per request through the per-request-scoped read
+    (``memplan.memory_in_use_bytes`` — live bytes, not the lifetime
+    high-water mark), throttled by ``sample_interval_s`` so the hot path
+    pays a clock read, not a device query (0 = sample every check).
     Disabled when no limit is configured (``limit_bytes`` arg or
     ``GP_SERVE_MEMORY_LIMIT_BYTES``)."""
 
@@ -312,12 +336,14 @@ class MemoryAdmissionGate:
         self._usage = 0.0
         self._shedding = False
         self.sheds = 0  # submits rejected (monotonic)
+        self.plan_sheds = 0  # of which: predicted-headroom sheds
 
     @property
     def enabled(self) -> bool:
         return self.limit_bytes is not None
 
-    def check(self, priority: int = 0) -> None:
+    def check(self, priority: int = 0,
+              predicted_bytes: Optional[float] = None) -> None:
         if self.limit_bytes is None:
             return
         changed = None
@@ -341,8 +367,17 @@ class MemoryAdmissionGate:
                         changed = False
             shedding = self._shedding
             usage = self._usage
-            if shedding and priority < self.priority_floor:
+            # per-request predicted-headroom admission (the memory plan):
+            # would THIS request's predicted bytes fit what remains?
+            over_headroom = (
+                predicted_bytes is not None
+                and usage + float(predicted_bytes) > self.limit_bytes
+            )
+            shed = (shedding or over_headroom) and priority < self.priority_floor
+            if shed:
                 self.sheds += 1
+                if over_headroom and not shedding:
+                    self.plan_sheds += 1
         if changed is not None:
             obs_trace.add_event(
                 "lifecycle.memory_pressure",
@@ -350,8 +385,15 @@ class MemoryAdmissionGate:
             )
             if self._on_state is not None:
                 self._on_state(changed)
-        if shedding and priority < self.priority_floor:
-            raise MemoryPressureError(usage, self.limit_bytes)
+        if shed:
+            if over_headroom and not shedding:
+                from spark_gp_tpu.obs.runtime import telemetry
+
+                telemetry.inc("plan.shed")
+            raise MemoryPressureError(
+                usage, self.limit_bytes,
+                predicted_bytes if over_headroom else None,
+            )
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -361,6 +403,7 @@ class MemoryAdmissionGate:
                 "usage_bytes": self._usage,
                 "shedding": self._shedding,
                 "sheds": self.sheds,
+                "plan_sheds": self.plan_sheds,
                 "high_watermark": self.high_watermark,
                 "low_watermark": self.low_watermark,
                 "priority_floor": self.priority_floor,

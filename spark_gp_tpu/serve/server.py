@@ -305,7 +305,23 @@ class GPServeServer:
             self.metrics.inc("shed.breaker")
             raise BreakerOpenError(name, breaker.reset_timeout_s)
         try:
-            self.memory_gate.check(priority)
+            # predicted-per-request admission (resilience/memplan.py):
+            # THIS request's bytes at its padded bucket shape against
+            # remaining headroom — BEFORE the dtype cast below, so a
+            # shed request never allocates the very memory being
+            # protected.  The row count is read from the payload's own
+            # shape (no conversion); with planning off or an unreadable
+            # payload the gate falls back to its watermark hysteresis,
+            # the pre-plan behavior.
+            self.memory_gate.check(
+                priority,
+                # priced only when a limit is configured: the disabled
+                # gate (the common case) must cost zero on the hot path
+                predicted_bytes=(
+                    self._predicted_request_bytes(entry, x)
+                    if self.memory_gate.enabled else None
+                ),
+            )
         except MemoryPressureError:
             self.metrics.inc("shed")
             self.metrics.inc("queue.shed.memory")
@@ -355,6 +371,32 @@ class GPServeServer:
         self.metrics.inc("requests_rows", x.shape[0])
         self.metrics.set_gauge("queue_depth", self._queue.depth())
         return future
+
+    @staticmethod
+    def _predicted_request_bytes(entry, x) -> Optional[float]:
+        """Margined predicted bytes of this request's dispatch, or None
+        (gate disabled / planning off / unreadable payload — the gate
+        then runs its watermark leg only).  Deliberately allocation-free:
+        the row count comes from the payload's OWN shape (ndarray
+        ``.shape``, or ``len`` of a sequence-of-rows), never from an
+        ``asarray`` conversion — this runs before the cast precisely so
+        shed requests cost nothing."""
+        if not entry or entry.predictor is None:
+            return None
+        from spark_gp_tpu.resilience import memplan
+
+        try:
+            shape = getattr(x, "shape", None)
+            if shape is not None:
+                rows = int(shape[0]) if len(shape) == 2 else 1
+            elif x and isinstance(x[0], (list, tuple, np.ndarray)):
+                rows = len(x)
+            else:
+                rows = 1
+            return memplan.predict_request_bytes(entry.predictor, rows)
+        except Exception:  # noqa: BLE001 — sizing is advisory; the
+            # validation below owns rejecting malformed payloads
+            return None
 
     def predict(
         self,

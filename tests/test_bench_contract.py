@@ -46,6 +46,11 @@ def _run(script, env_extra, args=(), timeout=900):
     env.pop("GP_RECORDER", None)
     env.pop("GP_XLA_COST", None)
     env.pop("GP_INCIDENT_DIR", None)
+    # an exported GP_MEMPLAN=0 (or a stray margin/limit) would fail the
+    # memory_plan section on a healthy bench.py
+    env.pop("GP_MEMPLAN", None)
+    env.pop("GP_MEMPLAN_MARGIN", None)
+    env.pop("GP_MEMPLAN_LIMIT_BYTES", None)
     for var in list(env):
         # GP_CHAOS_*: a staged fault (dead host / kill counter) from a
         # chaos shell would kill the bench worker mid-measurement;
@@ -120,6 +125,23 @@ def test_bench_emits_one_parseable_result_line():
     assert deg["failure_classes"] == ["oom"], deg
     assert deg["wallclock_ratio"] < 3.0, deg
     assert deg["theta_max_abs_delta"] <= 1e-6, deg
+    # the predictive memory planner (ISSUE 11, resilience/memplan.py):
+    # the same workload under a chaos-staged device budget completes with
+    # ZERO injected OOMs and zero reactive rung transitions — the plan
+    # sizes the dispatch down BEFORE execution instead of crashing into
+    # the ladder, and the decision is provenance-stamped
+    mp = detail["memory_plan"]
+    assert "error" not in mp, mp
+    assert "skipped" not in mp, mp
+    assert mp["injected_ooms"] == 0, mp
+    assert mp["oom_failures"] == 0, mp
+    assert mp["rung_transitions"] == 0, mp
+    assert mp["planned"] is True and mp["chosen"] == "segmented", mp
+    row = mp["plan_rows"][0]
+    assert row["fits"] is True
+    assert row["predicted_bytes"] >= row["raw_bytes"]
+    assert row["predicted_bytes"] <= mp["budget_bytes"]
+    assert mp["theta_max_abs_delta"] <= 1e-6, mp
     # the mixed-precision lane contract: the lane the primary fit ran at
     # is recorded, the MFU estimate is non-null (the peak table carries a
     # CPU-proxy entry precisely so this plumbing is exercised off-TPU),

@@ -1,0 +1,493 @@
+"""Predictive memory planning (resilience/memplan.py).
+
+The contract under test: with a resolvable device budget the planner
+picks the largest predicted-safe configuration BEFORE the first dispatch
+(fit rung, predict chunk, serve admission), every decision is
+provenance-stamped with ``predicted >= modeled-actual`` by construction,
+the compiled ``memory_analysis`` path brackets the analytic model, and
+``GP_MEMPLAN=0`` restores the reactive crash-then-degrade behavior
+bit-for-bit.  The chaos ``memory_limit_bytes`` injector makes all of it
+provable on CPU: it is both the planner's budget and the modeled
+allocator at the dispatch choke points.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import (
+    GaussianProcessClassifier,
+    GaussianProcessMulticlassClassifier,
+    GaussianProcessPoissonRegression,
+    GaussianProcessRegression,
+    RBFKernel,
+)
+from spark_gp_tpu.data import make_benchmark_data
+from spark_gp_tpu.obs import cost as obs_cost
+from spark_gp_tpu.obs.runtime import telemetry
+from spark_gp_tpu.parallel.experts import num_experts_for
+from spark_gp_tpu.resilience import chaos, memplan
+
+pytestmark = pytest.mark.chaos
+
+EXPERT = 40
+
+
+def _itemsize() -> int:
+    # the harness runs x64 (conftest): stacks and predict inputs are f64
+    import jax
+
+    return 8 if jax.config.jax_enable_x64 else 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_planner():
+    memplan.reset_calibration()
+    memplan.set_memory_planning(None)
+    yield
+    memplan.reset_calibration()
+    memplan.set_memory_planning(None)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    x, y = make_benchmark_data(240)
+    return np.asarray(x), np.asarray(y)
+
+
+def _gp(optimizer="device", max_iter=3):
+    return (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(0.1))
+        .setDatasetSizeForExpert(EXPERT)
+        .setActiveSetSize(EXPERT)
+        .setSeed(13)
+        .setSigma2(1e-3)
+        .setMaxIter(max_iter)
+        .setOptimizer(optimizer)
+    )
+
+
+def _counters():
+    return dict(telemetry.snapshot()["counters"])
+
+
+def _fit_limit_between_segment_and_native(x):
+    """A budget only the segmented dispatch fits under (f32 stack)."""
+    e = num_experts_for(x.shape[0], EXPERT)
+    native_raw = memplan.fit_dispatch_bytes(
+        e, EXPERT, x.shape[1], _itemsize(), "native"
+    )
+    seg_pred = memplan.predicted_bytes(
+        memplan.fit_dispatch_bytes(e, EXPERT, x.shape[1], _itemsize(), "segmented")
+    )
+    assert seg_pred < native_raw
+    return (seg_pred + native_raw) / 2.0
+
+
+# -- fit dispatch pre-sizing -------------------------------------------------
+
+
+def test_fit_plan_presizes_segmented_no_oom(problem):
+    x, y = problem
+    clean = _gp().fit(x, y)
+    limit = _fit_limit_between_segment_and_native(x)
+    before = _counters()
+    with chaos.memory_limit_bytes(limit) as fired:
+        model = _gp().fit(x, y)
+    after = _counters()
+    # no first-request OOM: the plan sized down BEFORE the dispatch
+    assert fired[0] == 0
+    assert after.get("fallback.failures.oom", 0.0) == before.get(
+        "fallback.failures.oom", 0.0
+    )
+    assert not getattr(model, "degradations", [])
+    assert after.get("plan.hit", 0.0) == before.get("plan.hit", 0.0) + 1
+    # provenance: the decision rows, predicted >= modeled actual <= budget
+    rows = model.instr.memory_plan
+    assert rows[0]["chosen"] == "segmented" and rows[0]["fits"] is True
+    assert rows[0]["raw_bytes"] <= rows[0]["predicted_bytes"] <= limit
+    names = [c["name"] for c in rows[0]["candidates"]]
+    assert names == ["native", "segmented"]
+    # the segmented rung is the SAME L-BFGS trajectory: exact theta parity
+    np.testing.assert_allclose(
+        model.raw_predictor.theta, clean.raw_predictor.theta, atol=1e-6
+    )
+
+
+def test_fit_kill_switch_restores_reactive_ladder(problem):
+    x, y = problem
+    limit = _fit_limit_between_segment_and_native(x)
+    memplan.set_memory_planning(False)
+    before = _counters()
+    with chaos.memory_limit_bytes(limit) as fired:
+        model = _gp().fit(x, y)
+    after = _counters()
+    # today's behavior bit-for-bit: crash at native, degrade to segmented
+    assert fired[0] >= 1
+    assert after.get("fallback.failures.oom", 0.0) > before.get(
+        "fallback.failures.oom", 0.0
+    )
+    assert [d["to"] for d in model.degradations] == ["segmented"]
+    assert not getattr(model.instr, "memory_plan", None)
+    assert after.get("plan.hit", 0.0) == before.get("plan.hit", 0.0)
+
+
+def test_fit_plan_miss_counted_when_nothing_fits(problem):
+    x, y = problem
+    # a budget even the segmented dispatch exceeds: the plan records a
+    # fits=False decision, the dispatch OOMs, and the reactive ladder
+    # backstops through the host rung — plan.miss is the alert trail
+    e = num_experts_for(x.shape[0], EXPERT)
+    seg_raw = memplan.fit_dispatch_bytes(
+        e, EXPERT, x.shape[1], _itemsize(), "segmented"
+    )
+    before = _counters()
+    with chaos.memory_limit_bytes(seg_raw / 2.0) as fired:
+        model = _gp().fit(x, y)
+    after = _counters()
+    assert fired[0] >= 1
+    assert after.get("plan.miss", 0.0) > before.get("plan.miss", 0.0)
+    rows = model.instr.memory_plan
+    assert rows and rows[0]["fits"] is False
+    # the backstop carried the fit: host rung, provenance-stamped
+    assert [d["to"] for d in model.degradations] == ["host_f64"]
+
+
+# -- predict chunk pre-sizing ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fitted(problem):
+    x, y = problem
+    model = _gp(optimizer="host").fit(x, y)
+    return model, model.predict(x[:64])
+
+
+def _predict_limit_between(m, p, big_rows, small_rows):
+    big = memplan.predict_dispatch_bytes(big_rows, m, p, _itemsize(), True)
+    small_pred = memplan.predicted_bytes(
+        memplan.predict_dispatch_bytes(small_rows, m, p, _itemsize(), True)
+    )
+    assert small_pred < big
+    return (small_pred + big) / 2.0
+
+
+def test_predict_plan_shrinks_chunk_no_oom(problem, fitted):
+    x, _ = problem
+    model, ref = fitted
+    m, p = model.raw_predictor.active.shape
+    limit = _predict_limit_between(m, p, 64, 16)
+    before = _counters()
+    with chaos.memory_limit_bytes(limit) as fired:
+        pred = model.predict(x[:64])
+    after = _counters()
+    assert fired[0] == 0
+    assert after.get("fallback.transitions", 0.0) == before.get(
+        "fallback.transitions", 0.0
+    )
+    assert after.get("plan.hit", 0.0) > before.get("plan.hit", 0.0)
+    np.testing.assert_allclose(pred, ref, atol=1e-6)
+
+
+def test_predict_kill_switch_restores_halving_ladder(problem, fitted):
+    x, _ = problem
+    model, ref = fitted
+    m, p = model.raw_predictor.active.shape
+    limit = _predict_limit_between(m, p, 64, 16)
+    memplan.set_memory_planning(False)
+    before = _counters()
+    with chaos.memory_limit_bytes(limit) as fired:
+        pred = model.predict(x[:64])
+    after = _counters()
+    # the pre-plan behavior: OOM at the default chunk, halve reactively
+    assert fired[0] >= 1
+    assert after.get("fallback.transitions", 0.0) > before.get(
+        "fallback.transitions", 0.0
+    )
+    np.testing.assert_allclose(pred, ref, atol=1e-6)
+
+
+# -- predicted vs measured (compiled memory_analysis) ------------------------
+
+
+def _family_fits(x, y):
+    rng = np.random.default_rng(7)
+    y_bin = (y > np.median(y)).astype(np.float64)
+    y_mc = rng.integers(0, 3, size=y.shape[0])
+    y_cnt = rng.poisson(2.0, size=y.shape[0]).astype(np.float64)
+
+    def cfg(est):
+        return (
+            est.setKernel(lambda: RBFKernel(0.1))
+            .setDatasetSizeForExpert(EXPERT)
+            .setActiveSetSize(EXPERT)
+            .setSeed(13)
+            .setSigma2(1e-3)
+            .setMaxIter(2)
+            .setOptimizer("device")
+        )
+
+    return [
+        ("gpr", cfg(GaussianProcessRegression()), y, 1),
+        ("gpc", cfg(GaussianProcessClassifier()), y_bin, 1),
+        ("gpc_mc", cfg(GaussianProcessMulticlassClassifier()), y_mc, 3),
+        ("gp_poisson", cfg(GaussianProcessPoissonRegression()), y_cnt, 1),
+    ]
+
+
+def test_predicted_brackets_compiled_peak_all_families(problem):
+    """The analytic model must BRACKET the compiler's own memory_analysis
+    peak (extracted through obs/cost.py's signature-cached lower+compile
+    path) for all four family fits and the PPA predict: predicted >=
+    compiled, and within a sane conservatism factor."""
+    x, y = problem
+    e = num_experts_for(x.shape[0], EXPERT)
+    obs_cost.set_cost_metering(True)
+    try:
+        for name, est, targets, n_targets in _family_fits(x, y):
+            memplan.reset_calibration()
+            model = est.fit(x, targets)
+            peaks = {
+                entry: peak for entry, peak in memplan.compiled_peaks().items()
+                if entry.startswith("fit.")
+            }
+            assert peaks, f"{name}: no compiled peak metered"
+            compiled = max(peaks.values())
+            predicted = memplan.predicted_bytes(memplan.fit_dispatch_bytes(
+                e, EXPERT, x.shape[1], _itemsize(), "native", n_targets
+            ))
+            assert predicted >= compiled, (name, predicted, compiled)
+            assert predicted <= compiled * 200, (name, predicted, compiled)
+        # PPA predict: the predict.ppa entry
+        memplan.reset_calibration()
+        pred_model = model  # the poisson model's raw predictor serves
+        pred_model.predict(x[:64])
+        compiled = memplan.compiled_peak("predict.ppa")
+        assert compiled is not None and compiled > 0
+        m, p = pred_model.raw_predictor.active.shape
+        predicted = memplan.predicted_bytes(
+            memplan.predict_dispatch_bytes(64, m, p, _itemsize(), True)
+        )
+        assert compiled <= predicted <= compiled * 200
+    finally:
+        obs_cost.set_cost_metering(None)
+
+
+def test_calibration_ratchets_model_upward():
+    raw = memplan.fit_dispatch_bytes(4, 32, 3, 4, "native")
+    # a measured peak ABOVE the model doubles the key's scale; a smaller
+    # one never ratchets down
+    memplan.observe_measured(memplan.fit_model_key(None, "native"), raw, raw * 2.0)
+    assert memplan.fit_dispatch_bytes(4, 32, 3, 4, "native") == (
+        pytest.approx(raw * 2.0)
+    )
+    memplan.observe_measured(memplan.fit_model_key(None, "native"), raw, raw * 0.5)
+    assert memplan.fit_dispatch_bytes(4, 32, 3, 4, "native") == (
+        pytest.approx(raw * 2.0)
+    )
+
+
+# -- plan cache identity (signature-cached lower+compile) --------------------
+
+
+def test_same_signature_never_relowers():
+    class FakeCompiled:
+        def cost_analysis(self):
+            return {"flops": 8.0, "bytes accessed": 64.0}
+
+        def memory_analysis(self):
+            return None
+
+    class FakeJitted:
+        lowered = 0
+
+        def lower(self, *args, **kwargs):
+            FakeJitted.lowered += 1
+
+            class Lowered:
+                def compile(self_inner):
+                    return FakeCompiled()
+
+            return Lowered()
+
+    jitted = FakeJitted()
+    a = np.zeros((8, 3), dtype=np.float32)
+    first = obs_cost.measure(jitted, (a,))
+    second = obs_cost.measure(jitted, (np.ones((8, 3), dtype=np.float32),))
+    assert FakeJitted.lowered == 1  # same signature: served from cache
+    assert first is second
+    obs_cost.measure(jitted, (np.zeros((16, 3), dtype=np.float32),))
+    assert FakeJitted.lowered == 2  # a new shape IS a new signature
+
+
+# -- serve admission ---------------------------------------------------------
+
+
+class _StubPredictor:
+    n_features = 3
+    active_rows = 40
+    dtype = np.float32
+    mean_only = True
+
+    @staticmethod
+    def padded_rows(n):
+        return max(8, n)
+
+
+def test_predict_request_bytes_uses_padded_bucket_shape():
+    small = memplan.predict_request_bytes(_StubPredictor(), 2)
+    # padded to the 8-row bucket: a 2-row request costs the dispatch that
+    # actually runs
+    assert small == memplan.predicted_bytes(
+        memplan.predict_dispatch_bytes(8, 40, 3, 4, True)
+    )
+    memplan.set_memory_planning(False)
+    assert memplan.predict_request_bytes(_StubPredictor(), 2) is None
+
+
+def test_gate_sheds_on_predicted_headroom_and_recovers():
+    from spark_gp_tpu.serve.lifecycle import (
+        MemoryAdmissionGate,
+        MemoryPressureError,
+    )
+
+    usage = {"bytes": 100.0}
+    gate = MemoryAdmissionGate(
+        limit_bytes=1000.0, sample_interval_s=0.0,
+        sampler=lambda: usage["bytes"],
+    )
+    before = _counters()
+    gate.check(priority=0, predicted_bytes=500.0)  # fits headroom
+    with pytest.raises(MemoryPressureError) as exc:
+        gate.check(priority=0, predicted_bytes=950.0)
+    assert exc.value.code == "queue.shed.memory"
+    assert exc.value.predicted_bytes == 950.0
+    gate.check(priority=1, predicted_bytes=950.0)  # the floor still admits
+    usage["bytes"] = 10.0
+    gate.check(priority=0, predicted_bytes=950.0)  # instant recovery
+    snap = gate.snapshot()
+    assert snap["plan_sheds"] == 1 and snap["sheds"] == 1
+    assert snap["shedding"] is False  # hysteresis latch never engaged
+    after = _counters()
+    assert after.get("plan.shed", 0.0) == before.get("plan.shed", 0.0) + 1
+
+
+def test_gate_watermark_hysteresis_untouched_without_prediction():
+    from spark_gp_tpu.serve.lifecycle import (
+        MemoryAdmissionGate,
+        MemoryPressureError,
+    )
+
+    usage = {"bytes": 95.0}
+    gate = MemoryAdmissionGate(
+        limit_bytes=100.0, high_watermark=0.9, low_watermark=0.5,
+        sample_interval_s=0.0, sampler=lambda: usage["bytes"],
+    )
+    with pytest.raises(MemoryPressureError):
+        gate.check(priority=0)
+    usage["bytes"] = 70.0  # between the watermarks: the latch holds
+    with pytest.raises(MemoryPressureError):
+        gate.check(priority=0)
+    assert gate.snapshot()["plan_sheds"] == 0
+
+
+# -- provenance: journal + incident bundle -----------------------------------
+
+
+def test_journal_stamps_predicted_vs_actual(problem, tmp_path, monkeypatch):
+    x, y = problem
+    monkeypatch.setenv("GP_RUN_JOURNAL_DIR", str(tmp_path))
+    limit = _fit_limit_between_segment_and_native(x)
+    with chaos.memory_limit_bytes(limit):
+        model = _gp().fit(x, y)
+    path = model.run_journal["path"]
+    assert path is not None
+    with open(path, encoding="utf-8") as fh:
+        journal = json.load(fh)
+    rows = journal["memory_plan"]
+    assert rows and rows[0]["chosen"] == "segmented"
+    assert rows[0]["predicted_bytes"] >= rows[0]["raw_bytes"]
+    # actuals stamped at journal time (device peak is None on CPU — the
+    # key must still be present, like-for-like comparisons only)
+    assert "actual_peak_bytes" in rows[0]
+    assert rows[0]["margin_breach"] is False
+
+
+def test_incident_bundle_carries_plan_rows_on_terminal_oom(
+    problem, tmp_path, monkeypatch
+):
+    x, y = problem
+    monkeypatch.setenv("GP_INCIDENT_DIR", str(tmp_path))
+    from spark_gp_tpu.resilience.fallback import DegradationExhaustedError
+
+    # a generous budget (the plan admits native: fits=True) + an injected
+    # OOM at EVERY choke point: the ladder exhausts, and the terminal
+    # bundle must carry the plan rows next to the measured gauges —
+    # predicted-vs-actual on OOM, the debuggable-artifact contract
+    with chaos.memory_limit_bytes(1e12):
+        with chaos.oom_after_calls(0):
+            with pytest.raises(DegradationExhaustedError):
+                _gp().fit(x, y)
+    bundles = [p for p in os.listdir(tmp_path) if p.startswith("incident_")]
+    assert len(bundles) == 1
+    with open(tmp_path / bundles[0], encoding="utf-8") as fh:
+        bundle = json.load(fh)
+    rows = bundle["memory_plan"]
+    assert rows and rows[0]["entry"] == "fit" and rows[0]["fits"] is True
+    assert bundle["failure_class"] == "oom"
+
+
+def test_gpctl_plan_renders_predicted_vs_actual(
+    problem, tmp_path, monkeypatch
+):
+    """``python -m tools.gpctl plan DIR`` prints the journals' plan table
+    (exit 0) and exits 2 with a readable note on plan-free artifacts."""
+    import subprocess
+    import sys
+
+    x, y = problem
+    monkeypatch.setenv("GP_RUN_JOURNAL_DIR", str(tmp_path))
+    limit = _fit_limit_between_segment_and_native(x)
+    with chaos.memory_limit_bytes(limit):
+        _gp().fit(x, y)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.gpctl", "plan", str(tmp_path)],
+        capture_output=True, text=True, timeout=60, cwd=root,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "segmented" in out.stdout and "predicted" in out.stdout
+    empty = subprocess.run(
+        [sys.executable, "-m", "tools.gpctl", "plan", str(tmp_path / "nope")],
+        capture_output=True, text=True, timeout=60, cwd=root,
+    )
+    assert empty.returncode == 2
+
+
+# -- knobs -------------------------------------------------------------------
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("GP_MEMPLAN", "0")
+    assert not memplan.enabled()
+    monkeypatch.setenv("GP_MEMPLAN", "1")
+    assert memplan.enabled()
+    monkeypatch.setenv("GP_MEMPLAN_MARGIN", "2.0")
+    assert memplan.margin() == 2.0
+    monkeypatch.setenv("GP_MEMPLAN_MARGIN", "0.5")
+    assert memplan.margin() == 1.0  # floored: a margin < 1 is a footgun
+    monkeypatch.setenv("GP_MEMPLAN_LIMIT_BYTES", "123456")
+    assert memplan.memory_budget_bytes() == 123456.0
+    with chaos.memory_limit_bytes(999.0):
+        # the chaos stage models the runtime: it wins over the env knob
+        assert memplan.memory_budget_bytes() == 999.0
+
+
+def test_plan_dispatch_none_without_budget(monkeypatch):
+    monkeypatch.delenv("GP_MEMPLAN_LIMIT_BYTES", raising=False)
+    # CPU backend reports no bytes_limit and no chaos limit is staged:
+    # planning imposes no constraint — today's path exactly
+    assert memplan.plan_dispatch("fit", [("native", 100.0)]) is None

@@ -11,6 +11,10 @@ tool is how an operator reads them as ONE story:
                                        # trace id into one document
     gpctl diff A B                   # two journals: phase timings, compile
                                      # counts, metrics, degradation rungs
+    gpctl plan DIR [...]             # memory-plan table: per decision the
+                                     # chosen config, predicted vs actual
+                                     # peak bytes (measured device peak +
+                                     # compiled memory_analysis), deltas
 
 ``merge`` groups artifacts by the stitched ``trace_id`` every journal and
 bundle carries (minted on process 0 and propagated over the coordination
@@ -143,6 +147,15 @@ def cmd_show(args) -> int:
             f"  degradation: [{row.get('entry')}] {row.get('failure_class')}"
             f" {row.get('from')} -> {row.get('to')}"
         )
+    for row in doc.get("memory_plan") or []:
+        print(
+            f"  memory_plan: [{row.get('entry')}] chose "
+            f"{row.get('chosen')!r} predicted="
+            f"{_fmt_bytes(row.get('predicted_bytes'))} budget="
+            f"{_fmt_bytes(row.get('budget_bytes'))} actual="
+            f"{_fmt_bytes(row.get('actual_peak_bytes'))}"
+            + (" MARGIN-BREACH" if row.get("margin_breach") else "")
+        )
     timings = doc.get("timings") or {}
     for phase, seconds in sorted(timings.items()):
         print(f"  phase {phase}: {seconds:.3f}s")
@@ -229,6 +242,67 @@ def cmd_merge(args) -> int:
     return 0
 
 
+def _fmt_bytes(value) -> str:
+    if value is None:
+        return "-"
+    value = float(value)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(value) < 1024.0 or unit == "GB":
+            return f"{value:.1f}{unit}"
+        value /= 1024.0
+    return f"{value:.1f}GB"  # pragma: no cover — loop always returns
+
+
+def cmd_plan(args) -> int:
+    """The memory planner's provenance, as one table: every journal's
+    ``memory_plan`` rows (resilience/memplan.py) with predicted vs actual
+    peak bytes — 'actual' being the measured device peak stamped at
+    journal time and, when cost metering ran, the compiler's own
+    ``memory_analysis`` peak — so a wrong prediction is a grep away, not
+    a mystery OOM."""
+    docs = [d for d in _collect(args.paths) if _kind_of(d) == "journal"]
+    if not docs:
+        print("no journals found", file=sys.stderr)
+        return 2
+    header = (
+        f"{'journal':<28s} {'entry':<8s} {'chosen':<10s} {'fits':<5s} "
+        f"{'predicted':>10s} {'budget':>10s} {'actual':>10s} "
+        f"{'compiled':>10s} {'delta':>10s} breach"
+    )
+    printed = False
+    for doc in docs:
+        rows = doc.get("memory_plan") or []
+        if not rows:
+            continue
+        if not printed:
+            print(header)
+            printed = True
+        name = str(doc.get("name", "?"))[:27]
+        for row in rows:
+            predicted = row.get("predicted_bytes")
+            actual = row.get("actual_peak_bytes")
+            delta = (
+                None if predicted is None or actual is None
+                else predicted - actual
+            )
+            print(
+                f"{name:<28s} {str(row.get('entry', '?')):<8s} "
+                f"{str(row.get('chosen', '?')):<10s} "
+                f"{str(bool(row.get('fits'))):<5s} "
+                f"{_fmt_bytes(predicted):>10s} "
+                f"{_fmt_bytes(row.get('budget_bytes')):>10s} "
+                f"{_fmt_bytes(actual):>10s} "
+                f"{_fmt_bytes(row.get('compiled_peak_bytes')):>10s} "
+                f"{_fmt_bytes(delta):>10s} "
+                f"{'YES' if row.get('margin_breach') else '-'}"
+            )
+    if not printed:
+        print("no memory_plan rows in the given journals (planning off, "
+              "no budget, or pre-plan artifacts)", file=sys.stderr)
+        return 2
+    return 0
+
+
 def _diff_numeric(label: str, a: Dict[str, float], b: Dict[str, float]) -> None:
     keys = sorted(set(a) | set(b))
     shown = False
@@ -304,6 +378,12 @@ def main(argv=None) -> int:
     p_diff.add_argument("a")
     p_diff.add_argument("b")
     p_diff.set_defaults(fn=cmd_diff)
+
+    p_plan = sub.add_parser(
+        "plan", help="memory-plan table: predicted vs actual peak bytes"
+    )
+    p_plan.add_argument("paths", nargs="+", help="files or directories")
+    p_plan.set_defaults(fn=cmd_plan)
 
     args = parser.parse_args(argv)
     try:

@@ -57,6 +57,13 @@ SCENARIOS = (
     # must terminate in ONE DegradationExhaustedError — and, per the
     # incident invariant below, exactly one schema-valid incident bundle
     "oom_exhausted_fit",
+    # shrunken device budget (chaos.memory_limit_bytes) with predictive
+    # memory planning ON (resilience/memplan.py): the plan must pre-size
+    # the fit under the budget — NO first-request OOM, zero fallback
+    # transitions, the plan decision journaled — and the serve gate must
+    # shed oversized requests with a classified code BEFORE dispatch
+    "memory_pressure_fit",
+    "memory_pressure_serve",
 )
 
 #: per-scenario tolerance on |pred - clean_pred|: execution-environment
@@ -77,6 +84,10 @@ SCENARIO_TOL = {
     # the repair working, so the bound sits above it, not at float noise
     "chol_fault": 1e-3,
     "guard_degrade": 1e-6,
+    # the plan's pre-sized segmented dispatch runs the identical L-BFGS
+    # trajectory as the clean one-dispatch fit (PR 9 segment driver)
+    "memory_pressure_fit": 1e-6,
+    "memory_pressure_serve": 1e-6,
 }
 _DATA_FAULT_TOL = 10.0
 
@@ -167,6 +178,74 @@ def _run_serve_campaign(rng, x, model) -> None:
         server.stop()
 
 
+def _run_memory_pressure_serve(rng, x, model) -> None:
+    """Predicted-per-request admission under a shrunken budget: oversized
+    low-priority requests shed with the classified ``queue.shed.memory``
+    code BEFORE any dispatch, small and high-priority requests answer —
+    and NO request ever reaches an OOM."""
+    import tempfile as _tf
+
+    from spark_gp_tpu.obs.runtime import telemetry
+    from spark_gp_tpu.resilience import memplan
+    from spark_gp_tpu.serve import GPServeServer
+    from spark_gp_tpu.serve.lifecycle import (
+        MemoryAdmissionGate,
+        MemoryPressureError,
+    )
+
+    server = GPServeServer(
+        max_batch=64, min_bucket=8, max_wait_ms=1.0, capacity=256,
+        request_timeout_ms=10_000.0,
+    )
+    with _tf.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "soak_model.npz")
+        model.save(path)
+        server.register("soak", path)
+    server.start()
+    try:
+        entry = server.registry.get("soak")
+        small = memplan.predict_request_bytes(entry.predictor, 4)
+        big = memplan.predict_request_bytes(entry.predictor, 64)
+        if not (small and big and small < big):
+            raise Violation("request byte model degenerate")
+        # deterministic usage + a budget that admits small requests and
+        # sheds 64-row ones: the per-request-scoped headroom admission
+        usage = 1000.0
+        server.memory_gate = MemoryAdmissionGate(
+            limit_bytes=usage + (small + big) / 2.0,
+            sampler=lambda: usage, sample_interval_s=0.0,
+        )
+        oom_before = telemetry.snapshot()["counters"].get(
+            "fallback.failures.oom", 0.0
+        )
+        answered = shed = 0
+        for _ in range(8):
+            sz = 4 if bool(rng.integers(0, 2)) else 64
+            row = int(rng.integers(0, max(1, x.shape[0] - 64)))
+            try:
+                server.predict("soak", x[row : row + sz], timeout_ms=10_000.0)
+                answered += 1
+            except MemoryPressureError as exc:
+                if exc.code != "queue.shed.memory":
+                    raise Violation(f"unclassified shed code {exc.code!r}")
+                shed += 1
+        # the big-but-important request must still be admitted (floor)
+        server.submit(
+            "soak", x[:64], timeout_ms=10_000.0, priority=1
+        ).result(timeout=15.0)
+        oom_after = telemetry.snapshot()["counters"].get(
+            "fallback.failures.oom", 0.0
+        )
+        if oom_after != oom_before:
+            raise Violation("serve request reached an OOM despite the plan")
+        if answered == 0:
+            raise Violation("no request admitted under the plan gate")
+        if server.memory_gate.snapshot()["plan_sheds"] != shed:
+            raise Violation("plan_sheds accounting diverged from sheds seen")
+    finally:
+        server.stop()
+
+
 def _assert_incident_invariant(incident_tmp: str, outcome: str) -> None:
     """The forensics invariant (obs/recorder.py): a campaign that ended in
     a single classified error produced EXACTLY ONE schema-valid incident
@@ -202,7 +281,9 @@ def run_campaign(seed: int, deadline_s: float = 120.0, deep: bool = False) -> di
     scenario = SCENARIOS[int(rng.integers(0, len(SCENARIOS)))]
     x, y, expert = _build_problem(deep)
     optimizer = "device" if scenario in (
-        "oom_fit", "compile_fit", "guard_degrade", "oom_exhausted_fit"
+        "oom_fit", "compile_fit", "guard_degrade", "oom_exhausted_fit",
+        # plan pre-sizing applies to the on-device dispatch path only
+        "memory_pressure_fit",
     ) or bool(rng.integers(0, 2)) else "host"
 
     threads_before = threading.active_count()
@@ -324,6 +405,75 @@ def _run_campaign_body(
                 raise Violation("cholesky fault never fired")
         elif scenario == "serve_flaky":
             _run_serve_campaign(rng, x, ref_model)
+            pred = ref_pred
+        elif scenario == "memory_pressure_fit":
+            import jax
+
+            from spark_gp_tpu.obs.runtime import telemetry
+            from spark_gp_tpu.resilience import memplan
+
+            e = num_experts_for(x.shape[0], expert)
+            # the stack dtype follows the runtime: f64 under the x64 test
+            # harness, f32 on the plain CLI harness
+            itemsize = 8 if jax.config.jax_enable_x64 else 4
+            native = memplan.fit_dispatch_bytes(
+                e, expert, x.shape[1], itemsize, "native"
+            )
+            seg_pred = memplan.predicted_bytes(
+                memplan.fit_dispatch_bytes(e, expert, x.shape[1], itemsize,
+                                           "segmented")
+            )
+            if not seg_pred < native:
+                raise Violation("fit byte model degenerate")
+            counters = telemetry.snapshot()["counters"]
+            oom_before = counters.get("fallback.failures.oom", 0.0)
+            trans_before = counters.get("fallback.transitions", 0.0)
+            # a budget only the segmented dispatch fits under: the plan
+            # must size down BEFORE the first dispatch — the acceptance
+            # invariant is zero injected OOMs and zero reactive rungs
+            with chaos.memory_limit_bytes((seg_pred + native) / 2.0) as fired:
+                model = _make_gp(expert, "device").fit(x, y)
+            counters = telemetry.snapshot()["counters"]
+            if fired[0] or counters.get(
+                "fallback.failures.oom", 0.0
+            ) != oom_before:
+                raise Violation("first-request OOM despite planning on")
+            if counters.get("fallback.transitions", 0.0) != trans_before:
+                raise Violation("reactive ladder engaged under a plan hit")
+            if getattr(model, "degradations", None):
+                raise Violation("plan-sized fit stamped degradations")
+            rows = getattr(model.instr, "memory_plan", None) or []
+            if not rows or rows[0].get("chosen") != "segmented" or not (
+                rows[0].get("fits")
+            ):
+                raise Violation(f"missing/wrong plan provenance: {rows}")
+            # predicted >= modeled-actual on the clean run, by contract
+            if rows[0]["predicted_bytes"] < rows[0]["raw_bytes"]:
+                raise Violation("prediction below modeled actual")
+            # the predict leg of the same invariant: a budget only the
+            # smaller chunk fits under — the plan pre-shrinks the chunk,
+            # zero OOMs, zero reactive halvings
+            m_rows, p_dim = model.raw_predictor.active.shape
+            big = memplan.predict_dispatch_bytes(
+                64, m_rows, p_dim, itemsize, True
+            )
+            small_pred = memplan.predicted_bytes(
+                memplan.predict_dispatch_bytes(16, m_rows, p_dim, itemsize,
+                                               True)
+            )
+            trans_before = telemetry.snapshot()["counters"].get(
+                "fallback.transitions", 0.0
+            )
+            with chaos.memory_limit_bytes(
+                (small_pred + big) / 2.0
+            ) as p_fired:
+                pred = model.predict(x[:64])
+            if p_fired[0] or telemetry.snapshot()["counters"].get(
+                "fallback.transitions", 0.0
+            ) != trans_before:
+                raise Violation("predict OOM/halving despite planning on")
+        elif scenario == "memory_pressure_serve":
+            _run_memory_pressure_serve(rng, x, ref_model)
             pred = ref_pred
         elif scenario == "guard_degrade":
             from spark_gp_tpu.ops import precision
