@@ -53,6 +53,11 @@ chaos-staged device budget only the segmented dispatch fits — the plan
 pre-sizes BEFORE the first dispatch, so the fit completes with ZERO
 injected OOMs and zero reactive rung transitions (asserted in
 test_bench_contract), with the plan decision journaled.
+The ``fleet`` section (BENCH_FLEET, "1" by default) drives a closed-loop
+client over a 3-replica consistent-hash fleet (serve/fleet.py +
+serve/router.py) and SIGKILLs the bucket owner mid-burst: p50/p99
+through the router and failover_failed_requests — asserted == 0 in
+test_bench_contract (every affected request re-routed in-deadline).
 BENCH_FIT_HOT_LOOP ("1" [default]: the theta-invariant precompute-plane
 section — cached vs uncached nll_evals/sec on a distance-dominated
 isotropic probe (BENCH_HOT_N/BENCH_HOT_EXPERT/BENCH_HOT_P/BENCH_HOT_REPS)
@@ -1421,6 +1426,101 @@ def worker() -> None:
     else:
         lifecycle = {"skipped": "BENCH_LIFECYCLE != 1"}
 
+    def _fleet_section():
+        """Closed-loop client over a 3-replica in-process fleet with one
+        replica SIGKILLed mid-burst (the chaos analogue): p50/p99 through
+        the router and the failed-request count — which must be ZERO,
+        every affected request re-routed by failover within its deadline
+        (ISSUE 12; serve/fleet.py + serve/router.py)."""
+        import tempfile
+
+        from spark_gp_tpu.parallel.coord import (
+            InProcessCoordClient,
+            InProcessCoordStore,
+        )
+        from spark_gp_tpu.resilience.chaos import kill_replica
+        from spark_gp_tpu.serve import GPServeServer
+        from spark_gp_tpu.serve.fleet import FleetMembership, LocalReplica
+        from spark_gp_tpu.serve.router import FleetRouter
+
+        membership = FleetMembership(
+            InProcessCoordClient(InProcessCoordStore(), 0, 1),
+            fleet="bench", interval_s=0.05,
+            straggler_after_s=0.15, dead_after_s=0.35,
+        )
+        replicas = []
+        counts = {"ok": 0, "failed": 0}
+        total = 120
+        with tempfile.TemporaryDirectory() as tmp:
+            mpath = os.path.join(tmp, "bench_fleet.npz")
+            model.save(mpath)
+            try:
+                for i in range(3):
+                    server = GPServeServer(
+                        max_batch=64, min_bucket=8, max_wait_ms=1.0,
+                        capacity=4096, request_timeout_ms=10_000.0,
+                        hang_timeout_s=None, replica_id=f"bench-r{i}",
+                    )
+                    server.register("fleet", mpath)
+                    server.start()
+                    replica = LocalReplica(server, f"bench-r{i}", membership)
+                    replica.register()
+                    replicas.append(replica)
+                router = FleetRouter(
+                    membership,
+                    transports={
+                        r.replica_id: r.transport for r in replicas
+                    },
+                    max_batch=64, min_bucket=8,
+                    default_timeout_ms=10_000.0, poll_interval_s=0.0,
+                )
+                victim = router.route("fleet", 4)[0]
+                by_id = {r.replica_id: r for r in replicas}
+                for i in range(total):
+                    if i == total // 2:
+                        kill_replica(by_id[victim])  # SIGKILL mid-burst
+                    for r in replicas:
+                        r.heartbeat()
+                    row = (i * 23) % max(1, n - 8)
+                    try:
+                        router.predict("fleet", x[row : row + 4])
+                        counts["ok"] += 1
+                    except Exception:  # noqa: BLE001 — counting IS the bar
+                        counts["failed"] += 1
+                latency = router.metrics.snapshot()["histograms"].get(
+                    "router.request_latency_s", {}
+                )
+                return {
+                    "replicas": 3,
+                    "requests": total,
+                    "requests_ok": counts["ok"],
+                    "failover_failed_requests": counts["failed"],
+                    "failovers": router.metrics.counter("router.failovers"),
+                    "latency_p50_ms": (latency.get("p50") or 0.0) * 1e3,
+                    "latency_p99_ms": (latency.get("p99") or 0.0) * 1e3,
+                    "killed_replica": victim,
+                    "note": (
+                        "closed-loop client over a 3-replica consistent-"
+                        "hash fleet; the bucket owner is SIGKILLed at "
+                        "request 60 — failover_failed_requests must be 0 "
+                        "(every re-route inside the request deadline)"
+                    ),
+                }
+            finally:
+                for r in replicas:
+                    try:
+                        r.stop()
+                    except Exception:  # noqa: BLE001 — teardown only
+                        pass
+
+    if os.environ.get("BENCH_FLEET", "1") == "1":
+        try:
+            fleet = _fleet_section()
+        except Exception as exc:  # noqa: BLE001 — secondary metric only
+            fleet = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    else:
+        fleet = {"skipped": "BENCH_FLEET != 1"}
+
     def _classifier_fit_seconds(estimator_cls, labels):
         """Warm-up + timed fit of a classifier at the same shape/config as
         the primary metric (one definition, so the binary and multiclass
@@ -1535,6 +1635,7 @@ def worker() -> None:
             "observability": observability,
             "multihost_resilience": multihost_resilience,
             "lifecycle": lifecycle,
+            "fleet": fleet,
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,
             "cpu_proxy_host_cores": host_cores,

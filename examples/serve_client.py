@@ -7,6 +7,12 @@ Demonstrates the online-inference subsystem end to end
 streams a mixed-size batch of JSON-line requests through the
 micro-batcher, checking the answers against in-process predictions.
 
+Part two boots the same CLI in TCP mode and demonstrates the
+**fleet-client pattern** (docs/SERVING.md "Fleet"): retry with bounded
+exponential backoff + jitter on classified shed codes, one ``request_id``
+per LOGICAL request reused verbatim on every resend, and answers
+recorded BY request_id so a duplicated reply can never double-count.
+
 Run: python examples/serve_client.py [--requests 40]
 """
 
@@ -21,10 +27,58 @@ from spark_gp_tpu.utils.platform import preflight_backend
 
 import argparse
 import json
+import random
+import socket
 import subprocess
 import tempfile
+import time
 
 import numpy as np
+
+# Shed/transient codes a client should RETRY (with backoff) — the server
+# is telling you "not now", not "never" (spark_gp_tpu/serve/codes.py has
+# the full catalog; anything else is a client error: do NOT retry it).
+RETRYABLE_CODES = {
+    "queue.shed.backpressure",  # full queue: back off, the burst will pass
+    "queue.shed.draining",      # replica shutting down: another will answer
+    "queue.shed.memory",        # memory gate: retry when pressure recedes
+    "shed.breaker",             # model breaker cooling: retry after reset
+}
+
+
+def send_with_retry(rf, wf, request, answers, attempts=4, backoff_s=0.05):
+    """The fleet-client pattern, inline:
+
+    1. the caller mints ONE ``request_id`` per logical request and this
+       function reuses it VERBATIM on every resend — the server stamps
+       it on its predict span (and any incident bundle), so all attempts
+       of one logical request stitch into one server-side story;
+    2. classified shed codes are retried with bounded exponential
+       backoff + jitter (a fleet under failover sheds transiently; a
+       retry stampede without jitter would re-converge on the same
+       recovering replica).  Unclassified errors raise immediately —
+       no replica answers a malformed request differently;
+    3. answers land in ``answers`` KEYED BY request_id — an overwrite,
+       never an append — so a duplicated/re-sent reply cannot
+       double-count one logical request in the client's results.
+    """
+    request_id = request["request_id"]
+    last = None
+    for attempt in range(attempts):
+        wf.write(json.dumps(request) + "\n")
+        wf.flush()
+        reply = json.loads(rf.readline())
+        if reply.get("request_id") is not None:
+            answers[reply["request_id"]] = reply  # keyed: idempotent
+        if "error" not in reply:
+            return reply
+        last = reply
+        if reply.get("code") not in RETRYABLE_CODES:
+            raise RuntimeError(f"unretryable reply: {reply}")
+        time.sleep(backoff_s * (2 ** attempt) * (1.0 + random.random()))
+    raise RuntimeError(
+        f"request {request_id} still shed after {attempts} attempts: {last}"
+    )
 
 
 def main():
@@ -105,6 +159,59 @@ def main():
     print(f"latency p50 {lat['p50'] * 1e3:.2f} ms / p99 {lat['p99'] * 1e3:.2f} ms; "
           f"batches {metrics['counters']['batches']:.0f}; "
           f"occupancy p50 {occ['p50']:.2f}")
+
+    # -- part two: the fleet-client pattern over TCP ----------------------
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _os.path.join(tmp, "model.npz")
+        model.save(path)
+        env = dict(_os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "spark_gp_tpu.serve",
+             "--model", f"demo={path}", "--max-batch", "64",
+             "--port", "0", "--replica-id", "demo-r0"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env,
+        )
+        try:
+            while True:  # wait for the TCP listener
+                event = json.loads(proc.stdout.readline())
+                if event.get("event") == "listening":
+                    port = event["port"]
+                    break
+            conn = socket.create_connection(("127.0.0.1", port), timeout=60)
+            rf, wf = conn.makefile("r"), conn.makefile("w")
+            answers = {}
+            logical = []
+            for i in range(8):
+                row = (i * 31) % (2000 - 8)
+                req = {
+                    "id": i,
+                    "model": "demo",
+                    "x": x[row : row + 4].tolist(),
+                    # ONE id per logical request, reused on every resend
+                    "request_id": f"req-{i}",
+                }
+                logical.append(req)
+                send_with_retry(rf, wf, req, answers)
+            # simulate a client-side timeout + resend of request 3: the
+            # SAME request_id goes back on the wire...
+            send_with_retry(rf, wf, logical[3], answers)
+            # ...and the keyed bookkeeping counts it exactly once
+            assert len(answers) == len(logical), (len(answers), len(logical))
+            assert all(f"req-{i}" in answers for i in range(8))
+            assert all("mean" in a for a in answers.values())
+            wf.write(json.dumps({"cmd": "shutdown"}) + "\n")
+            wf.flush()
+            conn.close()
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    print("fleet-client pattern: 8 logical requests, 9 sends, "
+          f"{len(answers)} answers — no double count")
     print("OK")
 
 

@@ -155,6 +155,28 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("coord.checkpoints", "counter", "coordinated checkpoint saves completed"),
     MetricName("coord.elastic_resumes", "counter", "resumes under a different process count than the save"),
     MetricName("coord.preemptions", "counter", "SIGTERM preemption signals observed by the watcher"),
+    # -- serving fleet (serve/fleet.py, serve/router.py) -------------------
+    MetricName("router.requests", "counter", "logical predict requests entering the fleet router"),
+    MetricName("router.failovers", "counter", "re-dispatches onto the next ring replica after a classified replica failure"),
+    MetricName("router.hedges", "counter", "hedged duplicate dispatches launched against straggling replicas"),
+    MetricName("router.hedge_wins", "counter", "hedged dispatches that answered before the primary"),
+    MetricName("router.failed", "counter", "router requests that exhausted failover or their deadline"),
+    MetricName("router.rebuilds", "counter", "routing views rebuilt from the KV membership (router start/restart)"),
+    MetricName("router.replica_errors.*", "counter", "failover-eligible errors observed per replica", label="replica"),
+    MetricName("router.request_latency_s", "histogram", "router submit-to-answer seconds (failover/hedging included)"),
+    MetricName("fleet.joins", "counter", "replica registrations recorded in fleet membership"),
+    MetricName("fleet.leaves", "counter", "replica deregistrations recorded in fleet membership"),
+    MetricName("fleet.replica_stragglers", "counter", "replicas flagged straggling by the fleet heartbeat ledger"),
+    MetricName("fleet.replica_deaths", "counter", "replicas declared dead by the fleet heartbeat ledger"),
+    MetricName("fleet.canary_promotions", "counter", "fleet-wide canary verdicts that promoted on every replica"),
+    MetricName("fleet.canary_rollbacks", "counter", "fleet-wide canary verdicts that rolled back on every replica"),
+    MetricName("fleet.replicas_live", "gauge", "serving (non-dead) replicas in the routing view"),
+    MetricName("fleet.replicas_draining", "gauge", "replicas draining out of the ring"),
+    MetricName("fleet.replicas_dead", "gauge", "replicas evicted by heartbeat verdict"),
+    MetricName("fleet.generation", "gauge", "membership generation of the current routing view"),
+    MetricName("fleet.scale_up", "gauge", "1 while aggregated queue/memory pressure asks for another replica"),
+    MetricName("fleet.queue_pressure.*", "gauge", "per-replica queue depth / capacity", label="replica"),
+    MetricName("fleet.memory_shedding.*", "gauge", "1 while the replica's memory admission gate sheds", label="replica"),
     # -- forensics plane (obs/recorder.py, obs/cost.py) --------------------
     MetricName("incident.bundles", "counter", "incident bundles assembled on terminal classified failures"),
     MetricName("incident.bundle_failures", "counter", "incident bundles that could not be persisted"),
@@ -190,6 +212,15 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("coord.checkpoint", "event", "coordinated checkpoint save completed"),
     MetricName("coord.preempted", "event", "SIGTERM preemption observed"),
     MetricName("incident.bundle", "event", "incident bundle dumped"),
+    MetricName("router.failover", "event", "request re-dispatched onto the next ring replica"),
+    MetricName("router.hedge", "event", "hedged duplicate dispatch launched against a straggler"),
+    MetricName("fleet.member_joined", "event", "replica registered into fleet membership"),
+    MetricName("fleet.member_left", "event", "replica deregistered from fleet membership"),
+    MetricName("fleet.replica_straggler", "event", "replica flagged straggling (stale fleet heartbeat)"),
+    MetricName("fleet.replica_dead", "event", "replica declared dead by the fleet heartbeat ledger"),
+    MetricName("fleet.replica_recovered", "event", "flagged replica resumed heartbeating"),
+    MetricName("fleet.canary_promote", "event", "fleet-wide canary promoted on every replica"),
+    MetricName("fleet.canary_rollback", "event", "fleet-wide canary rolled back on every replica"),
     MetricName("metric.*", "event", "watchlisted serve-metric increment relayed to the flight recorder", label="key"),
 )
 

@@ -74,6 +74,7 @@ __all__ = [
     "CoordinationTimeoutError",
     "DcnContext",
     "HeartbeatMonitor",
+    "LivenessLedger",
     "InProcessCoordClient",
     "InProcessCoordStore",
     "KVStoreClient",
@@ -614,6 +615,103 @@ def kv_allgather(
 # --------------------------------------------------------------------------
 
 
+class LivenessLedger:
+    """Identity-agnostic straggler/dead escalation — the one state machine
+    behind every heartbeat surface: the process :class:`HeartbeatMonitor`
+    (integer pids over ``heartbeat/``) and the serve fleet's replica
+    membership (string replica ids over ``fleet/<name>/heartbeat/``,
+    ``serve/fleet.py``) both drive this ledger so "straggler past 3
+    intervals, dead past 10, recovered on a fresh stamp" means exactly
+    the same thing at both scales.
+
+    ``observe`` takes one sweep's view — the current clock, the stamp
+    counters read back from the KV plane, and the expected identity set —
+    and updates the flags; the callbacks fire OUTSIDE the lock (they emit
+    metrics and span events, which may take other locks).  A peer is
+    considered *seen* when its stamp counter CHANGES, not when a key
+    merely exists: a dead process's last stamp stays in the store forever.
+    """
+
+    def __init__(
+        self,
+        straggler_after_s: float,
+        dead_after_s: float,
+        on_straggler: Optional[Callable[[object, float], None]] = None,
+        on_dead: Optional[Callable[[object, float], None]] = None,
+        on_recover: Optional[Callable[[object], None]] = None,
+    ) -> None:
+        self.straggler_after_s = float(straggler_after_s)
+        self.dead_after_s = float(dead_after_s)
+        self._on_straggler = on_straggler
+        self._on_dead = on_dead
+        self._on_recover = on_recover
+        self._last_seen: Dict[object, Tuple[int, float]] = {}  # id -> (n, at)
+        self._flagged: Dict[object, str] = {}  # id -> "straggler" | "dead"
+        self._lock = threading.Lock()
+
+    def observe(self, now: float, stamps: Dict[object, int],
+                expected: Sequence[object] = (),
+                skip: Sequence[object] = ()) -> None:
+        skip_set = set(skip)
+        recovered: List[object] = []
+        escalated: List[Tuple[object, str, float]] = []
+        with self._lock:
+            # seed every expected identity at first sight: a peer that dies
+            # before its first stamp would otherwise never enter the
+            # escalation scan and read as healthy forever
+            for ident in expected:
+                self._last_seen.setdefault(ident, (-1, now))
+            for ident, n in stamps.items():
+                prev = self._last_seen.get(ident)
+                if prev is None or prev[0] != n:
+                    self._last_seen[ident] = (int(n), now)
+                    if ident in self._flagged:
+                        del self._flagged[ident]
+                        recovered.append(ident)
+            for ident, (_, at) in self._last_seen.items():
+                if ident in skip_set:
+                    continue
+                age = now - at
+                state = self._flagged.get(ident)
+                if age > self.dead_after_s and state != "dead":
+                    self._flagged[ident] = "dead"
+                    escalated.append((ident, "dead", age))
+                elif (
+                    self.dead_after_s >= age > self.straggler_after_s
+                    and state is None
+                ):
+                    self._flagged[ident] = "straggler"
+                    escalated.append((ident, "straggler", age))
+        for ident in recovered:
+            if self._on_recover is not None:
+                self._on_recover(ident)
+        for ident, state, age in escalated:
+            callback = self._on_dead if state == "dead" else self._on_straggler
+            if callback is not None:
+                callback(ident, age)
+
+    def _flagged_as(self, state: str) -> List[object]:
+        with self._lock:
+            return [i for i, s in self._flagged.items() if s == state]
+
+    def dead(self) -> List[object]:
+        return self._flagged_as("dead")
+
+    def stragglers(self) -> List[object]:
+        return self._flagged_as("straggler")
+
+    def last_seen(self) -> Dict[object, Tuple[int, float]]:
+        with self._lock:
+            return dict(self._last_seen)
+
+    def forget(self, ident: object) -> None:
+        """Drop one identity entirely (a deregistered fleet member must
+        not keep reading as dead after it politely left)."""
+        with self._lock:
+            self._last_seen.pop(ident, None)
+            self._flagged.pop(ident, None)
+
+
 class HeartbeatMonitor:
     """Liveness over the KV store: stamp ``heartbeat/<pid>`` every
     ``interval_s``, watch every peer's stamp age, and escalate —
@@ -645,9 +743,22 @@ class HeartbeatMonitor:
             _env_float("GP_COORD_DEAD_AFTER_S", 10.0 * self.interval_s)
             if dead_after_s is None else float(dead_after_s)
         )
-        self._last_seen: Dict[int, Tuple[int, float]] = {}  # pid -> (n, at)
-        self._flagged: Dict[int, str] = {}  # pid -> "straggler" | "dead"
-        self._lock = threading.Lock()
+        # the shared escalation state machine (LivenessLedger): the serve
+        # fleet's replica membership drives the same one, so process- and
+        # replica-level verdicts share identical semantics
+        self._ledger = LivenessLedger(
+            self.straggler_after_s,
+            self.dead_after_s,
+            on_straggler=lambda pid, age: (
+                _bump("coord.stragglers"),
+                _event("coord.straggler", pid=pid, stamp_age_s=age),
+            ),
+            on_dead=lambda pid, age: (
+                _bump("coord.dead_hosts"),
+                _event("coord.dead_host", pid=pid, stamp_age_s=age),
+            ),
+            on_recover=lambda pid: _event("coord.recovered", pid=pid),
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._beats = 0
@@ -666,42 +777,18 @@ class HeartbeatMonitor:
                 json.dumps({"n": self._beats, "t": now}).encode(),
             )
             _bump("coord.heartbeats")
-        stamps = cl.dir_get("heartbeat/")
-        with self._lock:
-            # seed every expected pid at the FIRST poll: a peer that dies
-            # before its first stamp (crash during init, a DeadHost from
-            # the start) would otherwise never enter the escalation scan
-            # and read as healthy forever
-            for pid in range(cl.num_processes):
-                self._last_seen.setdefault(pid, (-1, now))
-            for key, raw in stamps.items():
-                try:
-                    pid = int(key.rsplit("/", 1)[-1])
-                    n = int(json.loads(raw.decode())["n"])
-                except (ValueError, KeyError):
-                    continue
-                prev = self._last_seen.get(pid)
-                if prev is None or prev[0] != n:
-                    self._last_seen[pid] = (n, now)
-                    if pid in self._flagged:
-                        _event("coord.recovered", pid=pid)
-                        del self._flagged[pid]
-            for pid, (_, at) in self._last_seen.items():
-                if pid == cl.process_id:
-                    continue
-                age = now - at
-                state = self._flagged.get(pid)
-                if age > self.dead_after_s and state != "dead":
-                    self._flagged[pid] = "dead"
-                    _bump("coord.dead_hosts")
-                    _event("coord.dead_host", pid=pid, stamp_age_s=age)
-                elif (
-                    self.dead_after_s >= age > self.straggler_after_s
-                    and state is None
-                ):
-                    self._flagged[pid] = "straggler"
-                    _bump("coord.stragglers")
-                    _event("coord.straggler", pid=pid, stamp_age_s=age)
+        parsed: Dict[object, int] = {}
+        for key, raw in cl.dir_get("heartbeat/").items():
+            try:
+                parsed[int(key.rsplit("/", 1)[-1])] = int(
+                    json.loads(raw.decode())["n"]
+                )
+            except (ValueError, KeyError):
+                continue
+        self._ledger.observe(
+            now, parsed,
+            expected=range(cl.num_processes), skip=(cl.process_id,),
+        )
 
     def maybe_poll(self) -> None:
         """Rate-limited :meth:`poll_once` for the PASSIVE (main-thread)
@@ -721,30 +808,23 @@ class HeartbeatMonitor:
             pass
 
     def dead_pids(self) -> List[int]:
-        with self._lock:
-            return [p for p, s in self._flagged.items() if s == "dead"]
+        return sorted(self._ledger.dead())
 
     def stragglers(self) -> List[int]:
-        with self._lock:
-            return [p for p, s in self._flagged.items() if s == "straggler"]
+        return sorted(self._ledger.stragglers())
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "process_id": self.client.process_id,
-                "process_count": self.client.num_processes,
-                "interval_s": self.interval_s,
-                "stragglers": sorted(
-                    p for p, s in self._flagged.items() if s == "straggler"
-                ),
-                "dead": sorted(
-                    p for p, s in self._flagged.items() if s == "dead"
-                ),
-                "last_seen": {
-                    str(p): {"n": n, "at": at}
-                    for p, (n, at) in self._last_seen.items()
-                },
-            }
+        return {
+            "process_id": self.client.process_id,
+            "process_count": self.client.num_processes,
+            "interval_s": self.interval_s,
+            "stragglers": sorted(self._ledger.stragglers()),
+            "dead": sorted(self._ledger.dead()),
+            "last_seen": {
+                str(p): {"n": n, "at": at}
+                for p, (n, at) in self._ledger.last_seen().items()
+            },
+        }
 
     # -- thread plumbing ---------------------------------------------------
     def start(self) -> "HeartbeatMonitor":

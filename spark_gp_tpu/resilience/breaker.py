@@ -29,6 +29,10 @@ from typing import Callable
 class BreakerOpenError(RuntimeError):
     """Request rejected without dispatch: the target's breaker is open."""
 
+    #: machine-readable class on the wire (serve/codes.py): clients back
+    #: off, the fleet router fails over to the next ring replica
+    code = "shed.breaker"
+
     def __init__(self, name: str, retry_after_s: float) -> None:
         self.retry_after_s = max(0.0, retry_after_s)
         super().__init__(

@@ -278,6 +278,33 @@ def hang_model(server, name: str, version: Optional[int] = None, **hang_kw):
 
 
 # --------------------------------------------------------------------------
+# fleet replica faults (serve/fleet.py + serve/router.py consume these)
+# --------------------------------------------------------------------------
+
+
+def kill_replica(replica) -> None:
+    """SIGKILL analogue for a fleet replica
+    (:class:`~spark_gp_tpu.serve.fleet.LocalReplica`): the transport goes
+    unreachable, heartbeats stop (the membership ledger must reach a
+    dead verdict), and queued/in-flight futures are failed fast — the
+    router's failover must re-route every affected request within its
+    deadline with zero lost answers."""
+    replica.kill()
+
+
+def hang_replica(replica, name: Optional[str] = None, **hang_kw):
+    """Wedge one fleet replica: its model predictor BLOCKS
+    (:class:`HangingPredictor`) and — a wedged process stamps nothing —
+    its heartbeats stop, so the membership ledger must evict it by
+    verdict while the rest of the fleet keeps serving (the router hedges
+    around the straggler in the meantime).  Returns the wrapper — call
+    ``release()`` in teardown so the parked batcher thread unwinds."""
+    replica.alive = False  # heartbeats stop with the wedge
+    target = name if name is not None else replica.server.registry.names()[0]
+    return hang_model(replica.server, target, **hang_kw)
+
+
+# --------------------------------------------------------------------------
 # multi-host faults (parallel/coord.py consumes these at its choke points)
 # --------------------------------------------------------------------------
 

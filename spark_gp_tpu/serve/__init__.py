@@ -33,6 +33,15 @@ SIGTERM/SIGINT, canary rollouts shadow-scored against the incumbent
 with auto-promote/auto-rollback, a hang watchdog over device
 dispatches, and memory-pressure admission with hysteresis.
 
+At fleet scale (:mod:`~spark_gp_tpu.serve.fleet` +
+:mod:`~spark_gp_tpu.serve.router`): consistent-hash routing of
+``(model, bucket)`` across N replicas, registration/heartbeat/
+generation-stamped membership over the coord KV plane, per-request
+failover with bounded jittered retry, hedged re-dispatch around
+stragglers, drain-aware rebalancing, fleet-wide canary (promote only
+when ALL replicas clear the guard bar), and aggregated scaling signals
+on one OpenMetrics page (docs/SERVING.md "Fleet").
+
 See docs/SERVING.md for architecture, tuning and the
 "Deployment & lifecycle" section.
 """
@@ -59,10 +68,36 @@ from spark_gp_tpu.serve.queue import (
     RequestTimeoutError,
     ServeFuture,
 )
+from spark_gp_tpu.serve.fleet import (
+    FleetCanary,
+    FleetMembership,
+    HashRing,
+    LocalReplica,
+)
 from spark_gp_tpu.serve.registry import ModelRegistry, ServableModel
+from spark_gp_tpu.serve.router import (
+    FailoverExhaustedError,
+    FleetRouter,
+    LocalReplicaTransport,
+    NoReplicasError,
+    ReplicaUnreachableError,
+    RouterDeadlineError,
+    TcpReplicaTransport,
+)
 from spark_gp_tpu.serve.server import GPServeServer
 
 __all__ = [
+    "FailoverExhaustedError",
+    "FleetCanary",
+    "FleetMembership",
+    "FleetRouter",
+    "HashRing",
+    "LocalReplica",
+    "LocalReplicaTransport",
+    "NoReplicasError",
+    "ReplicaUnreachableError",
+    "RouterDeadlineError",
+    "TcpReplicaTransport",
     "BreakerOpenError",
     "BucketedPredictor",
     "BucketOverflowError",

@@ -101,6 +101,23 @@ def _parse_args(argv):
     parser.add_argument("--port", type=int, default=None,
                         help="serve a TCP socket on 127.0.0.1:PORT instead of stdin")
     parser.add_argument(
+        "--replica-id", default=None,
+        help="stable replica identity reported by the health verb and "
+        "stamped on fleet membership (default: GP_REPLICA_ID env or a "
+        "pid-derived id)",
+    )
+    parser.add_argument(
+        "--conn-read-timeout-s", type=float, default=300.0,
+        help="TCP mode: per-connection read timeout (0 disables) — a "
+        "half-open or vanished client is disconnected instead of "
+        "pinning a reader thread forever (code=serve.conn_idle)",
+    )
+    parser.add_argument(
+        "--max-connections", type=int, default=64,
+        help="TCP mode: concurrent-connection bound; connections past it "
+        "are refused with one code=serve.conn_limit line",
+    )
+    parser.add_argument(
         "--metrics-port", type=int, default=None,
         help="expose a plain-text OpenMetrics scrape endpoint on "
         "127.0.0.1:PORT (0 picks a free port; reported in the ready line)",
@@ -322,7 +339,9 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
     return shutdown
 
 
-def _serve_socket(server, port: int, out_lock, drain_flag=None) -> None:
+def _serve_socket(server, port: int, out_lock, drain_flag=None,
+                  read_timeout_s: float = 300.0,
+                  max_connections: int = 64) -> None:
     import socket
 
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -332,12 +351,37 @@ def _serve_socket(server, port: int, out_lock, drain_flag=None) -> None:
     bound = sock.getsockname()[1]
     _out(out_lock, sys.stdout, {"event": "listening", "port": bound})
     stop = threading.Event()
+    # connection hygiene against half-open clients: a per-connection read
+    # timeout (a connect-and-vanish client can never pin a reader thread)
+    # and a hard concurrent-connection bound (reader threads are the
+    # resource being protected — one per connection)
+    count_lock = threading.Lock()
+    live = [0]
 
     def _handle(conn):
-        with conn, conn.makefile("r") as rf, conn.makefile("w") as wf:
-            conn_lock = threading.Lock()
-            if _serve_stream(server, rf, wf, conn_lock):
-                stop.set()
+        try:
+            with conn, conn.makefile("r") as rf, conn.makefile("w") as wf:
+                conn_lock = threading.Lock()
+                try:
+                    if _serve_stream(server, rf, wf, conn_lock):
+                        stop.set()
+                except socket.timeout:
+                    # the per-connection read timeout fired: tell a
+                    # slow-but-live client why, then free the thread
+                    # (a vanished client simply never reads it)
+                    try:
+                        _out(conn_lock, wf, {
+                            "error": "connection idle past "
+                            f"{read_timeout_s:.0f}s read timeout",
+                            "code": "serve.conn_idle",
+                        })
+                    except OSError:
+                        pass
+                except OSError:
+                    pass  # client went away mid-read/mid-write
+        finally:
+            with count_lock:
+                live[0] -= 1
 
     try:
         sock.settimeout(0.5)
@@ -349,6 +393,25 @@ def _serve_socket(server, port: int, out_lock, drain_flag=None) -> None:
             try:
                 conn, _ = sock.accept()
             except socket.timeout:
+                continue
+            if read_timeout_s and read_timeout_s > 0:
+                conn.settimeout(read_timeout_s)
+            with count_lock:
+                over = live[0] >= max_connections
+                if not over:
+                    live[0] += 1
+            if over:
+                # refuse at the door with one classified line — never by
+                # silently queueing a connection no thread will read
+                try:
+                    conn.sendall((json.dumps({
+                        "error": "connection limit "
+                        f"({max_connections}) reached",
+                        "code": "serve.conn_limit",
+                    }) + "\n").encode("utf-8"))
+                except OSError:
+                    pass
+                conn.close()
                 continue
             threading.Thread(
                 target=_handle, args=(conn,), daemon=True
@@ -404,6 +467,7 @@ def main(argv=None) -> int:
         ),
         memory_limit_bytes=args.memory_limit_bytes,
         drain_deadline_s=args.drain_deadline_s,
+        replica_id=args.replica_id,
     )
     for spec in args.model:
         name, sep, path = spec.partition("=")
@@ -444,7 +508,11 @@ def main(argv=None) -> int:
     explicit_shutdown = False
     try:
         if args.port is not None:
-            _serve_socket(server, args.port, out_lock, drain_flag)
+            _serve_socket(
+                server, args.port, out_lock, drain_flag,
+                read_timeout_s=args.conn_read_timeout_s,
+                max_connections=args.max_connections,
+            )
         else:
             # the stdin reader runs on a side thread so a drain signal can
             # act even while the reader is parked in a blocking readline

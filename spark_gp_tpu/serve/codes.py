@@ -1,0 +1,76 @@
+"""THE wire error-code catalog: every ``code=`` a serve surface emits.
+
+Clients branch on these codes (``examples/serve_client.py`` retries on
+the shed classes, the fleet router fails over on the infrastructure
+classes), and dashboards slice error rates by them — so a renamed or
+uncatalogued code is the wire-protocol version of the metric-rename bug
+:mod:`spark_gp_tpu.obs.names` exists to kill.  The contract is the same:
+every ``code`` string that can reach a client — an exception class's
+``code`` attribute, or a literal ``"code"`` field in a reply payload —
+must (a) satisfy the dot-separated-lowercase grammar and (b) be
+registered here.  ``tools/check_error_codes.py`` walks the package AST
+and fails CI on any emission that breaks either rule (tier-1 wrapper:
+``tests/test_error_codes.py``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+#: same grammar as metric keys: lowercase [a-z0-9_] components, dot-joined
+CODE_GRAMMAR = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+#: code -> operator/client-facing meaning.  Grouped by the surface that
+#: emits it; docs/SERVING.md and docs/RESILIENCE.md describe the client
+#: contract per class.
+ERROR_CODES: Dict[str, str] = {
+    # -- single-replica shed / failure classes (serve/queue.py, lifecycle)
+    "queue.shed.deadline": (
+        "request deadline expired while queued — the server is saturated"
+    ),
+    "queue.shed.backpressure": (
+        "submit rejected on a full queue — retry with backoff or add capacity"
+    ),
+    "queue.shed.draining": (
+        "server draining for shutdown — retry against another replica"
+    ),
+    "queue.shed.memory": (
+        "submit shed by the memory admission gate (low priority or "
+        "predicted bytes over headroom)"
+    ),
+    "exec.hung": (
+        "dispatch exceeded its hang deadline; the model's breaker tripped"
+    ),
+    "shed.breaker": (
+        "the model's circuit breaker is open — retry after its cooldown"
+    ),
+    # -- router failover codes (serve/router.py) ---------------------------
+    "router.no_replicas": (
+        "no live serving replica owns the request's ring key"
+    ),
+    "router.replica_unreachable": (
+        "the owning replica's transport is down (killed or partitioned)"
+    ),
+    "router.failover_exhausted": (
+        "every eligible ring replica failed within the failover budget"
+    ),
+    "router.deadline": (
+        "the request's overall deadline lapsed across failover attempts"
+    ),
+    # -- serve CLI connection hygiene (serve/__main__.py TCP mode) ---------
+    "serve.conn_limit": (
+        "connection rejected: the TCP server is at --max-connections"
+    ),
+    "serve.conn_idle": (
+        "connection closed: no line arrived within --conn-read-timeout-s"
+    ),
+}
+
+
+def is_registered(code: str) -> bool:
+    return code in ERROR_CODES
+
+
+def grammar_ok(code: str) -> bool:
+    return bool(CODE_GRAMMAR.match(code))

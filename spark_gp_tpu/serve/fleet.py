@@ -1,0 +1,609 @@
+"""Fleet membership, the consistent-hash ring, and fleet-wide canary
+coordination over the PR 5 KV/coord plane.
+
+Every serve mechanism built so far — hot-swap registry, canary, drain,
+breaker, watchdog, memory-plan admission — lives inside ONE process,
+so one wedged replica was a total outage.  This module is the control
+plane that turns N such processes into a tier:
+
+* :class:`FleetMembership` — replica registration, heartbeat liveness
+  and **generation-stamped membership** over the coordination KV plane
+  (``parallel/coord.py``).  Liveness reuses the exact
+  :class:`~spark_gp_tpu.parallel.coord.HeartbeatMonitor` semantics via
+  the shared :class:`~spark_gp_tpu.parallel.coord.LivenessLedger`:
+  *straggler* past 3 intervals without a fresh stamp, *dead* past 10,
+  recovery on the next stamp — and every read is a non-blocking
+  ``dir_get``, so a membership sweep can never hang past a deadline.
+  The generation counter bumps on every join/leave/state change; routers
+  stamp their views with it, so a stale view is detectable and a
+  restarted router recovers the full membership from the store alone;
+* :class:`HashRing` — consistent hashing of ``(model, bucket)`` keys
+  over replica ids (vnodes for balance): removing a replica moves only
+  its own keys, and the clockwise successor order IS the failover order
+  the router walks;
+* :class:`LocalReplica` — one in-process serve replica bound to
+  membership: the tier-1 / chaos-soak replica (a production replica is
+  the same wiring with the CLI process's server and a TCP address in
+  the member record).  ``kill()`` is the SIGKILL analogue the chaos
+  injectors (``resilience/chaos.py``) drive: transport unreachable,
+  heartbeats stop, queued work failed fast;
+* :class:`FleetCanary` — the fleet-wide rollout state machine: every
+  replica runs its LOCAL canary (shadow-scoring against its incumbent,
+  local auto-ROLLBACK armed) but local auto-promotion is disabled;
+  replicas publish their observations to the KV plane and the
+  adjudicator promotes only when **all** live replicas' shadow scores
+  cleared the guard bar — while a single local breach/rollback is a
+  SPLIT verdict that rolls the candidate back on every replica.
+
+Observability: ``fleet.*`` counters/events ride the process-global
+runtime telemetry (the ``coord.*`` pattern); the router's per-replica
+gauges live on its own metrics page (``serve/router.py``).  All keys
+are catalogued in ``obs/names.py``; docs/SERVING.md "Fleet" has the
+architecture.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import uuid
+from bisect import bisect_right
+from typing import Dict, List, Optional, Sequence, Union
+
+from spark_gp_tpu.obs import trace as obs_trace
+from spark_gp_tpu.parallel import coord
+
+
+def _bump(key: str, n: float = 1.0) -> None:
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    telemetry.inc(key, n=n)  # metric-name-ok (concrete key from the caller)
+
+
+# --------------------------------------------------------------------------
+# consistent-hash ring
+# --------------------------------------------------------------------------
+
+
+class HashRing:
+    """Consistent hash of ``(model, bucket)`` keys over replica ids.
+
+    ``vnodes`` virtual points per replica smooth the key distribution;
+    :meth:`owners` returns the owner followed by each DISTINCT clockwise
+    successor — the router's failover preference order.  The hash is
+    blake2b (stable across processes and Python builds, unlike
+    ``hash()``), so every router instance — including one rebuilt after
+    a restart — computes the identical assignment.
+    """
+
+    def __init__(self, nodes: Sequence[str], vnodes: int = 64) -> None:
+        self.nodes = sorted(set(str(n) for n in nodes))
+        self._points = sorted(
+            (self._hash(f"{node}#{i}"), node)
+            for node in self.nodes
+            for i in range(int(vnodes))
+        )
+        self._hashes = [h for h, _ in self._points]
+
+    @staticmethod
+    def _hash(value: str) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(value.encode("utf-8"), digest_size=8).digest(),
+            "big",
+        )
+
+    def owners(self, key: str, count: Optional[int] = None) -> List[str]:
+        """Preference order for ``key``: owner first, then distinct
+        successors clockwise (at most ``count`` replicas; all by default)."""
+        if not self._points:
+            return []
+        want = len(self.nodes) if count is None else min(
+            int(count), len(self.nodes)
+        )
+        start = bisect_right(self._hashes, self._hash(key))
+        out: List[str] = []
+        for offset in range(len(self._points)):
+            node = self._points[(start + offset) % len(self._points)][1]
+            if node not in out:
+                out.append(node)
+                if len(out) >= want:
+                    break
+        return out
+
+
+# --------------------------------------------------------------------------
+# membership
+# --------------------------------------------------------------------------
+
+
+class FleetMembership:
+    """Replica registration + heartbeat liveness + generation-stamped
+    membership over a coord-plane KV client.
+
+    KV schema (all under ``fleet/<fleet>/``): ``members/<rid>`` holds the
+    JSON member record (id, address, state, pid, the generation it was
+    written at); ``heartbeat/<rid>`` holds ``{"n": k, "t": ...}`` stamp
+    counters; ``generation`` is the monotonic membership generation.
+    Routers read via ``dir_get`` only — non-blocking, so a sweep never
+    hangs — and replicas write; the clock is the client's own
+    (injectable on :class:`~spark_gp_tpu.parallel.coord.
+    InProcessCoordClient`, so verdict tests need no real waiting).
+    """
+
+    def __init__(
+        self,
+        client,
+        fleet: str = "default",
+        interval_s: Optional[float] = None,
+        straggler_after_s: Optional[float] = None,
+        dead_after_s: Optional[float] = None,
+    ) -> None:
+        self.client = client
+        self.fleet = str(fleet)
+        self.interval_s = (
+            coord.heartbeat_interval_s() if interval_s is None
+            else float(interval_s)
+        )
+        self.straggler_after_s = (
+            3.0 * self.interval_s if straggler_after_s is None
+            else float(straggler_after_s)
+        )
+        self.dead_after_s = (
+            10.0 * self.interval_s if dead_after_s is None
+            else float(dead_after_s)
+        )
+        self._ledger = coord.LivenessLedger(
+            self.straggler_after_s,
+            self.dead_after_s,
+            on_straggler=lambda rid, age: (
+                _bump("fleet.replica_stragglers"),
+                obs_trace.add_event(
+                    "fleet.replica_straggler", replica=rid, stamp_age_s=age
+                ),
+            ),
+            on_dead=lambda rid, age: (
+                _bump("fleet.replica_deaths"),
+                obs_trace.add_event(
+                    "fleet.replica_dead", replica=rid, stamp_age_s=age
+                ),
+            ),
+            on_recover=lambda rid: obs_trace.add_event(
+                "fleet.replica_recovered", replica=rid
+            ),
+        )
+        self._beats: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._last_poll: Optional[float] = None
+        # unique writer token for generation markers (see generation())
+        self._token = uuid.uuid4().hex[:12]
+        self._gen_seq = 0
+        #: the newest generation this process has observed — the "coord
+        #: liveness era" the serve ``health`` verb reports for a bound
+        #: replica (verdict attribution, ISSUE 12)
+        self.last_known_generation = 0
+
+    def _key(self, *parts: str) -> str:
+        return "/".join(("fleet", self.fleet) + parts)
+
+    def _get_now(self, key: str) -> Optional[bytes]:
+        """Non-blocking single-key read: ``dir_get`` on the exact key (a
+        prefix match includes it), so a membership sweep never waits on
+        the KV plane — the property every deadline proof here rests on."""
+        for found, raw in self.client.dir_get(key).items():
+            if found == key:
+                return raw
+        return None
+
+    # -- generation --------------------------------------------------------
+    # The generation is a marker COUNT, not a read-modify-write counter:
+    # every membership change writes one new key under ``genlog/`` (the
+    # writer's unique token + a local sequence — two writers can never
+    # collide on a key), and ``generation()`` is the number of markers.
+    # Concurrent joins from separate replica processes therefore each
+    # advance the generation (no lost update, no CAS needed on a KV
+    # plane that has none); growth is one tiny key per membership
+    # change, which is rare by construction.
+    def generation(self) -> int:
+        return len(self.client.dir_get(self._key("genlog") + "/"))
+
+    def _bump_generation(self) -> int:
+        with self._lock:
+            self._gen_seq += 1
+            seq = self._gen_seq
+        self.client.set(
+            self._key("genlog", f"{self._token}-{seq}"), b"1"
+        )
+        gen = self.generation()
+        self.last_known_generation = gen
+        return gen
+
+    # -- replica side ------------------------------------------------------
+    def register(self, replica_id: str, address: str = "",
+                 state: str = "serving", pid: Optional[int] = None) -> int:
+        """Publish one replica's member record and its first heartbeat;
+        returns the new membership generation."""
+        replica_id = str(replica_id)
+        gen = self._bump_generation()
+        record = {
+            "replica_id": replica_id,
+            "address": str(address),
+            "state": str(state),
+            "pid": int(os.getpid() if pid is None else pid),
+            "generation": gen,
+        }
+        self.client.set(
+            self._key("members", replica_id), json.dumps(record).encode()
+        )
+        self.heartbeat(replica_id)
+        _bump("fleet.joins")
+        obs_trace.add_event(
+            "fleet.member_joined", replica=replica_id, generation=gen
+        )
+        return gen
+
+    def set_state(self, replica_id: str, state: str) -> int:
+        """Flip a member's state (``serving`` -> ``draining``): the next
+        router poll drops it from the ring, so its keys migrate to the
+        clockwise successors BEFORE the replica exits."""
+        replica_id = str(replica_id)
+        record = self.members().get(replica_id)
+        if record is None:
+            raise KeyError(f"no fleet member {replica_id!r} to update")
+        gen = self._bump_generation()
+        record.update(state=str(state), generation=gen)
+        self.client.set(
+            self._key("members", replica_id), json.dumps(record).encode()
+        )
+        return gen
+
+    def deregister(self, replica_id: str) -> int:
+        replica_id = str(replica_id)
+        self.client.delete(self._key("members", replica_id))
+        self.client.delete(self._key("heartbeat", replica_id))
+        self._ledger.forget(replica_id)
+        gen = self._bump_generation()
+        _bump("fleet.leaves")
+        obs_trace.add_event(
+            "fleet.member_left", replica=replica_id, generation=gen
+        )
+        return gen
+
+    def heartbeat(self, replica_id: str) -> None:
+        replica_id = str(replica_id)
+        with self._lock:
+            n = self._beats.get(replica_id, 0) + 1
+            self._beats[replica_id] = n
+        self.client.set(
+            self._key("heartbeat", replica_id),
+            json.dumps({"n": n, "t": self.client.clock()}).encode(),
+        )
+
+    # -- router-side view --------------------------------------------------
+    def members(self) -> Dict[str, dict]:
+        prefix = self._key("members") + "/"
+        out: Dict[str, dict] = {}
+        for key, raw in self.client.dir_get(prefix).items():
+            try:
+                out[key[len(prefix):]] = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return out
+
+    def poll(self) -> dict:
+        """One membership/liveness sweep — non-blocking reads only, never
+        a wait past a deadline: read member records + heartbeat stamps,
+        escalate straggler/dead verdicts through the shared ledger, and
+        return the generation-stamped view the router routes on."""
+        now = self.client.clock()
+        members = self.members()
+        # forget ledger state for identities no longer registered: a
+        # replica that politely DEREGISTERED must not age into a false
+        # dead verdict in every OTHER process's ledger (and churn must
+        # not grow the ledger forever)
+        for ident in set(self._ledger.last_seen()) - set(members):
+            self._ledger.forget(ident)
+        prefix = self._key("heartbeat") + "/"
+        stamps: Dict[object, int] = {}
+        for key, raw in self.client.dir_get(prefix).items():
+            try:
+                stamps[key[len(prefix):]] = int(
+                    json.loads(raw.decode())["n"]
+                )
+            except (ValueError, KeyError, UnicodeDecodeError):
+                continue
+        self._ledger.observe(now, stamps, expected=list(members))
+        dead = set(self._ledger.dead()) & set(members)
+        gen = max(
+            [self.generation()]
+            + [int(r.get("generation", 0)) for r in members.values()]
+        )
+        self.last_known_generation = gen
+        self._last_poll = now
+        return {
+            "generation": gen,
+            "members": members,
+            "live": sorted(
+                rid for rid, rec in members.items()
+                if rec.get("state") == "serving" and rid not in dead
+            ),
+            "draining": sorted(
+                rid for rid, rec in members.items()
+                if rec.get("state") == "draining"
+            ),
+            "dead": sorted(dead),
+            "stragglers": sorted(
+                set(self._ledger.stragglers()) & set(members)
+            ),
+        }
+
+    def snapshot(self) -> dict:
+        """The latest flags without a fresh sweep (health surfaces)."""
+        return {
+            "fleet": self.fleet,
+            "generation": self.last_known_generation,
+            "interval_s": self.interval_s,
+            "stragglers": sorted(str(r) for r in self._ledger.stragglers()),
+            "dead": sorted(str(r) for r in self._ledger.dead()),
+        }
+
+
+def bind_server(server, replica_id: str, membership: FleetMembership) -> None:
+    """Attach fleet identity to a serve server: the ``health`` verb then
+    reports ``replica_id`` + the membership generation (the coord-plane
+    era), so a router or ``gpctl`` can attribute a verdict to exactly
+    this process."""
+    server.replica_id = str(replica_id)
+    server.fleet_binding = {
+        "fleet": membership.fleet,
+        "membership": membership,
+    }
+
+
+class LocalReplica:
+    """One in-process serve replica bound to fleet membership — the
+    tier-1 / chaos-soak replica.  A production replica is the same
+    wiring with the CLI process's server and a TCP address in the member
+    record (``serve/router.TcpReplicaTransport`` dials it)."""
+
+    def __init__(self, server, replica_id: str,
+                 membership: FleetMembership, address: str = "") -> None:
+        from spark_gp_tpu.serve.router import LocalReplicaTransport
+
+        self.server = server
+        self.replica_id = str(replica_id)
+        self.membership = membership
+        self.address = str(address)
+        #: False once killed/hung: a wedged or dead process stamps nothing
+        self.alive = True
+        self.transport = LocalReplicaTransport(server, self.replica_id)
+
+    def register(self) -> int:
+        gen = self.membership.register(self.replica_id, address=self.address)
+        bind_server(self.server, self.replica_id, self.membership)
+        return gen
+
+    def heartbeat(self) -> None:
+        if self.alive:
+            self.membership.heartbeat(self.replica_id)
+
+    def begin_drain(self) -> int:
+        """Graceful exit, fleet-aware: the server stops taking new work
+        (``code=queue.shed.draining``) AND the member record flips to
+        ``draining`` — the next router poll migrates this replica's ring
+        keys to its successors while in-flight work completes."""
+        self.server.begin_drain()
+        return self.membership.set_state(self.replica_id, "draining")
+
+    def kill(self) -> None:
+        """The SIGKILL analogue (driven by ``resilience/chaos.py``):
+        transport unreachable, heartbeats stop, queued and in-flight
+        futures failed fast — the router must re-route every affected
+        request within its deadline."""
+        self.alive = False
+        self.transport.kill()
+        self.server.stop(drain=False)
+
+    def stop(self) -> None:
+        if self.alive:
+            try:
+                self.membership.deregister(self.replica_id)
+            except Exception:  # noqa: BLE001 — teardown must not mask the
+                pass           # test/campaign failure being unwound
+        # unconditional: a hung (alive=False, released) replica still has
+        # a batcher thread to join; a killed one's stop() is a no-op
+        self.server.stop()
+
+
+# --------------------------------------------------------------------------
+# fleet-wide canary
+# --------------------------------------------------------------------------
+
+
+class FleetCanary:
+    """Fleet-wide canary rollout over the KV plane.
+
+    State machine (docs/SERVING.md "Fleet"):
+
+    * ``start`` begins the LOCAL canary on every replica with local
+      auto-promotion disabled (``promote_after`` effectively infinite)
+      but local auto-ROLLBACK armed — a replica seeing a guard-bar
+      breach or elevated candidate errors protects itself immediately,
+      without waiting for the fleet;
+    * each replica ``publish``-es its canary observations
+      (``fleet/<f>/canary/<model>/replica/<rid>``);
+    * ``adjudicate`` promotes only when EVERY expected replica reports
+      ``scoring`` with ``clean_scores >= promote_after`` — and declares
+      a SPLIT verdict (rollback everywhere) the moment ANY replica
+      reports a breach/local rollback;
+    * the verdict is written once (``.../verdict``) and ``apply`` is
+      idempotent per replica: promote moves the local latest pointer
+      (:meth:`CanaryController.force_promote`), rollback cancels +
+      quarantines the local candidate.
+    """
+
+    #: local promote_after under fleet control: never auto-promote locally
+    LOCAL_PROMOTE_NEVER = 10 ** 9
+
+    def __init__(self, client, fleet: str = "default",
+                 promote_after: int = 10) -> None:
+        self.client = client
+        self.fleet = str(fleet)
+        self.promote_after = int(promote_after)
+
+    def _key(self, *parts: str) -> str:
+        return "/".join(("fleet", self.fleet, "canary") + parts)
+
+    def start(
+        self,
+        servers: Dict[str, object],
+        model: str,
+        path: Union[str, Dict[str, str]],
+        fraction: float = 0.1,
+        max_errors: int = 3,
+        delta_predict_bar: Optional[float] = None,
+    ) -> None:
+        """Begin the rollout on every replica.  ``path`` may be one
+        artifact for the whole fleet or a per-replica dict (chaos tests
+        stage a divergent candidate on one replica that way)."""
+        from spark_gp_tpu.serve.lifecycle import CanaryPolicy
+
+        extra = (
+            {} if delta_predict_bar is None
+            else {"delta_predict_bar": float(delta_predict_bar)}
+        )
+        policy = CanaryPolicy(
+            fraction=fraction, max_errors=max_errors,
+            promote_after=self.LOCAL_PROMOTE_NEVER, **extra,
+        )
+        # a fresh experiment clears the previous one's verdict + reports
+        self.client.delete(self._key(model, "verdict"))
+        prefix = self._key(model, "replica") + "/"
+        for key in list(self.client.dir_get(prefix)):
+            self.client.delete(key)
+        for rid, server in servers.items():
+            source = path if isinstance(path, str) else path[rid]
+            server.register(model, source, canary_policy=policy)
+
+    def publish(self, replica_id: str, model: str, server) -> dict:
+        """One replica's canary observations onto the KV plane."""
+        active = server.canaries.active(model)
+        if active is not None:
+            state = {
+                "state": "scoring",
+                "candidate": active["candidate"],
+                "clean_scores": active["clean_scores"],
+                "errors": active["errors"],
+                "max_delta": active["max_delta"],
+            }
+        else:
+            quarantined = server.canaries.snapshot()["quarantined"]
+            breached = sorted(
+                key for key in quarantined if key.startswith(f"{model}:")
+            )
+            state = (
+                {"state": "breach", "quarantined": breached}
+                if breached else {"state": "idle"}
+            )
+        self.client.set(
+            self._key(model, "replica", str(replica_id)),
+            json.dumps(state).encode(),
+        )
+        return state
+
+    def _reports(self, model: str) -> Dict[str, dict]:
+        prefix = self._key(model, "replica") + "/"
+        out: Dict[str, dict] = {}
+        for key, raw in self.client.dir_get(prefix).items():
+            try:
+                out[key[len(prefix):]] = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return out
+
+    def adjudicate(self, model: str,
+                   replica_ids: Sequence[str]) -> Optional[str]:
+        """The fleet verdict, or None while still scoring: ANY breach is
+        a split verdict (rollback everywhere); promote only when EVERY
+        expected replica cleared the bar."""
+        existing = self.verdict(model)
+        if existing is not None:
+            return existing["verdict"]
+        reports = self._reports(model)
+        if any(rep.get("state") == "breach" for rep in reports.values()):
+            split = sorted(
+                rid for rid, rep in reports.items()
+                if rep.get("state") == "breach"
+            )
+            return self._record(
+                model, "rollback",
+                f"split verdict: replica(s) {split} breached/rolled back",
+            )
+        expected = [str(r) for r in replica_ids]
+        if any(rid not in reports for rid in expected):
+            return None
+        if all(
+            rep.get("state") == "scoring"
+            and int(rep.get("clean_scores", 0)) >= self.promote_after
+            for rep in reports.values()
+        ):
+            return self._record(
+                model, "promote",
+                f"all {len(reports)} replicas cleared "
+                f"{self.promote_after} shadow scores",
+            )
+        return None
+
+    def _record(self, model: str, verdict: str, reason: str) -> str:
+        self.client.set(
+            self._key(model, "verdict"),
+            json.dumps({"verdict": verdict, "reason": reason}).encode(),
+        )
+        if verdict == "promote":
+            _bump("fleet.canary_promotions")
+            obs_trace.add_event(
+                "fleet.canary_promote", model=model, reason=reason
+            )
+        else:
+            _bump("fleet.canary_rollbacks")
+            obs_trace.add_event(
+                "fleet.canary_rollback", model=model, reason=reason
+            )
+        return verdict
+
+    def verdict(self, model: str) -> Optional[dict]:
+        key = self._key(model, "verdict")
+        for found, raw in self.client.dir_get(key).items():
+            if found != key:
+                continue
+            try:
+                return json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                return None
+        return None
+
+    def apply(self, replica_id: str, model: str, server) -> Optional[str]:
+        """Execute the recorded verdict on one replica (idempotent: a
+        replica that already rolled back locally is a no-op)."""
+        recorded = self.verdict(model)
+        if recorded is None:
+            return None
+        if recorded["verdict"] == "promote":
+            server.canaries.force_promote(model)
+        else:
+            server.canaries.cancel(
+                model, reason=f"fleet-wide rollback: {recorded['reason']}"
+            )
+        return recorded["verdict"]
+
+    def pump(self, model: str, servers: Dict[str, object]) -> Optional[str]:
+        """publish + adjudicate + apply in one deterministic turn — the
+        loop a fleet controller runs between traffic bursts."""
+        for rid, server in servers.items():
+            self.publish(rid, model, server)
+        verdict = self.adjudicate(model, list(servers))
+        if verdict is not None:
+            for rid, server in servers.items():
+                self.apply(rid, model, server)
+        return verdict

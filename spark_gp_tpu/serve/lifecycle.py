@@ -636,6 +636,20 @@ class CanaryController:
                 name, version, reason="elevated error rate on the candidate"
             )
 
+    def force_promote(self, name: str) -> bool:
+        """Promote the ACTIVE canary now, regardless of its local
+        clean-score count — the fleet-wide verdict's entry point
+        (``serve/fleet.py``): the guard bar was cleared on EVERY replica,
+        which local counters cannot see.  False when no canary is
+        active (idempotent across the fleet's apply loop)."""
+        with self._lock:
+            canary = self._canaries.get(name)
+            if canary is None:
+                return False
+            version = canary.candidate
+        self._promote(name, version)
+        return True
+
     # -- transitions ------------------------------------------------------
     def _rollback(self, name: str, version, reason: str) -> None:
         with self._lock:

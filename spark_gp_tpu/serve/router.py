@@ -1,0 +1,731 @@
+"""The fleet front-end: consistent-hash routing, per-request failover,
+hedged re-dispatch, and one aggregated fleet OpenMetrics page.
+
+:class:`FleetRouter` consistent-hashes ``(model, bucket)`` onto the live
+replica set published through :class:`~spark_gp_tpu.serve.fleet.
+FleetMembership` and walks the ring's successor order on failure.
+Robustness is the contract:
+
+* **bounded jittered failover** — a dispatch that fails with an
+  INFRASTRUCTURE verdict (dead transport, open breaker, drain,
+  backpressure, hang, replica deadline — :func:`failover_eligible`) is
+  re-dispatched onto the next ring replica after a jittered backoff, at
+  most ``failover_attempts`` extra times; client errors (bad shape,
+  unknown model) are never retried — no replica answers those
+  differently;
+* **hedged re-dispatch** — with ``hedge_after_s`` set, a request stuck
+  on a straggling replica past that bar gets a duplicate dispatch to
+  the next successor (same ``request_id``, so server-side spans and
+  incident bundles attribute both legs to one logical request); the
+  first answer wins and the loser is abandoned;
+* **deadline, always** — every router request carries a deadline; the
+  terminal outcomes are an answer or ONE classified error
+  (``router.failover_exhausted`` / ``router.deadline`` /
+  ``router.no_replicas`` — ``serve/codes.py``), never a hang;
+* **drain-aware rebalancing** — a replica whose member record flips to
+  ``draining`` leaves the ring at the next membership poll, so its keys
+  migrate to the clockwise successors while its in-flight work
+  completes;
+* **restart recovery** — a fresh router over the same KV store rebuilds
+  membership, generation and ring with no replica involvement
+  (``transport_factory`` re-dials each member record's address);
+* **scaling signals** — :meth:`sample_fleet` aggregates every replica's
+  queue pressure and memory-gate state onto the router's own metrics
+  page (``fleet.queue_pressure.*`` per-replica gauges plus one
+  ``fleet.scale_up`` signal), so one scrape answers "does this fleet
+  need another replica".
+
+The router is threadless by construction: it waits on the replicas' own
+futures in small slices (the serve queue completes every future —
+answered, deadline-expired or shutdown-errored), so there is no pool to
+wedge and nothing to leak.  Clock and sleep are injectable for
+deterministic tests.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from spark_gp_tpu.obs import trace as obs_trace
+from spark_gp_tpu.resilience.breaker import BreakerOpenError
+from spark_gp_tpu.serve.batcher import bucket_sizes
+from spark_gp_tpu.serve.fleet import FleetMembership, HashRing
+from spark_gp_tpu.serve.lifecycle import DrainingError, ExecHungError
+from spark_gp_tpu.serve.metrics import ServingMetrics
+from spark_gp_tpu.serve.queue import (
+    QueueFullError,
+    RequestTimeoutError,
+    ServeFuture,
+)
+
+
+class ReplicaUnreachableError(ConnectionError):
+    """The replica's transport is down (killed process, partition)."""
+
+    code = "router.replica_unreachable"
+
+    def __init__(self, replica_id: str) -> None:
+        self.replica_id = str(replica_id)
+        super().__init__(f"replica {replica_id!r} is unreachable")
+
+
+class NoReplicasError(RuntimeError):
+    """No live serving replica owns the request's ring key."""
+
+    code = "router.no_replicas"
+
+    def __init__(self, model: str) -> None:
+        super().__init__(
+            f"no live serving replica available for model {model!r}"
+        )
+
+
+class FailoverExhaustedError(RuntimeError):
+    """Every eligible ring replica failed within the failover budget.
+    Carries the per-attempt ``(replica_id, code)`` trail."""
+
+    code = "router.failover_exhausted"
+
+    def __init__(self, model: str, attempts) -> None:
+        self.attempts = tuple(attempts)
+        trail = "; ".join(f"{rid}: {code}" for rid, code in self.attempts)
+        super().__init__(
+            f"request for model {model!r} failed on every attempted ring "
+            f"replica ({trail or 'no replica accepted the dispatch'})"
+        )
+
+
+class RouterDeadlineError(TimeoutError):
+    """The request's overall deadline lapsed across failover attempts."""
+
+    code = "router.deadline"
+
+    def __init__(self, model: str, timeout_s: float, attempts) -> None:
+        self.attempts = tuple(attempts)
+        super().__init__(
+            f"request for model {model!r} exceeded its {timeout_s:.3f}s "
+            f"deadline after {len(self.attempts)} failed attempt(s)"
+        )
+
+
+class WireError(RuntimeError):
+    """A replica's error reply over the wire, code preserved so failover
+    eligibility works identically for local and TCP transports."""
+
+    def __init__(self, message: str, code: Optional[str] = None) -> None:
+        self.code = code
+        super().__init__(message)
+
+
+#: wire codes that justify re-dispatching to the NEXT ring replica: the
+#: replica (not the request) is the problem — another one may answer
+_FAILOVER_CODES = frozenset({
+    "queue.shed.draining",
+    "queue.shed.backpressure",
+    "queue.shed.deadline",
+    "queue.shed.memory",
+    "exec.hung",
+    "shed.breaker",
+    "router.replica_unreachable",
+    "serve.conn_idle",
+    "serve.conn_limit",
+})
+
+
+def failover_eligible(exc: BaseException) -> bool:
+    """Whether an error from ONE replica justifies failover: dead owner,
+    breaker-open, drain, overload shed, hang, or a replica-side deadline
+    are; client errors (bad shape, unknown model/version, poisoned
+    payload) are not — no replica will answer those differently."""
+    if isinstance(exc, (
+        ReplicaUnreachableError, ConnectionError, BreakerOpenError,
+        DrainingError, ExecHungError, QueueFullError, RequestTimeoutError,
+        OSError,
+    )):
+        return True
+    code = getattr(exc, "code", None)
+    if code is not None:
+        return code in _FAILOVER_CODES
+    # a SIGKILLed replica's queue fails its leftovers with the shutdown
+    # error before the membership verdict lands — that is the replica
+    # dying, not the request being wrong
+    return isinstance(exc, RuntimeError) and "shut down" in str(exc)
+
+
+# --------------------------------------------------------------------------
+# transports
+# --------------------------------------------------------------------------
+
+
+class LocalReplicaTransport:
+    """In-process transport over a :class:`GPServeServer` — the tier-1 /
+    chaos-soak replica leg.  ``submit`` returns the server's own
+    :class:`ServeFuture`; ``kill()`` makes the transport unreachable
+    (the chaos SIGKILL analogue)."""
+
+    kind = "local"
+
+    def __init__(self, server, replica_id: str) -> None:
+        self.server = server
+        self.replica_id = str(replica_id)
+        self._killed = False
+
+    @property
+    def unusable(self) -> bool:
+        """True once killed: the router's re-dial sweep may replace this
+        transport through its factory (an in-process 'restart')."""
+        return self._killed
+
+    def submit(self, model: str, x, timeout_ms=None, request_id=None,
+               priority: int = 0, version=None) -> ServeFuture:
+        if self._killed:
+            raise ReplicaUnreachableError(self.replica_id)
+        return self.server.submit(
+            model, x, version=version, timeout_ms=timeout_ms,
+            priority=priority, request_id=request_id,
+        )
+
+    def health(self) -> dict:
+        if self._killed:
+            raise ReplicaUnreachableError(self.replica_id)
+        return self.server.health()
+
+    def kill(self) -> None:
+        self._killed = True
+
+    def close(self) -> None:
+        pass
+
+
+class TcpReplicaTransport:
+    """JSON-lines client of one ``python -m spark_gp_tpu.serve --port``
+    replica: one persistent connection, a reader thread routing replies
+    by ``id`` into :class:`ServeFuture` instances, errors mapped back to
+    :class:`WireError` with the wire ``code`` preserved.  Any socket
+    failure marks the transport dead and fails every pending future with
+    :class:`ReplicaUnreachableError` — exactly the failover-eligible
+    verdict the router needs."""
+
+    kind = "tcp"
+
+    def __init__(self, address: str, replica_id: str,
+                 connect_timeout_s: float = 5.0) -> None:
+        host, _, port = str(address).rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.replica_id = str(replica_id)
+        self._connect_timeout_s = float(connect_timeout_s)
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._pending: Dict[int, ServeFuture] = {}
+        self._health_waiters: List[ServeFuture] = []
+        self._next_id = 0
+        self._dead = False
+        self._reader: Optional[threading.Thread] = None
+
+    @property
+    def unusable(self) -> bool:
+        """True after any socket failure: this instance never reconnects
+        (in-flight ids would be ambiguous across connections) — the
+        router drops it and re-dials a FRESH transport via its factory,
+        so a restarted replica becomes routable again."""
+        return self._dead
+
+    def _ensure_locked(self) -> None:
+        if self._dead:
+            raise ReplicaUnreachableError(self.replica_id)
+        if self._sock is not None:
+            return
+        try:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self._connect_timeout_s
+            )
+            self._sock.settimeout(None)
+            self._rfile = self._sock.makefile("r")
+        except OSError as exc:
+            self._dead = True
+            raise ReplicaUnreachableError(self.replica_id) from exc
+        self._reader = threading.Thread(
+            target=self._read_loop,
+            name=f"gp-router-reader-{self.replica_id}", daemon=True,
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                if msg.get("event") == "health":
+                    with self._lock:
+                        waiter = (
+                            self._health_waiters.pop(0)
+                            if self._health_waiters else None
+                        )
+                    if waiter is not None and not waiter.done():
+                        waiter.set_result(msg)
+                    continue
+                if "id" not in msg:
+                    continue  # listening/shutdown events on this stream
+                with self._lock:
+                    future = self._pending.pop(msg["id"], None)
+                if future is None or future.done():
+                    continue
+                if "error" in msg:
+                    future.set_error(
+                        WireError(msg["error"], code=msg.get("code"))
+                    )
+                else:
+                    var = msg.get("var")
+                    future.set_result((
+                        np.asarray(msg["mean"], dtype=np.float64),
+                        None if var is None
+                        else np.asarray(var, dtype=np.float64),
+                    ))
+        except (OSError, ValueError):
+            pass
+        self._fail_all()
+
+    def _fail_all(self) -> None:
+        with self._lock:
+            self._dead = True
+            pending = list(self._pending.values()) + self._health_waiters
+            self._pending.clear()
+            self._health_waiters = []
+        for future in pending:
+            if not future.done():
+                future.set_error(ReplicaUnreachableError(self.replica_id))
+
+    def _send(self, payload: dict) -> None:
+        data = (json.dumps(payload) + "\n").encode("utf-8")
+        # serialized: two client threads' lines must never interleave
+        with self._lock:
+            sock = self._sock
+        if sock is None:
+            raise ReplicaUnreachableError(self.replica_id)
+        try:
+            with self._send_lock:
+                sock.sendall(data)
+        except OSError as exc:
+            self._fail_all()
+            raise ReplicaUnreachableError(self.replica_id) from exc
+
+    def submit(self, model: str, x, timeout_ms=None, request_id=None,
+               priority: int = 0, version=None) -> ServeFuture:
+        with self._lock:
+            self._ensure_locked()
+            self._next_id += 1
+            req_id = self._next_id
+            future = ServeFuture()
+            self._pending[req_id] = future
+            payload = {
+                "id": req_id,
+                "model": model,
+                "x": np.asarray(x).tolist(),
+                "priority": int(priority),
+            }
+            if timeout_ms is not None:
+                payload["timeout_ms"] = float(timeout_ms)
+            if request_id is not None:
+                payload["request_id"] = str(request_id)
+            if version is not None:
+                payload["version"] = int(version)
+        self._send(payload)
+        return future
+
+    def health(self, timeout_s: float = 5.0) -> dict:
+        with self._lock:
+            self._ensure_locked()
+            waiter = ServeFuture()
+            self._health_waiters.append(waiter)
+        self._send({"cmd": "health"})
+        return waiter.result(timeout_s)
+
+    def close(self) -> None:
+        with self._lock:
+            sock, self._sock = self._sock, None
+            self._dead = True
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+# --------------------------------------------------------------------------
+# the router
+# --------------------------------------------------------------------------
+
+
+class FleetRouter:
+    """Consistent-hash front-end over N serve replicas (module docstring
+    has the robustness contract).
+
+    ``transports`` maps replica id -> transport for replicas known at
+    construction; ``transport_factory(replica_id, member_record)`` builds
+    one lazily for members discovered from the KV store (the restart
+    path).  Construction itself performs the first membership rebuild —
+    a router started against a populated store routes immediately.
+    """
+
+    def __init__(
+        self,
+        membership: FleetMembership,
+        transports: Optional[Dict[str, object]] = None,
+        *,
+        transport_factory=None,
+        max_batch: int = 256,
+        min_bucket: int = 8,
+        failover_attempts: int = 2,
+        backoff_s: float = 0.005,
+        backoff_jitter: float = 0.5,
+        hedge_after_s: Optional[float] = None,
+        default_timeout_ms: Optional[float] = 1000.0,
+        vnodes: int = 64,
+        poll_interval_s: Optional[float] = None,
+        scale_pressure_bar: float = 0.7,
+        health_timeout_s: float = 1.0,
+        seed: int = 0,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        metrics: Optional[ServingMetrics] = None,
+    ) -> None:
+        self.membership = membership
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._transports: Dict[str, object] = dict(transports or {})
+        self._factory = transport_factory
+        self._buckets = bucket_sizes(max_batch, min_bucket)
+        self.failover_attempts = int(failover_attempts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_jitter = float(backoff_jitter)
+        self.hedge_after_s = (
+            None if hedge_after_s is None else float(hedge_after_s)
+        )
+        # the router ALWAYS has a deadline — "terminates within deadline
+        # with an answer or one classified error, never a hang" is the
+        # tier's core invariant, so a disabled client timeout still gets
+        # a (generous) router-side bound
+        self._default_timeout_s = (
+            30.0 if default_timeout_ms is None else default_timeout_ms / 1e3
+        )
+        self._vnodes = int(vnodes)
+        self._poll_interval_s = (
+            membership.interval_s if poll_interval_s is None
+            else float(poll_interval_s)
+        )
+        self._scale_bar = float(scale_pressure_bar)
+        self._health_timeout_s = float(health_timeout_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._view: dict = {}
+        self._ring = HashRing(())
+        self._last_poll: Optional[float] = None
+        self.rebuild()
+
+    # -- membership view ---------------------------------------------------
+    def _transport_for(self, replica_id: str, view: dict):
+        transport = self._transports.get(replica_id)
+        if (
+            transport is not None
+            and self._factory is not None
+            and getattr(transport, "unusable", False)
+        ):
+            # a transport that died (socket failure, one-shot connect
+            # error) must not shadow a RESTARTED replica forever: drop it
+            # and let the factory re-dial the member record.  Without a
+            # factory (statically-wired fleets) the dead transport stays
+            # — there is nothing to re-dial with.
+            close = getattr(transport, "close", None)
+            if close is not None:
+                close()
+            self._transports.pop(replica_id, None)
+            transport = None
+        if transport is None and self._factory is not None:
+            record = view["members"].get(replica_id, {})
+            try:
+                transport = self._factory(replica_id, record)
+            except Exception:  # noqa: BLE001 — an undialable member must
+                # not take the whole view down; it simply stays unroutable
+                transport = None
+            if transport is not None:
+                self._transports[replica_id] = transport
+        return transport
+
+    def _sync(self) -> dict:
+        view = self.membership.poll()
+        with self._lock:
+            self._view = view
+            routable = [
+                rid for rid in view["live"]
+                if self._transport_for(rid, view) is not None
+            ]
+            self._ring = HashRing(routable, vnodes=self._vnodes)
+            self._last_poll = self._clock()
+        return view
+
+    def rebuild(self) -> dict:
+        """(Re)build the routing view from the KV store — also the
+        router-RESTART path: a fresh router over the same store recovers
+        the full membership, generation and ring with no replica
+        involvement."""
+        view = self._sync()
+        self.metrics.inc("router.rebuilds")
+        self._set_fleet_gauges(view)
+        return view
+
+    def _refresh(self) -> None:
+        with self._lock:
+            stale = (
+                self._last_poll is None
+                or self._clock() - self._last_poll >= self._poll_interval_s
+            )
+        if stale:
+            self._sync()
+
+    def bucket_for(self, rows: int) -> int:
+        for bucket in self._buckets:
+            if rows <= bucket:
+                return bucket
+        return self._buckets[-1]
+
+    def route(self, model: str, rows: int) -> List[str]:
+        """The ring's preference order for this request's ``(model,
+        bucket)`` key: owner first, then the failover successors.
+        Refreshes the membership view first (rate-limited), so a drain
+        or death verdict rebalances the answer."""
+        self._refresh()
+        with self._lock:
+            return self._ring.owners(
+                f"{model}/{self.bucket_for(int(rows))}"
+            )
+
+    # -- request path ------------------------------------------------------
+    def predict(self, model: str, x, timeout_ms=None, request_id=None,
+                priority: int = 0, version=None):
+        """One logical request through the fleet: returns ``(mean, var)``
+        or raises ONE classified error — never hangs past the deadline."""
+        x = np.asarray(x)
+        rows = x.shape[0] if x.ndim == 2 else 1
+        timeout_s = (
+            self._default_timeout_s if timeout_ms is None
+            else timeout_ms / 1e3
+        )
+        started = self._clock()
+        deadline = started + timeout_s
+        order = self.route(model, rows)  # refreshes the membership view
+        self.metrics.inc("router.requests")
+        request_id = (
+            str(request_id) if request_id is not None
+            else f"fr-{uuid.uuid4().hex[:12]}"
+        )
+        if not order:
+            self.metrics.inc("router.failed")
+            raise NoReplicasError(model)
+
+        attempts: List[tuple] = []  # (replica_id, wire code / exc type)
+        pending: List[list] = []    # [replica_id, future, launched_at, hedged]
+        state = {"idx": 0, "dispatched": 0}
+        max_dispatches = min(len(order), self.failover_attempts + 1)
+
+        def note_failover(rid: str, exc: BaseException) -> None:
+            code = getattr(exc, "code", None) or type(exc).__name__
+            attempts.append((rid, code))
+            self.metrics.inc("router.failovers")
+            self.metrics.inc(f"router.replica_errors.{rid}")
+            obs_trace.add_event(
+                "router.failover", model=model, replica=rid, reason=code
+            )
+
+        def launch(hedged: bool = False) -> bool:
+            """Dispatch onto the next ring replica (one per call); a
+            submit-time failure counts as a failover attempt and falls
+            through to the successor."""
+            while (
+                state["idx"] < len(order)
+                and state["dispatched"] < max_dispatches
+            ):
+                rid = order[state["idx"]]
+                state["idx"] += 1
+                transport = self._transports.get(rid)
+                if transport is None:
+                    continue
+                if attempts and not hedged:
+                    # bounded jittered backoff before a failure-driven
+                    # re-dispatch (hedges skip it: speed is their point)
+                    self._backoff(deadline)
+                state["dispatched"] += 1
+                remaining_ms = max(1.0, (deadline - self._clock()) * 1e3)
+                try:
+                    future = transport.submit(
+                        model, x, timeout_ms=remaining_ms,
+                        request_id=request_id, priority=priority,
+                        version=version,
+                    )
+                except Exception as exc:  # noqa: BLE001 — classified below
+                    if not failover_eligible(exc):
+                        self.metrics.inc("router.failed")
+                        raise
+                    note_failover(rid, exc)
+                    continue
+                pending.append([rid, future, self._clock(), hedged])
+                if hedged:
+                    self.metrics.inc("router.hedges")
+                    obs_trace.add_event(
+                        "router.hedge", model=model, replica=rid
+                    )
+                return True
+            return False
+
+        launch()
+        while True:
+            now = self._clock()
+            if now >= deadline:
+                self.metrics.inc("router.failed")
+                raise RouterDeadlineError(model, timeout_s, attempts)
+            if not pending:
+                if not launch():
+                    self.metrics.inc("router.failed")
+                    raise FailoverExhaustedError(model, attempts)
+                continue
+            progressed = False
+            for entry in list(pending):
+                rid, future, _, hedged = entry
+                if not future.done():
+                    continue
+                pending.remove(entry)
+                progressed = True
+                try:
+                    mean, var = future.result(0)
+                except Exception as exc:  # noqa: BLE001 — classified below
+                    if not failover_eligible(exc):
+                        self.metrics.inc("router.failed")
+                        raise
+                    note_failover(rid, exc)
+                else:
+                    if hedged:
+                        self.metrics.inc("router.hedge_wins")
+                    self.metrics.observe(
+                        "router.request_latency_s", self._clock() - started
+                    )
+                    return mean, var
+            if progressed:
+                continue
+            if (
+                self.hedge_after_s is not None
+                and pending
+                and not any(entry[3] for entry in pending)
+                and now - pending[0][2] >= self.hedge_after_s
+            ):
+                # straggler: duplicate the dispatch onto the successor —
+                # first answer wins, the loser is abandoned
+                launch(hedged=True)
+                continue
+            self._sleep(min(0.002, max(0.0, deadline - now)))
+
+    def _backoff(self, deadline: float) -> None:
+        with self._lock:
+            jitter = float(self._rng.uniform(0.0, self.backoff_jitter))
+        pause = self.backoff_s * (1.0 + jitter)
+        self._sleep(max(0.0, min(pause, deadline - self._clock())))
+
+    # -- fleet page --------------------------------------------------------
+    def _set_fleet_gauges(self, view: dict) -> None:
+        self.metrics.set_gauge("fleet.replicas_live", float(len(view["live"])))
+        self.metrics.set_gauge(
+            "fleet.replicas_draining", float(len(view["draining"]))
+        )
+        self.metrics.set_gauge("fleet.replicas_dead", float(len(view["dead"])))
+        self.metrics.set_gauge("fleet.generation", float(view["generation"]))
+
+    def sample_fleet(self) -> dict:
+        """Aggregate per-replica scaling signals (queue pressure, memory
+        shedding) onto THIS router's metrics page; returns the sampled
+        view.  ``fleet.scale_up`` flips to 1 when mean live queue
+        pressure crosses the bar or any replica's memory gate sheds —
+        the one-number 'add a replica' signal."""
+        view = self._sync()
+        self._set_fleet_gauges(view)
+        pressures: Dict[str, float] = {}
+        shedding: Dict[str, bool] = {}
+        for rid in view["live"] + view["draining"]:
+            transport = self._transports.get(rid)
+            if transport is None:
+                continue
+            try:
+                # sub-default timeout where the transport supports one: a
+                # wedged-but-connected replica (the fleet_hang fault) must
+                # not stall the scrape by its full RPC timeout per replica
+                try:
+                    health = transport.health(
+                        timeout_s=self._health_timeout_s
+                    )
+                except TypeError:
+                    health = transport.health()
+            except Exception:  # noqa: BLE001 — a dying replica must not
+                continue       # fail the whole fleet scrape
+            pressures[rid] = float(
+                health.get("queue", {}).get("pressure", 0.0)
+            )
+            shedding[rid] = bool(
+                health.get("lifecycle", {}).get("memory", {}).get("shedding")
+            )
+            self.metrics.set_gauge(
+                f"fleet.queue_pressure.{rid}", pressures[rid]
+            )
+            self.metrics.set_gauge(
+                f"fleet.memory_shedding.{rid}",
+                1.0 if shedding[rid] else 0.0,
+            )
+        live_pressure = [
+            p for rid, p in pressures.items() if rid in view["live"]
+        ]
+        scale_up = bool(live_pressure) and (
+            sum(live_pressure) / len(live_pressure) > self._scale_bar
+            or any(shedding.values())
+        )
+        self.metrics.set_gauge("fleet.scale_up", 1.0 if scale_up else 0.0)
+        return {
+            "generation": view["generation"],
+            "live": view["live"],
+            "draining": view["draining"],
+            "dead": view["dead"],
+            "stragglers": view["stragglers"],
+            "queue_pressure": pressures,
+            "memory_shedding": shedding,
+            "scale_up": scale_up,
+        }
+
+    def openmetrics(self) -> str:
+        """The one fleet OpenMetrics page: router counters/histograms
+        plus the per-replica scaling gauges, freshly sampled."""
+        from spark_gp_tpu.obs.expo import render_openmetrics
+
+        self.sample_fleet()
+        return render_openmetrics(self.metrics)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            view = dict(self._view)
+        return {"view": view, "metrics": self.metrics.snapshot()}
+
+    def close(self) -> None:
+        for transport in self._transports.values():
+            close = getattr(transport, "close", None)
+            if close is not None:
+                close()

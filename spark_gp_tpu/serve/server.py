@@ -12,6 +12,7 @@ consumer makes the coalescing window race-free.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import List, Optional, Sequence
 
@@ -65,7 +66,17 @@ class GPServeServer:
         hang_timeout_s: Optional[float] = 30.0,
         memory_limit_bytes: Optional[float] = None,
         drain_deadline_s: float = 30.0,
+        replica_id: Optional[str] = None,
     ):
+        # replica identity (health verb + fleet attribution): explicit
+        # arg > GP_REPLICA_ID env > a pid-derived default — stable for
+        # the process's lifetime either way
+        self.replica_id = (
+            str(replica_id) if replica_id is not None
+            else os.environ.get("GP_REPLICA_ID") or f"replica-{os.getpid()}"
+        )
+        #: set by serve/fleet.bind_server when this process joins a fleet
+        self.fleet_binding: Optional[dict] = None
         self.metrics = metrics if metrics is not None else ServingMetrics()
         # one circuit breaker per model NAME (not version: a reload that
         # fixes the model closes the breaker through its half-open probe)
@@ -745,8 +756,31 @@ class GPServeServer:
             coord_live.get("dead") or coord_live.get("stragglers")
         ):
             status = "degraded" if status == "ok" else status
+        # replica identity (router/gpctl verdict attribution): who exactly
+        # answered — id, pid, build identity, and the fleet-membership
+        # generation (the coord-plane "era") this replica last observed
+        # when it is fleet-bound (serve/fleet.bind_server)
+        try:
+            from spark_gp_tpu.obs.runtime import build_info
+
+            identity_build = build_info()
+        except Exception:  # noqa: BLE001 — health must answer regardless
+            identity_build = {}
+        binding = self.fleet_binding
+        membership = None if binding is None else binding.get("membership")
+        replica = {
+            "replica_id": self.replica_id,
+            "pid": os.getpid(),
+            "build_info": identity_build,
+            "coord_era": (
+                None if membership is None
+                else int(getattr(membership, "last_known_generation", 0))
+            ),
+            **({"fleet": binding["fleet"]} if binding is not None else {}),
+        }
         return {
             **({"coord": coord_live} if coord_live is not None else {}),
+            "replica": replica,
             "status": status,
             "ready": self.ready(),
             "models": self.registry.names(),

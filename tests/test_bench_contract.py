@@ -230,6 +230,17 @@ def test_bench_emits_one_parseable_result_line():
     assert lc["drain_seconds"] > 0
     assert lc["drained_clean"] is True, lc
     assert lc["drain_burst_answered"] == lc["drain_burst_requests"], lc
+    # the fleet contract (ISSUE 12, serve/fleet.py + serve/router.py): a
+    # closed-loop client over a 3-replica consistent-hash fleet with the
+    # bucket owner SIGKILLed mid-burst answers EVERY request — zero
+    # failed requests, at least one failover re-route, sane p50 <= p99
+    fl = detail["fleet"]
+    assert "error" not in fl, fl
+    assert fl["replicas"] == 3
+    assert fl["failover_failed_requests"] == 0, fl
+    assert fl["requests_ok"] == fl["requests"], fl
+    assert fl["failovers"] >= 1, fl
+    assert 0 < fl["latency_p50_ms"] <= fl["latency_p99_ms"], fl
 
 
 @pytest.mark.slow

@@ -1,0 +1,88 @@
+"""Tier-1 wrapper for the wire error-code lint (tools/check_error_codes.py)
+and the catalog contract (spark_gp_tpu/serve/codes.py): every ``code=``
+string that can reach a client is grammar-clean and registered — the
+router failover codes included — so clients' retry/failover branching
+and dashboards' error-class slicing can never silently rot on a rename.
+"""
+
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import check_error_codes  # noqa: E402
+
+from spark_gp_tpu.serve import codes  # noqa: E402
+
+
+def test_error_code_lint_is_clean():
+    violations = check_error_codes.find_violations(
+        os.path.join(ROOT, "spark_gp_tpu")
+    )
+    assert violations == [], "\n".join(
+        f"{path}:{line}: {code!r}: {why}"
+        for path, line, code, why in violations
+    )
+
+
+def test_catalog_entries_are_grammar_clean():
+    for code, help_text in codes.ERROR_CODES.items():
+        assert codes.grammar_ok(code), code
+        assert help_text.strip(), code
+
+
+@pytest.mark.parametrize("required", [
+    # the shed classes clients retry/back off on
+    "queue.shed.deadline", "queue.shed.backpressure",
+    "queue.shed.draining", "queue.shed.memory", "exec.hung",
+    "shed.breaker",
+    # the router failover codes (ISSUE 12)
+    "router.no_replicas", "router.replica_unreachable",
+    "router.failover_exhausted", "router.deadline",
+    # TCP connection hygiene
+    "serve.conn_limit", "serve.conn_idle",
+])
+def test_required_codes_are_registered(required):
+    assert codes.is_registered(required), required
+
+
+def test_exception_classes_carry_cataloged_codes():
+    """The ``code`` attribute convention: every serve/router exception
+    class that puts a code on the wire is registered in the catalog."""
+    from spark_gp_tpu.resilience.breaker import BreakerOpenError
+    from spark_gp_tpu.serve.lifecycle import (
+        DrainingError,
+        ExecHungError,
+        MemoryPressureError,
+    )
+    from spark_gp_tpu.serve.queue import DeadlineExpiredError, QueueFullError
+    from spark_gp_tpu.serve.router import (
+        FailoverExhaustedError,
+        NoReplicasError,
+        ReplicaUnreachableError,
+        RouterDeadlineError,
+    )
+
+    for cls in (
+        BreakerOpenError, DrainingError, ExecHungError, MemoryPressureError,
+        DeadlineExpiredError, QueueFullError, FailoverExhaustedError,
+        NoReplicasError, ReplicaUnreachableError, RouterDeadlineError,
+    ):
+        assert codes.is_registered(cls.code), cls.__name__
+
+
+def test_lint_catches_an_unregistered_code(tmp_path):
+    """Falsifiability: a rogue code= emission is actually flagged."""
+    rogue = tmp_path / "rogue.py"
+    rogue.write_text(
+        "class Oops(RuntimeError):\n"
+        "    code = 'queue.shed.not_a_thing'\n"
+        "reply = {'error': 'x', 'code': 'Bad.Grammar'}\n"
+    )
+    violations = check_error_codes.find_violations(str(tmp_path))
+    found = {code for _, _, code, _ in violations}
+    assert found == {"queue.shed.not_a_thing", "Bad.Grammar"}

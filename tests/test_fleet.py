@@ -1,0 +1,670 @@
+"""Multi-replica serving fleet (spark_gp_tpu/serve/fleet.py + router.py):
+consistent-hash ring, generation-stamped membership + heartbeat verdicts,
+per-request failover with bounded jittered retry, hedged re-dispatch,
+drain-aware rebalancing, fleet-wide canary, and router restart recovery.
+
+Router logic is proven against scripted stub transports (no jax, no real
+waiting: clock and sleep are injectable); the end-to-end legs run real
+:class:`GPServeServer` replicas over an in-process KV store — the same
+rig the chaos soak (``tools/soak.py`` fleet_* scenarios) and bench's
+``fleet`` section drive.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.parallel.coord import (
+    InProcessCoordClient,
+    InProcessCoordStore,
+)
+from spark_gp_tpu.resilience import chaos
+from spark_gp_tpu.serve import GPServeServer
+from spark_gp_tpu.serve.fleet import (
+    FleetCanary,
+    FleetMembership,
+    HashRing,
+    LocalReplica,
+)
+from spark_gp_tpu.serve.lifecycle import DrainingError
+from spark_gp_tpu.serve.queue import ServeFuture
+from spark_gp_tpu.serve.router import (
+    FailoverExhaustedError,
+    FleetRouter,
+    NoReplicasError,
+    ReplicaUnreachableError,
+    RouterDeadlineError,
+    failover_eligible,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    """Injectable clock whose sleep advances time (the coord-test idiom):
+    deadlines and hedge timers resolve without real waiting."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+class StubTransport:
+    """Scripted replica transport: ``script`` maps call index -> one of
+    ``"ok"`` (answer), an exception instance (raised at submit), a
+    future-side exception wrapped in ``("error", exc)``, or ``"hang"``
+    (a future that never completes)."""
+
+    def __init__(self, replica_id, script=None, health=None):
+        self.replica_id = replica_id
+        self.script = script or {}
+        self.default = self.script.pop("default", "ok")
+        self._health = health or {"queue": {"pressure": 0.1}, "lifecycle": {}}
+        self.calls = []
+
+    def submit(self, model, x, timeout_ms=None, request_id=None,
+               priority=0, version=None):
+        action = self.script.get(len(self.calls), self.default)
+        self.calls.append((model, request_id))
+        if isinstance(action, BaseException):
+            raise action
+        future = ServeFuture()
+        if action == "hang":
+            return future
+        if isinstance(action, tuple) and action[0] == "error":
+            future.set_error(action[1])
+            return future
+        rows = np.asarray(x).shape[0]
+        future.set_result((np.full(rows, 7.0), np.full(rows, 0.5)))
+        return future
+
+    def health(self):
+        return self._health
+
+    def close(self):
+        pass
+
+
+def _membership(store=None, **kw):
+    defaults = dict(
+        fleet="t", interval_s=0.05, straggler_after_s=0.15,
+        dead_after_s=0.35,
+    )
+    defaults.update(kw)
+    return FleetMembership(
+        InProcessCoordClient(store or InProcessCoordStore(), 0, 1),
+        **defaults,
+    )
+
+
+def _router(membership, transports, clock=None, **kw):
+    defaults = dict(
+        max_batch=16, min_bucket=8, default_timeout_ms=2_000.0,
+        poll_interval_s=0.0, backoff_s=0.001,
+    )
+    defaults.update(kw)
+    if clock is not None:
+        defaults.update(clock=clock, sleep=clock.sleep)
+    return FleetRouter(membership, transports, **defaults)
+
+
+# -- hash ring -------------------------------------------------------------
+
+
+def test_ring_is_deterministic_and_orders_distinct_owners():
+    nodes = ["r0", "r1", "r2", "r3"]
+    a, b = HashRing(nodes), HashRing(list(reversed(nodes)))
+    for key in ("m/8", "m/16", "other/8"):
+        order = a.owners(key)
+        assert order == b.owners(key)  # stable across constructions
+        assert sorted(order) == sorted(nodes)  # all distinct replicas
+    assert a.owners("m/8", count=2) == a.owners("m/8")[:2]
+
+
+def test_ring_removal_moves_only_the_removed_nodes_keys():
+    nodes = ["r0", "r1", "r2", "r3"]
+    full = HashRing(nodes)
+    keys = [f"m/{b}" for b in (8, 16, 32, 64)] + [
+        f"model{i}/8" for i in range(40)
+    ]
+    gone = "r1"
+    reduced = HashRing([n for n in nodes if n != gone])
+    for key in keys:
+        before = full.owners(key)[0]
+        after = reduced.owners(key)[0]
+        if before != gone:
+            # consistent hashing: keys not owned by the removed node
+            # keep their owner
+            assert after == before, key
+        else:
+            # the removed node's keys land on its successor
+            assert after == full.owners(key)[1], key
+
+
+# -- membership ------------------------------------------------------------
+
+
+def test_membership_register_generation_and_view():
+    m = _membership()
+    g1 = m.register("r0", address="127.0.0.1:9000")
+    g2 = m.register("r1")
+    assert g2 == g1 + 1
+    view = m.poll()
+    assert view["generation"] == g2
+    assert view["live"] == ["r0", "r1"]
+    assert view["members"]["r0"]["address"] == "127.0.0.1:9000"
+    assert view["members"]["r0"]["pid"] > 0
+    m.set_state("r0", "draining")
+    view = m.poll()
+    assert view["live"] == ["r1"]
+    assert view["draining"] == ["r0"]
+    m.deregister("r0")
+    assert "r0" not in m.poll()["members"]
+
+
+def test_membership_dead_verdict_and_recovery_fake_clock():
+    clock = FakeClock()
+    store = InProcessCoordStore()
+    client = InProcessCoordClient(store, 0, 1, clock=clock, sleep=clock.sleep)
+    m = FleetMembership(
+        client, fleet="t", interval_s=1.0,
+        straggler_after_s=3.0, dead_after_s=10.0,
+    )
+    m.register("r0")
+    m.register("r1")
+    m.poll()
+    clock.t += 5.0  # r1 goes quiet past the straggler threshold
+    m.heartbeat("r0")
+    view = m.poll()
+    assert view["stragglers"] == ["r1"]
+    assert view["dead"] == []
+    clock.t += 7.0  # now past the dead threshold
+    m.heartbeat("r0")
+    view = m.poll()
+    assert view["dead"] == ["r1"]
+    assert "r1" not in view["live"]
+    m.heartbeat("r1")  # the stamp resumes: recovery
+    view = m.poll()
+    assert view["dead"] == [] and view["stragglers"] == []
+    assert view["live"] == ["r0", "r1"]
+
+
+def test_deregistered_member_is_not_flagged_dead_by_other_ledgers():
+    """A replica that politely deregisters must not age into a false
+    dead verdict in ANOTHER process's membership ledger (the router's),
+    and churn must not grow that ledger forever."""
+    clock = FakeClock()
+    store = InProcessCoordStore()
+
+    def view_of():
+        return FleetMembership(
+            InProcessCoordClient(store, 0, 1, clock=clock,
+                                 sleep=clock.sleep),
+            fleet="t", interval_s=1.0, straggler_after_s=3.0,
+            dead_after_s=10.0,
+        )
+
+    writer, router_view = view_of(), view_of()
+    writer.register("r0")
+    writer.register("r1")
+    router_view.poll()
+    writer.deregister("r1")  # polite exit — in the WRITER's process
+    clock.t += 60.0  # far past the dead threshold
+    writer.heartbeat("r0")
+    view = router_view.poll()
+    assert view["dead"] == []  # no false corpse
+    assert router_view.snapshot()["dead"] == []
+    assert "r1" not in router_view._ledger.last_seen()  # ledger pruned
+
+
+def test_generation_bumps_from_concurrent_writers_never_collide():
+    """Two replica processes registering 'simultaneously' (each through
+    its own membership client) must BOTH advance the generation: the
+    marker-count scheme has no lost update to race on."""
+    store = InProcessCoordStore()
+    a = _membership(store)
+    b = _membership(store)
+    g1 = a.register("r0")
+    g2 = b.register("r1")
+    g3 = a.register("r2")
+    assert (g1, g2, g3) == (1, 2, 3)
+    assert a.poll()["generation"] == 3
+    assert b.poll()["generation"] == 3
+
+
+def test_router_redials_a_dead_transport_through_its_factory():
+    """A transport that died must not shadow a restarted replica: the
+    re-dial sweep drops the unusable instance and builds a fresh one
+    from the member record."""
+    m = _membership()
+    _registered(m, ["r0"])
+
+    class DyingStub(StubTransport):
+        def __init__(self, rid):
+            super().__init__(rid)
+            self.unusable = False
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    dialed = []
+
+    def factory(rid, record):
+        transport = DyingStub(rid)
+        dialed.append(transport)
+        return transport
+
+    router = _router(m, {}, transport_factory=factory)
+    router.predict("m", np.zeros((2, 3)))
+    assert len(dialed) == 1
+    dialed[0].unusable = True  # the replica 'dies' and restarts
+    router.predict("m", np.zeros((2, 3)))
+    assert len(dialed) == 2  # re-dialed a fresh transport
+    assert dialed[0].closed and dialed[1].calls
+
+
+# -- router: failover / hedging / deadline ---------------------------------
+
+
+def _registered(membership, rids):
+    for rid in rids:
+        membership.register(rid)
+
+
+def test_router_fails_over_on_unreachable_owner():
+    m = _membership()
+    _registered(m, ["r0", "r1", "r2"])
+    order = _router(m, {r: StubTransport(r) for r in ["r0", "r1", "r2"]})
+    order = order.route("m", 4)
+    owner, successor = order[0], order[1]
+    transports = {
+        rid: StubTransport(
+            rid,
+            script=(
+                {"default": ReplicaUnreachableError(rid)}
+                if rid == owner else None
+            ),
+        )
+        for rid in ["r0", "r1", "r2"]
+    }
+    router = _router(m, transports)
+    mean, var = router.predict("m", np.zeros((4, 3)))
+    assert mean.shape == (4,)
+    assert transports[owner].calls and transports[successor].calls
+    assert router.metrics.counter("router.failovers") == 1
+    assert router.metrics.counter("router.failed") == 0
+    # the re-dispatch reuses the SAME request_id: one logical request
+    assert transports[owner].calls[0][1] == transports[successor].calls[0][1]
+
+
+def test_router_fails_over_on_draining_and_breaker_codes():
+    m = _membership()
+    _registered(m, ["r0", "r1"])
+    probe = _router(m, {r: StubTransport(r) for r in ["r0", "r1"]})
+    owner = probe.route("m", 4)[0]
+    other = [r for r in ["r0", "r1"] if r != owner][0]
+    transports = {
+        owner: StubTransport(owner, script={"default": DrainingError()}),
+        other: StubTransport(other),
+    }
+    router = _router(m, transports)
+    mean, _ = router.predict("m", np.zeros((2, 3)))
+    assert float(mean[0]) == 7.0
+    assert router.metrics.counter("router.failovers") == 1
+
+
+def test_router_does_not_retry_client_errors():
+    m = _membership()
+    _registered(m, ["r0", "r1"])
+    transports = {
+        rid: StubTransport(rid, script={"default": ValueError("bad shape")})
+        for rid in ["r0", "r1"]
+    }
+    router = _router(m, transports)
+    with pytest.raises(ValueError):
+        router.predict("m", np.zeros((2, 3)))
+    # no replica beyond the owner was burned on an unretryable error
+    assert sum(len(t.calls) for t in transports.values()) == 1
+    assert router.metrics.counter("router.failovers") == 0
+
+
+def test_router_failover_budget_is_bounded():
+    m = _membership()
+    _registered(m, ["r0", "r1", "r2", "r3"])
+    transports = {
+        rid: StubTransport(
+            rid, script={"default": ReplicaUnreachableError(rid)}
+        )
+        for rid in ["r0", "r1", "r2", "r3"]
+    }
+    router = _router(m, transports, failover_attempts=1)
+    with pytest.raises(FailoverExhaustedError) as err:
+        router.predict("m", np.zeros((2, 3)))
+    # 1 + failover_attempts dispatches, not the whole ring
+    assert sum(len(t.calls) for t in transports.values()) == 2
+    assert len(err.value.attempts) == 2
+    assert err.value.code == "router.failover_exhausted"
+    assert router.metrics.counter("router.failed") == 1
+
+
+def test_router_deadline_is_terminal_fake_clock():
+    clock = FakeClock()
+    store = InProcessCoordStore()
+    m = FleetMembership(
+        InProcessCoordClient(store, 0, 1, clock=clock, sleep=clock.sleep),
+        fleet="t", interval_s=1.0,
+    )
+    m.register("r0")
+    transports = {"r0": StubTransport("r0", script={"default": "hang"})}
+    started = time.monotonic()
+    router = _router(m, transports, clock=clock, default_timeout_ms=500.0)
+    with pytest.raises(RouterDeadlineError) as err:
+        router.predict("m", np.zeros((2, 3)))
+    assert err.value.code == "router.deadline"
+    assert time.monotonic() - started < 5.0  # fake clock: no real wait
+
+
+def test_router_hedges_around_a_straggler_fake_clock():
+    clock = FakeClock()
+    store = InProcessCoordStore()
+    m = FleetMembership(
+        InProcessCoordClient(store, 0, 1, clock=clock, sleep=clock.sleep),
+        fleet="t", interval_s=1.0,
+    )
+    for rid in ("r0", "r1", "r2"):
+        m.register(rid)
+    probe = _router(
+        m, {r: StubTransport(r) for r in ["r0", "r1", "r2"]}, clock=clock
+    )
+    order = probe.route("m", 4)
+    transports = {
+        rid: StubTransport(
+            rid,
+            script={"default": "hang"} if rid == order[0] else None,
+        )
+        for rid in ["r0", "r1", "r2"]
+    }
+    router = _router(
+        m, transports, clock=clock, hedge_after_s=0.1,
+        default_timeout_ms=5_000.0,
+    )
+    mean, _ = router.predict("m", np.zeros((4, 3)))
+    assert float(mean[0]) == 7.0
+    assert router.metrics.counter("router.hedges") == 1
+    assert router.metrics.counter("router.hedge_wins") == 1
+    assert transports[order[0]].calls and transports[order[1]].calls
+    # the hedge reused the primary's request_id (one logical request)
+    assert transports[order[0]].calls[0][1] == transports[order[1]].calls[0][1]
+
+
+def test_router_no_replicas_is_classified():
+    m = _membership()
+    router = _router(m, {})
+    with pytest.raises(NoReplicasError) as err:
+        router.predict("m", np.zeros((2, 3)))
+    assert err.value.code == "router.no_replicas"
+
+
+def test_failover_eligibility_vocabulary():
+    assert failover_eligible(ReplicaUnreachableError("r0"))
+    assert failover_eligible(DrainingError())
+    assert failover_eligible(RuntimeError("server shut down"))
+    assert not failover_eligible(ValueError("bad shape"))
+    assert not failover_eligible(KeyError("no model"))
+
+
+# -- drain-aware rebalancing ----------------------------------------------
+
+
+def test_draining_replica_leaves_the_ring_before_it_exits():
+    m = _membership()
+    _registered(m, ["r0", "r1", "r2"])
+    transports = {r: StubTransport(r) for r in ["r0", "r1", "r2"]}
+    router = _router(m, transports)
+    owner = router.route("m", 4)[0]
+    m.set_state(owner, "draining")
+    assert owner not in router.route("m", 4)  # keys migrated...
+    mean, _ = router.predict("m", np.zeros((4, 3)))  # ...and traffic flows
+    assert float(mean[0]) == 7.0
+    assert not transports[owner].calls
+    view = router.snapshot()["view"]
+    assert view["draining"] == [owner]
+
+
+# -- fleet metrics page ----------------------------------------------------
+
+
+def test_fleet_page_aggregates_scaling_signals():
+    m = _membership()
+    _registered(m, ["r0", "r1"])
+    transports = {
+        "r0": StubTransport("r0", health={
+            "queue": {"pressure": 0.95},
+            "lifecycle": {"memory": {"shedding": False}},
+        }),
+        "r1": StubTransport("r1", health={
+            "queue": {"pressure": 0.9},
+            "lifecycle": {"memory": {"shedding": True}},
+        }),
+    }
+    router = _router(m, transports)
+    sample = router.sample_fleet()
+    assert sample["scale_up"] is True
+    assert sample["queue_pressure"]["r0"] == pytest.approx(0.95)
+    page = router.openmetrics()
+    assert 'gp_fleet_queue_pressure{replica="r0"} 0.95' in page
+    assert 'gp_fleet_memory_shedding{replica="r1"} 1' in page
+    assert "gp_fleet_scale_up 1" in page
+    assert "gp_fleet_replicas_live 2" in page
+    assert "gp_router_rebuilds_total" in page
+    assert page.rstrip().endswith("# EOF")
+
+
+# -- router restart --------------------------------------------------------
+
+
+def test_router_restart_recovers_membership_from_kv():
+    store = InProcessCoordStore()
+    m = _membership(store)
+    _registered(m, ["r0", "r1", "r2"])
+    transports = {r: StubTransport(r) for r in ["r0", "r1", "r2"]}
+    first = _router(m, transports)
+    first.predict("m", np.zeros((4, 3)))
+    gen = m.last_known_generation
+    # a BRAND-NEW router over the same store, transports re-dialed lazily
+    built = []
+    second = _router(
+        _membership(store), {},
+        transport_factory=lambda rid, record: (
+            built.append(rid) or transports[rid]
+        ),
+    )
+    view = second.snapshot()["view"]
+    assert sorted(view["members"]) == ["r0", "r1", "r2"]
+    assert view["generation"] == gen
+    assert sorted(built) == ["r0", "r1", "r2"]
+    assert second.metrics.counter("router.rebuilds") >= 1
+    mean, _ = second.predict("m", np.zeros((4, 3)))
+    assert float(mean[0]) == 7.0
+    # identical ring: both routers agree on every key's owner
+    for bucket in (8, 16):
+        assert first.route("m", bucket) == second.route("m", bucket)
+
+
+# -- end-to-end over real serve replicas -----------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_model(tmp_path_factory):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(120, 3))
+    y = np.sin(x.sum(axis=1))
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(30).setActiveSetSize(30)
+        .setMaxIter(5).setSeed(3).fit(x, y)
+    )
+    path = str(tmp_path_factory.mktemp("fleet") / "fleet.npz")
+    model.save(path)
+    return path, model, x
+
+
+def _real_fleet(path, n=3, **server_kw):
+    membership = _membership()
+    replicas = []
+    for i in range(n):
+        defaults = dict(
+            max_batch=16, min_bucket=8, max_wait_ms=1.0,
+            request_timeout_ms=5_000.0, hang_timeout_s=None,
+            replica_id=f"r{i}",
+        )
+        defaults.update(server_kw)
+        server = GPServeServer(**defaults)
+        server.register("m", path)
+        server.start()
+        replica = LocalReplica(server, f"r{i}", membership)
+        replica.register()
+        replicas.append(replica)
+    router = FleetRouter(
+        membership,
+        transports={r.replica_id: r.transport for r in replicas},
+        max_batch=16, min_bucket=8, default_timeout_ms=5_000.0,
+        poll_interval_s=0.0,
+    )
+    return membership, replicas, router
+
+
+def test_fleet_end_to_end_kill_failover_zero_lost(fleet_model):
+    path, model, x = fleet_model
+    membership, replicas, router = _real_fleet(path)
+    by_id = {r.replica_id: r for r in replicas}
+    try:
+        local = model.predict(x[:4])
+        for _ in range(3):
+            for r in replicas:
+                r.heartbeat()
+            mean, _ = router.predict("m", x[:4])
+            np.testing.assert_allclose(mean, local, rtol=1e-5, atol=1e-6)
+        owner = router.route("m", 4)[0]
+        chaos.kill_replica(by_id[owner])
+        for _ in range(4):  # mid-burst kill: every request re-routes
+            mean, _ = router.predict("m", x[:4])
+            np.testing.assert_allclose(mean, local, rtol=1e-5, atol=1e-6)
+        assert router.metrics.counter("router.failovers") >= 1
+        assert router.metrics.counter("router.failed") == 0
+        # the heartbeat verdict evicts the corpse from the ring
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            for r in replicas:
+                r.heartbeat()
+            if owner in router.rebuild()["dead"]:
+                break
+            time.sleep(0.05)
+        assert owner in router.snapshot()["view"]["dead"]
+        assert owner not in router.route("m", 4)
+    finally:
+        router.close()
+        for r in replicas:
+            r.stop()
+
+
+def test_fleet_canary_promotes_only_when_all_replicas_clear(fleet_model):
+    path, model, x = fleet_model
+    membership, replicas, router = _real_fleet(path)
+    servers = {r.replica_id: r.server for r in replicas}
+    try:
+        canary = FleetCanary(
+            membership.client, fleet="t", promote_after=2
+        )
+        canary.start(servers, "m", path, fraction=1.0)
+        # replica identity rode into health for verdict attribution
+        health = replicas[0].server.health()
+        assert health["replica"]["replica_id"] == "r0"
+        assert health["replica"]["fleet"] == "t"
+        assert health["replica"]["coord_era"] >= 1
+        verdict = None
+        for _ in range(6):
+            for server in servers.values():
+                for i in range(3):
+                    server.predict("m", x[i: i + 4], timeout_ms=5_000.0)
+            verdict = canary.pump("m", servers)
+            if verdict is not None:
+                break
+        assert verdict == "promote"
+        for rid, server in servers.items():
+            assert server.registry.get("m").version == 2, rid
+            assert server.canaries.active("m") is None, rid
+            assert server.metrics.counter("canary.promotions") == 1, rid
+        # one replica still scoring would have held the fleet back: the
+        # verdict needed EVERY replica above the bar (promote_after=2,
+        # so >= 2 clean scores per replica were required and recorded)
+        for server in servers.values():
+            assert server.metrics.counter("canary.shadow_scores") >= 2
+    finally:
+        router.close()
+        for r in replicas:
+            r.stop()
+
+
+def test_fleet_canary_local_promotion_is_disabled(fleet_model):
+    """Under fleet control a replica must never promote on its own: the
+    local policy's promote_after is effectively infinite."""
+    path, _, x = fleet_model
+    membership, replicas, router = _real_fleet(path, n=1)
+    servers = {r.replica_id: r.server for r in replicas}
+    try:
+        canary = FleetCanary(membership.client, fleet="t", promote_after=50)
+        canary.start(servers, "m", path, fraction=1.0)
+        server = replicas[0].server
+        for i in range(8):
+            server.predict("m", x[i: i + 4], timeout_ms=5_000.0)
+        # plenty of clean scores, yet no local promotion happened
+        assert server.canaries.active("m") is not None
+        assert server.registry.get("m").version == 1
+        assert canary.pump("m", servers) is None  # fleet bar not met either
+    finally:
+        router.close()
+        for r in replicas:
+            r.stop()
+
+
+def test_plain_server_health_carries_replica_identity():
+    server = GPServeServer(replica_id="solo-1")
+    health = server.health()
+    assert health["replica"]["replica_id"] == "solo-1"
+    assert health["replica"]["pid"] > 0
+    assert "backend" in health["replica"]["build_info"]
+    assert health["replica"]["coord_era"] is None  # not fleet-bound
+
+
+def test_router_is_thread_safe_under_concurrent_clients():
+    m = _membership()
+    _registered(m, ["r0", "r1", "r2"])
+    transports = {r: StubTransport(r) for r in ["r0", "r1", "r2"]}
+    router = _router(m, transports)
+    errors = []
+
+    def client():
+        try:
+            for _ in range(20):
+                router.predict("m", np.zeros((4, 3)))
+        except Exception as exc:  # noqa: BLE001 — collected for assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errors
+    assert router.metrics.counter("router.requests") == 80

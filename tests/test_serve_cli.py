@@ -63,18 +63,63 @@ def _run_cli(args, input_text, timeout=240):
     return proc.returncode, out, err
 
 
+def _popen_tcp(args, timeout=240):
+    """Boot the CLI in TCP mode; returns (proc, port) once listening."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spark_gp_tpu.serve", *args, "--port", "0"],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        start_new_session=True,
+    )
+    port = None
+    try:
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                pytest.fail("serve CLI exited before listening")
+            event = json.loads(line)
+            if event.get("event") == "listening":
+                port = event["port"]
+                break
+    except Exception:
+        os.killpg(proc.pid, signal.SIGKILL)
+        raise
+    return proc, port
+
+
+def _finish_tcp(proc, timeout=60):
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        pytest.fail("serve CLI wedged at shutdown")
+    finally:
+        for stream in (proc.stdin, proc.stdout, proc.stderr):
+            if stream is not None:
+                stream.close()
+
+
 def test_cli_boot_score_shutdown(tiny_model):
     path, model, x = tiny_model
     request_rows = x[:3].tolist()
     lines = "\n".join(
         [
             json.dumps({"id": 1, "model": "tiny", "x": request_rows}),
+            json.dumps({"cmd": "health"}),
             json.dumps({"cmd": "metrics"}),
             json.dumps({"cmd": "shutdown"}),
         ]
     ) + "\n"
     rc, out, err = _run_cli(
-        ["--model", f"tiny={path}", "--max-batch", "16", "--min-bucket", "4"],
+        ["--model", f"tiny={path}", "--max-batch", "16", "--min-bucket", "4",
+         "--replica-id", "cli-r0"],
         lines,
     )
     assert rc == 0, err[-500:]
@@ -101,6 +146,13 @@ def test_cli_boot_score_shutdown(tiny_model):
     metrics = next(e for e in events if e.get("event") == "metrics")
     assert metrics["counters"]["requests"] >= 1
     assert "request_latency_s" in metrics["histograms"]
+
+    # the health verb carries replica identity (ISSUE 12): id, pid and
+    # build_info, so a router/gpctl can attribute verdicts to THIS process
+    health = next(e for e in events if e.get("event") == "health")
+    assert health["replica"]["replica_id"] == "cli-r0"
+    assert health["replica"]["pid"] > 0
+    assert "backend" in health["replica"]["build_info"]
 
     assert events[-1]["event"] == "shutdown"
     assert events[-1]["requests"] >= 1
@@ -129,3 +181,154 @@ def test_cli_requires_a_model():
     rc, out, err = _run_cli([], "")
     assert rc == 2
     assert "--model" in err
+
+
+def test_cli_tcp_read_timeout_unpins_vanished_client(tiny_model):
+    """ISSUE 12 satellite: a connect-and-vanish client (half-open socket,
+    never sends a byte) must be disconnected by the per-connection read
+    timeout instead of pinning a reader thread — and a live client on the
+    same server keeps being served throughout."""
+    import socket
+
+    path, model, x = tiny_model
+    proc, port = _popen_tcp(
+        ["--model", f"tiny={path}", "--max-batch", "16", "--min-bucket", "4",
+         "--conn-read-timeout-s", "1"],
+    )
+    try:
+        # the ghost: connects and never sends anything.  Within the read
+        # timeout the server hangs up — a classified serve.conn_idle line
+        # then EOF — instead of pinning a reader thread forever
+        ghost = socket.create_connection(("127.0.0.1", port), timeout=30)
+        ghost.settimeout(30)
+        got = b""
+        try:
+            while True:
+                chunk = ghost.recv(4096)
+                if not chunk:
+                    break
+                got += chunk
+        except OSError:
+            pass
+        if got:
+            reply = json.loads(got.decode().splitlines()[0])
+            assert reply["code"] == "serve.conn_idle", reply
+        ghost.close()
+        # the server is fully alive after evicting the ghost: a prompt
+        # client (no 1s gaps between lines) is served normally
+        live = socket.create_connection(("127.0.0.1", port), timeout=30)
+        lf = live.makefile("rw")
+        for req_id in (1, 2):
+            lf.write(json.dumps(
+                {"id": req_id, "model": "tiny", "x": x[:2].tolist()}
+            ) + "\n")
+            lf.flush()
+            answer = json.loads(lf.readline())
+            assert "mean" in answer, answer
+        lf.write(json.dumps({"cmd": "shutdown"}) + "\n")
+        lf.flush()
+        live.close()
+    finally:
+        _finish_tcp(proc)
+
+
+def test_tcp_replica_transport_round_trip_and_unreachable(tiny_model):
+    """The fleet router's TCP leg against a REAL CLI replica: predicts
+    round-trip through the ring, health carries the replica identity,
+    and the process dying surfaces as the failover-eligible
+    ReplicaUnreachableError — exactly what the router needs to re-route."""
+    from spark_gp_tpu.parallel.coord import (
+        InProcessCoordClient,
+        InProcessCoordStore,
+    )
+    from spark_gp_tpu.serve.fleet import FleetMembership
+    from spark_gp_tpu.serve.router import (
+        FleetRouter,
+        ReplicaUnreachableError,
+        TcpReplicaTransport,
+        failover_eligible,
+    )
+
+    path, model, x = tiny_model
+    proc, port = _popen_tcp(
+        ["--model", f"tiny={path}", "--max-batch", "16", "--min-bucket", "4",
+         "--replica-id", "tcp-r0", "--conn-read-timeout-s", "0"],
+    )
+    transport = TcpReplicaTransport(f"127.0.0.1:{port}", "tcp-r0")
+    try:
+        membership = FleetMembership(
+            InProcessCoordClient(InProcessCoordStore(), 0, 1),
+            fleet="tcp", interval_s=0.05,
+        )
+        membership.register("tcp-r0", address=f"127.0.0.1:{port}")
+        router = FleetRouter(
+            membership, {"tcp-r0": transport},
+            max_batch=16, min_bucket=4, default_timeout_ms=30_000.0,
+            poll_interval_s=0.0,
+        )
+        mean, var = router.predict("tiny", x[:3])
+        np.testing.assert_allclose(
+            mean, model.predict(x[:3]), rtol=1e-4, atol=1e-5
+        )
+        assert len(var) == 3
+        health = transport.health()
+        assert health["replica"]["replica_id"] == "tcp-r0"
+        # the replica dies: pending/submit surface the unreachable verdict
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        deadline = 30.0
+        import time as _time
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < deadline:
+            try:
+                transport.submit("tiny", x[:2], timeout_ms=1_000.0)
+                _time.sleep(0.05)
+            except ReplicaUnreachableError as exc:
+                assert failover_eligible(exc)
+                break
+        else:
+            pytest.fail("dead TCP replica never reported unreachable")
+    finally:
+        transport.close()
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+        _finish_tcp(proc, timeout=10)
+
+
+def test_cli_tcp_connection_limit(tiny_model):
+    """Connections past --max-connections are refused at the door with
+    one classified code=serve.conn_limit line, never silently queued."""
+    import socket
+
+    path, model, x = tiny_model
+    proc, port = _popen_tcp(
+        ["--model", f"tiny={path}", "--max-batch", "16", "--min-bucket", "4",
+         "--max-connections", "1", "--conn-read-timeout-s", "60"],
+    )
+    try:
+        holder = socket.create_connection(("127.0.0.1", port), timeout=30)
+        hf = holder.makefile("rw")
+        # prove the slot-holder is live before probing the limit
+        hf.write(json.dumps(
+            {"id": 1, "model": "tiny", "x": x[:2].tolist()}
+        ) + "\n")
+        hf.flush()
+        assert "mean" in json.loads(hf.readline())
+        # the second connection is over the bound: one refusal line + EOF
+        extra = socket.create_connection(("127.0.0.1", port), timeout=30)
+        xf = extra.makefile("r")
+        refusal = json.loads(xf.readline())
+        assert refusal["code"] == "serve.conn_limit", refusal
+        assert xf.readline() == ""  # closed after the refusal
+        extra.close()
+        # the holder is unaffected
+        hf.write(json.dumps(
+            {"id": 2, "model": "tiny", "x": x[:2].tolist()}
+        ) + "\n")
+        hf.flush()
+        assert "mean" in json.loads(hf.readline())
+        hf.write(json.dumps({"cmd": "shutdown"}) + "\n")
+        hf.flush()
+        holder.close()
+    finally:
+        _finish_tcp(proc)

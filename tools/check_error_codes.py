@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Tier-1 lint: every wire error code is well-formed and catalogued.
+
+Clients branch on the ``code`` field of error replies (retry/backoff on
+shed classes, failover on infrastructure classes) and dashboards slice
+error rates by it — a renamed or uncatalogued code silently breaks both.
+This checker walks the package AST and, for every statically-visible
+code emission —
+
+* a class-body assignment ``code = "<literal>"`` (the exception-class
+  convention: ``DrainingError.code``, ``BreakerOpenError.code``, ...);
+* a dict literal carrying a ``"code": "<literal>"`` entry (the CLI's
+  inline reply payloads);
+* a keyword argument ``code="<literal>"`` on any call —
+
+requires the code to (a) satisfy the dot-separated-lowercase grammar and
+(b) be registered in :mod:`spark_gp_tpu.serve.codes` (THE catalog).
+Codes that are runtime variables (``response["code"] = code``) can't be
+checked statically and are skipped — they re-emit an already-linted
+attribute.
+
+Run standalone (``python tools/check_error_codes.py``; exit 1 on
+violations) or through the tier-1 wrapper
+(``tests/test_error_codes.py``), the same wiring as
+``tools/check_metric_names.py``.  A deliberate exemption opts out with a
+trailing ``# error-code-ok`` comment — greppable, so every escape stays
+auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_ALLOW = "error-code-ok"
+
+
+def _literal(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _emissions(tree: ast.AST) -> List[Tuple[int, str]]:
+    """``(lineno, code)`` for every statically-visible code emission."""
+    found: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "code"
+                        for t in stmt.targets
+                    )
+                ):
+                    code = _literal(stmt.value)
+                    if code is not None:
+                        found.append((stmt.lineno, code))
+        elif isinstance(node, ast.Dict):
+            for key, value in zip(node.keys, node.values):
+                if key is not None and _literal(key) == "code":
+                    code = _literal(value)
+                    if code is not None:
+                        found.append((value.lineno, code))
+        elif isinstance(node, ast.Call):
+            for keyword in node.keywords:
+                if keyword.arg == "code":
+                    code = _literal(keyword.value)
+                    if code is not None:
+                        found.append((keyword.value.lineno, code))
+    return found
+
+
+def check_file(path: str) -> List[Tuple[str, int, str, str]]:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [(path, exc.lineno or 0, "<unparseable>", str(exc))]
+
+    from spark_gp_tpu.serve import codes
+
+    violations = []
+    for lineno, code in _emissions(tree):
+        line_text = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        if _ALLOW in line_text:
+            continue
+        if not codes.grammar_ok(code):
+            violations.append((
+                path, lineno, code,
+                "not dot-separated lowercase ([a-z0-9_]+, '.'-joined)",
+            ))
+        elif not codes.is_registered(code):
+            violations.append((
+                path, lineno, code,
+                "not registered in spark_gp_tpu/serve/codes.py",
+            ))
+    return violations
+
+
+def find_violations(package_root: str) -> List[Tuple[str, int, str, str]]:
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(os.path.abspath(package_root)):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                violations.extend(check_file(os.path.join(dirpath, name)))
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = (argv if argv is not None else sys.argv[1:]) or [
+        os.path.join(repo_root, "spark_gp_tpu")
+    ]
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    violations = find_violations(args[0])
+    if violations:
+        print(
+            "unregistered or ill-formed wire error codes — register every "
+            "emitted code in spark_gp_tpu/serve/codes.py (dot-separated "
+            "lowercase), or mark a deliberate exemption with "
+            f"'# {_ALLOW}':",
+            file=sys.stderr,
+        )
+        for path, lineno, code, why in violations:
+            rel = os.path.relpath(path, repo_root)
+            print(f"  {rel}:{lineno}: {code!r}: {why}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,17 @@ SCENARIOS = (
     # shed oversized requests with a classified code BEFORE dispatch
     "memory_pressure_fit",
     "memory_pressure_serve",
+    # multi-replica fleet faults (serve/fleet.py + serve/router.py): a
+    # replica SIGKILLed mid-burst loses zero answered requests (failover
+    # re-routes within the deadline), a chaos-hung replica is hedged
+    # around and then evicted by heartbeat verdict while the others keep
+    # serving, a split canary verdict rolls back on EVERY replica with
+    # zero failed requests, and a restarted router rebuilds membership
+    # from the KV store alone
+    "fleet_kill",
+    "fleet_hang",
+    "fleet_split_canary",
+    "fleet_restart",
 )
 
 #: per-scenario tolerance on |pred - clean_pred|: execution-environment
@@ -88,6 +99,12 @@ SCENARIO_TOL = {
     # trajectory as the clean one-dispatch fit (PR 9 segment driver)
     "memory_pressure_fit": 1e-6,
     "memory_pressure_serve": 1e-6,
+    # fleet campaigns assert internally and hand back the reference
+    # predictions (the serve_flaky pattern): delta is identically zero
+    "fleet_kill": 1e-6,
+    "fleet_hang": 1e-6,
+    "fleet_split_canary": 1e-6,
+    "fleet_restart": 1e-6,
 }
 _DATA_FAULT_TOL = 10.0
 
@@ -244,6 +261,224 @@ def _run_memory_pressure_serve(rng, x, model) -> None:
             raise Violation("plan_sheds accounting diverged from sheds seen")
     finally:
         server.stop()
+
+
+def _fleet_rig(model, tmp: str, hang_timeout_s=None, hedge_after_s=None):
+    """A 3-replica in-process fleet over one KV store: servers + bound
+    LocalReplicas + a router with fast liveness thresholds (dead verdict
+    within ~0.4 s of silence)."""
+    from spark_gp_tpu.parallel.coord import (
+        InProcessCoordClient,
+        InProcessCoordStore,
+    )
+    from spark_gp_tpu.serve import GPServeServer
+    from spark_gp_tpu.serve.fleet import FleetMembership, LocalReplica
+    from spark_gp_tpu.serve.router import FleetRouter
+
+    path = os.path.join(tmp, "fleet_model.npz")
+    model.save(path)
+    store = InProcessCoordStore()
+    membership = FleetMembership(
+        InProcessCoordClient(store, 0, 1), fleet="soak",
+        interval_s=0.05, straggler_after_s=0.15, dead_after_s=0.35,
+    )
+    replicas = []
+    for i in range(3):
+        server = GPServeServer(
+            max_batch=16, min_bucket=8, max_wait_ms=1.0, capacity=256,
+            request_timeout_ms=10_000.0, replica_id=f"r{i}",
+            hang_timeout_s=hang_timeout_s,
+        )
+        server.register("fleet", path)
+        server.start()
+        replica = LocalReplica(server, f"r{i}", membership)
+        replica.register()
+        replicas.append(replica)
+    router = FleetRouter(
+        membership,
+        transports={r.replica_id: r.transport for r in replicas},
+        max_batch=16, min_bucket=8, default_timeout_ms=10_000.0,
+        hedge_after_s=hedge_after_s, poll_interval_s=0.0,
+    )
+    return store, membership, replicas, router, path
+
+
+def _run_fleet_campaign(rng, x, y, ref_model, expert, mode: str) -> None:
+    """One fleet chaos campaign (mode: kill | hang | split_canary |
+    restart); raises :class:`Violation` on any invariant breach.  All
+    faults are the deterministic chaos injectors
+    (``resilience/chaos.py``); liveness rides real (sub-second) clocks."""
+    import tempfile as _tf
+
+    import numpy as np
+
+    from spark_gp_tpu.resilience import chaos
+
+    with _tf.TemporaryDirectory() as tmp:
+        store, membership, replicas, router, path = _fleet_rig(
+            ref_model, tmp,
+            hedge_after_s=0.05 if mode == "hang" else None,
+        )
+        by_id = {r.replica_id: r for r in replicas}
+        hung = None
+        try:
+            def burst(k: int, sz: int = 4) -> None:
+                for _ in range(k):
+                    for replica in replicas:
+                        replica.heartbeat()
+                    row = int(rng.integers(0, max(1, x.shape[0] - 16)))
+                    mean, _ = router.predict("fleet", x[row: row + sz])
+                    if not np.all(np.isfinite(np.asarray(mean))):
+                        raise Violation("fleet answer non-finite")
+
+            def await_dead(rid: str) -> None:
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    for replica in replicas:
+                        replica.heartbeat()
+                    if rid in router.rebuild()["dead"]:
+                        return
+                    time.sleep(0.05)
+                raise Violation(f"replica {rid} never declared dead")
+
+            if mode == "kill":
+                burst(4)
+                owner = router.route("fleet", 4)[0]
+                chaos.kill_replica(by_id[owner])  # SIGKILL mid-burst
+                burst(6)  # every request re-routes — zero failures
+                if router.metrics.counter("router.failovers") < 1:
+                    raise Violation("kill never exercised failover")
+                if router.metrics.counter("router.failed") != 0:
+                    raise Violation("fleet kill lost requests")
+                await_dead(owner)
+                if owner in router.route("fleet", 4):
+                    raise Violation("dead replica still in the ring")
+                burst(3)
+            elif mode == "hang":
+                burst(3)
+                owner = router.route("fleet", 4)[0]
+                hung = chaos.hang_replica(
+                    by_id[owner], hang_forever=True, max_block_s=60.0
+                )
+                burst(4)  # hedges answer around the wedged primary
+                if router.metrics.counter("router.hedges") < 1:
+                    raise Violation("hung replica never hedged")
+                if router.metrics.counter("router.hedge_wins") < 1:
+                    raise Violation("no hedge ever won")
+                if router.metrics.counter("router.failed") != 0:
+                    raise Violation("fleet hang lost requests")
+                await_dead(owner)  # heartbeat verdict evicts the wedge
+                hedges_before = router.metrics.counter("router.hedges")
+                burst(3)  # post-eviction traffic needs no hedging
+                if router.metrics.counter("router.hedges") != hedges_before:
+                    raise Violation("evicted replica still being dispatched")
+            elif mode == "split_canary":
+                from spark_gp_tpu.serve.fleet import FleetCanary
+
+                burst(3)
+                # candidate B is a genuinely different model: ONE
+                # replica's shadow scores breach the guard bar
+                model_b = _make_gp(expert, "host").fit(
+                    np.asarray(x), np.asarray(y) + 3.0
+                )
+                path_b = os.path.join(tmp, "fleet_model_b.npz")
+                model_b.save(path_b)
+                servers = {r.replica_id: r.server for r in replicas}
+                breach_rid = sorted(servers)[int(rng.integers(0, 3))]
+                paths = {rid: path for rid in servers}
+                paths[breach_rid] = path_b
+                canary = FleetCanary(
+                    membership.client, fleet="soak", promote_after=3
+                )
+                canary.start(servers, "fleet", paths, fraction=0.5)
+                failed = 0
+                verdict = None
+                for _ in range(6):
+                    for server in servers.values():
+                        for _ in range(4):
+                            row = int(
+                                rng.integers(0, max(1, x.shape[0] - 16))
+                            )
+                            try:
+                                server.predict(
+                                    "fleet", x[row: row + 4],
+                                    timeout_ms=10_000.0,
+                                )
+                            except Exception:  # noqa: BLE001 — counting
+                                failed += 1     # IS the invariant
+                    verdict = canary.pump("fleet", servers)
+                    if verdict is not None:
+                        break
+                if verdict != "rollback":
+                    raise Violation(
+                        f"split canary verdict was {verdict!r}, not rollback"
+                    )
+                if failed:
+                    raise Violation(
+                        f"{failed} request(s) failed during the split rollout"
+                    )
+                for rid, server in servers.items():
+                    if server.canaries.active("fleet") is not None:
+                        raise Violation(
+                            f"{rid} still has an active canary after the "
+                            "fleet rollback"
+                        )
+                    if server.registry.get("fleet").version != 1:
+                        raise Violation(
+                            f"{rid} moved its stable latest despite the "
+                            "split verdict"
+                        )
+            elif mode == "restart":
+                from spark_gp_tpu.parallel.coord import InProcessCoordClient
+                from spark_gp_tpu.serve.fleet import FleetMembership
+                from spark_gp_tpu.serve.router import FleetRouter
+
+                burst(4)
+                gen_before = membership.last_known_generation
+                transports = {r.replica_id: r.transport for r in replicas}
+                # a BRAND-NEW router over the same store: membership,
+                # generation and ring recovered with no replica involved
+                router2 = FleetRouter(
+                    FleetMembership(
+                        InProcessCoordClient(store, 0, 1), fleet="soak",
+                        interval_s=0.05, straggler_after_s=0.15,
+                        dead_after_s=0.35,
+                    ),
+                    transport_factory=lambda rid, record: transports[rid],
+                    max_batch=16, min_bucket=8,
+                    default_timeout_ms=10_000.0, poll_interval_s=0.0,
+                )
+                try:
+                    view = router2.snapshot()["view"]
+                    if set(view["members"]) != set(by_id):
+                        raise Violation(
+                            "restarted router lost membership: "
+                            f"{sorted(view['members'])}"
+                        )
+                    if view["generation"] != gen_before:
+                        raise Violation("membership generation not recovered")
+                    if router2.metrics.counter("router.rebuilds") < 1:
+                        raise Violation("restart never counted a rebuild")
+                    for _ in range(3):
+                        for replica in replicas:
+                            replica.heartbeat()
+                        row = int(rng.integers(0, max(1, x.shape[0] - 16)))
+                        mean, _ = router2.predict("fleet", x[row: row + 4])
+                        if not np.all(np.isfinite(np.asarray(mean))):
+                            raise Violation("post-restart answer non-finite")
+                finally:
+                    router2.close()
+            else:  # pragma: no cover — closed menu
+                raise Violation(f"unknown fleet mode {mode!r}")
+        finally:
+            if hung is not None:
+                hung.release()
+            router.close()
+            for replica in replicas:
+                try:
+                    replica.stop()
+                except Exception:  # noqa: BLE001 — teardown must not mask
+                    pass            # the campaign verdict being unwound
 
 
 def _assert_incident_invariant(incident_tmp: str, outcome: str) -> None:
@@ -474,6 +709,11 @@ def _run_campaign_body(
                 raise Violation("predict OOM/halving despite planning on")
         elif scenario == "memory_pressure_serve":
             _run_memory_pressure_serve(rng, x, ref_model)
+            pred = ref_pred
+        elif scenario.startswith("fleet_"):
+            _run_fleet_campaign(
+                rng, x, y, ref_model, expert, scenario.split("_", 1)[1]
+            )
             pred = ref_pred
         elif scenario == "guard_degrade":
             from spark_gp_tpu.ops import precision
