@@ -1011,8 +1011,28 @@ def worker() -> None:
             server.register("obs", mpath)  # AOT warmup before any burst
         server.start()
         serve_on, serve_off = [], []
+        qual_on, qual_off = [], []
         serve_reps = max(reps, 5)  # bursts are short; the max needs samples
         batches_before = batches_after = 0.0
+
+        def serve_burst_ids(server_, n_requests):
+            """The quality-plane variant: every request carries a
+            request_id, so the pending ring (obs/quality.py) is exercised
+            on top of the drift scorer — the monitor's full hot-path."""
+            futs = []
+            total_rows = 0
+            t0 = time.perf_counter()
+            for i in range(n_requests):
+                sz = (1, 4, 16)[i % 3]
+                row = (i * 37) % max(1, n_obs - 64)
+                futs.append(server_.submit(
+                    "obs", xo[row : row + sz], request_id=f"bq-{i}"
+                ))
+                total_rows += sz
+            for f in futs:
+                f.result(timeout=300.0)
+            return total_rows / (time.perf_counter() - t0)
+
         try:
             serve_burst(server, n_requests)  # warm the whole request path
             for _ in range(serve_reps):
@@ -1022,6 +1042,15 @@ def worker() -> None:
                 batches_before = server.metrics.counter("batches")
                 serve_on.append(serve_burst(server, n_requests))
                 batches_after = server.metrics.counter("batches")
+            # quality monitor on vs off (interleaved, ids attached):
+            # server.quality is the executor's per-batch gate, so
+            # toggling it prices exactly the statistical health plane
+            quality_plane = server.quality
+            for _ in range(min(serve_reps, 3)):
+                server.quality = None
+                qual_off.append(serve_burst_ids(server, n_requests))
+                server.quality = quality_plane
+                qual_on.append(serve_burst_ids(server, n_requests))
         finally:
             obs_trace.set_tracing(None)
             server.stop()
@@ -1155,6 +1184,83 @@ def worker() -> None:
             notes_per_burst * note_s / burst_wall_s * 100.0
         )
 
+        # -- statistical quality monitor (obs/quality.py, ISSUE 13) --------
+        # same two-estimator discipline: the interleaved monitor-on vs
+        # monitor-off burst differential above (informational) plus the
+        # ASSERTED direct measurement.  The monitor's BATCHER-side work
+        # is one note_predictions call per dispatch (an id sweep + a
+        # bounded-queue handoff to the drainer thread); the pending-ring
+        # puts and drift scores run on the drainer, off the serving
+        # bottleneck, and are timed separately as informational
+        # drainer-side costs.
+        import types
+
+        from spark_gp_tpu.obs import quality as obs_quality
+        from spark_gp_tpu.serve.metrics import ServingMetrics as _SM
+
+        quality_serve_delta = statistics.median(
+            (pps_off - pps_on) / pps_off * 100.0
+            for pps_off, pps_on in zip(qual_off, qual_on)
+        )
+        summary = getattr(model_o.instr, "covariate_summary", None)
+        if summary is None:
+            summary = obs_quality.summarize_covariates(xo)
+        # batcher-side: the per-dispatch note_predictions handoff, with a
+        # representative ~10-request batch carrying ids
+        plane = obs_quality.ServeQualityPlane(_SM())
+        fake_entry = types.SimpleNamespace(
+            version=1,
+            model=types.SimpleNamespace(covariate_summary=summary),
+        )
+        fake_group = [
+            types.SimpleNamespace(request_id=f"bn-{i}") for i in range(10)
+        ]
+        fake_rows = [7] * 10
+        note_mu = np.zeros(70, dtype=np.float32)
+        note_var = np.ones(70, dtype=np.float32)
+        note_x = np.asarray(xo[:70], dtype=np.float32)
+        # reps stay under the feed bound so the timing is the pure
+        # enqueue path even if the drainer lags (no drop-path mixing)
+        note_reps = 400
+        t0 = time.perf_counter()
+        for _ in range(note_reps):
+            plane.note_predictions(
+                "bench", fake_entry, fake_group, fake_rows,
+                note_mu, note_var, note_x,
+            )
+        quality_note_s = (time.perf_counter() - t0) / note_reps
+        plane.flush()
+        plane.close()
+        # drainer-side (informational): one pending put, one drift score
+        ring = obs_quality.PendingRing(4096)
+        put_mu = np.zeros(4)
+        put_var = np.ones(4)
+        put_reps = 5000
+        t0 = time.perf_counter()
+        for i in range(put_reps):
+            ring.put(f"bench-{i % 512}", put_mu, put_var)
+        put_s = (time.perf_counter() - t0) / put_reps
+        drift_monitor = obs_quality.DriftMonitor(summary)
+        drift_batch = np.asarray(xo[:16], dtype=np.float64)
+        score_reps = 2000
+        t0 = time.perf_counter()
+        for _ in range(score_reps):
+            drift_monitor.score_rows(drift_batch)  # window closes included
+        score_s = (time.perf_counter() - t0) / score_reps
+        quality_overhead = (
+            batches_per_burst * quality_note_s / burst_wall_s * 100.0
+        )
+        quality_block = {
+            "monitor_on_points_per_sec_max": max(qual_on),
+            "monitor_off_points_per_sec_max": max(qual_off),
+            "measured_delta_pct": quality_serve_delta,
+            "note_seconds": quality_note_s,
+            "pending_put_seconds": put_s,
+            "drift_score_seconds": score_s,
+            "dropped_batches": plane.dropped_batches,
+            "overhead_pct": quality_overhead,
+        }
+
         # -- measured XLA cost / MFU (obs/cost.py, GP_XLA_COST) ------------
         # one metered fit: the journal's xla_cost block carries measured
         # flops/bytes per entry and the optimize-phase MFU against
@@ -1198,6 +1304,7 @@ def worker() -> None:
                 "fit_overhead_pct": recorder_fit_overhead,
                 "serve_overhead_pct": recorder_serve_overhead,
             },
+            "quality": quality_block,
             "xla_cost": xla_cost,
             "note": (
                 "tracer on = span tracing + run-journal capture + "
@@ -1209,7 +1316,11 @@ def worker() -> None:
                 "wall-clock; measured_delta_pct is the raw interleaved "
                 "differential, noise-dominated on shared hosts.  The "
                 "recorder block prices the flight-recorder feed the same "
-                "two ways (GP_RECORDER; asserted <2%); xla_cost is one "
+                "two ways (GP_RECORDER; asserted <2%); the quality block "
+                "prices the statistical health monitor (obs/quality.py — "
+                "pending-ring put per request + drift score per batch, "
+                "asserted <2% on the serve path, GP_SERVE_QUALITY); "
+                "xla_cost is one "
                 "GP_XLA_COST-metered fit's journal block — measured "
                 "flops/bytes per entry point and the optimize-phase MFU "
                 "against chip_peaks"

@@ -13,6 +13,17 @@ exponential backoff + jitter on classified shed codes, one ``request_id``
 per LOGICAL request reused verbatim on every resend, and answers
 recorded BY request_id so a duplicated reply can never double-count.
 
+Part three closes the **feedback loop** (docs/SERVING.md "The observe
+verb"): once ground-truth labels for the predicted rows become known —
+in production that is minutes to days later — the client sends them
+back via ``{"cmd": "observe", "request_id": ..., "y": [...]}``.  The
+server joins each label set to the (μ, σ) it served for that
+request_id, grades the prediction into the model's streaming
+calibration monitor (obs/quality.py), and the ``health`` verb then
+carries the calibration snapshot (coverage, z-statistics, alert state).
+Observations are idempotent per request_id — the same retry pattern as
+predicts, with a duplicate join counted as a no-op.
+
 Run: python examples/serve_client.py [--requests 40]
 """
 
@@ -202,6 +213,57 @@ def main():
             assert len(answers) == len(logical), (len(answers), len(logical))
             assert all(f"req-{i}" in answers for i in range(8))
             assert all("mean" in a for a in answers.values())
+
+            # -- part three: the feedback loop ----------------------------
+            # delayed ground-truth labels flow back via the observe verb,
+            # keyed by the SAME request_id the predict used; the server
+            # joins them to the (μ, σ) it served and grades calibration
+            joined = 0
+            for i, req in enumerate(logical):
+                rows = np.asarray(req["x"]).shape[0]
+                row = (i * 31) % (2000 - 8)
+                wf.write(json.dumps({
+                    "cmd": "observe",
+                    "model": "demo",
+                    "request_id": req["request_id"],
+                    "y": y[row : row + rows].tolist(),
+                }) + "\n")
+                wf.flush()
+                reply = json.loads(rf.readline())
+                assert reply.get("event") == "observed", reply
+                assert "error" not in reply, reply
+                joined += reply["joined"]
+            # re-observing request 3 is the idempotent duplicate: joined 0
+            req3 = logical[3]
+            row3 = (3 * 31) % (2000 - 8)
+            wf.write(json.dumps({
+                "cmd": "observe", "model": "demo",
+                "request_id": req3["request_id"],
+                "y": y[row3 : row3 + 4].tolist(),
+            }) + "\n")
+            wf.flush()
+            dup = json.loads(rf.readline())
+            assert dup.get("duplicate") is True and dup["joined"] == 0, dup
+            # an unknown request_id fails with the classified wire code
+            wf.write(json.dumps({
+                "cmd": "observe", "model": "demo",
+                "request_id": "never-served", "y": [0.0],
+            }) + "\n")
+            wf.flush()
+            unknown = json.loads(rf.readline())
+            assert unknown.get("code") == "observe.unknown_request", unknown
+            # the calibration snapshot rides the health verb
+            wf.write(json.dumps({"cmd": "health"}) + "\n")
+            wf.flush()
+            health = json.loads(rf.readline())
+            calib = health["quality"]["models"]["demo"]["calibration"]
+            assert calib["observations"] == joined, (calib, joined)
+            print(
+                f"feedback loop: {joined} labels joined; calibration "
+                f"z_std={calib['z_std']:.2f} alert={calib['alert']} "
+                f"(status {health['status']})"
+            )
+
             wf.write(json.dumps({"cmd": "shutdown"}) + "\n")
             wf.flush()
             conn.close()

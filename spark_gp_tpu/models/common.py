@@ -886,6 +886,11 @@ class GaussianProcessCommons(GaussianProcessParams):
                 state["extra"] = (
                     jnp.asarray(report.jitter, dtype=state["data"].x.dtype),
                 )
+                # per-expert jitter levels ride into the post-fit quality
+                # telemetry (_emit_expert_quality) and the run journal
+                instr.expert_jitter = np.asarray(
+                    report.jitter, dtype=np.float64
+                )
             if report.num_dropped:
                 state["data"] = self._apply_quarantine(
                     instr, state["data"], report.bad, "fit recovery"
@@ -1434,6 +1439,8 @@ class GaussianProcessCommons(GaussianProcessParams):
         self._emit_precision_guard(
             instr, kernel, theta, active64, magic_vector, data
         )
+        self._emit_expert_quality(instr, kernel, theta, data)
+        self._emit_covariate_summary(instr, data, active64)
         keep_stats = self._keeps_update_statistics
         return ppa.ProjectedProcessRawPredictor(
             kernel=kernel,
@@ -1568,6 +1575,133 @@ class GaussianProcessCommons(GaussianProcessParams):
                 # lane (resilience/fallback.py).  Default ("log") keeps
                 # the pre-ladder warn-only behavior bit-for-bit.
                 raise fallback.GuardBreachError(lane, worst, bar)
+
+    def _probeable_stack(self, data) -> bool:
+        """Whether the fitted stack can be host-probed for post-fit
+        telemetry — the same restriction as the precision guard and the
+        quarantine screen: cross-process shardings cannot be fetched
+        (DCN-fallback stacks are host-local and probe fine)."""
+        import jax
+
+        return (
+            data is not None
+            and (
+                jax.process_count() == 1
+                or getattr(self, "_dcn_ctx", None) is not None
+            )
+        )
+
+    def _emit_expert_quality(self, instr, kernel, theta, data) -> None:
+        """Fit-time per-expert quality telemetry (the statistical health
+        plane's fit-side leg, ISSUE 13 / obs/quality.py).
+
+        One vmapped probe of the per-expert marginal NLL at theta* —
+        the same per-expert decomposition the quarantine diagnosis uses
+        (``resilience/quarantine.expert_health``; the marginal objective
+        is the documented proxy for the non-decomposable families) —
+        plus the per-expert adaptive-jitter level the recovery driver
+        settled on and the effective BCM weight (renormalization for
+        active experts, 0 for quarantined ones).  Stamped onto the instr
+        as ``expert_quality`` (the run journal persists it —
+        ``gpctl quality`` renders the table) with scalar spread metrics
+        for dashboards.  Cost: one extra objective-evaluation-sized
+        dispatch per fit; ``GP_EXPERT_TELEMETRY=0`` disables."""
+        import os
+
+        if instr is None or not self._probeable_stack(data):
+            return
+        if os.environ.get("GP_EXPERT_TELEMETRY", "").strip().lower() in (
+            "0", "off", "false",
+        ):
+            return
+        try:
+            from spark_gp_tpu.resilience.quarantine import expert_health
+
+            # multi-head latent stacks ([E, s, C]) probe head 0 — this is
+            # a relative spread diagnostic, not a statistic (the
+            # precision guard's convention)
+            y = data.y if getattr(data.y, "ndim", 2) == 2 else data.y[..., 0]
+            probe = ExpertData(x=data.x, y=y, mask=data.mask)
+            jitter = getattr(instr, "expert_jitter", None)
+            nll, _ = expert_health(
+                kernel, np.asarray(theta, dtype=np.float64), probe,
+                "marginal", jitter=jitter,
+            )
+            mask = np.asarray(data.mask)
+            active = mask.sum(axis=1) > 0
+            renorm = float(instr.metrics.get("bcm_renorm", 1.0))
+            weights = np.where(active, renorm, 0.0)
+            jit_arr = (
+                np.zeros(nll.shape[0]) if jitter is None
+                else np.broadcast_to(
+                    np.asarray(jitter, dtype=np.float64), nll.shape
+                )
+            )
+            finite = active & np.isfinite(nll)
+            act_nll = nll[finite]
+            cap = 512  # journal stays bounded for E in the thousands
+            instr.expert_quality = {
+                "objective": "marginal_proxy",
+                "experts": int(nll.shape[0]),
+                "active": int(active.sum()),
+                "nll": [float(v) for v in nll[:cap]],
+                "jitter": [float(v) for v in jit_arr[:cap]],
+                "weight": [float(v) for v in weights[:cap]],
+                "truncated": bool(nll.shape[0] > cap),
+            }
+            if act_nll.size:
+                instr.log_metric(
+                    "expert_quality.nll_spread",
+                    float(act_nll.max() - act_nll.min()),
+                )
+                instr.log_metric(
+                    "expert_quality.nll_std", float(act_nll.std())
+                )
+            instr.log_metric(
+                "expert_quality.jitter_max", float(jit_arr.max(initial=0.0))
+            )
+            instr.log_metric(
+                "expert_quality.weight_min",
+                float(weights.min(initial=renorm)) if nll.shape[0] else 0.0,
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never fail a fit
+            import logging
+
+            logging.getLogger("spark_gp_tpu").warning(
+                "per-expert quality telemetry failed", exc_info=True
+            )
+
+    def _emit_covariate_summary(self, instr, data, active64) -> None:
+        """Compact training-covariate summary (per-dim moments + the
+        active-set-centroid distance sketch, ``obs/quality.
+        summarize_covariates``) stamped onto the instr; the saved model
+        carries it in ``provenance_json`` so serve can score incoming
+        rows for input drift against THIS fit's training mass.
+        ``GP_COVARIATE_SUMMARY=0`` disables (one host fetch of the
+        stack per fit is the cost)."""
+        import os
+
+        if instr is None or not self._probeable_stack(data):
+            return
+        if os.environ.get("GP_COVARIATE_SUMMARY", "").strip().lower() in (
+            "0", "off", "false",
+        ):
+            return
+        try:
+            from spark_gp_tpu.obs.quality import summarize_covariates
+
+            x = np.asarray(data.x)
+            mask = np.asarray(data.mask)
+            rows = x.reshape(-1, x.shape[-1])[mask.reshape(-1) > 0]
+            instr.covariate_summary = summarize_covariates(
+                rows, active=active64, seed=self._seed
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never fail a fit
+            import logging
+
+            logging.getLogger("spark_gp_tpu").warning(
+                "covariate summary failed", exc_info=True
+            )
 
     def _finalize_device_fit(
         self,

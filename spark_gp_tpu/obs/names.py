@@ -177,6 +177,31 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("fleet.scale_up", "gauge", "1 while aggregated queue/memory pressure asks for another replica"),
     MetricName("fleet.queue_pressure.*", "gauge", "per-replica queue depth / capacity", label="replica"),
     MetricName("fleet.memory_shedding.*", "gauge", "1 while the replica's memory admission gate sheds", label="replica"),
+    # -- statistical health plane (obs/quality.py) -------------------------
+    MetricName("quality.observations", "counter", "ground-truth labels joined to served predictions"),
+    MetricName("quality.observe.unknown_request", "counter", "observations naming a request_id with no pending prediction"),
+    MetricName("quality.observe.duplicate", "counter", "idempotent re-observations of an already-joined request_id"),
+    MetricName("quality.windows", "counter", "calibration verdict windows closed"),
+    MetricName("quality.alerts", "counter", "sustained-miscalibration alerts raised"),
+    MetricName("drift.windows", "counter", "input-drift verdict windows closed"),
+    MetricName("drift.alerts", "counter", "sustained-input-drift alerts raised"),
+    MetricName("quality.alert.*", "gauge", "1 while the model has an active miscalibration alert", label="model"),
+    MetricName("quality.z_mean.*", "gauge", "lifetime mean standardized residual of graded predictions", label="model"),
+    MetricName("quality.z_std.*", "gauge", "lifetime std of standardized residuals (1.0 = calibrated)", label="model"),
+    MetricName("quality.nll_mean.*", "gauge", "lifetime mean predictive NLL of graded predictions", label="model"),
+    MetricName("quality.coverage_50.*", "gauge", "empirical coverage of the nominal 50% central interval", label="model"),
+    MetricName("quality.coverage_90.*", "gauge", "empirical coverage of the nominal 90% central interval", label="model"),
+    MetricName("quality.coverage_99.*", "gauge", "empirical coverage of the nominal 99% central interval", label="model"),
+    MetricName("quality.pending_depth.*", "gauge", "predictions parked awaiting delayed labels", label="model"),
+    MetricName("drift.alert.*", "gauge", "1 while the model has an active input-drift alert", label="model"),
+    MetricName("drift.score.*", "gauge", "last drift window's max per-dim mean shift in train-std units", label="model"),
+    MetricName("fleet.quality_alert.*", "gauge", "1 while the replica reports any active quality/drift alert", label="replica"),
+    MetricName("router.observes", "counter", "observations forwarded to the replica that answered the request"),
+    # fit-time per-expert quality telemetry (models/common.py)
+    MetricName("expert_quality.nll_spread", "metric", "max - min per-expert NLL at theta* (marginal proxy, active experts)"),
+    MetricName("expert_quality.nll_std", "metric", "std of per-expert NLL at theta* across active experts"),
+    MetricName("expert_quality.jitter_max", "metric", "largest per-expert adaptive-jitter level the fit settled on"),
+    MetricName("expert_quality.weight_min", "metric", "smallest per-expert effective BCM weight (0 = quarantined)"),
     # -- forensics plane (obs/recorder.py, obs/cost.py) --------------------
     MetricName("incident.bundles", "counter", "incident bundles assembled on terminal classified failures"),
     MetricName("incident.bundle_failures", "counter", "incident bundles that could not be persisted"),
@@ -212,6 +237,10 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("coord.checkpoint", "event", "coordinated checkpoint save completed"),
     MetricName("coord.preempted", "event", "SIGTERM preemption observed"),
     MetricName("incident.bundle", "event", "incident bundle dumped"),
+    MetricName("quality.alert", "event", "sustained-miscalibration alert raised"),
+    MetricName("quality.recovered", "event", "miscalibration alert cleared by a clean window"),
+    MetricName("drift.alert", "event", "sustained-input-drift alert raised"),
+    MetricName("drift.recovered", "event", "input-drift alert cleared by a clean window"),
     MetricName("router.failover", "event", "request re-dispatched onto the next ring replica"),
     MetricName("router.hedge", "event", "hedged duplicate dispatch launched against a straggler"),
     MetricName("fleet.member_joined", "event", "replica registered into fleet membership"),

@@ -61,7 +61,7 @@ BUNDLE_REQUIRED_KEYS = (
 METRIC_WATCH_PREFIXES = (
     "shed", "queue.shed", "queue.poisoned", "timeouts", "breaker.trips",
     "exec.hung", "predict.failures", "lifecycle.", "canary.",
-    "registry.evictions",
+    "registry.evictions", "quality.alerts", "drift.alerts",
 )
 
 _seq = itertools.count(1)  # CPython-atomic, like trace._ids

@@ -369,6 +369,60 @@ def note_xla_cost(entry: str, cost: Dict[str, float],
 
 JOURNAL_FORMAT = "spark_gp_tpu.run_journal/v1"
 
+#: monotone integer bumped when journal KEYS change meaning or new
+#: required keys appear.  History: 1 (implicit — pre-stamp journals,
+#: through PR 12), 2 (explicit stamp + expert_quality).  ``gpctl show``
+#: validates journal documents against :data:`JOURNAL_REQUIRED_KEYS`
+#: exactly the way it validates incident bundles (exit 1 on malformed).
+JOURNAL_SCHEMA_VERSION = 2
+
+#: keys every schema-valid journal carries — the journal's twin of
+#: ``obs/recorder.BUNDLE_REQUIRED_KEYS`` (tests + gpctl validation read
+#: this, so the contract lives in one place).  ``schema_version`` itself
+#: is NOT required: pre-stamp journals on disk are legacy v1 and must
+#: keep loading without complaint.
+JOURNAL_REQUIRED_KEYS = (
+    "format", "name", "created_unix", "pid", "build_info", "precision_lane",
+    "timings", "metrics", "degradations", "quarantine", "compiles",
+    "memory", "spans",
+)
+
+#: keys that arrived AFTER the first journals shipped (``pid`` /
+#: ``build_info`` with the forensics plane, ``degradations`` with the
+#: fallback ladder) — a pre-stamp legacy document must not fail
+#: validation for predating them
+_JOURNAL_V2_ONLY_KEYS = frozenset(("pid", "build_info", "degradations"))
+
+
+def validate_journal(journal: dict) -> List[str]:
+    """Schema check shared by tests and ``tools/gpctl`` — returns the
+    list of problems (empty = valid).  A ``schema_version`` NEWER than
+    this build's is a problem (the document may carry semantics this
+    reader cannot interpret); an absent stamp is legacy v1 and fine."""
+    problems = []
+    if journal.get("format") != JOURNAL_FORMAT:
+        problems.append(f"format is {journal.get('format')!r}")
+    legacy = "schema_version" not in journal
+    for key in JOURNAL_REQUIRED_KEYS:
+        if key not in journal and not (legacy and key in _JOURNAL_V2_ONLY_KEYS):
+            problems.append(f"missing required key {key!r}")
+    version = journal.get("schema_version")
+    if version is not None:
+        if not isinstance(version, int):
+            problems.append(f"schema_version is {version!r}, not an int")
+        elif version > JOURNAL_SCHEMA_VERSION:
+            problems.append(
+                f"schema_version {version} is newer than this build's "
+                f"{JOURNAL_SCHEMA_VERSION}"
+            )
+    for key in ("timings", "metrics", "quarantine", "memory", "compiles"):
+        if key in journal and not isinstance(journal[key], dict):
+            problems.append(f"{key} is not an object")
+    for key in ("spans", "degradations"):
+        if key in journal and not isinstance(journal[key], list):
+            problems.append(f"{key} is not a list")
+    return problems
+
 #: per-fit artifacts that accumulate in a long-lived checkpoint/journal
 #: directory (journals are stamped unique per fit; host-optimizer
 #: checkpoints are per-tag; incident bundles per failure) — the
@@ -546,6 +600,7 @@ def write_run_journal(
         trace_token = active_trace_token()
     journal = {
         "format": JOURNAL_FORMAT,
+        "schema_version": JOURNAL_SCHEMA_VERSION,
         "name": getattr(instr, "name", "gp"),
         "created_unix": time.time(),
         # the STITCHED trace id: one value across every host's journal
@@ -571,6 +626,12 @@ def write_run_journal(
         # predicted-vs-actual peaks — the provenance that makes a wrong
         # prediction a debuggable artifact instead of a mystery crash
         "memory_plan": _memory_plan_rows(instr, capture),
+        # fit-time per-expert quality telemetry (models/common.
+        # _emit_expert_quality): per-expert NLL at theta*, settled jitter
+        # level, effective BCM weight — the statistical health plane's
+        # fit-side evidence (``gpctl quality`` renders it); None when the
+        # probe was skipped or disabled
+        "expert_quality": getattr(instr, "expert_quality", None),
         "quarantine": {
             "experts_quarantined": getattr(instr, "metrics", {}).get(
                 "experts_quarantined", 0.0

@@ -39,6 +39,12 @@ Fault classes:
   dispatch under the limit (zero OOM); ``GP_MEMPLAN=0`` restores the
   reactive crash-then-degrade behavior — both branches provable on CPU.
   Env channel: ``GP_CHAOS_MEMORY_LIMIT_BYTES``;
+* :func:`miscalibrate` / :func:`drift_inputs` — statistical-quality
+  faults for the health plane (``obs/quality.py``): scale every served
+  σ (an overconfident model) or shift every admitted request's features
+  (upstream covariate drift), so the calibration and drift alerts are
+  provable on CPU with seeded determinism.  Env channels:
+  ``GP_CHAOS_MISCALIBRATE``, ``GP_CHAOS_DRIFT_INPUTS``;
 * **multi-host faults** (consumed by ``parallel/coord.py``'s guarded
   collectives and coordinated checkpointers):
   :class:`StragglerHost` — inject a fixed delay before a named
@@ -327,6 +333,8 @@ _mp_state = {
     "compile_fired": None,    # one-element list: injected-failure count
     "memory_limit": None,     # float | None: staged device memory budget
     "memory_fired": None,     # one-element list: budget-OOM count
+    "sigma_scale": None,      # float | None: served-σ miscalibration factor
+    "input_shift": None,      # float | None: additive covariate shift
 }
 
 
@@ -494,6 +502,70 @@ def memory_limit_bytes(n: float):
         yield fired
     finally:
         _mp_state["memory_limit"], _mp_state["memory_fired"] = prev
+
+
+# --------------------------------------------------------------------------
+# statistical-quality faults (obs/quality.py consumes these on the serve path)
+# --------------------------------------------------------------------------
+
+
+def sigma_scale() -> Optional[float]:
+    """The staged served-σ miscalibration factor, or None: the in-process
+    stage (:func:`miscalibrate`) wins, else ``GP_CHAOS_MISCALIBRATE``.
+    Consulted by the serve executor AFTER a successful predict — the
+    served variance is scaled by ``scale**2``, modeling a model whose σ
+    is ``scale``× wrong (``scale < 1`` = overconfident, the
+    product-of-experts failure mode the quality monitor exists for)."""
+    staged = _mp_state["sigma_scale"]
+    if staged is not None:
+        return float(staged)
+    return _env_chaos_float("GP_CHAOS_MISCALIBRATE")
+
+
+def input_shift() -> Optional[float]:
+    """The staged additive covariate shift, or None: the in-process stage
+    (:func:`drift_inputs`) wins, else ``GP_CHAOS_DRIFT_INPUTS``.
+    Consulted by the serve submit path — every admitted request's
+    features are shifted by this constant, modeling upstream feature
+    drift the fit never saw (the drift monitor must alarm; predictions
+    legitimately move)."""
+    staged = _mp_state["input_shift"]
+    if staged is not None:
+        return float(staged)
+    return _env_chaos_float("GP_CHAOS_DRIFT_INPUTS")
+
+
+@contextlib.contextmanager
+def miscalibrate(scale: float):
+    """Stage a served-σ miscalibration: every serve answer's variance is
+    scaled by ``scale**2`` (``scale=0.5`` = the classic 2× σ-shrink
+    overconfidence).  The quality monitor (``obs/quality.py``) must trip
+    ``quality.alert.*`` within a bounded number of graded observations —
+    the acceptance proof in ``tools/soak.py`` and
+    ``tests/test_quality_obs.py``.  Subprocess channel:
+    ``GP_CHAOS_MISCALIBRATE``."""
+    if float(scale) <= 0:
+        raise ValueError("sigma scale must be > 0")
+    prev = _mp_state["sigma_scale"]
+    _mp_state["sigma_scale"] = float(scale)
+    try:
+        yield
+    finally:
+        _mp_state["sigma_scale"] = prev
+
+
+@contextlib.contextmanager
+def drift_inputs(shift: float):
+    """Stage an additive covariate shift on every admitted serve request:
+    the drift monitor must raise ``drift.alert.*`` within a bounded
+    number of rows while a clean run never does.  Subprocess channel:
+    ``GP_CHAOS_DRIFT_INPUTS``."""
+    prev = _mp_state["input_shift"]
+    _mp_state["input_shift"] = float(shift)
+    try:
+        yield
+    finally:
+        _mp_state["input_shift"] = prev
 
 
 @contextlib.contextmanager

@@ -18,6 +18,16 @@ Protocol (one JSON object per line, in either direction):
         -> same, plus "request_id": "abc" echoed; the id is stamped on the
            server-side serve.predict span and any incident bundle a hang
            verdict dumps (cross-process trace stitching, docs/OBSERVABILITY.md)
+    {"cmd": "observe", "model": "m", "request_id": "abc", "y": [...]}
+        -> {"event": "observed", "joined": k, ...}; joins delayed
+           ground-truth labels to the prediction served for that
+           request_id and feeds the model's calibration monitor
+           (obs/quality.py).  Idempotent per id (a duplicate join is a
+           counted no-op); an unknown/evicted id fails with
+           code=observe.unknown_request.  A predict carrying
+           "observe": false marks its request_id as infrastructure
+           dedupe only (fleet-router minted): it is echoed/stamped as
+           usual but never parked for a later observe
     {"cmd": "metrics"}                               -> {"event": "metrics", ...}
     {"cmd": "health"}   (alias: {"op": "health"})    -> {"event": "health", "status": "ok"|"degraded"|"unready", ...}
     {"cmd": "reload", "model": "m"}                  -> {"event": "reloaded", ...}
@@ -116,6 +126,12 @@ def _parse_args(argv):
         "--max-connections", type=int, default=64,
         help="TCP mode: concurrent-connection bound; connections past it "
         "are refused with one code=serve.conn_limit line",
+    )
+    parser.add_argument(
+        "--quality", type=int, default=None, choices=(0, 1),
+        help="statistical quality plane (obs/quality.py): 1 enables the "
+        "per-model calibration/drift monitors and the observe verb "
+        "(default: on unless GP_SERVE_QUALITY=0)",
     )
     parser.add_argument(
         "--metrics-port", type=int, default=None,
@@ -246,6 +262,34 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                     "event": "health", **server.health()
                 })
                 continue
+            if cmd == "observe":
+                # delayed-label feedback join (obs/quality.py): cheap
+                # (O(rows) numpy under the quality lock), but routed
+                # through the ordered writer queue so an observation can
+                # never be processed before the reply of the predict it
+                # grades was emitted.  Always answered as an "observed"
+                # event — success or a coded error — so wire clients can
+                # route the reply without a request id.
+                def _do_observe(m=msg):
+                    try:
+                        result = server.observe(
+                            m["model"], m["request_id"], m["y"]
+                        )
+                        return {"event": "observed", **result}
+                    except Exception as exc:  # noqa: BLE001 — per-request
+                        reply = {
+                            "event": "observed",
+                            "error": f"{type(exc).__name__}: {exc}"[:500],
+                        }
+                        code = getattr(exc, "code", None)
+                        if code is not None:
+                            reply["code"] = code
+                        if m.get("request_id") is not None:
+                            reply["request_id"] = str(m["request_id"])
+                        return reply
+
+                pending.put(_do_observe)
+                continue
             if cmd == "reload":
                 # on a side thread: a reload pays a full load + AOT warmup,
                 # and blocking the reader here would keep NEW requests from
@@ -300,6 +344,10 @@ def _serve_stream(server, lines, out_stream, out_lock) -> bool:
                     # work is shed with code=queue.shed.memory
                     priority=int(msg.get("priority", 0)),
                     request_id=request_id,
+                    # "observe": false marks an infrastructure-dedupe id
+                    # (fleet-router minted): the quality plane must not
+                    # park (μ, σ²) for an id no client can ever grade
+                    observable=bool(msg.get("observe", True)),
                 )
             except Exception as exc:  # noqa: BLE001 — shed/shape errors
                 # through the writer queue, not directly: error replies
@@ -468,6 +516,7 @@ def main(argv=None) -> int:
         memory_limit_bytes=args.memory_limit_bytes,
         drain_deadline_s=args.drain_deadline_s,
         replica_id=args.replica_id,
+        quality=None if args.quality is None else bool(args.quality),
     )
     for spec in args.model:
         name, sep, path = spec.partition("=")

@@ -45,6 +45,16 @@ ERROR_CODES: Dict[str, str] = {
     "shed.breaker": (
         "the model's circuit breaker is open — retry after its cooldown"
     ),
+    # -- observe verb / quality plane (server.observe, obs/quality.py) -----
+    "observe.unknown_request": (
+        "observation names a request_id with no pending prediction "
+        "(never served with an id here, or evicted from the bounded "
+        "pending ring)"
+    ),
+    "observe.disabled": (
+        "observation reached a server whose statistical quality plane "
+        "is disabled (GP_SERVE_QUALITY=0 / --quality 0)"
+    ),
     # -- router failover codes (serve/router.py) ---------------------------
     "router.no_replicas": (
         "no live serving replica owns the request's ring key"

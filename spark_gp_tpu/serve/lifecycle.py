@@ -431,12 +431,21 @@ class CanaryPolicy:
     candidate answer is shadow-scored against the incumbent on the same
     rows.  One shadow delta past ``delta_predict_bar``, or ``max_errors``
     raising dispatches, rolls back; ``promote_after`` clean shadow scores
-    promote."""
+    promote.
+
+    ``quality_guard=True`` adds the statistical health plane
+    (``obs/quality.py``) as a SECOND promotion input next to the shadow
+    score: at the moment the clean-score count clears the bar, an active
+    miscalibration/drift alert on the model vetoes the promotion and
+    rolls the candidate back instead — a candidate whose means match the
+    incumbent but whose σ's are dishonest must not be promoted on the
+    mean-delta evidence alone."""
 
     fraction: float = 0.1
     delta_predict_bar: float = field(default_factory=_default_predict_bar)
     max_errors: int = 3
     promote_after: int = 20
+    quality_guard: bool = False
 
     def __post_init__(self) -> None:
         if not 0.0 < self.fraction <= 1.0:
@@ -487,9 +496,13 @@ class CanaryController:
     via the registry, promotion moves the latest pointer and lets
     bounded retention evict the predecessor."""
 
-    def __init__(self, registry, metrics) -> None:
+    def __init__(self, registry, metrics, quality_lookup=None) -> None:
         self._registry = registry
         self._metrics = metrics
+        #: optional ``name -> active-alert reason | None`` callable (the
+        #: serve quality plane's verdict) consulted when a policy opts
+        #: into ``quality_guard``
+        self._quality_lookup = quality_lookup
         self._lock = threading.Lock()
         self._canaries: dict = {}
         #: (name, version) -> reason; rolled-back versions are quarantined
@@ -607,7 +620,24 @@ class CanaryController:
                 reason=f"shadow delta {delta:.3e} > guard bar {bar:.3e}",
             )
         elif promote:
-            self._promote(name, version)
+            quality_veto = None
+            if (
+                canary.policy.quality_guard
+                and self._quality_lookup is not None
+            ):
+                # the optional quality-guard input (obs/quality.py): a
+                # candidate that cleared the mean-delta bar while the
+                # model's served distributions are under an active
+                # miscalibration/drift alert is NOT promotable on that
+                # evidence — roll back instead
+                quality_veto = self._quality_lookup(name)
+            if quality_veto is not None:
+                self._rollback(
+                    name, version,
+                    reason=f"quality alert active at promotion: {quality_veto}",
+                )
+            else:
+                self._promote(name, version)
 
     def cancel(self, name: str, reason: str = "cancelled") -> bool:
         """Abort an active canary without a verdict (a direct reload or

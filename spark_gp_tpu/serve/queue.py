@@ -100,6 +100,13 @@ class PredictRequest:
     # into any incident bundle a hang verdict dumps — the client's handle
     # for cross-process trace stitching
     request_id: Optional[str] = None
+    # False when the id exists only for infrastructure dedupe (the fleet
+    # router mints an id per id-LESS request so a hedged duplicate is one
+    # logical request server-side): such ids can never receive a delayed
+    # label, so the quality plane must not park their (μ, σ²) — id-less
+    # fleet traffic would otherwise evict genuinely observable entries
+    # from the bounded pending ring
+    observable: bool = True
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and (

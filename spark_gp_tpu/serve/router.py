@@ -49,6 +49,7 @@ import socket
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -184,13 +185,22 @@ class LocalReplicaTransport:
         return self._killed
 
     def submit(self, model: str, x, timeout_ms=None, request_id=None,
-               priority: int = 0, version=None) -> ServeFuture:
+               priority: int = 0, version=None,
+               observable: bool = True) -> ServeFuture:
         if self._killed:
             raise ReplicaUnreachableError(self.replica_id)
         return self.server.submit(
             model, x, version=version, timeout_ms=timeout_ms,
             priority=priority, request_id=request_id,
+            observable=observable,
         )
+
+    def observe(self, model: str, request_id: str, y) -> dict:
+        """Forward a delayed-label observation to this replica's quality
+        plane (``server.observe``)."""
+        if self._killed:
+            raise ReplicaUnreachableError(self.replica_id)
+        return self.server.observe(model, request_id, y)
 
     def health(self) -> dict:
         if self._killed:
@@ -228,6 +238,13 @@ class TcpReplicaTransport:
         self._rfile = None
         self._pending: Dict[int, ServeFuture] = {}
         self._health_waiters: List[ServeFuture] = []
+        self._observe_waiters: List[ServeFuture] = []
+        # observe replies are matched to waiters FIFO, so the waiter
+        # append and the wire send must be ONE atomic step: two
+        # concurrent observe() callers (FleetRouter.observe is a public,
+        # any-thread API) could otherwise enqueue in one order and hit
+        # the wire in the other, cross-wiring their replies
+        self._observe_fifo = threading.Lock()
         self._next_id = 0
         self._dead = False
         self._reader: Optional[threading.Thread] = None
@@ -279,6 +296,24 @@ class TcpReplicaTransport:
                     if waiter is not None and not waiter.done():
                         waiter.set_result(msg)
                     continue
+                if msg.get("event") == "observed":
+                    # observe replies ride the writer queue in send order,
+                    # so FIFO waiter matching is exact (the health-waiter
+                    # convention); a coded error reply maps back onto the
+                    # same WireError surface as predict errors
+                    with self._lock:
+                        waiter = (
+                            self._observe_waiters.pop(0)
+                            if self._observe_waiters else None
+                        )
+                    if waiter is not None and not waiter.done():
+                        if "error" in msg:
+                            waiter.set_error(
+                                WireError(msg["error"], code=msg.get("code"))
+                            )
+                        else:
+                            waiter.set_result(msg)
+                    continue
                 if "id" not in msg:
                     continue  # listening/shutdown events on this stream
                 with self._lock:
@@ -303,9 +338,13 @@ class TcpReplicaTransport:
     def _fail_all(self) -> None:
         with self._lock:
             self._dead = True
-            pending = list(self._pending.values()) + self._health_waiters
+            pending = (
+                list(self._pending.values())
+                + self._health_waiters + self._observe_waiters
+            )
             self._pending.clear()
             self._health_waiters = []
+            self._observe_waiters = []
         for future in pending:
             if not future.done():
                 future.set_error(ReplicaUnreachableError(self.replica_id))
@@ -325,7 +364,8 @@ class TcpReplicaTransport:
             raise ReplicaUnreachableError(self.replica_id) from exc
 
     def submit(self, model: str, x, timeout_ms=None, request_id=None,
-               priority: int = 0, version=None) -> ServeFuture:
+               priority: int = 0, version=None,
+               observable: bool = True) -> ServeFuture:
         with self._lock:
             self._ensure_locked()
             self._next_id += 1
@@ -342,10 +382,32 @@ class TcpReplicaTransport:
                 payload["timeout_ms"] = float(timeout_ms)
             if request_id is not None:
                 payload["request_id"] = str(request_id)
+                if not observable:
+                    # router-minted hedging id: tell the replica's
+                    # quality plane not to park (μ, σ²) for it — no
+                    # client can ever send this id a label
+                    payload["observe"] = False
             if version is not None:
                 payload["version"] = int(version)
         self._send(payload)
         return future
+
+    def observe(self, model: str, request_id: str, y,
+                timeout_s: float = 5.0) -> dict:
+        """Forward a delayed-label observation over the wire; the reply
+        (success or a coded error) is routed back FIFO like health."""
+        with self._observe_fifo:
+            with self._lock:
+                self._ensure_locked()
+                waiter = ServeFuture()
+                self._observe_waiters.append(waiter)
+            self._send({
+                "cmd": "observe",
+                "model": model,
+                "request_id": str(request_id),
+                "y": np.asarray(y, dtype=np.float64).reshape(-1).tolist(),
+            })
+        return waiter.result(timeout_s)
 
     def health(self, timeout_s: float = 5.0) -> dict:
         with self._lock:
@@ -436,6 +498,13 @@ class FleetRouter:
         self._view: dict = {}
         self._ring = HashRing(())
         self._last_poll: Optional[float] = None
+        # bounded request_id -> replica_id memory of ANSWERED requests:
+        # the observe verb's delayed labels must reach the replica whose
+        # pending ring holds that request's (μ, σ²) — the one that
+        # actually answered, which failover/hedging may have made a
+        # successor, not the ring owner
+        self._answered: "OrderedDict[str, str]" = OrderedDict()
+        self._answered_capacity = 4096
         self.rebuild()
 
     # -- membership view ---------------------------------------------------
@@ -530,8 +599,14 @@ class FleetRouter:
         deadline = started + timeout_s
         order = self.route(model, rows)  # refreshes the membership view
         self.metrics.inc("router.requests")
+        # a CLIENT-supplied id can receive a delayed label later (the
+        # observe leg); an id-less request still gets a router-minted id
+        # so a hedged duplicate dispatch is one logical request server-
+        # side — but minted ids are unobservable and must not consume
+        # the answered memory or any replica's bounded pending ring
+        client_id = request_id is not None
         request_id = (
-            str(request_id) if request_id is not None
+            str(request_id) if client_id
             else f"fr-{uuid.uuid4().hex[:12]}"
         )
         if not order:
@@ -575,7 +650,7 @@ class FleetRouter:
                     future = transport.submit(
                         model, x, timeout_ms=remaining_ms,
                         request_id=request_id, priority=priority,
-                        version=version,
+                        version=version, observable=client_id,
                     )
                 except Exception as exc:  # noqa: BLE001 — classified below
                     if not failover_eligible(exc):
@@ -623,6 +698,8 @@ class FleetRouter:
                     self.metrics.observe(
                         "router.request_latency_s", self._clock() - started
                     )
+                    if client_id:
+                        self._note_answered(request_id, rid)
                     return mean, var
             if progressed:
                 continue
@@ -637,6 +714,36 @@ class FleetRouter:
                 launch(hedged=True)
                 continue
             self._sleep(min(0.002, max(0.0, deadline - now)))
+
+    def _note_answered(self, request_id: str, replica_id: str) -> None:
+        with self._lock:
+            self._answered[request_id] = replica_id
+            self._answered.move_to_end(request_id)
+            while len(self._answered) > self._answered_capacity:
+                self._answered.popitem(last=False)
+
+    def observe(self, model: str, request_id: str, y) -> dict:
+        """Forward a delayed-label observation to the replica that
+        ANSWERED ``request_id`` — only its pending ring holds that
+        request's (μ, σ²), and failover/hedging means that is not
+        necessarily the ring owner.  Raises
+        :class:`~spark_gp_tpu.obs.quality.UnknownRequestError`
+        (``code=observe.unknown_request``) when the router never
+        answered that id (or it aged out of the bounded memory), and
+        :class:`ReplicaUnreachableError` when the answering replica is
+        gone — the label is lost with the replica, by design."""
+        from spark_gp_tpu.obs.quality import UnknownRequestError
+
+        with self._lock:
+            rid = self._answered.get(str(request_id))
+        if rid is None:
+            raise UnknownRequestError(str(request_id))
+        transport = self._transports.get(rid)
+        if transport is None:
+            raise ReplicaUnreachableError(rid)
+        result = transport.observe(model, str(request_id), y)
+        self.metrics.inc("router.observes")
+        return result
 
     def _backoff(self, deadline: float) -> None:
         with self._lock:
@@ -663,6 +770,7 @@ class FleetRouter:
         self._set_fleet_gauges(view)
         pressures: Dict[str, float] = {}
         shedding: Dict[str, bool] = {}
+        quality_alerting: Dict[str, list] = {}
         for rid in view["live"] + view["draining"]:
             transport = self._transports.get(rid)
             if transport is None:
@@ -692,6 +800,17 @@ class FleetRouter:
                 f"fleet.memory_shedding.{rid}",
                 1.0 if shedding[rid] else 0.0,
             )
+            # statistical health per replica (obs/quality.py): which
+            # models the replica reports under an active miscalibration
+            # or drift alert — one scrape answers "is any replica
+            # serving dishonest σ's" next to the scaling signals
+            quality_alerting[rid] = list(
+                (health.get("quality") or {}).get("alerting") or []
+            )
+            self.metrics.set_gauge(
+                f"fleet.quality_alert.{rid}",
+                1.0 if quality_alerting[rid] else 0.0,
+            )
         live_pressure = [
             p for rid, p in pressures.items() if rid in view["live"]
         ]
@@ -708,6 +827,7 @@ class FleetRouter:
             "stragglers": view["stragglers"],
             "queue_pressure": pressures,
             "memory_shedding": shedding,
+            "quality_alerting": quality_alerting,
             "scale_up": scale_up,
         }
 

@@ -19,6 +19,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from spark_gp_tpu.obs import trace as obs_trace
+from spark_gp_tpu.resilience import chaos as _chaos
 from spark_gp_tpu.resilience.breaker import BreakerOpenError, CircuitBreaker
 from spark_gp_tpu.serve.lifecycle import (
     CanaryController,
@@ -67,6 +68,9 @@ class GPServeServer:
         memory_limit_bytes: Optional[float] = None,
         drain_deadline_s: float = 30.0,
         replica_id: Optional[str] = None,
+        quality: Optional[bool] = None,
+        quality_window: int = 128,
+        pending_capacity: int = 4096,
     ):
         # replica identity (health verb + fleet attribution): explicit
         # arg > GP_REPLICA_ID env > a pid-derived default — stable for
@@ -128,7 +132,31 @@ class GPServeServer:
                 "lifecycle.memory_pressure", 1.0 if shedding else 0.0
             ),
         )
-        self.canaries = CanaryController(self.registry, self.metrics)
+        # statistical health plane (obs/quality.py): per-model calibration
+        # + drift monitors fed by the observe verb and the batch executor.
+        # On by default — its per-request cost is a request_id check plus
+        # O(batch) numpy, priced <2% by the bench quality subsection —
+        # with GP_SERVE_QUALITY=0 / quality=False as the kill switch.
+        from spark_gp_tpu.obs.quality import (
+            ServeQualityPlane,
+            quality_enabled_default,
+        )
+
+        enabled = quality_enabled_default() if quality is None else bool(quality)
+        self.quality = (
+            ServeQualityPlane(
+                self.metrics,
+                window=quality_window,
+                pending_capacity=pending_capacity,
+            )
+            if enabled else None
+        )
+        self.canaries = CanaryController(
+            self.registry, self.metrics,
+            quality_lookup=(
+                None if self.quality is None else self.quality.alert_reason
+            ),
+        )
 
     def _breaker_for(self, name: str) -> CircuitBreaker:
         breaker = self._breakers.get(name)
@@ -230,6 +258,8 @@ class GPServeServer:
         self._queue.stop(drain=drain)
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self.quality is not None:
+            self.quality.close()  # joins the drainer; idempotent
         self._started = False
         self._state = "stopped"
         # begin_drain() -> stop() (without drain()) must not leave the
@@ -263,6 +293,8 @@ class GPServeServer:
         self._queue.stop(drain=drained)
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self.quality is not None:
+            self.quality.close()
         self._started = False
         self._state = "stopped"
         self.metrics.observe("lifecycle.drain_s", time.monotonic() - started)
@@ -279,6 +311,7 @@ class GPServeServer:
         timeout_ms: Optional[float] = None,
         priority: int = 0,
         request_id: Optional[str] = None,
+        observable: bool = True,
     ) -> ServeFuture:
         """Enqueue a predict; returns immediately with a future.
 
@@ -342,6 +375,13 @@ class GPServeServer:
         x = np.asarray(x, dtype=entry.predictor.dtype)
         if x.ndim == 1:
             x = x[None, :]
+        # chaos: staged upstream covariate drift (resilience/chaos.py) —
+        # shifts the real features, so predictions legitimately move and
+        # the drift monitor (obs/quality.py) must alarm.  A dict read +
+        # env probe when unstaged; never set it in production.
+        shift = _chaos.input_shift()
+        if shift is not None:
+            x = x + shift
         if x.ndim != 2 or x.shape[1] != entry.predictor.n_features:
             raise ValueError(
                 f"model {name!r} expects [t, {entry.predictor.n_features}] "
@@ -370,6 +410,7 @@ class GPServeServer:
             ),
             routed=routed is not None and entry.version == routed,
             request_id=None if request_id is None else str(request_id),
+            observable=bool(observable),
         )
         try:
             future = self._queue.submit(request)
@@ -425,6 +466,25 @@ class GPServeServer:
                   else self._request_timeout_s) + 5.0
         )
         return self.submit(name, x, version, timeout_ms).result(wait_s)
+
+    # -- delayed-label feedback (any thread) ------------------------------
+    def observe(self, name: str, request_id: str, y) -> dict:
+        """Join delayed ground-truth labels to the prediction served for
+        ``request_id`` and feed the model's calibration monitor
+        (``obs/quality.py``).  ``y`` is the label vector for that
+        request's rows.  Idempotent per id (a duplicate is a counted
+        no-op); raises :class:`~spark_gp_tpu.obs.quality.
+        UnknownRequestError` (``code=observe.unknown_request``) when no
+        prediction is pending, :class:`~spark_gp_tpu.obs.quality.
+        QualityDisabledError` when the plane is off."""
+        from spark_gp_tpu.obs.quality import QualityDisabledError
+
+        if self.quality is None:
+            raise QualityDisabledError()
+        # resolve for existence (KeyError for unknown names) and so the
+        # drift scorer binds the model's fit-time covariate summary
+        entry = self.registry.get(name)
+        return self.quality.observe(name, request_id, y, entry=entry)
 
     # -- batch execution (batcher thread) ---------------------------------
     def _execute(self, group: List[PredictRequest]) -> None:
@@ -519,6 +579,13 @@ class GPServeServer:
                     # incident bundle — the wedged dispatch's own evidence
                     token.span = predict_span
                 mean, var = entry.predict(x)
+                # chaos: staged σ-miscalibration (resilience/chaos.py) —
+                # the served variance is genuinely wrong by scale², the
+                # product-of-experts overconfidence fault the quality
+                # monitor's alert must catch.  Unstaged: one dict read.
+                scale = _chaos.sigma_scale()
+                if scale is not None and var is not None:
+                    var = var * (scale * scale)
         except BaseException as exc:  # classified-failure-site: counted via classify_failure, re-raised
             if token is not None:
                 self._watchdog.end(token)
@@ -575,6 +642,24 @@ class GPServeServer:
             if token is not None and token.fired:
                 return  # futures already failed, worker already replaced
         elapsed = time.monotonic() - started
+        if self.quality is not None:
+            # statistical health plane: hand this dispatch to the quality
+            # drainer thread (pending-ring puts for the delayed-label
+            # join + drift scoring happen OFF the batcher — the serial
+            # serving bottleneck pays only an id sweep and a bounded
+            # enqueue; a label racing the drainer is covered by observe's
+            # flush-and-retry).  Never allowed to fail a dispatch.
+            try:
+                self.quality.note_predictions(
+                    name, entry, group, rows, mean, var, x
+                )
+            except Exception:  # noqa: BLE001 — telemetry must never fail
+                # a healthy predict; the monitor just misses this batch
+                import logging
+
+                logging.getLogger("spark_gp_tpu").warning(
+                    "quality plane note_predictions failed", exc_info=True
+                )
         padded = entry.predictor.padded_rows(total)
         self.metrics.inc("batches")
         self.metrics.inc("padded_rows", padded - total)
@@ -687,6 +772,10 @@ class GPServeServer:
             name: b.snapshot() for name, b in sorted(dict(self._breakers).items())
         }
         snap["lifecycle"] = self.lifecycle_snapshot()
+        snap["quality"] = (
+            {"enabled": False} if self.quality is None
+            else self.quality.snapshot()
+        )
         return snap
 
     def openmetrics(self) -> str:
@@ -714,8 +803,9 @@ class GPServeServer:
 
         ``status``: ``"ok"`` (ready, all breakers closed),
         ``"degraded"`` (serving, but at least one model's breaker is
-        open/half-open, the queue is above 90% capacity, or the memory
-        gate is shedding), ``"draining"`` (shutdown in progress: finish
+        open/half-open, the queue is above 90% capacity, the memory
+        gate is shedding, or a sustained miscalibration/drift alert is
+        active — obs/quality.py), ``"draining"`` (shutdown in progress: finish
         queued work, route new traffic elsewhere) or ``"unready"`` (not
         started / no models).  A degraded server still answers requests
         for its healthy models — that is the point.
@@ -731,11 +821,24 @@ class GPServeServer:
             if b["state"] != CircuitBreaker.CLOSED
         )
         lifecycle = self.lifecycle_snapshot()
+        # statistical health (obs/quality.py): a model whose served σ's
+        # are provably dishonest — or whose inputs drifted off the
+        # training mass — degrades the replica exactly like an open
+        # breaker: it still answers, but an orchestrator should know the
+        # answers are suspect
+        quality = (
+            {"enabled": False} if self.quality is None
+            else self.quality.snapshot()
+        )
+        quality_alerting = quality.get("alerting") or []
         if lifecycle["draining"]:
             status = "draining"
         elif not self.ready():
             status = "unready"
-        elif broken or queue_pressure > 0.9 or lifecycle["memory"]["shedding"]:
+        elif (
+            broken or queue_pressure > 0.9 or lifecycle["memory"]["shedding"]
+            or quality_alerting
+        ):
             status = "degraded"
         else:
             status = "ok"
@@ -785,6 +888,7 @@ class GPServeServer:
             "ready": self.ready(),
             "models": self.registry.names(),
             "broken_models": broken,
+            "quality": quality,
             "breakers": breakers,
             "lifecycle": lifecycle,
             "queue": {

@@ -54,6 +54,15 @@ def save_model(path: str, model, kind: str) -> None:
     # entry: pre-provenance loaders ignore it, no format bump needed.
     import jax
 
+    # the fit-time covariate summary (obs/quality.summarize_covariates):
+    # per-dim training moments + the active-set distance sketch the serve
+    # drift monitor scores incoming rows against.  Carried on the model
+    # directly (a load->save round trip) or on its fit instr (a fresh fit).
+    instr = getattr(model, "instr", None)
+    covariate_summary = (
+        getattr(model, "covariate_summary", None)
+        or (getattr(instr, "covariate_summary", None) if instr else None)
+    )
     extras["provenance_json"] = np.frombuffer(
         json.dumps({
             "process_count": jax.process_count(),
@@ -61,6 +70,10 @@ def save_model(path: str, model, kind: str) -> None:
             # fallback.py): a model produced through fallback re-execution
             # says so permanently — [] for a clean fit
             "degradations": list(getattr(model, "degradations", None) or ()),
+            **(
+                {"covariate_summary": covariate_summary}
+                if covariate_summary else {}
+            ),
         }).encode(),
         dtype=np.uint8,
     )
@@ -134,6 +147,12 @@ def load_model(path: str):
     else:
         model = GaussianProcessRegressionModel(raw)
     model.provenance = provenance
+    # the drift scorer's input (obs/quality.py): restore the fit-time
+    # covariate summary onto the model so the serve registry can bind a
+    # DriftMonitor without re-reading provenance
+    model.covariate_summary = (
+        provenance.get("covariate_summary") if provenance else None
+    )
     if provenance and provenance.get("degradations"):
         # restore the ladder's stamp onto the model object itself, so a
         # save->load->save round trip keeps the degradation history

@@ -46,6 +46,11 @@ def _run(script, env_extra, args=(), timeout=900):
     env.pop("GP_RECORDER", None)
     env.pop("GP_XLA_COST", None)
     env.pop("GP_INCIDENT_DIR", None)
+    # a disabled quality plane / fit telemetry would null the quality
+    # overhead measurement on a healthy bench.py
+    env.pop("GP_SERVE_QUALITY", None)
+    env.pop("GP_EXPERT_TELEMETRY", None)
+    env.pop("GP_COVARIATE_SUMMARY", None)
     # an exported GP_MEMPLAN=0 (or a stray margin/limit) would fail the
     # memory_plan section on a healthy bench.py
     env.pop("GP_MEMPLAN", None)
@@ -200,6 +205,17 @@ def test_bench_emits_one_parseable_result_line():
     assert rec["record_seconds"] > 0 and rec["note_metric_seconds"] > 0
     assert rec["fit_overhead_pct"] < 2.0, rec
     assert rec["serve_overhead_pct"] < 2.0, rec
+    # the statistical health plane (ISSUE 13, obs/quality.py) rides the
+    # same bar: the monitor's BATCHER-side work (one note_predictions
+    # handoff per dispatch; puts/scoring run on the drainer thread)
+    # stays under 2% of the burst, with zero batches dropped
+    quality = obs["quality"]
+    assert quality["note_seconds"] > 0, quality
+    assert quality["pending_put_seconds"] > 0, quality
+    assert quality["drift_score_seconds"] > 0, quality
+    assert quality["dropped_batches"] == 0, quality
+    assert quality["overhead_pct"] < 2.0, quality
+    assert quality["monitor_on_points_per_sec_max"] > 0, quality
     # measured XLA cost attribution (obs/cost.py): the metered fit's
     # journal carries non-null flops and a measured optimize-phase MFU
     xla = obs["xla_cost"]

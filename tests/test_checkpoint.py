@@ -61,7 +61,7 @@ def test_kill_and_resume_reaches_same_theta(tmp_path):
 
     # "kill" after a few iterations: cap max_iter low, then restart uncapped
     interrupted = _gp(tmp_path / "resume").setMaxIter(4)
-    interrupted.fit(x, y)
+    int_iters = interrupted.fit(x, y).instr.metrics["lbfgs_iters"]
     ck = DeviceOptimizerCheckpointer(str(tmp_path / "resume"), "gpr")
     assert ck.path and (tmp_path / "resume" / "gpr_device_lbfgs.npz").exists()
 
@@ -69,9 +69,15 @@ def test_kill_and_resume_reaches_same_theta(tmp_path):
     np.testing.assert_allclose(
         resumed.raw_predictor.theta, theta_full, rtol=1e-5
     )
-    # resume really consumed the state: the second run's iteration counter
-    # continues past the interrupted run's cap
-    assert resumed.instr.metrics["lbfgs_iters"] > 4
+    # resume really consumed the state: the cumulative counter continues
+    # from the interrupted run's persisted count instead of restarting at
+    # zero.  Anchor on the count actually persisted, not the cap: under
+    # heavy CPU load XLA's thread partitioning can perturb summation order
+    # enough that the capped run converges just UNDER its cap, in which
+    # case the resumed run legitimately reports that same count.
+    assert resumed.instr.metrics["lbfgs_iters"] >= int_iters
+    if int_iters >= 4:  # the interrupted run really was capped mid-descent
+        assert resumed.instr.metrics["lbfgs_iters"] > 4
 
 
 def test_stale_checkpoint_ignored(tmp_path):

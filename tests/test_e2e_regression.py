@@ -67,7 +67,13 @@ def test_model_save_load_roundtrip(tmp_path):
     # topology that produced the BCM aggregate (utils/serialization.py)
     # a clean fit records an EMPTY degradation history (the ladder's
     # provenance stamp, resilience/fallback.py)
-    assert restored.provenance == {"process_count": 1, "degradations": []}
+    assert restored.provenance["process_count"] == 1
+    assert restored.provenance["degradations"] == []
+    # and the fit-time covariate summary the serve drift monitor scores
+    # against (obs/quality.summarize_covariates)
+    summary = restored.provenance["covariate_summary"]
+    assert summary["dims"] == x.shape[1] and summary["n"] == x.shape[0]
+    assert restored.covariate_summary == summary
 
 
 def test_duplicate_rows_survive_via_jitter(rng):

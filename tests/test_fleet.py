@@ -71,7 +71,7 @@ class StubTransport:
         self.calls = []
 
     def submit(self, model, x, timeout_ms=None, request_id=None,
-               priority=0, version=None):
+               priority=0, version=None, observable=True):
         action = self.script.get(len(self.calls), self.default)
         self.calls.append((model, request_id))
         if isinstance(action, BaseException):

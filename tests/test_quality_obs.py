@@ -1,0 +1,679 @@
+"""Statistical health plane (ISSUE 13, spark_gp_tpu/obs/quality.py):
+streaming calibration statistics, the multi-window verdict engine, the
+pending-ring feedback join behind the serve ``observe`` verb, covariate
+drift detection against the fit-time provenance summary, fit-time
+per-expert quality telemetry, and the gpctl renderers.
+
+The statistics themselves carry seeded property tests: a WELL-SPECIFIED
+model (labels drawn exactly from the served distributions) must show
+~uniform PIT and coverage inside the binomial CI — and never alert —
+while the chaos faults (``chaos.miscalibrate`` σ-scaling,
+``chaos.drift_inputs`` covariate shift) must trip their alerts within a
+bounded number of observations.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.obs.quality import (
+    COVERAGE_LEVELS,
+    DriftMonitor,
+    PendingRing,
+    QualityDisabledError,
+    QualityMonitor,
+    UnknownRequestError,
+    summarize_covariates,
+)
+from spark_gp_tpu.resilience import chaos
+from spark_gp_tpu.serve import GPServeServer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the ISSUE 13 acceptance bound: injected faults must alarm within this
+#: many graded observations; the clean twin must never alarm within it
+ALERT_BUDGET = 512
+
+
+def _calibrated_stream(rng, n, sigma_truth_factor=1.0):
+    """(mean, var, y): the model claims N(mean, var); the labels are
+    drawn from N(mean, (factor * sigma)^2) — factor 1 is the
+    well-specified case, factor 2 a served sigma shrunk 2x below truth."""
+    mean = rng.normal(size=n)
+    sigma = np.abs(rng.normal(1.0, 0.3, size=n)) + 0.2
+    y = mean + sigma_truth_factor * sigma * rng.standard_normal(n)
+    return mean, sigma**2, y
+
+
+def _fit(seed=3, n=160):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 3))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=n)
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(40)
+        .setActiveSetSize(40)
+        .setSigma2(1e-3)
+        .setMaxIter(5)
+        .setSeed(seed)
+        .fit(x, y)
+    )
+    return model, x, y
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    model, x, y = _fit()
+    path = str(tmp_path_factory.mktemp("quality") / "model.npz")
+    model.save(path)
+    return path, model, x, y
+
+
+# -- the statistics themselves (seeded property tests) ---------------------
+
+
+@pytest.mark.parametrize("seed", [0, 7, 23])
+def test_well_specified_stream_is_calibrated_and_never_alerts(seed):
+    rng = np.random.default_rng(seed)
+    monitor = QualityMonitor(window=128, breach_windows=2)
+    n = 4096
+    mean, var, y = _calibrated_stream(rng, n)
+    monitor.observe(mean, var, y)
+    snap = monitor.snapshot()
+    assert snap["observations"] == n
+    assert snap["windows_closed"] == n // 128
+    # coverage within a 4-sigma binomial CI of each nominal level
+    for level in COVERAGE_LEVELS:
+        p = float(level) / 100.0
+        ci = 4.0 * np.sqrt(p * (1.0 - p) / n)
+        assert abs(snap["coverage"][level] - p) < ci, (level, snap)
+    # z-statistics near the standard normal
+    assert abs(snap["z_mean"]) < 5.0 / np.sqrt(n)
+    assert abs(snap["z_std"] - 1.0) < 0.1
+    # PIT ~ uniform: chi^2 over the lifetime histogram under a generous
+    # bound (df=19; 60 is past the 1e-4 tail even per window)
+    pit = np.asarray(snap["pit"], dtype=np.float64)
+    expected = n / len(pit)
+    chi2 = float(np.sum((pit - expected) ** 2) / expected)
+    assert chi2 < 60.0, (chi2, pit)
+    # the clean run NEVER alerted
+    assert snap["alert"] is False
+    assert all(not w["breached"] for w in snap["recent_windows"]), snap
+
+
+@pytest.mark.parametrize("seed", [1, 11, 29])
+def test_sigma_shrink_trips_alert_within_budget(seed):
+    rng = np.random.default_rng(seed)
+    monitor = QualityMonitor(window=128, breach_windows=2)
+    mean, var, y = _calibrated_stream(rng, ALERT_BUDGET, sigma_truth_factor=2.0)
+    tripped_at = 0
+    for i in range(ALERT_BUDGET):
+        monitor.observe(mean[i : i + 1], var[i : i + 1], y[i : i + 1])
+        if monitor.alert:
+            tripped_at = i + 1
+            break
+    assert 0 < tripped_at <= ALERT_BUDGET, "2x sigma-shrink never alerted"
+    assert monitor.alert_reasons, monitor.snapshot()
+
+
+def test_systematic_bias_trips_alert():
+    rng = np.random.default_rng(5)
+    monitor = QualityMonitor(window=128, breach_windows=2)
+    mean, var, y = _calibrated_stream(rng, ALERT_BUDGET)
+    monitor.observe(mean, var, y + 2.0)  # labels systematically shifted
+    assert monitor.alert
+    assert any(
+        "z_mean" in r or "coverage" in r or "pit" in r
+        for r in monitor.alert_reasons
+    )
+
+
+def test_alert_recovers_after_clean_window():
+    rng = np.random.default_rng(9)
+    monitor = QualityMonitor(window=64, breach_windows=2)
+    mean, var, y = _calibrated_stream(rng, 256, sigma_truth_factor=3.0)
+    monitor.observe(mean, var, y)
+    assert monitor.alert
+    mean, var, y = _calibrated_stream(rng, 256)
+    monitor.observe(mean, var, y)
+    assert not monitor.alert  # clean windows clear the verdict
+
+
+# -- pending ring ----------------------------------------------------------
+
+
+def test_pending_ring_join_is_idempotent_and_bounded():
+    ring = PendingRing(capacity=4)
+    for i in range(6):
+        ring.put(f"r{i}", np.zeros(2), np.ones(2))
+    assert ring.depth() == 4 and ring.evicted == 2
+    with pytest.raises(UnknownRequestError):  # evicted oldest-first
+        ring.join("r0")
+    mean, var = ring.join("r5")
+    assert mean.shape == (2,)
+    assert ring.join("r5") is None  # duplicate: idempotent no-op
+    with pytest.raises(UnknownRequestError):
+        ring.join("never")
+    # a re-served id overwrites instead of double-counting
+    ring.put("dup", np.zeros(1), np.ones(1))
+    ring.put("dup", np.zeros(1) + 1.0, np.ones(1))
+    mean, _ = ring.join("dup")
+    assert float(mean[0]) == 1.0
+    # a length-mismatched join raises WITHOUT consuming the entry: the
+    # client's corrected retry must still find the prediction pending,
+    # not an idempotent-duplicate no-op that silently loses the labels
+    ring.put("mis", np.zeros(3), np.ones(3))
+    with pytest.raises(ValueError, match="3 row"):
+        ring.join("mis", expect_rows=2)
+    mean, _ = ring.join("mis", expect_rows=3)
+    assert mean.shape == (3,)
+
+
+# -- covariate summary + drift --------------------------------------------
+
+
+def test_covariate_summary_shape_and_provenance_round_trip(
+    saved_model, tmp_path
+):
+    path, model, x, y = saved_model
+    summary = getattr(model.instr, "covariate_summary", None)
+    assert summary is not None
+    assert summary["dims"] == 3 and summary["n"] > 0
+    assert len(summary["mean"]) == 3 and len(summary["std"]) == 3
+    assert summary["active_dist"]["q50"] <= summary["active_dist"]["q99"]
+    # the saved model carries it in provenance_json; load restores it
+    from spark_gp_tpu.utils.serialization import load_model
+
+    loaded = load_model(path)
+    assert loaded.covariate_summary == summary
+    # and a load -> save -> load round trip keeps it (the model-attr leg)
+    path2 = str(tmp_path / "round.npz")
+    loaded.save(path2)
+    assert load_model(path2).covariate_summary == summary
+
+
+def test_drift_monitor_clean_vs_shifted():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2000, 4))
+    summary = summarize_covariates(x, active=x[:64])
+    clean = DriftMonitor(summary, window=64, breach_windows=2)
+    for _ in range(ALERT_BUDGET // 16):
+        clean.score_rows(rng.normal(size=(16, 4)))
+    assert not clean.alert, clean.snapshot()
+    drifted = DriftMonitor(summary, window=64, breach_windows=2)
+    for _ in range(16):
+        drifted.score_rows(rng.normal(size=(16, 4)) + 3.0)
+    assert drifted.alert
+    assert drifted.windows_closed == 4
+    assert any("mean_shift" in r or "out_of_mass" in r
+               for r in drifted.alert_reasons)
+
+
+def test_drift_monitor_bounds_per_batch_cost_by_sampling():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2000, 4))
+    summary = summarize_covariates(x)
+    capped = DriftMonitor(summary, window=64, breach_windows=2)
+    capped.score_rows(rng.normal(size=(256, 4)))
+    assert capped.rows == 16  # stride-sampled down to the cap
+    # an uncapped monitor folds every row — one oversized batch closes
+    # as many FULL windows as it spans
+    full = DriftMonitor(
+        summary, window=64, breach_windows=2, max_rows_per_batch=None
+    )
+    full.score_rows(rng.normal(size=(256, 4)) + 3.0)
+    assert full.rows == 256 and full.windows_closed == 4
+    assert full.alert
+
+
+def test_drift_monitors_are_per_version_so_canary_alternation_counts():
+    """A canary rollout alternates stable/candidate dispatches of the
+    same model name: each version must keep ITS OWN drift monitor (a
+    single last-seen-version slot would rebuild on every alternation and
+    reset the window before it could ever close — drift alerting dead
+    exactly while a canary is active)."""
+    from types import SimpleNamespace
+
+    from spark_gp_tpu.obs.quality import ServeQualityPlane
+    from spark_gp_tpu.serve.metrics import ServingMetrics
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(2000, 4))
+    summary = summarize_covariates(x, active=x[:64])
+
+    def entry(version):
+        return SimpleNamespace(
+            version=version,
+            model=SimpleNamespace(covariate_summary=summary),
+        )
+
+    plane = ServeQualityPlane(
+        ServingMetrics(), window=32, drift_window=64, breach_windows=2
+    )
+    stable, candidate = entry(1), entry(2)
+    # 32 alternating 8-row drifted dispatches per version: each version's
+    # monitor accumulates 8 rows/dispatch (under the 16-row cap), so both
+    # close windows and alert despite the alternation
+    for _ in range(32):
+        for e in (stable, candidate):
+            plane._process(
+                "m", e, [], None, None, rng.normal(size=(8, 4)) + 3.0
+            )
+    state = plane._state_for("m")
+    monitors = {v: d for v, d in state.drifts.items()}
+    assert set(monitors) == {1, 2}
+    for version, monitor in monitors.items():
+        assert monitor.windows_closed >= 2, (version, monitor.snapshot())
+        assert monitor.alert, version
+    assert plane.alert_reason("m") is not None
+    # the bound holds: stale versions are evicted oldest-first
+    for version in range(3, 9):
+        plane._state_for("m", entry(version))
+    assert len(plane._state_for("m").drifts) == 4
+
+
+def test_summarize_covariates_degenerate_inputs():
+    assert summarize_covariates(np.zeros((1, 3))) is None
+    assert summarize_covariates(np.full((8, 2), np.nan)) is None
+    # constant dims must not divide by zero
+    summary = summarize_covariates(np.ones((32, 2)))
+    assert summary is not None and summary["std"] == [0.0, 0.0]
+    DriftMonitor(summary).score_rows(np.ones((8, 2)))
+
+
+# -- serve integration -----------------------------------------------------
+
+
+def _boot(path, **kw):
+    server = GPServeServer(
+        max_batch=32, min_bucket=8, max_wait_ms=1.0,
+        request_timeout_ms=10_000.0, **kw,
+    )
+    server.register("m", path)
+    server.start()
+    return server
+
+
+def test_observe_joins_labels_and_health_carries_snapshot(saved_model):
+    path, model, x, y = saved_model
+    server = _boot(path, quality_window=32)
+    try:
+        fut = server.submit("m", x[:4], request_id="r1")
+        mean, var = fut.result(10.0)
+        # a wrong-length observation is a client error that does NOT
+        # consume the pending entry — the corrected retry still grades
+        with pytest.raises(ValueError, match="4 row"):
+            server.observe("m", "r1", y[:3])
+        out = server.observe("m", "r1", y[:4])
+        assert out["joined"] == 4 and out["duplicate"] is False
+        # idempotent duplicate
+        dup = server.observe("m", "r1", y[:4])
+        assert dup["joined"] == 0 and dup["duplicate"] is True
+        assert server.metrics.counter("quality.observe.duplicate") == 1
+        with pytest.raises(UnknownRequestError):
+            server.observe("m", "never-served", y[:1])
+        assert (
+            server.metrics.counter("quality.observe.unknown_request") == 1
+        )
+        health = server.health()
+        calib = health["quality"]["models"]["m"]["calibration"]
+        assert calib["observations"] == 4
+        assert health["quality"]["models"]["m"]["pending"]["depth"] == 0
+        # a request WITHOUT an id is never parked
+        server.submit("m", x[:2]).result(10.0)
+        assert server.quality.snapshot()["models"]["m"]["pending"]["depth"] == 0
+    finally:
+        server.stop()
+
+
+def test_quality_disabled_server_rejects_observe(saved_model):
+    path, model, x, y = saved_model
+    server = _boot(path, quality=False)
+    try:
+        assert server.quality is None
+        server.submit("m", x[:2], request_id="r1").result(10.0)
+        with pytest.raises(QualityDisabledError) as err:
+            server.observe("m", "r1", y[:2])
+        assert err.value.code == "observe.disabled"
+        assert server.health()["quality"] == {"enabled": False}
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_miscalibrate_trips_serve_alert_and_degrades(saved_model):
+    """The acceptance proof at the server level: a clean feedback loop
+    never alerts; the 2x sigma-shrink injector alerts within the budget
+    and flips health to degraded."""
+    path, model, x, y = saved_model
+    rng = np.random.default_rng(17)
+    server = _boot(path, quality_window=64)
+
+    def feed(n_obs, sigma_truth_factor):
+        done = 0
+        i = 0
+        while done < n_obs:
+            rid = f"f{sigma_truth_factor}-{i}"
+            i += 1
+            row = int(rng.integers(0, x.shape[0] - 8))
+            mean, var = server.submit(
+                "m", x[row : row + 4], request_id=rid
+            ).result(10.0)
+            labels = np.asarray(mean) + sigma_truth_factor * np.sqrt(
+                np.asarray(var)
+            ) * rng.standard_normal(4)
+            server.observe("m", rid, labels)
+            done += 4
+            if server.health()["quality"]["alerting"]:
+                return done
+        return 0
+
+    try:
+        assert feed(ALERT_BUDGET, 1.0) == 0, "clean twin alerted"
+        assert server.health()["status"] == "ok"
+        with chaos.miscalibrate(0.5):
+            tripped = feed(ALERT_BUDGET, 2.0)
+        assert 0 < tripped <= ALERT_BUDGET
+        health = server.health()
+        assert health["status"] == "degraded"
+        assert server.metrics.counter("quality.alerts") >= 1
+        assert server.metrics.gauges.get("quality.alert.m") == 1.0
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_drift_inputs_trips_drift_alert(saved_model):
+    path, model, x, y = saved_model
+    server = _boot(path)
+
+    def pump(n_rows):
+        done = 0
+        while done < n_rows:
+            row = done % (x.shape[0] - 8)
+            server.submit("m", x[row : row + 8]).result(10.0)
+            done += 8
+            if server.health()["quality"]["alerting"]:
+                return done
+        return 0
+
+    try:
+        assert pump(ALERT_BUDGET) == 0, "clean traffic raised drift alert"
+        shift = 4.0 * float(x.std())
+        with chaos.drift_inputs(shift):
+            tripped = pump(ALERT_BUDGET)
+        assert 0 < tripped <= ALERT_BUDGET
+        assert server.metrics.counter("drift.alerts") >= 1
+        assert server.metrics.gauges.get("drift.alert.m") == 1.0
+        assert server.health()["status"] == "degraded"
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_canary_quality_guard_vetoes_promotion(saved_model):
+    """A candidate that clears the shadow-score bar while the model is
+    under an active miscalibration alert must roll back, not promote."""
+    from spark_gp_tpu.serve.lifecycle import CanaryPolicy
+
+    path, model, x, y = saved_model
+    rng = np.random.default_rng(23)
+    server = _boot(path, quality_window=32)
+    try:
+        # drive the model into a quality alert with miscalibrated labels
+        for i in range(40):
+            rid = f"g{i}"
+            row = int(rng.integers(0, x.shape[0] - 8))
+            mean, var = server.submit(
+                "m", x[row : row + 4], request_id=rid
+            ).result(10.0)
+            server.observe(
+                "m", rid,
+                np.asarray(mean)
+                + 3.0 * np.sqrt(np.asarray(var)) * rng.standard_normal(4),
+            )
+        assert server.quality.alert_reason("m") is not None
+        # same model file as candidate: shadow deltas are 0 (clean), so
+        # without the guard it would promote after promote_after scores
+        server.register(
+            "m", path,
+            canary_policy=CanaryPolicy(
+                fraction=1.0, promote_after=3, quality_guard=True
+            ),
+        )
+        for i in range(8):
+            server.submit("m", x[i : i + 2]).result(10.0)
+            if server.canaries.active("m") is None:
+                break
+        assert server.metrics.counter("canary.rollbacks") == 1
+        assert server.metrics.counter("canary.promotions") == 0
+        quarantined = server.canaries.snapshot()["quarantined"]
+        assert any(
+            "quality alert" in reason for reason in quarantined.values()
+        ), quarantined
+    finally:
+        server.stop()
+
+
+# -- fleet forwarding ------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_router_forwards_observation_to_answering_replica(saved_model):
+    from spark_gp_tpu.parallel.coord import (
+        InProcessCoordClient,
+        InProcessCoordStore,
+    )
+    from spark_gp_tpu.serve.fleet import FleetMembership, LocalReplica
+    from spark_gp_tpu.serve.router import FleetRouter
+
+    path, model, x, y = saved_model
+    store = InProcessCoordStore()
+    membership = FleetMembership(
+        InProcessCoordClient(store, 0, 1), fleet="q",
+        interval_s=0.05, straggler_after_s=5.0, dead_after_s=10.0,
+    )
+    replicas = []
+    for i in range(2):
+        server = GPServeServer(
+            max_batch=16, min_bucket=8, max_wait_ms=1.0,
+            request_timeout_ms=10_000.0, replica_id=f"r{i}",
+        )
+        server.register("m", path)
+        server.start()
+        replica = LocalReplica(server, f"r{i}", membership)
+        replica.register()
+        replicas.append(replica)
+    router = FleetRouter(
+        membership,
+        transports={r.replica_id: r.transport for r in replicas},
+        max_batch=16, min_bucket=8, default_timeout_ms=10_000.0,
+        poll_interval_s=0.0,
+    )
+    try:
+        for replica in replicas:
+            replica.heartbeat()
+        mean, var = router.predict("m", x[:4], request_id="fleet-1")
+        result = router.observe("m", "fleet-1", y[:4])
+        assert result["joined"] == 4
+        assert router.metrics.counter("router.observes") == 1
+        # the observation landed on exactly ONE replica — the answerer
+        joined_counts = [
+            r.server.metrics.counter("quality.observations")
+            for r in replicas
+        ]
+        assert sorted(joined_counts) == [0.0, 4.0], joined_counts
+        with pytest.raises(UnknownRequestError):
+            router.observe("m", "nobody-answered-this", y[:1])
+        # id-LESS fleet traffic (the router mints an internal hedging id)
+        # must consume neither the router's answered memory nor any
+        # replica's bounded pending ring — those minted ids can never
+        # receive a label, and parking them would evict observable ones
+        def pending_total():
+            return sum(
+                r.server.quality.snapshot()["models"]
+                .get("m", {"pending": {"depth": 0}})["pending"]["depth"]
+                for r in replicas
+            )
+
+        depth_before = pending_total()
+        router.predict("m", x[:4])
+        for r in replicas:
+            r.server.quality.flush()
+        assert len(router._answered) == 1  # just "fleet-1"
+        assert pending_total() == depth_before
+        # the fleet page aggregates quality verdicts per replica
+        sampled = router.sample_fleet()
+        assert set(sampled["quality_alerting"]) == {"r0", "r1"}
+        assert all(v == [] for v in sampled["quality_alerting"].values())
+    finally:
+        router.close()
+        for replica in replicas:
+            replica.stop()
+
+
+# -- fit-time telemetry + journal + gpctl ----------------------------------
+
+
+def test_fit_stamps_expert_quality_into_journal(saved_model):
+    path, model, x, y = saved_model
+    journal = model.run_journal
+    assert journal["schema_version"] >= 2
+    eq = journal["expert_quality"]
+    assert eq is not None
+    assert eq["experts"] == 4 and eq["active"] == 4
+    assert len(eq["nll"]) == 4 and len(eq["weight"]) == 4
+    assert all(np.isfinite(v) for v in eq["nll"])
+    assert all(w == 1.0 for w in eq["weight"])
+    metrics = model.instr.metrics
+    assert metrics["expert_quality.nll_spread"] >= 0.0
+    assert metrics["expert_quality.jitter_max"] == 0.0
+    assert metrics["expert_quality.weight_min"] == 1.0
+
+
+def test_expert_telemetry_kill_switch(monkeypatch):
+    monkeypatch.setenv("GP_EXPERT_TELEMETRY", "0")
+    monkeypatch.setenv("GP_COVARIATE_SUMMARY", "0")
+    model, x, y = _fit(seed=5)
+    assert getattr(model.instr, "expert_quality", None) is None
+    assert getattr(model.instr, "covariate_summary", None) is None
+    assert (model.run_journal or {}).get("expert_quality") is None
+
+
+def test_validate_journal_contract(tmp_path):
+    from spark_gp_tpu.obs.runtime import (
+        JOURNAL_SCHEMA_VERSION,
+        validate_journal,
+    )
+
+    model, x, y = _fit(seed=7)
+    journal = {k: v for k, v in model.run_journal.items() if k != "path"}
+    assert validate_journal(journal) == []
+    # legacy journals without the stamp stay valid — including true
+    # pre-forensics/pre-ladder documents that predate pid/build_info/
+    # degradations entirely
+    legacy = dict(journal)
+    legacy.pop("schema_version")
+    assert validate_journal(legacy) == []
+    for key in ("pid", "build_info", "degradations"):
+        legacy.pop(key)
+    assert validate_journal(legacy) == []
+    # ... but a STAMPED journal must carry the v2 keys
+    stamped = dict(journal)
+    del stamped["pid"]
+    assert any("pid" in p for p in validate_journal(stamped))
+    # a NEWER schema_version is a problem (unknown semantics)
+    future = dict(journal, schema_version=JOURNAL_SCHEMA_VERSION + 1)
+    assert any("newer" in p for p in validate_journal(future))
+    broken = dict(journal)
+    del broken["timings"]
+    broken["spans"] = "nope"
+    problems = validate_journal(broken)
+    assert any("timings" in p for p in problems)
+    assert any("spans" in p for p in problems)
+
+
+def _gpctl(*args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "tools.gpctl", *args],
+        capture_output=True, text=True, timeout=120, env=env, cwd=ROOT,
+    )
+
+
+@pytest.fixture(scope="module")
+def journal_dir(tmp_path_factory, saved_model):
+    path, model, x, y = saved_model
+    directory = str(tmp_path_factory.mktemp("journals"))
+    journal = dict(model.run_journal)
+    journal.pop("path", None)
+    with open(os.path.join(directory, "run_journal_q-1-p1-t1.json"), "w") as fh:
+        json.dump(journal, fh, default=str)
+    return directory
+
+
+def test_gpctl_show_validates_journal_schema(journal_dir, tmp_path):
+    good = os.path.join(journal_dir, "run_journal_q-1-p1-t1.json")
+    out = _gpctl("show", good)
+    assert out.returncode == 0, out.stderr
+    assert "expert_quality" in out.stdout
+    # a malformed journal exits 1 with the problems named — the bundle
+    # validation contract, now for journals
+    with open(good, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    doc.pop("timings")
+    doc["schema_version"] = 99
+    bad = str(tmp_path / "run_journal_bad-1-p1-t1.json")
+    with open(bad, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    out = _gpctl("show", bad)
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "SCHEMA" in out.stderr
+    assert "timings" in out.stderr and "newer" in out.stderr
+
+
+def test_gpctl_events_lists_and_filters(journal_dir):
+    out = _gpctl("events", journal_dir)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip(), "no events listed"
+    # --grep filters by event name; an unmatched pattern exits 2
+    out = _gpctl("events", journal_dir, "--grep", "compile")
+    if out.returncode == 0:
+        assert all(
+            "compile" in line for line in out.stdout.strip().splitlines()
+        )
+    else:
+        assert out.returncode == 2
+    out = _gpctl("events", journal_dir, "--grep", "no_such_event_name")
+    assert out.returncode == 2
+    out = _gpctl("events", journal_dir, "--grep", "[broken")
+    assert out.returncode == 2
+
+
+def test_gpctl_quality_renders_expert_table(journal_dir):
+    out = _gpctl("quality", journal_dir)
+    assert out.returncode == 0, out.stderr
+    assert "nll_spread=" in out.stdout
+    out = _gpctl("quality", "--experts", journal_dir)
+    assert out.returncode == 0
+    assert "expert" in out.stdout and "weight" in out.stdout
+
+
+def test_quality_metrics_render_on_openmetrics_page(saved_model):
+    path, model, x, y = saved_model
+    server = _boot(path, quality_window=32)
+    try:
+        mean, var = server.submit("m", x[:4], request_id="om1").result(10.0)
+        server.observe("m", "om1", y[:4])
+        page = server.openmetrics()
+        assert "gp_quality_observations_total" in page
+        assert 'gp_quality_z_std{model="m"}' in page
+    finally:
+        server.stop()

@@ -15,12 +15,22 @@ tool is how an operator reads them as ONE story:
                                      # chosen config, predicted vs actual
                                      # peak bytes (measured device peak +
                                      # compiled memory_analysis), deltas
+    gpctl events PATH [...] [--grep NAME]  # flight-recorder / span events
+                                     # out of journals and bundles, one
+                                     # line each, filterable by name
+    gpctl quality DIR [...]          # statistical health: per-journal
+                                     # per-expert NLL spread / jitter /
+                                     # effective weight table
 
 ``merge`` groups artifacts by the stitched ``trace_id`` every journal and
 bundle carries (minted on process 0 and propagated over the coordination
 KV plane — ``parallel/coord.stitch_trace_token``), so a 2-host fit's two
 journals render as one trace.  All subcommands exit 0 on success, 2 on
-bad input; ``show`` exits 1 when a bundle fails schema validation.
+bad input; ``show`` exits 1 when a bundle OR a journal fails schema
+validation (journals are validated against
+``obs/runtime.JOURNAL_REQUIRED_KEYS`` exactly like bundles are against
+``obs/recorder.BUNDLE_REQUIRED_KEYS``; pre-``schema_version`` journals
+load as legacy v1 without complaint).
 """
 
 from __future__ import annotations
@@ -188,6 +198,22 @@ def cmd_show(args) -> int:
             for problem in problems:
                 print(f"  SCHEMA: {problem}", file=sys.stderr)
             return 1
+    if kind == "journal":
+        eq = doc.get("expert_quality")
+        if eq:
+            print(
+                f"  expert_quality: {eq.get('active')}/{eq.get('experts')} "
+                "active experts (gpctl quality for the table)"
+            )
+        from spark_gp_tpu.obs.runtime import validate_journal
+
+        problems = validate_journal(doc)
+        if problems:
+            # the journal schema contract, enforced exactly like the
+            # bundle one: a malformed document exits 1, loudly
+            for problem in problems:
+                print(f"  SCHEMA: {problem}", file=sys.stderr)
+            return 1
     spans = doc.get("spans") or []
     if spans:
         print("  span tree:")
@@ -303,6 +329,126 @@ def cmd_plan(args) -> int:
     return 0
 
 
+def _walk_span_events(nodes: List[dict], out: List[dict]) -> None:
+    for node in nodes:
+        for event in node.get("events") or []:
+            out.append({**event, "span": node.get("name")})
+        _walk_span_events(node.get("children") or [], out)
+
+
+def _artifact_events(doc: dict) -> List[dict]:
+    """Every structured event a journal or bundle carries, flattened:
+    bundles have the flight-recorder ring verbatim (``events``); journals
+    carry span-attached events inside the span tree plus the quarantine
+    event digest.  De-duplicated by (seq) where present."""
+    events: List[dict] = []
+    for event in doc.get("events") or []:  # bundle recorder ring
+        events.append(dict(event))
+    _walk_span_events(doc.get("spans") or [], events)
+    if _kind_of(doc) == "journal":
+        for event in (doc.get("quarantine") or {}).get("events") or []:
+            events.append(dict(event))
+    seen = set()
+    unique = []
+    for event in events:
+        key = (event.get("seq"), event.get("name"), event.get("t_unix"))
+        if event.get("seq") is not None and key in seen:
+            continue
+        seen.add(key)
+        unique.append(event)
+    unique.sort(key=lambda e: (e.get("t_unix") or 0.0, e.get("seq") or 0))
+    return unique
+
+
+def cmd_events(args) -> int:
+    """List flight-recorder / span events out of journals and bundles —
+    the query surface for recorded events that previously existed only
+    inside full ``show`` output.  ``--grep`` filters by event name
+    (regex, searched)."""
+    import re
+
+    docs = _collect(args.paths)
+    if not docs:
+        print("no journals or bundles found", file=sys.stderr)
+        return 2
+    pattern = None
+    if args.grep:
+        try:
+            pattern = re.compile(args.grep)
+        except re.error as exc:
+            print(f"bad --grep pattern: {exc}", file=sys.stderr)
+            return 2
+    shown = 0
+    for doc in docs:
+        for event in _artifact_events(doc):
+            name = str(event.get("name", "?"))
+            if pattern is not None and not pattern.search(name):
+                continue
+            attrs = {
+                k: v for k, v in event.items()
+                if k not in ("seq", "t_unix", "thread", "name", "span")
+            }
+            span = event.get("span")
+            where = f" span={span}" if span else ""
+            print(
+                f"{_fmt_time(event.get('t_unix'))}  {name:<28s}"
+                f"{where} {attrs if attrs else ''} "
+                f"[{os.path.basename(doc['_path'])}]"
+            )
+            shown += 1
+    if shown == 0:
+        print("no matching events", file=sys.stderr)
+        return 2
+    return 0
+
+
+def cmd_quality(args) -> int:
+    """The statistical health plane's fit-side table: every journal's
+    ``expert_quality`` block (per-expert NLL at theta*, settled jitter,
+    effective BCM weight — models/common._emit_expert_quality) as one
+    table, so a fleet of fits' expert health is a grep away."""
+    docs = [d for d in _collect(args.paths) if _kind_of(d) == "journal"]
+    if not docs:
+        print("no journals found", file=sys.stderr)
+        return 2
+    printed = False
+    for doc in docs:
+        eq = doc.get("expert_quality")
+        if not eq:
+            continue
+        metrics = doc.get("metrics") or {}
+        printed = True
+        name = str(doc.get("name", "?"))
+        print(
+            f"{name}  experts={eq.get('experts')} active={eq.get('active')} "
+            f"nll_spread={metrics.get('expert_quality.nll_spread', '-')} "
+            f"nll_std={metrics.get('expert_quality.nll_std', '-')} "
+            f"jitter_max={metrics.get('expert_quality.jitter_max', '-')} "
+            f"weight_min={metrics.get('expert_quality.weight_min', '-')}"
+            + (" (truncated)" if eq.get("truncated") else "")
+            + f"  {doc['_path']}"
+        )
+        if args.experts:
+            nlls = eq.get("nll") or []
+            jit = eq.get("jitter") or []
+            wt = eq.get("weight") or []
+            print(f"  {'expert':>6s} {'nll':>14s} {'jitter':>10s} {'weight':>8s}")
+            for i, nll in enumerate(nlls):
+                print(
+                    f"  {i:>6d} {nll:>14.6g} "
+                    f"{(jit[i] if i < len(jit) else 0.0):>10.2e} "
+                    f"{(wt[i] if i < len(wt) else 0.0):>8.3f}"
+                )
+    if not printed:
+        print(
+            "no expert_quality blocks in the given journals (telemetry "
+            "off — GP_EXPERT_TELEMETRY=0 — or pre-quality artifacts)",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
 def _diff_numeric(label: str, a: Dict[str, float], b: Dict[str, float]) -> None:
     keys = sorted(set(a) | set(b))
     shown = False
@@ -384,6 +530,22 @@ def main(argv=None) -> int:
     )
     p_plan.add_argument("paths", nargs="+", help="files or directories")
     p_plan.set_defaults(fn=cmd_plan)
+
+    p_events = sub.add_parser(
+        "events", help="list flight-recorder/span events from artifacts"
+    )
+    p_events.add_argument("paths", nargs="+", help="files or directories")
+    p_events.add_argument("--grep", default=None,
+                          help="filter by event name (regex, searched)")
+    p_events.set_defaults(fn=cmd_events)
+
+    p_quality = sub.add_parser(
+        "quality", help="per-expert fit quality table from journals"
+    )
+    p_quality.add_argument("paths", nargs="+", help="files or directories")
+    p_quality.add_argument("--experts", action="store_true",
+                           help="print the full per-expert rows")
+    p_quality.set_defaults(fn=cmd_quality)
 
     args = parser.parse_args(argv)
     try:

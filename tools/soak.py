@@ -75,6 +75,14 @@ SCENARIOS = (
     "fleet_hang",
     "fleet_split_canary",
     "fleet_restart",
+    # statistical health plane (obs/quality.py): each campaign runs a
+    # CLEAN seeded twin first (graded observations drawn exactly from
+    # the served distributions / undrifted traffic — no alert may ever
+    # fire), then stages the fault (chaos.miscalibrate 2x sigma-shrink /
+    # chaos.drift_inputs covariate shift) and requires the respective
+    # quality.alert.* / drift.alert.* verdict within <= 512 observations
+    "quality_miscalibrated",
+    "quality_drift",
 )
 
 #: per-scenario tolerance on |pred - clean_pred|: execution-environment
@@ -105,6 +113,10 @@ SCENARIO_TOL = {
     "fleet_hang": 1e-6,
     "fleet_split_canary": 1e-6,
     "fleet_restart": 1e-6,
+    # quality campaigns assert internally and hand back the reference
+    # predictions (the serve_flaky pattern): delta is identically zero
+    "quality_miscalibrated": 1e-6,
+    "quality_drift": 1e-6,
 }
 _DATA_FAULT_TOL = 10.0
 
@@ -259,6 +271,132 @@ def _run_memory_pressure_serve(rng, x, model) -> None:
             raise Violation("no request admitted under the plan gate")
         if server.memory_gate.snapshot()["plan_sheds"] != shed:
             raise Violation("plan_sheds accounting diverged from sheds seen")
+    finally:
+        server.stop()
+
+
+#: quality-campaign acceptance bound: the fault must alarm within this
+#: many graded observations / scored rows (ISSUE 13 acceptance criteria)
+_QUALITY_ALERT_BUDGET = 512
+
+
+def _run_quality_campaign(rng, x, model, mode: str) -> None:
+    """Statistical-health campaign (mode: miscalibrated | drift).
+
+    Phase 1 — the CLEAN seeded twin: graded observations are drawn
+    exactly from the served distributions (labels = mu + sigma * eps)
+    resp. undrifted traffic; any alert is a Violation.  Phase 2 — the
+    staged fault (``chaos.miscalibrate(0.5)``: the served sigma
+    understates the label-generating truth by 2x;
+    ``chaos.drift_inputs``: every admitted request's features shift off
+    the training mass): the respective ``quality.alert.*`` /
+    ``drift.alert.*`` verdict must land within
+    ``_QUALITY_ALERT_BUDGET`` observations, and the health verb must
+    degrade."""
+    import tempfile as _tf
+
+    import numpy as np
+
+    from spark_gp_tpu.resilience import chaos
+    from spark_gp_tpu.serve import GPServeServer
+
+    server = GPServeServer(
+        max_batch=64, min_bucket=8, max_wait_ms=1.0, capacity=256,
+        request_timeout_ms=10_000.0, quality_window=64,
+    )
+    with _tf.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "soak_model.npz")
+        model.save(path)
+        server.register("soak", path)
+    server.start()
+    try:
+        def alerting() -> list:
+            return server.health()["quality"]["alerting"]
+
+        if mode == "miscalibrated":
+            def feed(n_obs: int, sigma_truth_factor: float) -> int:
+                """Serve + observe until ``n_obs`` labels are graded;
+                labels are drawn from N(mu, (factor * sigma_served)^2),
+                so factor 1 is the exactly-calibrated twin and factor 2
+                models a served sigma shrunk 2x below the truth.
+                Returns the observation count at the FIRST alert (0 =
+                never alerted)."""
+                done = 0
+                i = 0
+                while done < n_obs:
+                    sz = 4
+                    row = int(rng.integers(0, max(1, x.shape[0] - 16)))
+                    rid = f"q-{mode}-{sigma_truth_factor}-{i}"
+                    i += 1
+                    mean, var = server.submit(
+                        "soak", x[row : row + sz], request_id=rid,
+                        timeout_ms=10_000.0,
+                    ).result(timeout=15.0)
+                    labels = np.asarray(mean) + sigma_truth_factor * np.sqrt(
+                        np.asarray(var)
+                    ) * rng.standard_normal(sz)
+                    server.observe("soak", rid, labels)
+                    done += sz
+                    if alerting():
+                        return done
+                return 0
+
+            # clean twin: a full alert budget of perfectly-calibrated
+            # observations must never alarm
+            tripped = feed(_QUALITY_ALERT_BUDGET, 1.0)
+            if tripped:
+                raise Violation(
+                    f"clean twin raised a quality alert at {tripped} obs"
+                )
+            with chaos.miscalibrate(0.5):  # served sigma = 0.5 * honest
+                tripped = feed(_QUALITY_ALERT_BUDGET, 2.0)
+            if not tripped:
+                raise Violation(
+                    "2x sigma-shrink never raised quality.alert within "
+                    f"{_QUALITY_ALERT_BUDGET} observations"
+                )
+            if server.metrics.counter("quality.alerts") < 1:
+                raise Violation("quality.alerts counter never moved")
+            if server.health()["status"] != "degraded":
+                raise Violation("sustained miscalibration did not degrade")
+        elif mode == "drift":
+            def pump(n_rows: int) -> int:
+                """Serve ``n_rows`` rows (drift is scored per batch in
+                the executor — no labels needed); returns the row count
+                at the first drift alert (0 = never)."""
+                done = 0
+                while done < n_rows:
+                    sz = 8
+                    row = int(rng.integers(0, max(1, x.shape[0] - 16)))
+                    server.submit(
+                        "soak", x[row : row + sz], timeout_ms=10_000.0
+                    ).result(timeout=15.0)
+                    done += sz
+                    if alerting():
+                        return done
+                return 0
+
+            tripped = pump(_QUALITY_ALERT_BUDGET)
+            if tripped:
+                raise Violation(
+                    f"clean twin raised a drift alert at {tripped} rows"
+                )
+            # a shift of 4 per-dim standard deviations of the actual
+            # training features: unambiguous upstream drift
+            shift = 4.0 * float(np.asarray(x).std())
+            with chaos.drift_inputs(shift):
+                tripped = pump(_QUALITY_ALERT_BUDGET)
+            if not tripped:
+                raise Violation(
+                    "covariate shift never raised drift.alert within "
+                    f"{_QUALITY_ALERT_BUDGET} rows"
+                )
+            if server.metrics.counter("drift.alerts") < 1:
+                raise Violation("drift.alerts counter never moved")
+            if server.health()["status"] != "degraded":
+                raise Violation("sustained input drift did not degrade")
+        else:  # pragma: no cover — closed menu
+            raise Violation(f"unknown quality mode {mode!r}")
     finally:
         server.stop()
 
@@ -713,6 +851,11 @@ def _run_campaign_body(
         elif scenario.startswith("fleet_"):
             _run_fleet_campaign(
                 rng, x, y, ref_model, expert, scenario.split("_", 1)[1]
+            )
+            pred = ref_pred
+        elif scenario.startswith("quality_"):
+            _run_quality_campaign(
+                rng, x, ref_model, scenario.split("_", 1)[1]
             )
             pred = ref_pred
         elif scenario == "guard_degrade":
