@@ -548,11 +548,13 @@ def worker() -> None:
 
     # Degradation-ladder cost (ISSUE 9, resilience/fallback.py): the SAME
     # workload refit with a chaos-injected RESOURCE_EXHAUSTED on the
-    # one-dispatch device program — the ladder re-executes through the
-    # segmented rung (same optimizer trajectory, smaller dispatches).  The
+    # one-dispatch device program.  Since the solver-lane PR the OOM
+    # class degrades to the ITERATIVE rung first (same dispatch shape,
+    # CG workspace instead of factor stacks — ops/iterative.py); the
     # headline is the wall-clock ratio vs the clean fit and the fitted-
-    # theta delta (identical-tolerance contract: test_bench_contract
-    # asserts ratio < 3 and delta <= 1e-6).
+    # theta delta, now bounded by the iterative lane's documented
+    # stochastic tolerance rather than float noise (test_bench_contract
+    # asserts ratio < 3 and rel delta <= 5e-2).
     def _degraded_fit_section():
         from spark_gp_tpu.resilience import chaos
 
@@ -576,6 +578,11 @@ def worker() -> None:
                 degraded.raw_predictor.theta - model.raw_predictor.theta
             ))
         )
+        theta_scale = max(
+            float(np.max(np.abs(model.raw_predictor.theta))), 1e-12
+        )
+        nll_clean = float(model.instr.metrics.get("final_nll", np.nan))
+        nll_degr = float(degraded.instr.metrics.get("final_nll", np.nan))
         return {
             "injected_failures": fired[0],
             "engaged": bool(degr),
@@ -585,12 +592,21 @@ def worker() -> None:
             "degraded_fit_seconds": degraded_seconds,
             "wallclock_ratio": degraded_seconds / fit_seconds,
             "theta_max_abs_delta": theta_delta,
+            "theta_rel_delta": theta_delta / theta_scale,
+            # the objective-level parity contract: theta itself can ride a
+            # flat amplitude ridge at small iteration budgets, but the
+            # achieved objective must match within the lane's bar
+            "nll_rel_delta": abs(nll_degr - nll_clean)
+            / max(abs(nll_clean), 1.0),
             "note": (
-                "one-dispatch device fit OOM-injected at dispatch "
-                "(chaos.oom_after_calls); the ladder completes it through "
-                "the segmented rung — same L-BFGS trajectory in halved "
-                "segment batches, so theta matches the clean fit to float "
-                "noise and the cost is re-dispatch overhead only"
+                "one-dispatch device fit OOM-injected at EVERY dispatch "
+                "of that shape (chaos.oom_after_calls): the ladder walks "
+                "oom -> iterative (same shape, so the unconditional "
+                "injection kills it too) -> segmented, completing there; "
+                "the objective matches the clean fit within the rung "
+                "path's bar and the cost is re-dispatch overhead only.  "
+                "A budget-scoped OOM (memory_plan section below) shows "
+                "the iterative rung completing instead."
             ),
         }
 
@@ -639,6 +655,11 @@ def worker() -> None:
         theta_delta = float(np.max(np.abs(
             planned.raw_predictor.theta - model.raw_predictor.theta
         )))
+        theta_scale = max(
+            float(np.max(np.abs(model.raw_predictor.theta))), 1e-12
+        )
+        nll_clean = float(model.instr.metrics.get("final_nll", np.nan))
+        nll_plan = float(planned.instr.metrics.get("final_nll", np.nan))
         return {
             "budget_bytes": limit,
             "injected_ooms": fired[0],
@@ -653,11 +674,16 @@ def worker() -> None:
             "planned_fit_seconds": planned_seconds,
             "wallclock_ratio": planned_seconds / fit_seconds,
             "theta_max_abs_delta": theta_delta,
+            "theta_rel_delta": theta_delta / theta_scale,
+            "nll_rel_delta": abs(nll_plan - nll_clean)
+            / max(abs(nll_clean), 1.0),
             "note": (
-                "fit under a chaos-staged device budget only the segmented "
-                "dispatch fits (chaos.memory_limit_bytes): the memory plan "
-                "pre-sizes the dispatch BEFORE execution — zero OOMs, zero "
-                "reactive rungs, same L-BFGS trajectory as the clean fit"
+                "fit under a chaos-staged device budget the exact native "
+                "dispatch exceeds (chaos.memory_limit_bytes): the memory "
+                "plan pre-sizes the dispatch BEFORE execution — zero OOMs, "
+                "zero reactive rungs — preferring the iterative solver "
+                "rung (skinny CG workspace, same dispatch shape; theta "
+                "within the lane's stochastic bar) over halving segments"
             ),
         }
 
@@ -937,6 +963,164 @@ def worker() -> None:
             fit_hot_loop = {"error": f"{type(exc).__name__}: {exc}"[:200]}
     else:
         fit_hot_loop = {"skipped": "BENCH_FIT_HOT_LOOP != 1"}
+
+    # Solver lanes (ISSUE 14, ops/iterative.py): the SAME marginal-NLL
+    # value-and-grad at exact (batched Cholesky) vs iterative (batched
+    # preconditioned CG + stochastic Lanczos quadrature) across expert
+    # sizes — the O(s^3) -> O(t s^2) crossover is the headline, and the
+    # bar (iterative >= 1.3x exact nll_evals/sec at the largest probed s,
+    # on CPU) is asserted in test_bench_contract together with
+    # fitted-theta parity within the lane's documented stochastic
+    # tolerance and the analytic memory model showing the iterative rung
+    # admitted under a budget the exact lane's native dispatch exceeds —
+    # the "s = 2048 the exact bench config cannot reach" claim made
+    # checkable without actually crashing an allocator.
+    def _solver_lanes_section():
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from spark_gp_tpu.kernels.base import Const, EyeKernel
+        from spark_gp_tpu.models.likelihood import make_value_and_grad
+        from spark_gp_tpu.ops import iterative as it_ops
+        from spark_gp_tpu.parallel.experts import group_for_experts
+        from spark_gp_tpu.resilience import memplan
+
+        sizes = sorted({
+            int(v) for v in os.environ.get(
+                "BENCH_SOLVER_SIZES", "256,1024,2048"
+            ).split(",") if v.strip()
+        })
+        n_experts = int(os.environ.get("BENCH_SOLVER_EXPERTS", 2))
+        reps = int(os.environ.get("BENCH_SOLVER_REPS", 3))
+        rng_s = np.random.default_rng(23)
+        kernel = 1.0 * RBFKernel(0.5, 1e-6, 10.0) + Const(1e-3) * EyeKernel()
+        per_size = {}
+        for s in sizes:
+            xs = rng_s.normal(size=(n_experts * s, 3)).astype(np.float32)
+            ys = np.sin(xs.sum(axis=1)).astype(np.float32)
+            data_s = group_for_experts(xs, ys, s)
+            theta_s = _jnp.asarray(
+                kernel.init_theta(), dtype=data_s.x.dtype
+            )
+
+            def evals_per_sec(lane):
+                prev = it_ops.set_solver_lane(lane)
+                try:
+                    vag = make_value_and_grad(kernel, data_s)
+                    _jax.block_until_ready(vag(theta_s)[1])  # compile+warm
+                    t0 = time.perf_counter()
+                    out = None
+                    for _ in range(reps):
+                        out = vag(theta_s)
+                    _jax.block_until_ready(out[1])
+                    return reps / (time.perf_counter() - t0)
+                finally:
+                    it_ops.set_solver_lane(prev)
+
+            exact_rate = evals_per_sec("exact")
+            iter_rate = evals_per_sec("iterative")
+            itemsize = int(np.dtype(np.asarray(data_s.x).dtype).itemsize)
+            per_size[str(s)] = {
+                "experts": n_experts,
+                "nll_evals_per_sec": {
+                    "exact": exact_rate, "iterative": iter_rate,
+                },
+                "speedup": iter_rate / exact_rate,
+                # analytic peak-byte rows (resilience/memplan.py): the
+                # exact native dispatch's factor-stack liveness vs the
+                # iterative rung's skinny CG workspace
+                "modeled_fit_bytes": {
+                    "exact_native": memplan.fit_dispatch_bytes(
+                        n_experts, s, 3, itemsize, "native"
+                    ),
+                    "iterative": memplan.fit_dispatch_bytes(
+                        n_experts, s, 3, itemsize, "iterative"
+                    ),
+                },
+            }
+        largest = str(max(sizes))
+        big = per_size[largest]["modeled_fit_bytes"]
+        # the capacity demo: a budget with 1.5x headroom over the
+        # iterative prediction at the largest s ADMITS the iterative
+        # rung while the exact native dispatch is predicted over it
+        budget = 1.5 * memplan.predicted_bytes(big["iterative"])
+        per_size[largest]["memory_budget_demo"] = {
+            "budget_bytes": budget,
+            "iterative_fits": bool(
+                memplan.predicted_bytes(big["iterative"]) <= budget
+            ),
+            "exact_fits": bool(
+                memplan.predicted_bytes(big["exact_native"]) <= budget
+            ),
+        }
+
+        # fitted-theta parity: one small host-optimizer GPR fit per lane
+        # (four-family + device/sharded parity is pinned in
+        # tests/test_iterative.py); the iterative lane's stochastic
+        # log-det/trace legs bound the delta, not float noise
+        par_n = int(os.environ.get("BENCH_SOLVER_PARITY_N", 600))
+        # own O(1)-scale synthetic: the primary workload's tiny-amplitude
+        # ridge leaves theta ill-determined at small iteration budgets,
+        # which would measure optimizer flatness, not lane parity
+        xp_s = rng_s.normal(size=(par_n, 2))
+        yp_s = np.sin(xp_s.sum(axis=1)) + 0.05 * rng_s.normal(size=par_n)
+        thetas = {}
+        solver_metrics = {}
+        for lane in ("exact", "iterative"):
+            prev = it_ops.set_solver_lane(lane)
+            try:
+                m_l = (
+                    GaussianProcessRegression()
+                    .setKernel(lambda: RBFKernel(1.0))
+                    .setDatasetSizeForExpert(50)
+                    .setActiveSetSize(32)
+                    .setSeed(13)
+                    .setTol(1e-6)
+                    .setMaxIter(8)
+                    .setOptimizer("host")
+                    .fit(xp_s, yp_s)
+                )
+            finally:
+                it_ops.set_solver_lane(prev)
+            thetas[lane] = np.asarray(m_l.raw_predictor.theta)
+            if lane == "iterative":
+                solver_metrics = {
+                    k: v for k, v in m_l.instr.metrics.items()
+                    if k == "solver_lane" or k.startswith("solver.")
+                }
+        theta_scale = max(float(np.max(np.abs(thetas["exact"]))), 1e-12)
+        return {
+            "sizes": per_size,
+            "largest_s": int(largest),
+            "speedup_at_largest": per_size[largest]["speedup"],
+            "fitted_theta": {
+                "exact": [float(v) for v in thetas["exact"]],
+                "iterative": [float(v) for v in thetas["iterative"]],
+                "rel_delta": float(
+                    np.max(np.abs(thetas["exact"] - thetas["iterative"]))
+                    / theta_scale
+                ),
+            },
+            "solver_metrics": solver_metrics,
+            "note": (
+                "exact = one batched [E, s, s] Cholesky per evaluation; "
+                "iterative = multi-RHS preconditioned CG + SLQ log-det "
+                "over the same gram stack (GP_SOLVER_LANE, "
+                "ops/iterative.py).  Speedup grows with s (O(s^3) vs "
+                "O(t s^2)); the contract bar is >= 1.3x at the largest "
+                "probed s on CPU, theta parity within the documented "
+                "5e-2 stochastic bar, and the memory model admitting "
+                "the iterative rung under a budget native exceeds."
+            ),
+        }
+
+    if os.environ.get("BENCH_SOLVER_LANES", "1") == "1":
+        try:
+            solver_lanes = _solver_lanes_section()
+        except Exception as exc:  # noqa: BLE001 — secondary metric only
+            solver_lanes = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    else:
+        solver_lanes = {"skipped": "BENCH_SOLVER_LANES != 1"}
 
     # Observability overhead (the ISSUE 4 tracing layer): the SAME fit and
     # serve burst with the tracer on vs off (obs/trace.py set_tracing), at
@@ -1743,6 +1927,7 @@ def worker() -> None:
             "memory_plan": memory_plan,
             "precision_lanes": precision_lanes,
             "fit_hot_loop": fit_hot_loop,
+            "solver_lanes": solver_lanes,
             "observability": observability,
             "multihost_resilience": multihost_resilience,
             "lifecycle": lifecycle,

@@ -214,6 +214,28 @@ class GaussianProcessParams:
         set_precision_lane(value)
         return self
 
+    def setSolverLane(self, value: str):
+        """Dense-linear-algebra solver lane for the fit objectives
+        (:mod:`spark_gp_tpu.ops.iterative`): ``"exact"`` (default —
+        today's batched Cholesky/Pallas factorizations, bit-for-bit),
+        ``"iterative"`` (batched preconditioned CG + stochastic Lanczos
+        quadrature: every per-evaluation O(s^3) factorization becomes
+        O(t s^2) batched matmul work — the MXU's shape — at a documented
+        stochastic tolerance on the log-det/trace legs; the unlock for
+        expert sizes s in the thousands), or ``"auto"`` (iterative once
+        s reaches ``GP_SOLVER_AUTO_THRESHOLD``, default 1024).  The
+        setter is a fluent veneer over the PROCESS-wide knob
+        (``set_solver_lane`` / ``GP_SOLVER_LANE``); the fit entry points
+        carry the resolved lane in their jit cache keys, so the setting
+        takes effect from the next fit on.  The engaged lane and the
+        iterative lane's convergence stats (``solver.cg_iters`` /
+        ``solver.residual`` / ...) land in the fit metrics, the run
+        journal, and the saved model's ``provenance_json``."""
+        from spark_gp_tpu.ops.iterative import set_solver_lane
+
+        set_solver_lane(value)
+        return self
+
     def setOptimizer(self, value: str):
         """``"host"`` — SciPy L-BFGS-B driving the jitted objective (one
         device dispatch per evaluation; bitwise closest to the reference's
@@ -316,13 +338,18 @@ class GaussianProcessParams:
         the sharded-tile model)."""
         if self._mesh is not None:
             return None
+        from spark_gp_tpu.ops.iterative import resolve_solver
         from spark_gp_tpu.resilience import memplan
 
-        rung = (
-            "segmented"
-            if self._checkpoint_dir is not None or self._fallback_segmented()
-            else "native"
-        )
+        if self._checkpoint_dir is not None or self._fallback_segmented():
+            rung = "segmented"
+        elif resolve_solver(int(data.x.shape[1])) == "iterative":
+            # the CG/Lanczos solver lane (by knob, auto-threshold, or the
+            # ladder's iterative rung — all of which resolve here) has
+            # the skinny-workspace byte model, not the factor-stack one
+            rung = "iterative"
+        else:
+            rung = "native"
         n_targets = (
             int(data.y.shape[2]) if getattr(data.y, "ndim", 2) == 3 else 1
         )
@@ -385,6 +412,7 @@ class GaussianProcessParams:
     set_checkpoint_interval = setCheckpointInterval
     set_optimizer = setOptimizer
     set_precision_lane = setPrecisionLane
+    set_solver_lane = setSolverLane
     set_hyper_space = setHyperSpace
     set_num_restarts = setNumRestarts
     set_expert_quarantine = setExpertQuarantine
@@ -1439,6 +1467,7 @@ class GaussianProcessCommons(GaussianProcessParams):
         self._emit_precision_guard(
             instr, kernel, theta, active64, magic_vector, data
         )
+        self._emit_solver_stats(instr, kernel, theta, data)
         self._emit_expert_quality(instr, kernel, theta, data)
         self._emit_covariate_summary(instr, data, active64)
         keep_stats = self._keeps_update_statistics
@@ -1509,9 +1538,18 @@ class GaussianProcessCommons(GaussianProcessParams):
         mv_p = jnp.asarray(mv if mv.ndim == 1 else mv[:, 0], dtype=dtype)
         x_rows = data.x[0][: min(32, data.x.shape[1])]
 
+        from spark_gp_tpu.ops.iterative import solver_jit_key
+
+        # the guard varies the PRECISION lane only; the solver lane is
+        # pinned to whatever the fit actually ran, so an iterative-lane
+        # fit's guard compares iterative-vs-iterative numerics (the
+        # stochastic log-det legs cancel instead of reading as a breach)
+        solver = solver_jit_key()
+
         def probes(lane_name):
             nll, grad = guard_probe_value_and_grad(
-                kernel, theta_p, x_p, y_p, mask_p, lane=lane_name
+                kernel, theta_p, x_p, y_p, mask_p, lane=lane_name,
+                solver=solver,
             )
             mean = guard_probe_predict_mean(
                 kernel, theta_p, active_p, mv_p, x_rows, lane=lane_name
@@ -1575,6 +1613,62 @@ class GaussianProcessCommons(GaussianProcessParams):
                 # lane (resilience/fallback.py).  Default ("log") keeps
                 # the pre-ladder warn-only behavior bit-for-bit.
                 raise fallback.GuardBreachError(lane, worst, bar)
+
+    def _emit_solver_stats(self, instr, kernel, theta, data) -> None:
+        """The solver lane's fit-time provenance (ops/iterative.py).
+
+        ALWAYS stamps the engaged lane (``solver_lane`` — ``exact`` /
+        ``iterative``, resolved against the fitted stack's expert size
+        for ``auto``) so every artifact can prove which solver produced
+        the model, mirroring ``gram_cache_engaged``.  On the iterative
+        lane additionally runs one post-fit PCG convergence probe at the
+        FITTED hyperparameters over a bounded expert sub-stack and
+        publishes the knobs + achieved residuals: ``solver.cg_iters``,
+        ``solver.precond_rank``, ``solver.probes``, ``solver.residual``
+        (obs/names.py catalog; the run journal and the saved model's
+        ``provenance_json`` carry them).  Cost: one objective-sized
+        dispatch on <= 8 experts; never fails a fit."""
+        from spark_gp_tpu.ops import iterative as it_ops
+
+        if instr is None:
+            return
+        lane = it_ops.active_solver_lane()
+        resolved = (
+            it_ops.resolve_solver(int(data.x.shape[1]), lane)
+            if data is not None else lane if lane != "auto" else "exact"
+        )
+        instr.metrics["solver_lane"] = resolved
+        if resolved != "iterative" or not self._probeable_stack(data):
+            return
+        try:
+            import jax.numpy as jnp
+
+            from spark_gp_tpu.kernels.base import masked_gram_stack
+
+            probe = min(8, int(data.x.shape[0]))
+            x_p = data.x[:probe]
+            y_p = (
+                data.y[:probe] if getattr(data.y, "ndim", 2) == 2
+                else data.y[:probe, :, 0]
+            )
+            mask_p = data.mask[:probe]
+            theta_p = jnp.asarray(
+                np.asarray(theta, dtype=np.float64), dtype=data.x.dtype
+            )
+            kmat = masked_gram_stack(kernel, theta_p, x_p, mask_p)
+            report = it_ops.solver_report(kmat, y_p * mask_p)
+            instr.log_metric("solver.cg_iters", float(report["cg_iters"]))
+            instr.log_metric(
+                "solver.precond_rank", float(report["precond_rank"])
+            )
+            instr.log_metric("solver.probes", float(report["probes"]))
+            instr.log_metric("solver.residual", float(report["residual"]))
+        except Exception:  # noqa: BLE001 — telemetry must never fail a fit
+            import logging
+
+            logging.getLogger("spark_gp_tpu").warning(
+                "iterative-solver convergence probe failed", exc_info=True
+            )
 
     def _probeable_stack(self, data) -> bool:
         """Whether the fitted stack can be host-probed for post-fit

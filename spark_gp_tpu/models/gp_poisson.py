@@ -26,6 +26,7 @@ import numpy as np
 import jax
 
 from spark_gp_tpu.models.common import GaussianProcessCommons
+from spark_gp_tpu.ops import iterative as it_ops
 from spark_gp_tpu.models.laplace_generic import (
     NegativeBinomialLikelihood,
     PoissonLikelihood,
@@ -129,7 +130,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         jnp.asarray(upper, dtype=dtype),
                         data.x, data.y, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
-                        cache,
+                        cache, solver=it_ops.solver_jit_key(),
                     )
                 )
                 phase_sync(theta, nll)
@@ -316,7 +317,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         self._mesh, log_space, theta0, lower, upper,
                         data.x, data.y, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
-                        cache,
+                        cache, solver=it_ops.solver_jit_key(),
                     )
                 )
             else:
@@ -330,6 +331,7 @@ class GaussianProcessPoissonRegression(GaussianProcessCommons):
                         log_space, theta0, lower, upper, data.x, data.y,
                         data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32), cache,
+                        solver=it_ops.solver_jit_key(),
                     )
                 )
             phase_sync(theta, nll)

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_gp_tpu.models.common import GaussianProcessCommons
+from spark_gp_tpu.ops import iterative as it_ops
 from spark_gp_tpu.models.laplace import (
     make_laplace_objective,
     make_sharded_laplace_objective,
@@ -141,6 +142,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
             fit_gpc_device_multistart(
                 kernel, float(self._tol), log_space, theta_batch,
                 lower, upper, data.x, data.y, data.mask, max_iter, cache,
+                solver=it_ops.solver_jit_key(),
             )
         )
         return (
@@ -351,6 +353,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data.x, data.y, data.mask,
                         max_iter, cache,
+                        solver=it_ops.solver_jit_key(),
                     )
                 )
             else:
@@ -362,6 +365,7 @@ class GaussianProcessClassifier(GaussianProcessCommons):
                         "fit.device", fit_gpc_device,
                         kernel, float(self._tol), log_space, theta0, lower,
                         upper, data.x, data.y, data.mask, max_iter, cache,
+                        solver=it_ops.solver_jit_key(),
                     )
                 )
             phase_sync(theta, f)

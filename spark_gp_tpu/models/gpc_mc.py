@@ -35,6 +35,7 @@ import numpy as np
 
 from spark_gp_tpu.models import ppa
 from spark_gp_tpu.models.common import GaussianProcessCommons
+from spark_gp_tpu.ops import iterative as it_ops
 from spark_gp_tpu.models.laplace_mc import (
     fit_gpc_mc_device,
     make_mc_objective,
@@ -163,7 +164,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                         jnp.asarray(upper, dtype=dtype),
                         data.x, y1h, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
-                        cache,
+                        cache, solver=it_ops.solver_jit_key(),
                     )
                 )
                 phase_sync(theta, nll)
@@ -322,7 +323,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                         kernel, float(self._tol), self._mesh, log_space,
                         theta0, lower, upper, data.x, y1h, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32),
-                        cache,
+                        cache, solver=it_ops.solver_jit_key(),
                     )
                 )
             else:
@@ -335,6 +336,7 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                         kernel, float(self._tol), log_space, theta0, lower,
                         upper, data.x, y1h, data.mask,
                         jnp.asarray(self._max_iter, dtype=jnp.int32), cache,
+                        solver=it_ops.solver_jit_key(),
                     )
                 )
             phase_sync(theta, nll)
@@ -426,6 +428,10 @@ class GaussianProcessMulticlassClassifier(GaussianProcessCommons):
                 kernel, theta_opt, active64, u1, u2, mesh=self._mesh,
                 with_variance=self._predictive_variance,
             )
+        # the multiclass tail bypasses common._build_predictor, so the
+        # solver-lane provenance stamp rides here (the other families
+        # get it there)
+        self._emit_solver_stats(instr, kernel, theta_opt, data)
         return ProjectedProcessRawPredictor(
             kernel=kernel,
             theta=np.asarray(theta_opt, dtype=np.float64),

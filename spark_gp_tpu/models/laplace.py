@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 
 from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
 from spark_gp_tpu.obs import cost as obs_cost
+from spark_gp_tpu.ops import iterative as it_ops
 from spark_gp_tpu.ops.linalg import masked_kernel_matrix
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 from spark_gp_tpu.parallel.experts import ExpertData
@@ -83,6 +84,24 @@ def _posterior_terms_batch(kmat, y, mask, f):
     eye = jnp.eye(kmat.shape[-1], dtype=kmat.dtype)
     b_mat = eye[None] + sqw[:, :, None] * kmat * sqw[:, None, :]
     grad_log_p = (y - pi) * mask
+    if it_ops.resolve_solver(kmat.shape[-1]) == "iterative":
+        # the CG/Lanczos solver lane (ops/iterative.py): no full
+        # factorization — ``B v`` applications become pivoted-Cholesky
+        # preconditioned multi-RHS CG solves (B's eigenvalues are >= 1,
+        # but its CONDITIONING is 1 + lambda_max(K W), into the
+        # thousands on dense grams — unpreconditioned f32 CG diverges
+        # there) and log|B| the preconditioned SLQ estimate.  The rank-k
+        # preconditioner is built ONCE here and carried in the factor
+        # tuple, so the Newton-step solve, the convergence-time full
+        # inverse, and the log-det all share it.  Inside the Newton
+        # while_loop the unused log-det is DCE'd by XLA; each iteration
+        # pays O(t s^2) batched-matmul work instead of O(s^3).
+        precond = it_ops.build_spd_preconditioner(b_mat)
+        return (
+            pi, w, sqw, ("iter", (b_mat, precond)),
+            it_ops.spd_logdet(b_mat, precond=precond),
+            grad_log_p,
+        )
     if _use_pallas(b_mat):
         binv, logdet = spd_inv_logdet(b_mat)
         return pi, w, sqw, ("inv", binv), logdet, grad_log_p
@@ -97,6 +116,9 @@ def _apply_binv(factor, v):
     tag, mat = factor
     if tag == "inv":
         return jnp.einsum("eij,ej->ei", mat, v)
+    if tag == "iter":
+        b_mat, precond = mat
+        return it_ops.spd_solve(b_mat, v, precond=precond)
     return chol_solve(mat, v)
 
 
@@ -104,12 +126,23 @@ def _binv_full(factor):
     """Explicit ``B^-1 [E, s, s]`` — convergence-time only on the Cholesky
     branch (the Algorithm 5.1 terms genuinely consume the full inverse,
     matching the reference's solve-against-diag(sqw), GPClf.scala:115-116).
+    On the iterative lane the inverse is one s-column multi-RHS CG solve:
+    still no factorization (every step is a batched matmul on the MXU),
+    but O(t s^3) work — paid ONCE per objective evaluation at the
+    converged mode, not per Newton iteration like the exact lanes'
+    factorizations.
     """
     from spark_gp_tpu.ops.linalg import chol_solve
 
     tag, mat = factor
     if tag == "inv":
         return mat
+    if tag == "iter":
+        b_mat, precond = mat
+        eye = jnp.broadcast_to(
+            jnp.eye(b_mat.shape[-1], dtype=b_mat.dtype), b_mat.shape
+        )
+        return it_ops.spd_solve(b_mat, eye, precond=precond)
     eye = jnp.broadcast_to(
         jnp.eye(mat.shape[-1], dtype=mat.dtype), mat.shape
     )
@@ -284,10 +317,13 @@ def expert_neg_logz_and_grad(kernel: Kernel, tol, theta, x, y, mask, f0):
     return neg_z, neg_grad, f[0]
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _laplace_impl(kernel: Kernel, tol, theta, x, y, mask, f0, cache=None):
-    data = ExpertData(x=x, y=y, mask=mask)
-    return batched_neg_logz(kernel, tol, theta, data, f0, cache)
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("solver",))
+def _laplace_impl(
+    kernel: Kernel, tol, theta, x, y, mask, f0, cache=None, *, solver=None
+):
+    with it_ops.solver_lane_scope(solver):
+        data = ExpertData(x=x, y=y, mask=mask)
+        return batched_neg_logz(kernel, tol, theta, data, f0, cache)
 
 
 def make_laplace_objective(kernel: Kernel, data: ExpertData, tol, cache=None):
@@ -302,6 +338,7 @@ def make_laplace_objective(kernel: Kernel, data: ExpertData, tol, cache=None):
         return obs_cost.observed_call(
             "fit.host_objective", _laplace_impl,
             kernel, float(tol), theta, data.x, data.y, data.mask, f0, cache,
+            solver=it_ops.solver_jit_key(),
         )
 
     return obj
@@ -345,13 +382,15 @@ def _make_sharded_logz(
     return core
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("solver",))
 def _sharded_laplace_impl(
-    kernel: Kernel, tol, mesh, theta, x, y, mask, f0, cache=None
+    kernel: Kernel, tol, mesh, theta, x, y, mask, f0, cache=None, *,
+    solver=None,
 ):
-    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
-    core = _make_sharded_logz(kernel, tol, mesh, cache_specs, cache_of)
-    return core(theta, f0, x, y, mask, *cache_args)
+    with it_ops.solver_lane_scope(solver):
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        core = _make_sharded_logz(kernel, tol, mesh, cache_specs, cache_of)
+        return core(theta, f0, x, y, mask, *cache_args)
 
 
 def make_sharded_laplace_objective(
@@ -364,7 +403,7 @@ def make_sharded_laplace_objective(
         theta = jnp.asarray(theta, dtype=data.x.dtype)
         return _sharded_laplace_impl(
             kernel, float(tol), mesh, theta, data.x, data.y, data.mask, f0,
-            cache,
+            cache, solver=it_ops.solver_jit_key(),
         )
 
     return obj
@@ -373,38 +412,43 @@ def make_sharded_laplace_objective(
 # --- fully on-device fits (see likelihood.py counterparts) ----------------
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("solver",))
 def fit_gpc_device(
     kernel: Kernel, tol, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, cache=None,
+    max_iter, cache=None, *, solver=None,
 ):
     """Single-chip on-device classifier fit; the latent warm-start stack is
     the optimizer's auxiliary carry.  Returns (theta, f_latents, nll, n_iter,
     n_fev, stalled).  ``cache`` sits outside the L-BFGS while_loop and is
-    reused by every evaluation's gram + dK/dtheta builds."""
+    reused by every evaluation's gram + dK/dtheta builds.  ``solver`` is
+    the static solver lane (ops/iterative.py; the estimator passes the
+    resolved lane so switching lanes between fits recompiles)."""
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
     )
 
-    data = ExpertData(x=x, y=y, mask=mask)
+    with it_ops.solver_lane_scope(solver):
+        data = ExpertData(x=x, y=y, mask=mask)
 
-    def vag(theta, f_carry):
-        value, grad, f_new = batched_neg_logz(
-            kernel, tol, theta, data, f_carry, cache
+        def vag(theta, f_carry):
+            value, grad, f_new = batched_neg_logz(
+                kernel, tol, theta, data, f_carry, cache
+            )
+            return value, grad, f_new
+
+        if log_space:
+            vag, theta0, lower, upper, from_u = log_reparam(
+                vag, theta0, lower, upper
+            )
+        else:
+            from_u = lambda t: t
+
+        f0 = jnp.zeros_like(y)
+        theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
+            vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
         )
-        return value, grad, f_new
-
-    if log_space:
-        vag, theta0, lower, upper, from_u = log_reparam(vag, theta0, lower, upper)
-    else:
-        from_u = lambda t: t
-
-    f0 = jnp.zeros_like(y)
-    theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
-        vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
-    )
-    return from_u(theta), f_final, f, n_iter, n_fev, stalled
+        return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
 
 # --- segmented device fit: checkpoint/resume (likelihood.py counterpart) --
@@ -435,40 +479,45 @@ def _gpc_segment_vag(
     return log_transform_vag(base) if log_space else base
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",)
+)
 def gpc_device_segment_init(
     kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask,
-    cache=None,
+    cache=None, *, solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
-    data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data, cache)
-    t0 = jnp.log(theta0) if log_space else theta0
-    return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
+    with it_ops.solver_lane_scope(solver):
+        data = ExpertData(x=x, y=y, mask=mask)
+        vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data, cache)
+        t0 = jnp.log(theta0) if log_space else theta0
+        return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
 
 
 # the L-BFGS state carry is donated — consumed once per segment and
 # replaced by the return value (optimize/lbfgs_device.lbfgs_state_donation)
 @partial(
-    jax.jit, static_argnums=(0, 1, 2, 3),
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",),
     donate_argnums=lbfgs_state_donation(4),
 )
 def gpc_device_segment_run(
     kernel: Kernel, tol, mesh, log_space, state, lower, upper, x, y, mask,
-    iter_limit, cache=None,
+    iter_limit, cache=None, *, solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
         log_transform_bounds,
     )
 
-    data = ExpertData(x=x, y=y, mask=mask)
-    vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data, cache)
-    lo, hi = (
-        log_transform_bounds(lower, upper) if log_space else (lower, upper)
-    )
-    return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
+    with it_ops.solver_lane_scope(solver):
+        data = ExpertData(x=x, y=y, mask=mask)
+        vag = _gpc_segment_vag(kernel, tol, mesh, log_space, data, cache)
+        lo, hi = (
+            log_transform_bounds(lower, upper) if log_space
+            else (lower, upper)
+        )
+        return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
 
 
 def fit_gpc_device_checkpointed(
@@ -487,17 +536,18 @@ def fit_gpc_device_checkpointed(
     meta = segment_meta(
         "gpc", kernel, tol, log_space, theta0, data.x, data.y, data.mask
     )
+    solver = it_ops.solver_jit_key()
 
     def init(theta0_, lower_, upper_, x_, y_, mask_):
         return gpc_device_segment_init(
             kernel, float(tol), mesh, log_space, theta0_, lower_, upper_,
-            x_, y_, mask_, cache,
+            x_, y_, mask_, cache, solver=solver,
         )
 
     def run(state, limit):
         return gpc_device_segment_run(
             kernel, float(tol), mesh, log_space, state, lower, upper,
-            data.x, data.y, data.mask, limit, cache,
+            data.x, data.y, data.mask, limit, cache, solver=solver,
         )
 
     theta, state = run_segmented(
@@ -508,10 +558,12 @@ def fit_gpc_device_checkpointed(
     return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",)
+)
 def fit_gpc_device_sharded(
     kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, cache=None,
+    max_iter, cache=None, *, solver=None,
 ):
     """Multi-chip on-device classifier fit inside one shard_map: latent
     stacks stay device-resident and sharded for the entire optimization;
@@ -527,54 +579,60 @@ def fit_gpc_device_sharded(
         # shard_map wedges the compile; GSPMD partitions the same stack
         return fit_gpc_device(
             kernel, tol, log_space, theta0, lower, upper, x, y, mask,
-            max_iter, cache,
+            max_iter, cache, solver=solver,
         )
 
-    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
-    in_specs = (
-        P(), P(), P(),
-        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-        P(),
-    ) + cache_specs
+    with it_ops.solver_lane_scope(solver):
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        in_specs = (
+            P(), P(), P(),
+            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+            P(),
+        ) + cache_specs
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
-    )
-    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, *maybe_cache):
-        local = ExpertData(x=x_, y=y_, mask=mask_)
-        local_cache = cache_of(maybe_cache)
-
-        def vag(theta, f_carry):
-            value, grad, f_new = batched_neg_logz(
-                kernel, tol, theta, local, f_carry, local_cache
-            )
-            return (
-                jax.lax.psum(value, EXPERT_AXIS),
-                jax.lax.psum(grad, EXPERT_AXIS),
-                f_new,
-            )
-
-        if log_space:
-            vag, t0, lo, hi, from_u = log_reparam(vag, theta0_, lower_, upper_)
-        else:
-            vag, t0, lo, hi, from_u = vag, theta0_, lower_, upper_, (lambda t: t)
-
-        f0 = jnp.zeros_like(y_)
-        theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
-            vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
         )
-        return from_u(theta), f_final, f, n_iter, n_fev, stalled
+        def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_,
+                *maybe_cache):
+            local = ExpertData(x=x_, y=y_, mask=mask_)
+            local_cache = cache_of(maybe_cache)
 
-    return run(theta0, lower, upper, x, y, mask, max_iter, *cache_args)
+            def vag(theta, f_carry):
+                value, grad, f_new = batched_neg_logz(
+                    kernel, tol, theta, local, f_carry, local_cache
+                )
+                return (
+                    jax.lax.psum(value, EXPERT_AXIS),
+                    jax.lax.psum(grad, EXPERT_AXIS),
+                    f_new,
+                )
+
+            if log_space:
+                vag, t0, lo, hi, from_u = log_reparam(
+                    vag, theta0_, lower_, upper_
+                )
+            else:
+                vag, t0, lo, hi, from_u = (
+                    vag, theta0_, lower_, upper_, (lambda t: t)
+                )
+
+            f0 = jnp.zeros_like(y_)
+            theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
+                vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
+            )
+            return from_u(theta), f_final, f, n_iter, n_fev, stalled
+
+        return run(theta0, lower, upper, x, y, mask, max_iter, *cache_args)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("solver",))
 def fit_gpc_device_multistart(
     kernel: Kernel, tol, log_space, theta0_batch, lower, upper, x, y, mask,
-    max_iter, cache=None,
+    max_iter, cache=None, *, solver=None,
 ):
     """Multi-start single-chip classifier fit: R restarts as ONE vmapped
     device program (see lbfgs_device.lbfgs_minimize_device_multistart); the
@@ -584,18 +642,19 @@ def fit_gpc_device_multistart(
     f_all [R], best)``."""
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
-    data = ExpertData(x=x, y=y, mask=mask)
+    with it_ops.solver_lane_scope(solver):
+        data = ExpertData(x=x, y=y, mask=mask)
 
-    def vag(theta, f_carry):
-        value, grad, f_new = batched_neg_logz(
-            kernel, tol, theta, data, f_carry, cache
-        )
-        return value, grad, f_new
+        def vag(theta, f_carry):
+            value, grad, f_new = batched_neg_logz(
+                kernel, tol, theta, data, f_carry, cache
+            )
+            return value, grad, f_new
 
-    theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
-        multistart_minimize(
-            vag, log_space, theta0_batch, lower, upper, jnp.zeros_like(y),
-            max_iter, tol,
+        theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
+            multistart_minimize(
+                vag, log_space, theta0_batch, lower, upper,
+                jnp.zeros_like(y), max_iter, tol,
+            )
         )
-    )
-    return theta, f_final, f, n_iter, n_fev, stalled, f_all, best
+        return theta, f_final, f, n_iter, n_fev, stalled, f_all, best

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
 from spark_gp_tpu.obs import cost as obs_cost
+from spark_gp_tpu.ops import iterative as it_ops
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 
 
@@ -207,12 +208,25 @@ def _gen_newton_quantities(lik: Likelihood, kmat, y, mask, f) -> _GenStep:
 
     eye = jnp.eye(kmat.shape[-1], dtype=kmat.dtype)
     b_mats = eye[None] + sqw[:, :, None] * kmat * sqw[:, None, :]
-    chol_l = cholesky(b_mats)
-    half_logdet_b = 0.5 * chol_logdet(chol_l)
-
     b_vec = w * f + grad_log_p
     kb = jnp.einsum("eij,ej->ei", kmat, b_vec)
-    a = b_vec - sqw * chol_solve(chol_l, sqw * kb)
+    if it_ops.resolve_solver(kmat.shape[-1]) == "iterative":
+        # the CG/Lanczos solver lane (ops/iterative.py): the B solve rides
+        # preconditioned multi-RHS CG under custom_linear_solve (implicit
+        # differentiation — this function is autodiffed by the
+        # Newton-fixed-point gradient) and log|B| the preconditioned SLQ
+        # estimate with the Hutchinson surrogate gradient.  O(t s^2)
+        # matmul work, no full factorization anywhere; the one rank-k
+        # preconditioner build is shared by both consumers.
+        precond = it_ops.build_spd_preconditioner(b_mats)
+        half_logdet_b = 0.5 * it_ops.spd_logdet(b_mats, precond=precond)
+        a = b_vec - sqw * it_ops.spd_solve(
+            b_mats, sqw * kb, precond=precond
+        )
+    else:
+        chol_l = cholesky(b_mats)
+        half_logdet_b = 0.5 * chol_logdet(chol_l)
+        a = b_vec - sqw * chol_solve(chol_l, sqw * kb)
     f_new = jnp.einsum("eij,ej->ei", kmat, a)
     return _GenStep(a=a, f_new=f_new, half_logdet_b=half_logdet_b)
 
@@ -303,11 +317,14 @@ def batched_neg_logz_generic(
     return value, grad, f_hat
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
-def _generic_vag_impl(lik, kernel, tol, theta, x, y, mask, f0, cache=None):
-    return batched_neg_logz_generic(
-        lik, kernel, tol, theta, x, y, mask, f0, cache
-    )
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("solver",))
+def _generic_vag_impl(
+    lik, kernel, tol, theta, x, y, mask, f0, cache=None, *, solver=None
+):
+    with it_ops.solver_lane_scope(solver):
+        return batched_neg_logz_generic(
+            lik, kernel, tol, theta, x, y, mask, f0, cache
+        )
 
 
 def make_generic_objective(
@@ -323,6 +340,7 @@ def make_generic_objective(
         return obs_cost.observed_call(
             "fit.host_objective", _generic_vag_impl,
             lik, kernel, float(tol), theta, x, y, mask, f0, cache,
+            solver=it_ops.solver_jit_key(),
         )
 
     return obj
@@ -361,17 +379,18 @@ def _make_sharded_generic_logz(
     return core
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",))
 def _sharded_generic_vag_impl(
-    lik, kernel, tol, mesh, theta, x, y, mask, f0, cache=None
+    lik, kernel, tol, mesh, theta, x, y, mask, f0, cache=None, *, solver=None
 ):
     from spark_gp_tpu.parallel.mesh import sharded_cache_operand
 
-    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
-    core = _make_sharded_generic_logz(
-        lik, kernel, tol, mesh, cache_specs, cache_of
-    )
-    return core(theta, f0, x, y, mask, *cache_args)
+    with it_ops.solver_lane_scope(solver):
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        core = _make_sharded_generic_logz(
+            lik, kernel, tol, mesh, cache_specs, cache_of
+        )
+        return core(theta, f0, x, y, mask, *cache_args)
 
 
 def make_sharded_generic_objective(
@@ -380,16 +399,19 @@ def make_sharded_generic_objective(
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=x.dtype)
         return _sharded_generic_vag_impl(
-            lik, kernel, float(tol), mesh, theta, x, y, mask, f0, cache
+            lik, kernel, float(tol), mesh, theta, x, y, mask, f0, cache,
+            solver=it_ops.solver_jit_key(),
         )
 
     return obj
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",)
+)
 def fit_generic_device(
     lik: Likelihood, kernel: Kernel, tol, log_space,
-    theta0, lower, upper, x, y, mask, max_iter, cache=None,
+    theta0, lower, upper, x, y, mask, max_iter, cache=None, *, solver=None,
 ):
     """Single-chip on-device fit for any likelihood: the latent warm-start
     stack rides as the optimizer's auxiliary carry (laplace.py pattern).
@@ -400,28 +422,33 @@ def fit_generic_device(
         log_reparam,
     )
 
-    def vag(theta, f_carry):
-        value, grad, f_new = batched_neg_logz_generic(
-            lik, kernel, tol, theta, x, y, mask, f_carry, cache
+    with it_ops.solver_lane_scope(solver):
+        def vag(theta, f_carry):
+            value, grad, f_new = batched_neg_logz_generic(
+                lik, kernel, tol, theta, x, y, mask, f_carry, cache
+            )
+            return value, grad, f_new
+
+        if log_space:
+            vag, theta0, lower, upper, from_u = log_reparam(
+                vag, theta0, lower, upper
+            )
+        else:
+            from_u = lambda t: t
+
+        f0 = jnp.zeros_like(y)
+        theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
+            vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
         )
-        return value, grad, f_new
-
-    if log_space:
-        vag, theta0, lower, upper, from_u = log_reparam(vag, theta0, lower, upper)
-    else:
-        from_u = lambda t: t
-
-    f0 = jnp.zeros_like(y)
-    theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
-        vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
-    )
-    return from_u(theta), f_final, f, n_iter, n_fev, stalled
+        return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3, 4), static_argnames=("solver",)
+)
 def fit_generic_device_sharded(
     lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
-    theta0, lower, upper, x, y, mask, max_iter, cache=None,
+    theta0, lower, upper, x, y, mask, max_iter, cache=None, *, solver=None,
 ):
     """Multi-chip on-device fit for any likelihood inside one shard_map:
     latent stacks stay device-resident and sharded for the entire
@@ -442,49 +469,56 @@ def fit_generic_device_sharded(
         # shard_map wedges the compile; GSPMD partitions the same stack
         return fit_generic_device(
             lik, kernel, tol, log_space, theta0, lower, upper, x, y, mask,
-            max_iter, cache,
+            max_iter, cache, solver=solver,
         )
 
     from spark_gp_tpu.parallel.mesh import sharded_cache_operand
 
-    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
-    in_specs = (
-        P(), P(), P(),
-        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-        P(),
-    ) + cache_specs
+    with it_ops.solver_lane_scope(solver):
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        in_specs = (
+            P(), P(), P(),
+            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+            P(),
+        ) + cache_specs
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
-    )
-    def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, *maybe_cache):
-        local_cache = cache_of(maybe_cache)
-
-        def vag(theta, f_carry):
-            value, grad, f_new = batched_neg_logz_generic(
-                lik, kernel, tol, theta, x_, y_, mask_, f_carry, local_cache
-            )
-            return (
-                jax.lax.psum(value, EXPERT_AXIS),
-                jax.lax.psum(grad, EXPERT_AXIS),
-                f_new,
-            )
-
-        if log_space:
-            vag, t0, lo, hi, from_u = log_reparam(vag, theta0_, lower_, upper_)
-        else:
-            vag, t0, lo, hi, from_u = vag, theta0_, lower_, upper_, (lambda t: t)
-
-        f0 = jnp.zeros_like(y_)
-        theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
-            vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
         )
-        return from_u(theta), f_final, f, n_iter, n_fev, stalled
+        def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_,
+                *maybe_cache):
+            local_cache = cache_of(maybe_cache)
 
-    return run(theta0, lower, upper, x, y, mask, max_iter, *cache_args)
+            def vag(theta, f_carry):
+                value, grad, f_new = batched_neg_logz_generic(
+                    lik, kernel, tol, theta, x_, y_, mask_, f_carry,
+                    local_cache,
+                )
+                return (
+                    jax.lax.psum(value, EXPERT_AXIS),
+                    jax.lax.psum(grad, EXPERT_AXIS),
+                    f_new,
+                )
+
+            if log_space:
+                vag, t0, lo, hi, from_u = log_reparam(
+                    vag, theta0_, lower_, upper_
+                )
+            else:
+                vag, t0, lo, hi, from_u = (
+                    vag, theta0_, lower_, upper_, (lambda t: t)
+                )
+
+            f0 = jnp.zeros_like(y_)
+            theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
+                vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
+            )
+            return from_u(theta), f_final, f, n_iter, n_fev, stalled
+
+        return run(theta0, lower, upper, x, y, mask, max_iter, *cache_args)
 
 
 # --- segmented device fit: checkpoint/resume (laplace.py counterpart) ------
@@ -515,42 +549,47 @@ def _generic_segment_vag(lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
     return log_transform_vag(base) if log_space else base
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3, 4), static_argnames=("solver",)
+)
 def generic_device_segment_init(
     lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
-    theta0, lower, upper, x, y, mask, cache=None,
+    theta0, lower, upper, x, y, mask, cache=None, *, solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
-    vag = _generic_segment_vag(
-        lik, kernel, tol, mesh, log_space, x, y, mask, cache
-    )
-    t0 = jnp.log(theta0) if log_space else theta0
-    return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
+    with it_ops.solver_lane_scope(solver):
+        vag = _generic_segment_vag(
+            lik, kernel, tol, mesh, log_space, x, y, mask, cache
+        )
+        t0 = jnp.log(theta0) if log_space else theta0
+        return lbfgs_init_state(vag, t0, jnp.zeros_like(y))
 
 
 # the L-BFGS state carry is donated — consumed once per segment and
 # replaced by the return value (optimize/lbfgs_device.lbfgs_state_donation)
 @partial(
-    jax.jit, static_argnums=(0, 1, 2, 3, 4),
+    jax.jit, static_argnums=(0, 1, 2, 3, 4), static_argnames=("solver",),
     donate_argnums=lbfgs_state_donation(5),
 )
 def generic_device_segment_run(
     lik: Likelihood, kernel: Kernel, tol, mesh, log_space,
-    state, lower, upper, x, y, mask, iter_limit, cache=None,
+    state, lower, upper, x, y, mask, iter_limit, cache=None, *, solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
         log_transform_bounds,
     )
 
-    vag = _generic_segment_vag(
-        lik, kernel, tol, mesh, log_space, x, y, mask, cache
-    )
-    lo, hi = (
-        log_transform_bounds(lower, upper) if log_space else (lower, upper)
-    )
-    return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
+    with it_ops.solver_lane_scope(solver):
+        vag = _generic_segment_vag(
+            lik, kernel, tol, mesh, log_space, x, y, mask, cache
+        )
+        lo, hi = (
+            log_transform_bounds(lower, upper) if log_space
+            else (lower, upper)
+        )
+        return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
 
 
 def fit_generic_device_checkpointed(
@@ -569,17 +608,18 @@ def fit_generic_device_checkpointed(
         f"generic:{type(lik).__name__}{lik._spec()}", kernel, tol, log_space,
         theta0, x, y, mask,
     )
+    solver = it_ops.solver_jit_key()
 
     def init(theta0_, lower_, upper_, x_, y_, mask_):
         return generic_device_segment_init(
             lik, kernel, float(tol), mesh, log_space, theta0_, lower_,
-            upper_, x_, y_, mask_, cache,
+            upper_, x_, y_, mask_, cache, solver=solver,
         )
 
     def run(state, limit):
         return generic_device_segment_run(
             lik, kernel, float(tol), mesh, log_space, state, lower, upper,
-            x, y, mask, limit, cache,
+            x, y, mask, limit, cache, solver=solver,
         )
 
     theta, state = run_segmented(
@@ -589,10 +629,13 @@ def fit_generic_device_checkpointed(
     return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",)
+)
 def fit_generic_device_multistart(
     lik: Likelihood, kernel: Kernel, tol, log_space,
-    theta0_batch, lower, upper, x, y, mask, max_iter, cache=None,
+    theta0_batch, lower, upper, x, y, mask, max_iter, cache=None, *,
+    solver=None,
 ):
     """Multi-start single-chip fit for any likelihood: R restarts as ONE
     vmapped device program; one gram cache broadcasts to every lane.
@@ -600,16 +643,17 @@ def fit_generic_device_multistart(
     stalled, f_all [R], best)``."""
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
-    def vag(theta, f_carry):
-        value, grad, f_new = batched_neg_logz_generic(
-            lik, kernel, tol, theta, x, y, mask, f_carry, cache
-        )
-        return value, grad, f_new
+    with it_ops.solver_lane_scope(solver):
+        def vag(theta, f_carry):
+            value, grad, f_new = batched_neg_logz_generic(
+                lik, kernel, tol, theta, x, y, mask, f_carry, cache
+            )
+            return value, grad, f_new
 
-    theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
-        multistart_minimize(
-            vag, log_space, theta0_batch, lower, upper, jnp.zeros_like(y),
-            max_iter, tol,
+        theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
+            multistart_minimize(
+                vag, log_space, theta0_batch, lower, upper,
+                jnp.zeros_like(y), max_iter, tol,
+            )
         )
-    )
-    return theta, f_final, f, n_iter, n_fev, stalled, f_all, best
+        return theta, f_final, f, n_iter, n_fev, stalled, f_all, best

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 
 from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
 from spark_gp_tpu.obs import cost as obs_cost
+from spark_gp_tpu.ops import iterative as it_ops
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 
 
@@ -100,6 +101,9 @@ def _mc_newton_quantities(kmat, y1h, mask, f) -> _McStep:
     pi_pos = pi > 0.0
     sqd = jnp.where(pi_pos, jnp.sqrt(jnp.where(pi_pos, pi, 1.0)), 0.0)
 
+    if it_ops.resolve_solver(kmat.shape[-1]) == "iterative":
+        return _mc_newton_quantities_iter(kmat, y1h, mask, f, pi, sqd)
+
     # B_c = I + sqrt(D_c) K sqrt(D_c), batched over (expert, class)
     eye = jnp.eye(kmat.shape[-1], dtype=kmat.dtype)
     sq_ec = jnp.moveaxis(sqd, -1, 1)  # [E, C, s]
@@ -138,6 +142,50 @@ def _mc_newton_quantities(kmat, y1h, mask, f) -> _McStep:
         f_new=f_new,
         half_logdet_b=0.5 * jnp.sum(logdet_b, axis=1),
         half_logdet_m=half_logdet_m,
+    )
+
+
+def _mc_newton_quantities_iter(kmat, y1h, mask, f, pi, sqd) -> _McStep:
+    """The CG/Lanczos solver lane's Newton step (ops/iterative.py): no
+    per-class factorizations, no explicit inverses — ONE factored system.
+
+    The softmax Hessian admits a closed-form root ``W = S S^T`` with
+    ``S = D^{1/2} (I - q q^T)``, ``q = sqrt(pi)`` (unit per unmasked row,
+    since softmax rows sum to one; masked rows give ``S = 0``), i.e.
+    elementwise ``S_cd = sqrt(pi_c) delta_cd - pi_c sqrt(pi_d)``.  Then by
+    push-through the Newton step ``a = (I + W K_blk)^{-1} b`` becomes
+
+        a = b - S (I + S^T K_blk S)^{-1} S^T K_blk b
+
+    solved by multi-RHS CG on the FACTORED operator (never materializing
+    the ``[sC, sC]`` block system — :func:`ops.iterative.factored_solve`,
+    differentiable via ``custom_linear_solve``), and by Sylvester the
+    whole normalizer determinant collapses to one term,
+
+        log det(I + K_blk W) = log det(I + S^T K_blk S)
+
+    (:func:`ops.iterative.factored_logdet`, SLQ value + Hutchinson
+    surrogate gradient) — returned in the ``half_logdet_b`` slot with
+    ``half_logdet_m = 0``, which the exact path's two-term split sums to.
+    """
+    eye_c = jnp.eye(pi.shape[-1], dtype=kmat.dtype)
+    smat = sqd[..., :, None] * eye_c - pi[..., :, None] * sqd[..., None, :]
+
+    # b = W f + (y - pi)  (rowwise, same as the exact path)
+    pif_sum = jnp.sum(pi * f, axis=-1, keepdims=True)
+    b_vec = (pi * f - pi * pif_sum + (y1h - pi)) * mask[..., None]
+
+    kb = jnp.einsum("est,etc->esc", kmat, b_vec)         # K_blk b
+    skb = jnp.einsum("esdc,esd->esc", smat, kb)          # S^T K_blk b
+    v = it_ops.factored_solve(kmat, smat, skb)           # B'^-1 S^T K b
+    a = b_vec - jnp.einsum("escd,esd->esc", smat, v)     # (I + W K)^-1 b
+    f_new = jnp.einsum("est,etc->esc", kmat, a)
+    half_logdet = 0.5 * it_ops.factored_logdet(kmat, smat)
+    return _McStep(
+        a=a,
+        f_new=f_new,
+        half_logdet_b=half_logdet,
+        half_logdet_m=jnp.zeros_like(half_logdet),
     )
 
 
@@ -251,9 +299,14 @@ def batched_neg_logz_mc(
     return value, grad, f_hat
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _mc_vag_impl(kernel: Kernel, tol, theta, x, y1h, mask, f0, cache=None):
-    return batched_neg_logz_mc(kernel, tol, theta, x, y1h, mask, f0, cache)
+@partial(jax.jit, static_argnums=(0, 1), static_argnames=("solver",))
+def _mc_vag_impl(
+    kernel: Kernel, tol, theta, x, y1h, mask, f0, cache=None, *, solver=None
+):
+    with it_ops.solver_lane_scope(solver):
+        return batched_neg_logz_mc(
+            kernel, tol, theta, x, y1h, mask, f0, cache
+        )
 
 
 def make_mc_objective(kernel: Kernel, x, y1h, mask, tol, cache=None):
@@ -267,6 +320,7 @@ def make_mc_objective(kernel: Kernel, x, y1h, mask, tol, cache=None):
         return obs_cost.observed_call(
             "fit.host_objective", _mc_vag_impl,
             kernel, float(tol), theta, x, y1h, mask, f0, cache,
+            solver=it_ops.solver_jit_key(),
         )
 
     return obj
@@ -308,15 +362,17 @@ def _make_sharded_mc_logz(
     return core
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("solver",))
 def _sharded_mc_vag_impl(
-    kernel: Kernel, tol, mesh, theta, x, y1h, mask, f0, cache=None
+    kernel: Kernel, tol, mesh, theta, x, y1h, mask, f0, cache=None, *,
+    solver=None,
 ):
     from spark_gp_tpu.parallel.mesh import sharded_cache_operand
 
-    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
-    core = _make_sharded_mc_logz(kernel, tol, mesh, cache_specs, cache_of)
-    return core(theta, f0, x, y1h, mask, *cache_args)
+    with it_ops.solver_lane_scope(solver):
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        core = _make_sharded_mc_logz(kernel, tol, mesh, cache_specs, cache_of)
+        return core(theta, f0, x, y1h, mask, *cache_args)
 
 
 def make_sharded_mc_objective(
@@ -325,16 +381,17 @@ def make_sharded_mc_objective(
     def obj(theta, f0):
         theta = jnp.asarray(theta, dtype=x.dtype)
         return _sharded_mc_vag_impl(
-            kernel, float(tol), mesh, theta, x, y1h, mask, f0, cache
+            kernel, float(tol), mesh, theta, x, y1h, mask, f0, cache,
+            solver=it_ops.solver_jit_key(),
         )
 
     return obj
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("solver",))
 def fit_gpc_mc_device(
     kernel: Kernel, tol, log_space, theta0, lower, upper, x, y1h, mask,
-    max_iter, cache=None,
+    max_iter, cache=None, *, solver=None,
 ):
     """Single-chip on-device multiclass fit: the latent ``[E, s, C]``
     warm-start stack rides as the optimizer's auxiliary carry, exactly like
@@ -346,28 +403,33 @@ def fit_gpc_mc_device(
         log_reparam,
     )
 
-    def vag(theta, f_carry):
-        value, grad, f_new = batched_neg_logz_mc(
-            kernel, tol, theta, x, y1h, mask, f_carry, cache
+    with it_ops.solver_lane_scope(solver):
+        def vag(theta, f_carry):
+            value, grad, f_new = batched_neg_logz_mc(
+                kernel, tol, theta, x, y1h, mask, f_carry, cache
+            )
+            return value, grad, f_new
+
+        if log_space:
+            vag, theta0, lower, upper, from_u = log_reparam(
+                vag, theta0, lower, upper
+            )
+        else:
+            from_u = lambda t: t
+
+        f0 = jnp.zeros_like(y1h)
+        theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
+            vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
         )
-        return value, grad, f_new
-
-    if log_space:
-        vag, theta0, lower, upper, from_u = log_reparam(vag, theta0, lower, upper)
-    else:
-        from_u = lambda t: t
-
-    f0 = jnp.zeros_like(y1h)
-    theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
-        vag, theta0, lower, upper, f0, max_iter=max_iter, tol=tol
-    )
-    return from_u(theta), f_final, f, n_iter, n_fev, stalled
+        return from_u(theta), f_final, f, n_iter, n_fev, stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",)
+)
 def fit_gpc_mc_device_sharded(
     kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y1h, mask,
-    max_iter, cache=None,
+    max_iter, cache=None, *, solver=None,
 ):
     """Multi-chip on-device multiclass fit inside one shard_map — the
     counterpart of laplace.fit_gpc_device_sharded with the ``[E, s, C]``
@@ -382,45 +444,50 @@ def fit_gpc_mc_device_sharded(
 
     from spark_gp_tpu.parallel.mesh import sharded_cache_operand
 
-    cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
-    in_specs = (
-        P(), P(), P(),
-        P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
-        P(),
-    ) + cache_specs
+    with it_ops.solver_lane_scope(solver):
+        cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+        in_specs = (
+            P(), P(), P(),
+            P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+            P(),
+        ) + cache_specs
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
-    )
-    def run(theta0_, lower_, upper_, x_, y1h_, mask_, max_iter_,
-            *maybe_cache):
-        local_cache = cache_of(maybe_cache)
-
-        def vag(theta, f_carry):
-            value, grad, f_new = batched_neg_logz_mc(
-                kernel, tol, theta, x_, y1h_, mask_, f_carry, local_cache
-            )
-            return (
-                jax.lax.psum(value, EXPERT_AXIS),
-                jax.lax.psum(grad, EXPERT_AXIS),
-                f_new,
-            )
-
-        if log_space:
-            vag, t0, lo, hi, from_u = log_reparam(vag, theta0_, lower_, upper_)
-        else:
-            vag, t0, lo, hi, from_u = vag, theta0_, lower_, upper_, (lambda t: t)
-
-        f0 = jnp.zeros_like(y1h_)
-        theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
-            vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(), P(EXPERT_AXIS), P(), P(), P(), P()),
         )
-        return from_u(theta), f_final, f, n_iter, n_fev, stalled
+        def run(theta0_, lower_, upper_, x_, y1h_, mask_, max_iter_,
+                *maybe_cache):
+            local_cache = cache_of(maybe_cache)
 
-    return run(theta0, lower, upper, x, y1h, mask, max_iter, *cache_args)
+            def vag(theta, f_carry):
+                value, grad, f_new = batched_neg_logz_mc(
+                    kernel, tol, theta, x_, y1h_, mask_, f_carry, local_cache
+                )
+                return (
+                    jax.lax.psum(value, EXPERT_AXIS),
+                    jax.lax.psum(grad, EXPERT_AXIS),
+                    f_new,
+                )
+
+            if log_space:
+                vag, t0, lo, hi, from_u = log_reparam(
+                    vag, theta0_, lower_, upper_
+                )
+            else:
+                vag, t0, lo, hi, from_u = (
+                    vag, theta0_, lower_, upper_, (lambda t: t)
+                )
+
+            f0 = jnp.zeros_like(y1h_)
+            theta, f, f_final, n_iter, n_fev, stalled = lbfgs_minimize_device(
+                vag, t0, lo, hi, f0, max_iter=max_iter_, tol=tol
+            )
+            return from_u(theta), f_final, f, n_iter, n_fev, stalled
+
+        return run(theta0, lower, upper, x, y1h, mask, max_iter, *cache_args)
 
 
 # --- segmented device fit: checkpoint/resume (laplace.py counterpart) ------
@@ -450,38 +517,47 @@ def _mc_segment_vag(kernel: Kernel, tol, mesh, log_space, x, y1h, mask,
     return log_transform_vag(base) if log_space else base
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",)
+)
 def gpc_mc_device_segment_init(
     kernel: Kernel, tol, mesh, log_space, theta0, lower, upper, x, y1h, mask,
-    cache=None,
+    cache=None, *, solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
-    vag = _mc_segment_vag(kernel, tol, mesh, log_space, x, y1h, mask, cache)
-    t0 = jnp.log(theta0) if log_space else theta0
-    return lbfgs_init_state(vag, t0, jnp.zeros_like(y1h))
+    with it_ops.solver_lane_scope(solver):
+        vag = _mc_segment_vag(
+            kernel, tol, mesh, log_space, x, y1h, mask, cache
+        )
+        t0 = jnp.log(theta0) if log_space else theta0
+        return lbfgs_init_state(vag, t0, jnp.zeros_like(y1h))
 
 
 # the L-BFGS state carry is donated — consumed once per segment and
 # replaced by the return value (optimize/lbfgs_device.lbfgs_state_donation)
 @partial(
-    jax.jit, static_argnums=(0, 1, 2, 3),
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("solver",),
     donate_argnums=lbfgs_state_donation(4),
 )
 def gpc_mc_device_segment_run(
     kernel: Kernel, tol, mesh, log_space, state, lower, upper, x, y1h, mask,
-    iter_limit, cache=None,
+    iter_limit, cache=None, *, solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
         log_transform_bounds,
     )
 
-    vag = _mc_segment_vag(kernel, tol, mesh, log_space, x, y1h, mask, cache)
-    lo, hi = (
-        log_transform_bounds(lower, upper) if log_space else (lower, upper)
-    )
-    return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
+    with it_ops.solver_lane_scope(solver):
+        vag = _mc_segment_vag(
+            kernel, tol, mesh, log_space, x, y1h, mask, cache
+        )
+        lo, hi = (
+            log_transform_bounds(lower, upper) if log_space
+            else (lower, upper)
+        )
+        return lbfgs_run_segment(vag, state, lo, hi, iter_limit, tol)
 
 
 def fit_gpc_mc_device_checkpointed(
@@ -499,17 +575,18 @@ def fit_gpc_mc_device_checkpointed(
         "gpc_mc", kernel, tol, log_space, theta0, x, y1h, mask,
         num_classes=int(y1h.shape[-1]),
     )
+    solver = it_ops.solver_jit_key()
 
     def init(theta0_, lower_, upper_, x_, y1h_, mask_):
         return gpc_mc_device_segment_init(
             kernel, float(tol), mesh, log_space, theta0_, lower_, upper_,
-            x_, y1h_, mask_, cache,
+            x_, y1h_, mask_, cache, solver=solver,
         )
 
     def run(state, limit):
         return gpc_mc_device_segment_run(
             kernel, float(tol), mesh, log_space, state, lower, upper,
-            x, y1h, mask, limit, cache,
+            x, y1h, mask, limit, cache, solver=solver,
         )
 
     theta, state = run_segmented(
@@ -519,10 +596,10 @@ def fit_gpc_mc_device_checkpointed(
     return theta, state.aux, state.f, state.n_iter, state.n_fev, state.stalled
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
+@partial(jax.jit, static_argnums=(0, 1, 2), static_argnames=("solver",))
 def fit_gpc_mc_device_multistart(
     kernel: Kernel, tol, log_space, theta0_batch, lower, upper, x, y1h, mask,
-    max_iter, cache=None,
+    max_iter, cache=None, *, solver=None,
 ):
     """Multi-start single-chip multiclass fit: R restarts as ONE vmapped
     device program; the ``[E, s, C]`` latent stacks ride per lane while one
@@ -531,16 +608,17 @@ def fit_gpc_mc_device_multistart(
     f_all [R], best)``."""
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
-    def vag(theta, f_carry):
-        value, grad, f_new = batched_neg_logz_mc(
-            kernel, tol, theta, x, y1h, mask, f_carry, cache
-        )
-        return value, grad, f_new
+    with it_ops.solver_lane_scope(solver):
+        def vag(theta, f_carry):
+            value, grad, f_new = batched_neg_logz_mc(
+                kernel, tol, theta, x, y1h, mask, f_carry, cache
+            )
+            return value, grad, f_new
 
-    theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
-        multistart_minimize(
-            vag, log_space, theta0_batch, lower, upper, jnp.zeros_like(y1h),
-            max_iter, tol,
+        theta, f_final, f, n_iter, n_fev, stalled, f_all, best = (
+            multistart_minimize(
+                vag, log_space, theta0_batch, lower, upper,
+                jnp.zeros_like(y1h), max_iter, tol,
+            )
         )
-    )
-    return theta, f_final, f, n_iter, n_fev, stalled, f_all, best
+        return theta, f_final, f, n_iter, n_fev, stalled, f_all, best

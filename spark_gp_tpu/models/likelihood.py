@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
 from spark_gp_tpu.obs import cost as obs_cost
+from spark_gp_tpu.ops import iterative as it_ops
 from spark_gp_tpu.ops.linalg import chol_logdet, chol_solve, cholesky
 from spark_gp_tpu.ops.precision import active_lane, precision_lane_scope
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
@@ -43,7 +44,10 @@ from spark_gp_tpu.parallel.mesh import EXPERT_AXIS, sharded_cache_operand
 # jit cache key, so set_precision_lane / GP_PRECISION_LANE switches
 # between fits compile fresh executables instead of silently reusing the
 # old lane's programs.  Public wrappers resolve lane=None to the ambient
-# lane at CALL time.
+# lane at CALL time.  The SOLVER lane (ops/iterative.py: exact batched
+# Cholesky vs the CG/Lanczos lane) rides the same contract as a second
+# static argument, so GP_SOLVER_LANE / set_solver_lane switches between
+# fits recompile too.
 
 
 def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
@@ -86,6 +90,15 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
             s, dtype=kmat.dtype
         )
     ym = data.y * data.mask
+    if it_ops.resolve_solver(kmat.shape[-1]) == "iterative":
+        # the iterative solver lane (ops/iterative.py): one multi-RHS
+        # preconditioned-CG stream replaces the batched factorization —
+        # O(t s^2) matmul work instead of O(s^3), selected by
+        # GP_SOLVER_LANE / setSolverLane (auto: s past the threshold).
+        # The jittered, cache-fed kmat above is shared verbatim, so
+        # jitter escalation and the gram cache ride both lanes.
+        quad, logdet = it_ops.inv_quad_logdet(kmat, ym)
+        return 0.5 * jnp.sum(quad) + 0.5 * jnp.sum(logdet)
     if _use_pallas(kmat):
         kinv, logdet = spd_inv_logdet(kmat)
         alpha = jnp.einsum("eij,ej->ei", kinv, ym)
@@ -151,12 +164,14 @@ def objective_fn(objective: str):
     )
 
 
-@partial(jax.jit, static_argnums=0, static_argnames=("objective", "lane"))
+@partial(
+    jax.jit, static_argnums=0, static_argnames=("objective", "lane", "solver")
+)
 def _vag_impl(
     kernel: Kernel, theta, x, y, mask, extra=(), cache=None, *,
-    objective="marginal", lane=None,
+    objective="marginal", lane=None, solver=None,
 ):
-    with precision_lane_scope(lane):
+    with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         data = ExpertData(x=x, y=y, mask=mask)
         obj = objective_fn(objective)
         return jax.value_and_grad(
@@ -191,17 +206,22 @@ def make_value_and_grad(
             "fit.host_objective", _vag_impl,
             kernel, theta, data.x, data.y, data.mask, extra, cache,
             objective=objective, lane=active_lane(),
+            solver=it_ops.solver_jit_key(),
         )
 
     return vag
 
 
-@partial(jax.jit, static_argnums=0, static_argnames=("lane",))
-def guard_probe_value_and_grad(kernel: Kernel, theta, x, y, mask, *, lane):
+@partial(jax.jit, static_argnums=0, static_argnames=("lane", "solver"))
+def guard_probe_value_and_grad(
+    kernel: Kernel, theta, x, y, mask, *, lane, solver=None
+):
     """(NLL, grad) of one probe expert stack at an EXPLICIT lane — the
     fit-time mixed_precision_guard's objective probe (models/common.py).
     ``lane`` is static, so the strict and non-strict evaluations compile
     as separate executables and can be compared within one process.
+    ``solver`` pins the solver lane the fit actually ran (ops/iterative)
+    so the guard compares the very programs the fit dispatched.
 
     Probes the path the fit ACTUALLY runs: when the kernel carries a
     theta-invariant cache, the probe builds it (inside this program, under
@@ -209,7 +229,7 @@ def guard_probe_value_and_grad(kernel: Kernel, theta, x, y, mask, *, lane):
     what the guard compares) and evaluates the cached objective."""
     from spark_gp_tpu.kernels.base import supports_gram_cache
 
-    with precision_lane_scope(lane):
+    with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         data = ExpertData(x=x, y=y, mask=mask)
         cache = (
             jax.vmap(kernel.prepare)(x) if supports_gram_cache(kernel)
@@ -266,12 +286,15 @@ def _make_sharded_vag(
     return sharded
 
 
-@partial(jax.jit, static_argnums=(0, 1), static_argnames=("objective", "lane"))
+@partial(
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("objective", "lane", "solver"),
+)
 def _sharded_vag_impl(
     kernel: Kernel, mesh, theta, x, y, mask, cache=None, *,
-    objective="marginal", lane=None,
+    objective="marginal", lane=None, solver=None,
 ):
-    with precision_lane_scope(lane):
+    with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
         core = _make_sharded_vag(kernel, mesh, objective, cache_specs, cache_of)
         return core(theta, x, y, mask, *cache_args)
@@ -298,6 +321,7 @@ def make_sharded_value_and_grad(
             "fit.sharded_objective", _sharded_vag_impl,
             kernel, mesh, theta, data.x, data.y, data.mask, cache,
             objective=objective, lane=active_lane(),
+            solver=it_ops.solver_jit_key(),
         )
 
     return vag
@@ -307,18 +331,20 @@ def make_sharded_value_and_grad(
 
 
 @partial(
-    jax.jit, static_argnums=(0, 1), static_argnames=("objective", "lane")
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("objective", "lane", "solver"),
 )
 def _fit_gpr_device_impl(
     kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter,
     tol, extra=(), cache=None, *, objective="marginal", lane=None,
+    solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
         log_reparam,
     )
 
-    with precision_lane_scope(lane):
+    with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         data = ExpertData(x=x, y=y, mask=mask)
         obj = objective_fn(objective)
 
@@ -345,13 +371,15 @@ def _fit_gpr_device_impl(
 def fit_gpr_device(
     kernel: Kernel, log_space, theta0, lower, upper, x, y, mask, max_iter,
     tol, extra=(), cache=None, *, objective="marginal", lane=None,
+    solver=None,
 ):
     """Single-chip on-device fit: objective + projected L-BFGS in one XLA
     program.  Returns (theta_opt, final_nll, n_iter, n_fev, stalled).
-    ``lane=None`` resolves the ambient precision lane at call time into
-    the jit key (module note above).  ``cache`` (the theta-invariant gram
-    cache) enters the program as a constant operand OUTSIDE the L-BFGS
-    while_loop, so every iteration's evaluation reuses it."""
+    ``lane=None`` / ``solver=None`` resolve the ambient precision/solver
+    lanes at call time into the jit key (module note above).  ``cache``
+    (the theta-invariant gram cache) enters the program as a constant
+    operand OUTSIDE the L-BFGS while_loop, so every iteration's
+    evaluation reuses it."""
     # measured cost of the whole one-dispatch program (the while body is
     # counted once by XLA's cost model — per-dispatch semantics, like the
     # compile counters)
@@ -360,19 +388,22 @@ def fit_gpr_device(
         kernel, log_space, theta0, lower, upper, x, y, mask, max_iter, tol,
         extra, cache, objective=objective,
         lane=active_lane() if lane is None else lane,
+        solver=it_ops.solver_jit_key() if solver is None else solver,
     )
 
 
 @partial(
-    jax.jit, static_argnums=(0, 1), static_argnames=("objective", "lane")
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("objective", "lane", "solver"),
 )
 def _fit_gpr_device_multistart_impl(
     kernel: Kernel, log_space, theta0_batch, lower, upper, x, y, mask,
     max_iter, tol, extra=(), cache=None, *, objective="marginal", lane=None,
+    solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import multistart_minimize
 
-    with precision_lane_scope(lane):
+    with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         data = ExpertData(x=x, y=y, mask=mask)
         obj = objective_fn(objective)
 
@@ -408,6 +439,7 @@ def fit_gpr_device_multistart(
         kernel, log_space, theta0_batch, lower, upper, x, y, mask,
         max_iter, tol, extra, cache, objective=objective,
         lane=active_lane() if lane is None else lane,
+        solver=it_ops.solver_jit_key(),
     )
 
 
@@ -445,17 +477,18 @@ def _gpr_segment_vag(
 
 
 @partial(
-    jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective", "lane")
+    jax.jit, static_argnums=(0, 1, 2),
+    static_argnames=("objective", "lane", "solver"),
 )
 def gpr_device_segment_init(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    extra=(), cache=None, *, objective="marginal", lane=None,
+    extra=(), cache=None, *, objective="marginal", lane=None, solver=None,
 ):
     """One objective evaluation -> the optimizer's carried state (the
     checkpoint unit)."""
     from spark_gp_tpu.optimize.lbfgs_device import lbfgs_init_state
 
-    with precision_lane_scope(lane):
+    with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         data = ExpertData(x=x, y=y, mask=mask)
         vag = _gpr_segment_vag(
             kernel, mesh, log_space, data, objective, extra, cache
@@ -467,13 +500,14 @@ def gpr_device_segment_init(
 def _gpr_segment_run_impl(
     kernel: Kernel, mesh, log_space, state, lower, upper, x, y, mask,
     iter_limit, tol, extra=(), cache=None, *, objective="marginal", lane=None,
+    solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_run_segment,
         log_transform_bounds,
     )
 
-    with precision_lane_scope(lane):
+    with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         data = ExpertData(x=x, y=y, mask=mask)
         vag = _gpr_segment_vag(
             kernel, mesh, log_space, data, objective, extra, cache
@@ -493,7 +527,7 @@ def _gpr_segment_run_impl(
 gpr_device_segment_run = jax.jit(
     _gpr_segment_run_impl,
     static_argnums=(0, 1, 2),
-    static_argnames=("objective", "lane"),
+    static_argnames=("objective", "lane", "solver"),
     donate_argnums=lbfgs_state_donation(3),
 )
 
@@ -531,11 +565,12 @@ def fit_gpr_device_checkpointed(
         **extra_meta,
     )
     lane = active_lane()
+    solver = it_ops.solver_jit_key()
 
     def init(theta0_, lower_, upper_, x_, y_, mask_):
         return gpr_device_segment_init(
             kernel, mesh, log_space, theta0_, lower_, upper_, x_, y_, mask_,
-            extra, cache, objective=objective, lane=lane,
+            extra, cache, objective=objective, lane=lane, solver=solver,
         )
 
     tol_arr = jnp.asarray(tol, theta0.dtype)
@@ -544,7 +579,7 @@ def fit_gpr_device_checkpointed(
         return gpr_device_segment_run(
             kernel, mesh, log_space, state, lower, upper,
             data.x, data.y, data.mask, limit, tol_arr, extra, cache,
-            objective=objective, lane=lane,
+            objective=objective, lane=lane, solver=solver,
         )
 
     theta, state = run_segmented(
@@ -556,22 +591,24 @@ def fit_gpr_device_checkpointed(
 
 
 @partial(
-    jax.jit, static_argnums=(0, 1, 2), static_argnames=("objective", "lane")
+    jax.jit, static_argnums=(0, 1, 2),
+    static_argnames=("objective", "lane", "solver"),
 )
 def _fit_gpr_device_sharded_impl(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
     max_iter, tol, cache=None, *, objective="marginal", lane=None,
+    solver=None,
 ):
-    with precision_lane_scope(lane):
+    with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         return _fit_gpr_device_sharded_body(
             kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-            max_iter, tol, cache, objective, lane,
+            max_iter, tol, cache, objective, lane, solver,
         )
 
 
 def _fit_gpr_device_sharded_body(
     kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, tol, cache, objective, lane,
+    max_iter, tol, cache, objective, lane, solver=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
@@ -588,6 +625,7 @@ def _fit_gpr_device_sharded_body(
         return fit_gpr_device(
             kernel, log_space, theta0, lower, upper, x, y, mask,
             max_iter, tol, (), cache, objective=objective, lane=lane,
+            solver=solver,
         )
 
     cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
@@ -634,15 +672,18 @@ def _fit_gpr_device_sharded_body(
 def fit_gpr_device_sharded(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
     max_iter, tol, cache=None, *, objective="marginal", lane=None,
+    solver=None,
 ):
     """Multi-chip on-device fit: the WHOLE optimizer runs inside shard_map —
     per-iteration communication is exactly one psum of the scalar NLL plus
     the implicit gradient all-reduce, all over ICI, with zero host syncs.
-    ``lane=None`` resolves the ambient precision lane at call time into
-    the jit key (module note above); ``cache`` (expert-sharded) rides into
-    each device's local program and is reused every iteration."""
+    ``lane=None`` / ``solver=None`` resolve the ambient precision/solver
+    lanes at call time into the jit key (module note above); ``cache``
+    (expert-sharded) rides into each device's local program and is reused
+    every iteration."""
     return _fit_gpr_device_sharded_impl(
         kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
         max_iter, tol, cache, objective=objective,
         lane=active_lane() if lane is None else lane,
+        solver=it_ops.solver_jit_key() if solver is None else solver,
     )

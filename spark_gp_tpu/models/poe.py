@@ -41,6 +41,7 @@ import numpy as np
 from spark_gp_tpu.kernels.base import Kernel
 from spark_gp_tpu.ops.linalg import (
     JITTER_SCHEDULE,
+    chol_solve,
     cholesky,
     cholesky_escalated,
     is_pd,
@@ -62,7 +63,7 @@ def _expert_grams(kernel: Kernel, theta, x, mask):
 @jax.jit
 def _alpha_from_chol(chol_l, y, mask):
     ym = y * mask
-    return jax.scipy.linalg.cho_solve((chol_l, True), ym[..., None])[..., 0]
+    return chol_solve(chol_l, ym)
 
 
 @partial(jax.jit, static_argnums=0)

@@ -357,10 +357,10 @@ def _magic_solve_device_impl(
     eye = jnp.eye(m, dtype=u1.dtype)
 
     def chol(mat, rel_jitter):
+        from spark_gp_tpu.ops.linalg import cholesky
+
         sym = 0.5 * (mat + mat.T)
-        return jnp.linalg.cholesky(
-            sym + (rel_jitter * jnp.trace(sym) / m) * eye
-        )
+        return cholesky(sym + (rel_jitter * jnp.trace(sym) / m) * eye)
 
     l_pd = chol(sn2 * kmm + u1, tau)
 

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 
 from spark_gp_tpu.kernels.base import Kernel
+from spark_gp_tpu.ops.linalg import cholesky
 
 
 def batched_elbo_nll(kernel: Kernel, theta, data, active, sigma2):
@@ -75,7 +76,7 @@ def batched_elbo_nll(kernel: Kernel, theta, data, active, sigma2):
     # build, solved there by precision and here by formulation.)
     kmm = kernel.gram(theta, active)
     jitter = 1e-6 * jnp.mean(jnp.diagonal(kmm))
-    chol_l = jnp.linalg.cholesky(kmm + jitter * jnp.eye(m, dtype=kmm.dtype))
+    chol_l = cholesky(kmm + jitter * jnp.eye(m, dtype=kmm.dtype))
 
     # --- global statistics: linear sums over the (shardable) expert axis
     def per_expert(xe, ye, me):
@@ -99,7 +100,7 @@ def batched_elbo_nll(kernel: Kernel, theta, data, active, sigma2):
     )
 
     b = jnp.eye(m, dtype=aat.dtype) + aat
-    chol_b = jnp.linalg.cholesky(b)
+    chol_b = cholesky(b)
     c = jax.scipy.linalg.solve_triangular(chol_b, ay, lower=True)
 
     log_det_b = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol_b)))

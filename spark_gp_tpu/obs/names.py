@@ -111,6 +111,11 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("fit_retries", "metric", "recovery re-dispatches of the fit"),
     MetricName("bcm_renorm", "metric", "E_active / E_kept BCM renormalization factor"),
     MetricName("precision_lane", "metric", "precision lane the fit ran at (strict/mixed/fast)"),
+    MetricName("solver_lane", "metric", "solver lane the fit engaged (exact/iterative — ops/iterative.py, auto resolved)"),
+    MetricName("solver.cg_iters", "metric", "iterative lane: max live CG iterations on the post-fit convergence probe"),
+    MetricName("solver.precond_rank", "metric", "iterative lane: pivoted-Cholesky preconditioner rank k"),
+    MetricName("solver.probes", "metric", "iterative lane: Hutchinson/SLQ probe vectors per log-det estimate"),
+    MetricName("solver.residual", "metric", "iterative lane: max relative CG residual at the fitted theta"),
     MetricName("gram_cache_engaged", "metric", "1 when the theta-invariant gram cache served the fit hot loop"),
     MetricName("mixed_precision_guard.delta_nll_rel", "metric", "guard: relative NLL delta vs strict"),
     MetricName("mixed_precision_guard.delta_grad_rel", "metric", "guard: relative gradient delta vs strict"),

@@ -585,6 +585,7 @@ def write_run_journal(
     opts into :func:`prune_artifacts` after each persist — keep the
     newest K journals and host checkpoints.  Schema:
     docs/OBSERVABILITY.md."""
+    from spark_gp_tpu.ops.iterative import active_solver_lane
     from spark_gp_tpu.ops.precision import active_lane
 
     spans = _trace.spans_of_root(root) if getattr(root, "trace_id", 0) else []
@@ -611,6 +612,13 @@ def write_run_journal(
         "pid": os.getpid(),
         "build_info": build_info(),
         "precision_lane": active_lane(),
+        # the engaged solver (exact/iterative, auto resolved against the
+        # fitted stack) is the metrics-level ``solver_lane`` stamp; this
+        # top-level key is the AMBIENT knob for journals written outside
+        # a fit (and the gpctl one-liner's quick read)
+        "solver_lane": getattr(instr, "metrics", {}).get(
+            "solver_lane", active_solver_lane()
+        ),
         "mesh": (
             None if mesh is None
             else {"axes": {str(k): int(v) for k, v in dict(mesh.shape).items()}}

@@ -1,0 +1,770 @@
+"""Iterative expert inference: the batched CG / Lanczos solver lane.
+
+Every fit objective's dense path pays a batched ``[E, s, s]`` Cholesky
+per optimizer evaluation (``models/likelihood.py``, the Laplace
+families' ``B = I + sqrtW K sqrtW`` factorizations).  That O(s^3)
+factorization caps the expert size s in the hundreds and leaves the MXU
+underfed: past PR 7's gram cache the distance build is cheap, and the
+factorization is the only non-matmul op left on the hot loop
+(docs/ROOFLINE.md).  Following GPyTorch's blackbox matrix-matrix
+inference (PAPERS.md, arXiv 1809.11165) this module supplies a second
+**solver lane** that expresses the same quantities as batched matmuls —
+O(t * s^2) work in the shape the hardware is actually fast at:
+
+* **batched preconditioned conjugate gradients** — ONE iteration loop
+  over the whole ``[E, s, s]`` stack, multi-RHS so the solve against
+  ``y`` and the stochastic probe vectors ride one matmul stream
+  (:func:`batched_pcg`);
+* a **partial pivoted-Cholesky preconditioner** of rank k << s built
+  from the (cached) gram stack (:func:`pivoted_cholesky`), applied
+  through the Woodbury identity — its exact log-determinant is the
+  variance-reduction anchor of the log-det estimate;
+* **stochastic Lanczos quadrature** for the log-det: the PCG recurrence
+  coefficients ARE the Lanczos tridiagonal of the preconditioned
+  operator, so ``logdet(K) ~= logdet(P) + E_z[ (z^T P^-1 z) * e1^T
+  log(T_z) e1 ]`` comes for free from the same solves
+  (:func:`slq_logdet_from_coeffs`); **Hutchinson probes** feed the
+  trace terms of the gradient: ``tr(K^-1 dK) ~= mean_i v_i^T dK u_i``
+  with ``u_i = K^-1 z_i`` and ``v_i = P^-1 z_i``.
+
+Differentiation strategy (no autodiff ever traverses the CG loop):
+
+* solves whose *outputs* feed the objective nonlinearly (the Laplace
+  Newton steps) ride :func:`jax.lax.custom_linear_solve` — implicit
+  differentiation re-uses the same CG for the cotangent solve;
+* the marginal NLL's quadratic term uses the CG iterate's variational
+  value ``2 a^T y - a^T K a`` with ``a = stop_grad(K^-1 y)`` — equal to
+  ``y^T K^-1 y`` at convergence (error quadratic in the residual) and
+  carrying the EXACT gradient ``-a a^T`` w.r.t. K;
+* log-determinants return the SLQ value with a **surrogate gradient**:
+  ``stop_grad(slq - surr) + surr`` where ``surr = mean_i v_i^T K u_i``
+  — value is the SLQ estimate, gradient is the Hutchinson trace
+  estimator, and only three batched einsums touch the autodiff graph.
+
+Lane selection mirrors the precision lanes (``ops/precision.py``):
+``GP_SOLVER_LANE`` in {``exact``, ``iterative``, ``auto``} (env),
+:func:`set_solver_lane` (process-wide), :func:`solver_lane_scope`
+(trace-local, pinned by the jitted fit entry points whose cache keys
+carry the lane), default ``exact`` — today's factorization path
+bit-for-bit.  ``auto`` switches to the iterative lane when the expert
+size s reaches ``GP_SOLVER_AUTO_THRESHOLD`` (default 1024): below that
+the batched factorization is competitive and exact.  Tuning knobs (all
+read at trace time): ``GP_SOLVER_MAX_ITERS`` (CG/Lanczos steps, default
+min(s, 64)), ``GP_SOLVER_PROBES`` (Hutchinson probes, default 8),
+``GP_SOLVER_PRECOND_RANK`` (pivoted-Cholesky rank, default min(s, 64)),
+``GP_SOLVER_CG_TOL`` (relative-residual freeze tolerance),
+``GP_SOLVER_SEED`` (probe seed — FIXED across a fit's evaluations so
+the stochastic objective is a deterministic, smooth surrogate).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+# --------------------------------------------------------------------------
+# the solver-lane policy (the precision-lane pattern, ops/precision.py)
+# --------------------------------------------------------------------------
+
+SOLVER_LANES = ("exact", "iterative", "auto")
+
+_LANE_OVERRIDE: Optional[str] = None
+_SCOPE = threading.local()
+
+
+def _validate_lane(lane, source: str) -> str:
+    lane = str(lane).strip().lower()
+    if lane not in SOLVER_LANES:
+        raise ValueError(
+            f"{source}={lane!r} is not a solver lane; use one of "
+            f"{sorted(SOLVER_LANES)}"
+        )
+    return lane
+
+
+def active_solver_lane() -> str:
+    """The lane in effect: innermost :func:`solver_lane_scope`, else the
+    :func:`set_solver_lane` process override, else ``GP_SOLVER_LANE``,
+    else ``exact`` (today's factorization path, bit-for-bit)."""
+    scoped = getattr(_SCOPE, "lane", None)
+    if scoped is not None:
+        return scoped
+    if _LANE_OVERRIDE is not None:
+        return _LANE_OVERRIDE
+    env = os.environ.get("GP_SOLVER_LANE")
+    if env is None or not env.strip():
+        return "exact"
+    return _validate_lane(env, "GP_SOLVER_LANE")
+
+
+def set_solver_lane(lane):
+    """Process-wide lane setter (the programmatic twin of
+    ``GP_SOLVER_LANE``).  ``None`` clears the override.  Returns the
+    previous override so callers (the fallback ladder's ``iterative``
+    rung) can restore it.  Fit entry points carry the resolved lane in
+    their jit cache keys, so switching between fits recompiles."""
+    global _LANE_OVERRIDE
+    previous = _LANE_OVERRIDE
+    _LANE_OVERRIDE = (
+        None if lane is None else _validate_lane(lane, "set_solver_lane")
+    )
+    return previous
+
+
+@contextlib.contextmanager
+def solver_lane_scope(lane):
+    """Pin the lane for the duration of a trace (used inside jitted
+    programs whose cache key carries the lane as a static argument).
+    ``None`` is a no-op — the ambient lane applies.  Also accepts the
+    ``(lane, knob_signature)`` tuples of :func:`solver_jit_key` — the
+    knob part is cache salt only; the lane element is what pins."""
+    if lane is None:
+        yield
+        return
+    if isinstance(lane, tuple):
+        lane = lane[0]
+    lane = _validate_lane(lane, "solver_lane_scope")
+    prev = getattr(_SCOPE, "lane", None)
+    _SCOPE.lane = lane
+    try:
+        yield
+    finally:
+        _SCOPE.lane = prev
+
+
+#: the env knobs whose trace-time reads shape an iterative-lane program;
+#: folded into :func:`solver_jit_key` so a changed knob RECOMPILES
+#: instead of silently reusing the old executable while the post-fit
+#: probe stamps the new values into provenance
+_KNOB_ENV = (
+    "GP_SOLVER_MAX_ITERS", "GP_SOLVER_PROBES", "GP_SOLVER_PRECOND_RANK",
+    "GP_SOLVER_CG_TOL", "GP_SOLVER_SEED", "GP_SOLVER_AUTO_THRESHOLD",
+)
+
+
+def solver_jit_key():
+    """The hashable static the fit entry points carry in their jit cache
+    keys: the active lane alone when ``exact`` (today's single program),
+    else ``(lane, knob-signature)`` so switching any iterative knob
+    between fits compiles a fresh executable.  Resolved at CALL time by
+    the public wrappers, exactly like the precision lane."""
+    lane = active_solver_lane()
+    if lane == "exact":
+        return "exact"
+    return (lane, tuple(os.environ.get(k, "") for k in _KNOB_ENV))
+
+
+def auto_threshold() -> int:
+    """Expert size at which the ``auto`` lane switches to ``iterative``
+    (``GP_SOLVER_AUTO_THRESHOLD``, default 1024 — below that the batched
+    factorization is competitive and exact; docs/ROOFLINE.md)."""
+    raw = os.environ.get("GP_SOLVER_AUTO_THRESHOLD", "").strip()
+    try:
+        return int(raw) if raw else 1024
+    except ValueError:
+        return 1024
+
+
+def resolve_solver(expert_size: int, lane: Optional[str] = None) -> str:
+    """``exact`` or ``iterative`` for an expert of ``expert_size`` rows
+    under ``lane`` (default: the active lane).  Read at TRACE time by
+    the objectives — ``expert_size`` comes from static shapes, so the
+    resolution is part of the compiled program."""
+    lane = active_solver_lane() if lane is None else _validate_lane(
+        lane, "resolve_solver"
+    )
+    if lane == "auto":
+        return "iterative" if int(expert_size) >= auto_threshold() else "exact"
+    return lane
+
+
+class SolverConfig(NamedTuple):
+    """Resolved per-trace iterative-solver knobs (env reads happen once,
+    at trace time, like the precision policy)."""
+
+    iters: int
+    probes: int
+    rank: int
+    tol: float
+    seed: int
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def solver_config(expert_size: int) -> SolverConfig:
+    """The iterative lane's knobs for experts of ``expert_size`` rows."""
+    s = int(expert_size)
+    iters = _env_int("GP_SOLVER_MAX_ITERS", 0) or min(s, 64)
+    probes = _env_int("GP_SOLVER_PROBES", 8)
+    rank = _env_int("GP_SOLVER_PRECOND_RANK", 0) or min(s, 64)
+    raw = os.environ.get("GP_SOLVER_CG_TOL", "").strip()
+    try:
+        tol = float(raw) if raw else 1e-5
+    except ValueError:
+        tol = 1e-5
+    return SolverConfig(
+        iters=min(iters, s),
+        probes=probes,
+        rank=min(rank, s),
+        tol=tol,
+        seed=_env_int("GP_SOLVER_SEED", 0),
+    )
+
+
+# --------------------------------------------------------------------------
+# partial pivoted Cholesky + Woodbury preconditioner
+# --------------------------------------------------------------------------
+
+
+def pivoted_cholesky(kmat: jax.Array, rank: int):
+    """Greedy rank-``k`` pivoted partial Cholesky of a ``[..., s, s]``
+    SPD stack: ``(L [..., s, k], delta [...])`` with ``L L^T ~= K`` on
+    the k dominant pivots and ``delta`` the mean residual diagonal
+    (floored at a dtype-relative fraction of trace/s, so
+    ``P = L L^T + delta I`` is always SPD).  O(s * k^2) per matrix —
+    matmul-shaped, no factorization.  Callers pass a ``stop_gradient``
+    view: the preconditioner is numerics, never part of the autodiff
+    graph."""
+    s = kmat.shape[-1]
+    k = max(1, min(int(rank), s))
+    batch = kmat.shape[:-2]
+    dtype = kmat.dtype
+    diag0 = jnp.diagonal(kmat, axis1=-2, axis2=-1)  # [..., s]
+    trace = jnp.sum(diag0, axis=-1)
+    scale = jnp.where(trace > 0, trace / s, 1.0)  # [...]
+    eps = 100.0 * jnp.finfo(dtype).eps
+    floor = eps * scale
+    l0 = jnp.zeros(batch + (s, k), dtype=dtype)
+    iota_s = jnp.arange(s)
+
+    def step(carry, j):
+        lmat, d = carry
+        piv = jnp.argmax(d, axis=-1)  # [...]
+        dmax = jnp.take_along_axis(d, piv[..., None], axis=-1)[..., 0]
+        ok = dmax > floor
+        col = jnp.take_along_axis(
+            kmat, piv[..., None, None], axis=-1
+        )[..., 0]  # K[:, :, piv] -> [..., s]
+        lrow = jnp.take_along_axis(
+            lmat, piv[..., None, None], axis=-2
+        )[..., 0, :]  # L[piv, :] -> [..., k]
+        proj = jnp.einsum("...sk,...k->...s", lmat, lrow)
+        denom = jnp.sqrt(jnp.where(ok, dmax, 1.0))
+        newcol = jnp.where(
+            ok[..., None], (col - proj) / denom[..., None], 0.0
+        )
+        lmat = lmat + newcol[..., :, None] * (jnp.arange(k) == j)
+        d = jnp.maximum(d - newcol * newcol, 0.0)
+        # exclude the chosen pivot from future argmax rounds
+        d = jnp.where(iota_s == piv[..., None], -jnp.inf, d)
+        return (lmat, d), None
+
+    (lmat, d), _ = jax.lax.scan(step, (l0, diag0), jnp.arange(k))
+    resid = jnp.where(d > 0, d, 0.0)
+    denom = jnp.maximum(float(s - k), 1.0)
+    delta = jnp.maximum(jnp.sum(resid, axis=-1) / denom, floor)
+    return lmat, delta
+
+
+def woodbury_factor(lmat: jax.Array, delta: jax.Array) -> jax.Array:
+    """Cholesky of ``C = delta I_k + L^T L`` ([..., k, k]) — the one
+    small factorization behind every ``P^-1`` application."""
+    k = lmat.shape[-1]
+    c = delta[..., None, None] * jnp.eye(k, dtype=lmat.dtype) + jnp.einsum(
+        "...sk,...sl->...kl", lmat, lmat
+    )
+    return jnp.linalg.cholesky(c)
+
+
+def woodbury_apply(lmat, delta, cfac, v):
+    """``P^-1 v`` for ``P = L L^T + delta I`` via the Woodbury identity;
+    ``v`` is ``[..., s, n]``."""
+    from spark_gp_tpu.ops.linalg import chol_solve
+
+    ltv = jnp.einsum("...sk,...sn->...kn", lmat, v)
+    inner = chol_solve(cfac, ltv)
+    return (v - jnp.einsum("...sk,...kn->...sn", lmat, inner)) / delta[
+        ..., None, None
+    ]
+
+
+def woodbury_logdet(lmat, delta, cfac):
+    """``log|P|`` exactly: ``(s - k) log delta + log|delta I + L^T L|``
+    — the deterministic anchor of the log-det estimate."""
+    s = lmat.shape[-2]
+    k = lmat.shape[-1]
+    logdet_c = 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(cfac, axis1=-2, axis2=-1)), axis=-1
+    )
+    return (s - k) * jnp.log(delta) + logdet_c
+
+
+# --------------------------------------------------------------------------
+# batched multi-RHS preconditioned conjugate gradients
+# --------------------------------------------------------------------------
+
+
+class PcgResult(NamedTuple):
+    x: jax.Array        # [..., m, n] solutions
+    alphas: jax.Array   # [t, ..., n] CG step sizes (1.0 past convergence)
+    betas: jax.Array    # [t, ..., n] CG conjugation coeffs (0.0 past conv.)
+    rel_resid: jax.Array  # [..., n] final relative residual norms
+    iters_used: jax.Array  # [..., n] live iterations per RHS
+
+
+def batched_pcg(matvec, rhs, precond=None, iters: int = 32,
+                tol: float = 1e-5) -> PcgResult:
+    """Preconditioned CG over a batched multi-RHS stack ``[..., m, n]``.
+
+    ONE shared iteration loop (``lax.scan`` with a static trip count —
+    vmap/shard_map friendly, no data-dependent control flow): converged
+    columns freeze (their state stops updating) while the others keep
+    iterating; the per-step ``(alpha, beta)`` records are the Lanczos
+    tridiagonal of the preconditioned operator, consumed by
+    :func:`slq_logdet_from_coeffs`.  Every step is one batched matmul
+    against the whole RHS block — the solve against ``y`` and the probe
+    vectors ride the same stream."""
+    dtype = rhs.dtype
+    tiny = jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+    apply_p = precond if precond is not None else (lambda v: v)
+    x0 = jnp.zeros_like(rhs)
+    r0 = rhs
+    z0 = apply_p(r0)
+    p0 = z0
+    rz0 = jnp.sum(r0 * z0, axis=-2)  # [..., n]
+    rr0 = jnp.sum(r0 * r0, axis=-2)
+    thresh = (tol * tol) * jnp.maximum(rr0, tiny)
+
+    def step(carry, _):
+        x, r, z, p, rz = carry
+        rr = jnp.sum(r * r, axis=-2)
+        live = rr > thresh
+        ap = matvec(p)
+        pap = jnp.sum(p * ap, axis=-2)
+        ok = live & (pap > tiny)
+        alpha = jnp.where(ok, rz / jnp.where(ok, pap, 1.0), 0.0)
+        x2 = x + alpha[..., None, :] * p
+        r2 = r - alpha[..., None, :] * ap
+        z2 = apply_p(r2)
+        rz2 = jnp.sum(r2 * z2, axis=-2)
+        beta = jnp.where(ok, rz2 / jnp.where(ok, rz, 1.0), 0.0)
+        p2 = z2 + beta[..., None, :] * p
+        # frozen columns carry their state unchanged
+        keep = ok[..., None, :]
+        x2 = jnp.where(keep, x2, x)
+        r2 = jnp.where(keep, r2, r)
+        z2 = jnp.where(keep, z2, z)
+        p2 = jnp.where(keep, p2, p)
+        rz2 = jnp.where(ok, rz2, rz)
+        live_next = ok & (jnp.sum(r2 * r2, axis=-2) > thresh)
+        # tridiagonal records: identity-pad frozen steps so the T matrix
+        # decouples into [live block] + I (e1^T log T e1 untouched)
+        alpha_rec = jnp.where(ok, alpha, 1.0)
+        beta_rec = jnp.where(ok & live_next, beta, 0.0)
+        return (x2, r2, z2, p2, rz2), (alpha_rec, beta_rec, ok)
+
+    (x, r, _, _, _), (alphas, betas, lives) = jax.lax.scan(
+        step, (x0, r0, z0, p0, rz0), None, length=int(iters)
+    )
+    rel = jnp.sqrt(
+        jnp.sum(r * r, axis=-2) / jnp.maximum(rr0, tiny)
+    )
+    return PcgResult(
+        x=x, alphas=alphas, betas=betas, rel_resid=rel,
+        iters_used=jnp.sum(lives.astype(dtype), axis=0),
+    )
+
+
+def slq_logdet_from_coeffs(alphas, betas, weights):
+    """Stochastic Lanczos quadrature from the PCG coefficients.
+
+    ``alphas``/``betas`` are ``[t, ..., n]`` per-probe records; the CG
+    recurrence on ``(K, P)`` started at probe ``z`` generates the
+    Lanczos tridiagonal ``T`` of ``P^-1/2 K P^-1/2``:
+    ``T_jj = 1/alpha_j + beta_{j-1}/alpha_{j-1}``,
+    ``T_{j,j+1} = sqrt(beta_j)/alpha_j``.  With probes drawn
+    ``z ~ N(0, P)`` and ``weights = z^T P^-1 z``, the estimator
+    ``mean_i weights_i * e1^T log(T_i) e1`` converges to
+    ``tr log(P^-1/2 K P^-1/2) = logdet(K) - logdet(P)``
+    (Gardner et al. 2018).  The tiny ``[t, t]`` eigenproblems run as one
+    batched ``eigh`` — O(t^3) per probe, noise next to the matvecs."""
+    t = alphas.shape[0]
+    a = jnp.moveaxis(alphas, 0, -1)  # [..., n, t]
+    b = jnp.moveaxis(betas, 0, -1)
+    inv_a = 1.0 / a
+    diag = inv_a + jnp.concatenate(
+        [jnp.zeros_like(b[..., :1]), b[..., :-1] * inv_a[..., :-1]], axis=-1
+    )
+    off = jnp.sqrt(jnp.maximum(b[..., :-1], 0.0)) * inv_a[..., :-1]
+    tmat = (
+        jnp.zeros(diag.shape + (t,), dtype=diag.dtype)
+        + diag[..., None] * jnp.eye(t, dtype=diag.dtype)
+    )
+    if t > 1:
+        eye_up = jnp.eye(t, k=1, dtype=diag.dtype)
+        pad = jnp.concatenate(
+            [off, jnp.zeros_like(off[..., :1])], axis=-1
+        )
+        tmat = tmat + pad[..., None] * eye_up + (
+            pad[..., None] * eye_up
+        ).swapaxes(-1, -2)
+    evals, evecs = jnp.linalg.eigh(tmat)
+    log_evals = jnp.log(jnp.maximum(evals, 1e-12))
+    e1sq = evecs[..., 0, :] ** 2  # first-component weights per eigenpair
+    per_probe = jnp.sum(e1sq * log_evals, axis=-1)  # [..., n]
+    n = per_probe.shape[-1]
+    return jnp.sum(weights * per_probe, axis=-1) / n
+
+
+def _probe_keys(seed: int):
+    key = jax.random.PRNGKey(seed)
+    return jax.random.split(key, 2)
+
+
+# --------------------------------------------------------------------------
+# the marginal-NLL engine: fused inv-quad + logdet over the gram stack
+# --------------------------------------------------------------------------
+
+
+def inv_quad_logdet(kmat: jax.Array, y: jax.Array,
+                    config: Optional[SolverConfig] = None):
+    """``(y^T K^-1 y [E], logdet K [E])`` over an ``[E, s, s]`` SPD gram
+    stack — the iterative lane's replacement for the batched Cholesky of
+    the marginal NLL (GPyTorch's ``inv_quad_logdet``, arXiv 1809.11165).
+
+    One multi-RHS PCG solves ``K^-1 [y, Z]`` (probes ``Z ~ N(0, P)``
+    drawn from the pivoted-Cholesky preconditioner, the variance-reduced
+    pairing whose SLQ weights are exact); the quadratic term returns the
+    CG variational value with the exact ``-a a^T`` gradient, the log-det
+    returns ``logdet(P) + SLQ`` with the Hutchinson surrogate gradient
+    (module docstring).  NaN/inf in ``kmat`` propagates to NaN outputs —
+    the same non-finite surface the exact lane shows the resilience
+    driver."""
+    s = kmat.shape[-1]
+    cfg = config or solver_config(s)
+    km = jax.lax.stop_gradient(kmat)
+    y_s = jax.lax.stop_gradient(y)
+
+    lmat, delta = pivoted_cholesky(km, cfg.rank)
+    cfac = woodbury_factor(lmat, delta)
+
+    k1, k2 = _probe_keys(cfg.seed)
+    batch = km.shape[:-2]
+    g1 = jax.random.normal(
+        k1, batch + (lmat.shape[-1], cfg.probes), dtype=km.dtype
+    )
+    g2 = jax.random.normal(k2, batch + (s, cfg.probes), dtype=km.dtype)
+    z = jnp.einsum("...sk,...kn->...sn", lmat, g1) + jnp.sqrt(delta)[
+        ..., None, None
+    ] * g2
+
+    rhs = jnp.concatenate([y_s[..., None], z], axis=-1)
+    res = batched_pcg(
+        lambda v: jnp.einsum("...st,...tn->...sn", km, v),
+        rhs,
+        precond=lambda v: woodbury_apply(lmat, delta, cfac, v),
+        iters=cfg.iters,
+        tol=cfg.tol,
+    )
+    alpha = res.x[..., 0]           # K^-1 y       [E, s]
+    u = res.x[..., 1:]              # K^-1 Z       [E, s, r]
+    vtil = woodbury_apply(lmat, delta, cfac, z)  # P^-1 Z
+    weights = jnp.sum(z * vtil, axis=-2)         # z^T P^-1 z  [E, r]
+
+    # value: logdet(P) exact + SLQ of the preconditioned remainder
+    logdet_val = woodbury_logdet(lmat, delta, cfac) + slq_logdet_from_coeffs(
+        res.alphas[..., 1:], res.betas[..., 1:], weights
+    )
+
+    # differentiable legs — the ONLY places the traced kmat/y appear
+    alpha = jax.lax.stop_gradient(alpha)
+    u = jax.lax.stop_gradient(u)
+    vtil = jax.lax.stop_gradient(vtil)
+    quad = 2.0 * jnp.einsum("...s,...s->...", alpha, y) - jnp.einsum(
+        "...s,...st,...t->...", alpha, kmat, alpha
+    )
+    surr = jnp.einsum("...sn,...st,...tn->...", vtil, kmat, u) / cfg.probes
+    logdet = jax.lax.stop_gradient(logdet_val - surr) + surr
+    return quad, logdet
+
+
+# --------------------------------------------------------------------------
+# SPD solve / logdet for materialized operators (the Laplace B systems)
+# --------------------------------------------------------------------------
+
+
+def _cg_only(matvec, b, iters, tol, precond=None):
+    return batched_pcg(matvec, b, precond, iters, tol).x
+
+
+def build_spd_preconditioner(amat: jax.Array,
+                             config: Optional[SolverConfig] = None):
+    """Public one-stop build of the rank-k pivoted-Cholesky/Woodbury
+    preconditioner triple ``(lmat, delta, cfac)`` for an SPD stack —
+    the object :func:`spd_solve` / :func:`spd_logdet` accept as
+    ``precond`` so callers issuing several solves/log-dets against ONE
+    stack (the Laplace families' convergence recomputes) pay the
+    O(s k^2) build once.  ``stop_gradient`` is applied here: the
+    preconditioner is numerics, never part of the autodiff graph."""
+    cfg = config or solver_config(amat.shape[-1])
+    _, lmat, delta, cfac = _spd_preconditioner(
+        jax.lax.stop_gradient(amat), cfg
+    )
+    return lmat, delta, cfac
+
+
+def _spd_preconditioner(am: jax.Array, cfg: SolverConfig):
+    """``P^-1`` applier + factors for a STOP-GRADIENT SPD stack: the
+    rank-k pivoted-Cholesky + Woodbury machinery shared with the
+    marginal path.  The Laplace ``B = I + sqrtW K sqrtW`` systems have
+    eigenvalues >= 1 but conditioning like ``1 + lambda_max(K W)`` —
+    into the thousands on dense ill-conditioned grams, where
+    unpreconditioned f32 CG loses conjugacy and can outright diverge
+    (the product-path failure mode this preconditioner exists for)."""
+    lmat, delta = pivoted_cholesky(am, cfg.rank)
+    cfac = woodbury_factor(lmat, delta)
+    return (
+        lambda v: woodbury_apply(lmat, delta, cfac, v),
+        lmat, delta, cfac,
+    )
+
+
+def spd_solve(amat: jax.Array, b: jax.Array,
+              config: Optional[SolverConfig] = None,
+              precond=None) -> jax.Array:
+    """``A^-1 b`` for a materialized SPD stack ``A [..., s, s]`` with
+    ``b [..., s]`` (or ``[..., s, n]``) via pivoted-Cholesky
+    preconditioned CG under ``lax.custom_linear_solve`` — the backward
+    pass re-solves the symmetric system with the SAME PCG, so implicit
+    differentiation w.r.t. both ``A`` and ``b`` is exact at
+    convergence.  Used by the Laplace families' ``B = I + sqrtW K
+    sqrtW`` applications; the preconditioner is numerics only
+    (stop-gradient), never part of the autodiff graph.  ``precond`` is
+    an optional prebuilt ``(lmat, delta, cfac)`` triple so callers
+    issuing several solves/log-dets against ONE stack (the binary
+    Laplace convergence recompute) pay the O(s k^2) build once."""
+    cfg = config or solver_config(amat.shape[-1])
+    vector = b.ndim == amat.ndim - 1
+    b2 = b[..., None] if vector else b
+    if precond is None:
+        apply_p, _, _, _ = _spd_preconditioner(
+            jax.lax.stop_gradient(amat), cfg
+        )
+    else:
+        p_l, p_d, p_c = precond
+        apply_p = lambda v: woodbury_apply(p_l, p_d, p_c, v)
+
+    def mv(v):
+        return jnp.einsum("...st,...tn->...sn", amat, v)
+
+    x = jax.lax.custom_linear_solve(
+        mv, b2,
+        solve=lambda mv_, b_: _cg_only(
+            mv_, b_, cfg.iters, cfg.tol, precond=apply_p
+        ),
+        symmetric=True,
+    )
+    return x[..., 0] if vector else x
+
+
+def spd_logdet(amat: jax.Array,
+               config: Optional[SolverConfig] = None,
+               precond=None) -> jax.Array:
+    """``logdet(A) [...]`` for a materialized SPD stack: the exact
+    pivoted-Cholesky/Woodbury ``logdet(P)`` anchor plus preconditioned
+    SLQ of the remainder (probes ``z ~ N(0, P)`` — the variance-reduced
+    pairing of :func:`inv_quad_logdet`), with the Hutchinson surrogate
+    gradient ``tr(A^-1 dA) ~= mean_i (P^-1 z_i)^T dA (A^-1 z_i)``.
+    ``precond`` shares a prebuilt ``(lmat, delta, cfac)`` triple (see
+    :func:`spd_solve`)."""
+    s = amat.shape[-1]
+    cfg = config or solver_config(s)
+    am = jax.lax.stop_gradient(amat)
+    if precond is None:
+        apply_p, lmat, delta, cfac = _spd_preconditioner(am, cfg)
+    else:
+        lmat, delta, cfac = precond
+        apply_p = lambda v: woodbury_apply(lmat, delta, cfac, v)
+    k1, k2 = _probe_keys(cfg.seed + 1)
+    batch = am.shape[:-2]
+    g1 = jax.random.normal(
+        k1, batch + (lmat.shape[-1], cfg.probes), dtype=am.dtype
+    )
+    g2 = jax.random.normal(k2, batch + (s, cfg.probes), dtype=am.dtype)
+    z = jnp.einsum("...sk,...kn->...sn", lmat, g1) + jnp.sqrt(delta)[
+        ..., None, None
+    ] * g2
+    res = batched_pcg(
+        lambda v: jnp.einsum("...st,...tn->...sn", am, v),
+        z, apply_p, cfg.iters, cfg.tol,
+    )
+    vtil = apply_p(z)                      # P^-1 z
+    weights = jnp.sum(z * vtil, axis=-2)   # z^T P^-1 z
+    val = woodbury_logdet(lmat, delta, cfac) + slq_logdet_from_coeffs(
+        res.alphas, res.betas, weights
+    )
+    u = jax.lax.stop_gradient(res.x)
+    vtil = jax.lax.stop_gradient(vtil)
+    surr = jnp.einsum("...sn,...st,...tn->...", vtil, amat, u) / cfg.probes
+    return jax.lax.stop_gradient(val - surr) + surr
+
+
+# --------------------------------------------------------------------------
+# factored operators: B' = I + S^T K_blk S (the multiclass Laplace system)
+# --------------------------------------------------------------------------
+
+
+def _factored_matvec(kmat, smat, v):
+    """``(I + S^T K_blk S) v`` on ``[E, s, C]`` latent vectors, with
+    ``S [E, s, C, C]`` the per-row factor of the softmax Hessian
+    (``W = S S^T``) and ``K_blk = I_C (x) K`` applied per class — the
+    multiclass Laplace system WITHOUT materializing the ``[sC, sC]``
+    block operator.  O(C s^2 + s C^2) per application, all einsums."""
+    sv = jnp.einsum("escd,esd->esc", smat, v)
+    ksv = jnp.einsum("est,etc->esc", kmat, sv)
+    return v + jnp.einsum("esdc,esd->esc", smat, ksv)
+
+
+def factored_solve(kmat, smat, b, config: Optional[SolverConfig] = None):
+    """``(I + S^T K_blk S)^-1 b`` for ``b [E, s, C]`` via CG under
+    ``custom_linear_solve`` (implicit differentiation w.r.t. BOTH
+    ``kmat`` and ``smat`` through the matvec closure)."""
+    e, s, c = b.shape
+    cfg = config or solver_config(s)
+
+    def mv(vflat):
+        v = vflat[..., 0].reshape(e, s, c)
+        return _factored_matvec(kmat, smat, v).reshape(e, s * c)[..., None]
+
+    x = jax.lax.custom_linear_solve(
+        mv, b.reshape(e, s * c)[..., None],
+        solve=lambda mv_, b_: _cg_only(mv_, b_, cfg.iters, cfg.tol),
+        symmetric=True,
+    )
+    return x[..., 0].reshape(e, s, c)
+
+
+def _factored_matvec_probes(kmat, smat, v):
+    """The factored operator applied to a PROBE-BATCHED block
+    ``v [E, n, s, C]`` — the probe axis rides the einsums' batch
+    dimensions, so the ``[E, s, s]`` gram stack is read once per
+    application instead of materializing n repeated copies (which would
+    defeat the lane's skinny-workspace premise and the memplan byte
+    model)."""
+    sv = jnp.einsum("escd,ensd->ensc", smat, v)
+    ksv = jnp.einsum("est,entc->ensc", kmat, sv)
+    return v + jnp.einsum("esdc,ensd->ensc", smat, ksv)
+
+
+def factored_logdet(kmat, smat, config: Optional[SolverConfig] = None):
+    """``logdet(I + S^T K_blk S) [E]`` — equal to
+    ``logdet(I + K_blk W)`` by Sylvester — via SLQ with Rademacher
+    probes on the implicit operator, surrogate gradient through the
+    differentiable matvec (gradients flow to both ``kmat`` and
+    ``smat``)."""
+    e, s = kmat.shape[0], kmat.shape[-1]
+    c = smat.shape[-1]
+    cfg = config or solver_config(s)
+    km = jax.lax.stop_gradient(kmat)
+    sm = jax.lax.stop_gradient(smat)
+    k1, _ = _probe_keys(cfg.seed + 2)
+    z = jax.random.rademacher(k1, (e, s * c, cfg.probes), dtype=km.dtype)
+
+    def mv(vs):
+        # vs [E, sC, n] -> probe-batched factored operator application
+        v = jnp.moveaxis(vs, -1, 1).reshape(e, cfg.probes, s, c)
+        out = _factored_matvec_probes(km, sm, v)
+        return jnp.moveaxis(out.reshape(e, cfg.probes, s * c), 1, -1)
+
+    res = batched_pcg(mv, z, None, cfg.iters, cfg.tol)
+    weights = jnp.sum(z * z, axis=-2)
+    val = slq_logdet_from_coeffs(res.alphas, res.betas, weights)
+    u = jax.lax.stop_gradient(res.x)  # [E, sC, n]
+
+    # surrogate: mean_i z_i^T (dB u_i) through the DIFFERENTIABLE matvec
+    u4 = jnp.moveaxis(u, -1, 1).reshape(e, cfg.probes, s, c)
+    bu = _factored_matvec_probes(kmat, smat, u4).reshape(
+        e, cfg.probes, s * c
+    )
+    z3 = jnp.moveaxis(z, -1, 1)  # [E, n, sC]
+    surr = jnp.einsum("enm,enm->e", z3, bu) / cfg.probes
+    return jax.lax.stop_gradient(val - surr) + surr
+
+
+# --------------------------------------------------------------------------
+# diagnostics — the post-fit convergence probe (models/common.py journals it)
+# --------------------------------------------------------------------------
+
+
+def solver_report(kmat, y, config: Optional[SolverConfig] = None) -> dict:
+    """Host-side convergence diagnostics of the iterative lane at the
+    FITTED hyperparameters: ONE jitted :func:`inv_quad_logdet`-shaped
+    pass over the (sub)stack — the preconditioner build, the multi-RHS
+    PCG, and the value legs all come out of the same dispatch —
+    reporting the knobs, the achieved residuals, and value finiteness.
+    Forward-only; called once per fit by
+    ``models/common._emit_solver_stats``."""
+    import numpy as np
+
+    s = int(kmat.shape[-1])
+    cfg = config or solver_config(s)
+    quad, logdet, rel, iters = (
+        np.asarray(r) for r in jax.jit(
+            lambda k_, y_: _report_pass(k_, y_, cfg)
+        )(kmat, y)
+    )
+    return {
+        "cg_iters": float(iters.max(initial=0.0)),
+        "cg_iters_mean": float(iters.mean()) if iters.size else 0.0,
+        "residual": float(rel.max(initial=0.0)),
+        "precond_rank": float(cfg.rank),
+        "probes": float(cfg.probes),
+        "max_iters": float(cfg.iters),
+        "quad_finite": bool(np.all(np.isfinite(quad))),
+        "logdet_finite": bool(np.all(np.isfinite(logdet))),
+    }
+
+
+def _report_pass(kmat, y, cfg: SolverConfig):
+    """The probe program behind :func:`solver_report`: the exact
+    :func:`inv_quad_logdet` math, additionally surfacing the PCG
+    convergence record of the ``y`` column."""
+    lmat, delta = pivoted_cholesky(kmat, cfg.rank)
+    cfac = woodbury_factor(lmat, delta)
+    k1, k2 = _probe_keys(cfg.seed)
+    batch = kmat.shape[:-2]
+    s = kmat.shape[-1]
+    g1 = jax.random.normal(
+        k1, batch + (lmat.shape[-1], cfg.probes), dtype=kmat.dtype
+    )
+    g2 = jax.random.normal(k2, batch + (s, cfg.probes), dtype=kmat.dtype)
+    z = jnp.einsum("...sk,...kn->...sn", lmat, g1) + jnp.sqrt(delta)[
+        ..., None, None
+    ] * g2
+    rhs = jnp.concatenate([y[..., None], z], axis=-1)
+    res = batched_pcg(
+        lambda v: jnp.einsum("...st,...tn->...sn", kmat, v),
+        rhs,
+        precond=lambda v: woodbury_apply(lmat, delta, cfac, v),
+        iters=cfg.iters,
+        tol=cfg.tol,
+    )
+    alpha = res.x[..., 0]
+    vtil = woodbury_apply(lmat, delta, cfac, z)
+    weights = jnp.sum(z * vtil, axis=-2)
+    quad = jnp.einsum("...s,...s->...", alpha, y)
+    logdet = woodbury_logdet(lmat, delta, cfac) + slq_logdet_from_coeffs(
+        res.alphas[..., 1:], res.betas[..., 1:], weights
+    )
+    return quad, logdet, res.rel_resid[..., 0], res.iters_used[..., 0]

@@ -256,15 +256,20 @@ def _stamp(instr, model, degradations: List[dict]) -> None:
 #: rung order per entry point; per-class policy below selects which of a
 #: ladder's rungs a failure class may fall to (docs/RESILIENCE.md table)
 LADDERS = {
-    "fit": ("native", "segmented", "host_f64", "strict_lane"),
+    "fit": ("native", "iterative", "segmented", "host_f64", "strict_lane"),
     "fit_sharded": ("sharded", "dcn_fallback", "single_host", "strict_lane"),
     "predict": ("chunked", "chunk_halved", "host_solve"),
     "ppa": ("device_solve", "host_solve"),
 }
 
-#: per-class candidate rungs at the ``fit`` entry, in order
+#: per-class candidate rungs at the ``fit`` entry, in order.  An OOM
+#: tries the ``iterative`` solver rung FIRST: the CG/Lanczos lane
+#: (ops/iterative.py) re-executes the SAME dispatch shape with the
+#: factorization workspace — the peak resident of every exact fit
+#: program — replaced by O(E s (k + r)) CG state, which is the cheapest
+#: memory axis to degrade along (no smaller dispatches, no host sync).
 _FIT_POLICY = {
-    OOM: ("segmented", "host_f64"),
+    OOM: ("iterative", "segmented", "host_f64"),
     COMPILE: ("segmented", "host_f64"),
     NON_FINITE_EXHAUSTED: ("host_f64",),
     NOT_PSD_EXHAUSTED: ("host_f64",),
@@ -300,8 +305,11 @@ class NullSegmentSaver:
         pass
 
 
-def _fit_rung_applies(est, rung: str, cls: str, visited) -> bool:
+def _fit_rung_applies(est, rung: str, cls: str, visited,
+                      expert_size=None) -> bool:
     """Whether ``rung`` is a legal next step for this estimator + class.
+    ``expert_size`` (when the caller has the stack) lets the iterative
+    gate resolve the ``auto`` lane instead of comparing raw lane names.
 
     The gates keep pre-ladder behavior intact everywhere degradation
     cannot help: ``segmented`` needs the plain single-chip one-dispatch
@@ -313,6 +321,22 @@ def _fit_rung_applies(est, rung: str, cls: str, visited) -> bool:
     off the strict lane."""
     if rung in visited:
         return False
+    if rung == "iterative":
+        # the solver rung re-executes on the CG/Lanczos lane
+        # (ops/iterative.py) — applicable only when the fit was not
+        # already running it.  With the stack's expert size in hand the
+        # ``auto`` lane resolves exactly; without it (no data at the
+        # call site) an auto-over-large-experts fit may get one
+        # redundant attempt, bounded by ``visited``.
+        from spark_gp_tpu.ops.iterative import (
+            active_solver_lane,
+            resolve_solver,
+        )
+
+        lane = active_solver_lane()
+        if expert_size is not None:
+            return resolve_solver(int(expert_size), lane) != "iterative"
+        return lane != "iterative"
     if rung == "segmented":
         return (
             getattr(est, "_checkpoint_dir", None) is None
@@ -339,9 +363,9 @@ def _fit_rung_applies(est, rung: str, cls: str, visited) -> bool:
     return False
 
 
-def _next_fit_rung(est, cls: str, visited) -> Optional[str]:
+def _next_fit_rung(est, cls: str, visited, expert_size=None) -> Optional[str]:
     for rung in _FIT_POLICY.get(cls, ()):
-        if _fit_rung_applies(est, rung, cls, visited):
+        if _fit_rung_applies(est, rung, cls, visited, expert_size):
             return rung
     return None
 
@@ -366,6 +390,18 @@ def _fit_rung_scope(est, rung: str):
             yield
         finally:
             set_precision_lane(prev_lane)
+        return
+    if rung == "iterative":
+        # the solver rung: pin the CG/Lanczos lane for the re-fit (the
+        # fit entry points carry it in their jit keys, so the rung's
+        # dispatch compiles its own executable) and restore after
+        from spark_gp_tpu.ops.iterative import set_solver_lane
+
+        prev_solver = set_solver_lane("iterative")
+        try:
+            yield
+        finally:
+            set_solver_lane(prev_solver)
         return
     est._fallback_mode = rung
     try:
@@ -428,7 +464,12 @@ def run_fit_ladder(est, instr, attempt: Callable, data=None):
                 # already counted its miss at plan time.
                 plan_missed = True
                 memplan.record_plan_miss("fit")
-            nxt = _next_fit_rung(est, last_cls, visited)
+            nxt = _next_fit_rung(
+                est, last_cls, visited,
+                expert_size=(
+                    None if data is None else int(data.x.shape[1])
+                ),
+            )
             if nxt is None:
                 if degradations:
                     from spark_gp_tpu.obs.runtime import telemetry

@@ -71,6 +71,13 @@ _FIT_RUNG_WORK_FACTOR = {
     "native": 16.0,
     "segmented": 8.0,
     "host_f64": 12.0,
+    # the CG/Lanczos solver rung (ops/iterative.py): the gram stack, the
+    # jittered copy the matvec closes over, and the autodiff residuals of
+    # the three differentiable einsums stay [E, s, s]-sized, but the
+    # factorization / explicit-inverse / SPD-VJP chains — the bulk of the
+    # exact rungs' liveness — are replaced by skinny CG state accounted
+    # separately below (O(E s (k + r)) workspace, not O(E s^2) factor)
+    "iterative": 6.0,
 }
 
 
@@ -230,6 +237,18 @@ def fit_dispatch_bytes(
     # with heads^2: the multiclass Laplace dK-stack jacobians cross every
     # latent-head pair.
     raw = stack + (1.0 + k * heads * heads) * gram
+    if rung == "iterative":
+        # the solver rung's extra residents are SKINNY, not square: the
+        # rank-k pivoted-Cholesky preconditioner [E, s, k], the multi-RHS
+        # block [E, s, 1 + probes], and the four CG carries over it —
+        # O(E s (k + r)) workspace where the exact rungs hold O(E s^2)
+        # factors (why plan_fit_dispatch can admit it at sizes the native
+        # rung cannot reach under the same budget)
+        from spark_gp_tpu.ops.iterative import solver_config
+
+        cfg = solver_config(int(s))
+        cols = cfg.rank + 5.0 * (1.0 + cfg.probes)
+        raw += e * s * cols * heads * itemsize
     return _calibrated(fit_model_key(family, rung), raw)
 
 
@@ -407,9 +426,11 @@ def plan_dispatch(
     fastest / largest config first); the first whose margined prediction
     fits the budget wins.  Returns None when planning is off or no
     budget resolves (no constraint — callers keep today's behavior
-    exactly), and a ``fits=False`` decision on the LAST (smallest)
-    candidate when nothing fits — the caller dispatches it anyway and
-    the reactive ladder stays the backstop."""
+    exactly), and a ``fits=False`` decision on the SMALLEST-predicted
+    candidate when nothing fits (preference order need not be
+    monotone-by-bytes — the fit ladder's iterative rung is preferred
+    over segmented but not always smaller) — the caller dispatches it
+    anyway and the reactive ladder stays the backstop."""
     if not enabled() or not candidates:
         return None
     if budget is None:
@@ -425,7 +446,10 @@ def plan_dispatch(
         }
         for name, raw in candidates
     ]
-    chosen = next((r for r in rows if r["fits"]), rows[-1])
+    chosen = next(
+        (r for r in rows if r["fits"]),
+        min(rows, key=lambda r: r["predicted_bytes"]),
+    )
     decision = PlanDecision(
         entry=entry,
         chosen=chosen["name"],
@@ -499,12 +523,37 @@ def plan_fit_dispatch(est, instr, data) -> Optional[PlanDecision]:
     n_targets = int(data.y.shape[2]) if getattr(data.y, "ndim", 2) == 3 else 1
     family = type(est).__name__
 
+    from spark_gp_tpu.ops.iterative import resolve_solver
+
+    # the "native" candidate prices the program the fit will ACTUALLY
+    # dispatch first: the iterative-rung byte model when the solver lane
+    # (pinned, or auto over large experts) already resolves there —
+    # mirroring common._dispatch_raw_bytes
+    native_rung = (
+        "iterative" if resolve_solver(s) == "iterative" else "native"
+    )
     candidates = [
         ("native",
-         fit_dispatch_bytes(e, s, p, itemsize, "native", n_targets, family))
+         fit_dispatch_bytes(e, s, p, itemsize, native_rung, n_targets,
+                            family))
     ]
     from spark_gp_tpu.resilience import fallback
 
+    if fallback._fit_rung_applies(
+        est, "iterative", fallback.OOM, set(), expert_size=s
+    ):
+        # the CG/Lanczos solver rung as a PRE-SIZED choice: same dispatch
+        # shape, skinny workspace instead of O(E s^2) factors — preferred
+        # over shrinking dispatches when it fits.  (When the fit already
+        # resolves to the iterative lane — pinned or auto over large
+        # experts — the "native" candidate above IS that program, priced
+        # by _dispatch_raw_bytes at the iterative rung, and no duplicate
+        # row is offered.)
+        candidates.append((
+            "iterative",
+            fit_dispatch_bytes(e, s, p, itemsize, "iterative", n_targets,
+                               family),
+        ))
     if fallback._fit_rung_applies(est, "segmented", fallback.OOM, set()):
         candidates.append((
             "segmented",

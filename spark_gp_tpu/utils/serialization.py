@@ -63,6 +63,20 @@ def save_model(path: str, model, kind: str) -> None:
         getattr(model, "covariate_summary", None)
         or (getattr(instr, "covariate_summary", None) if instr else None)
     )
+    # the solver lane that produced the model (ops/iterative.py) plus the
+    # iterative lane's convergence stats, mirroring gram_cache_engaged:
+    # an iterative-lane model carries its stochastic-solver provenance
+    # permanently, so a prediction-quality investigation can tell "CG at
+    # residual 1e-6" from "exact factorization" after the fact
+    fit_metrics = dict(getattr(instr, "metrics", {}) or {}) if instr else {}
+    solver = {
+        key: fit_metrics[key]
+        for key in (
+            "solver_lane", "solver.cg_iters", "solver.precond_rank",
+            "solver.probes", "solver.residual",
+        )
+        if key in fit_metrics
+    }
     extras["provenance_json"] = np.frombuffer(
         json.dumps({
             "process_count": jax.process_count(),
@@ -70,6 +84,7 @@ def save_model(path: str, model, kind: str) -> None:
             # fallback.py): a model produced through fallback re-execution
             # says so permanently — [] for a clean fit
             "degradations": list(getattr(model, "degradations", None) or ()),
+            **({"solver": solver} if solver else {}),
             **(
                 {"covariate_summary": covariate_summary}
                 if covariate_summary else {}

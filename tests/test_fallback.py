@@ -110,24 +110,45 @@ def test_classifier_follows_retry_budget_cause():
 # -- fit ladder: injected OOM / compile -------------------------------------
 
 
-def test_injected_oom_completes_via_segmented_rung(problem, clean_model, tmp_path, monkeypatch):
+def test_injected_oom_completes_via_iterative_rung(problem, clean_model, tmp_path, monkeypatch):
     """The acceptance contract: a RESOURCE_EXHAUSTED on the one-dispatch
-    device fit completes through the segmented rung with the IDENTICAL
-    fitted theta (same L-BFGS trajectory in halved segment batches),
-    fallback metrics emitted, and the classified failure + rung sequence
-    recorded in the run journal and the saved model's provenance_json."""
+    device fit completes through the ITERATIVE solver rung (ISSUE 14 —
+    the oom class tries the CG/Lanczos lane first: the SAME dispatch
+    shape, skinny CG workspace instead of factor stacks — so a memory
+    budget the exact program exceeds admits the re-fit) with the
+    achieved objective inside the lane's documented stochastic
+    tolerance, fallback metrics emitted, and the classified failure +
+    rung sequence recorded in the run journal and the saved model's
+    provenance_json.  The budget is chaos-staged between the two rungs'
+    modeled bytes with the PLANNER disabled, so the reactive ladder —
+    not pre-sizing — is what carries the fit."""
+    from spark_gp_tpu.parallel.experts import num_experts_for
+    from spark_gp_tpu.resilience import memplan
+
     x, y = problem
     monkeypatch.setenv("GP_RUN_JOURNAL_DIR", str(tmp_path))
+    monkeypatch.setenv("GP_MEMPLAN", "0")
     from spark_gp_tpu.obs.runtime import telemetry
 
+    e = num_experts_for(x.shape[0], 50)
+    itemsize = int(np.dtype(np.asarray(x).dtype).itemsize)
+    native_raw = memplan.fit_dispatch_bytes(
+        e, 50, x.shape[1], itemsize, "native"
+    )
+    iter_raw = memplan.fit_dispatch_bytes(
+        e, 50, x.shape[1], itemsize, "iterative"
+    )
+    assert iter_raw < native_raw
     before = telemetry.snapshot()["counters"]
-    with chaos.oom_after_calls(0, op="one_dispatch") as fired:
+    with chaos.memory_limit_bytes((iter_raw + native_raw) / 2.0) as fired:
         model = _gp().fit(x, y)
     assert fired[0] == 1
-    np.testing.assert_allclose(
-        model.raw_predictor.theta, clean_model.raw_predictor.theta,
-        atol=1e-6,
-    )
+    # objective-level parity: theta can ride a flat amplitude ridge at
+    # this small iteration budget, but the achieved objective must match
+    # within the iterative lane's documented stochastic bar
+    nll_clean = float(clean_model.instr.metrics["final_nll"])
+    nll_degr = float(model.instr.metrics["final_nll"])
+    assert abs(nll_degr - nll_clean) / max(abs(nll_clean), 1.0) <= 1e-2
     # metrics
     assert model.instr.metrics["fallback.engaged"] == 1.0
     after = telemetry.snapshot()["counters"]
@@ -141,7 +162,7 @@ def test_injected_oom_completes_via_segmented_rung(problem, clean_model, tmp_pat
     (transition,) = model.degradations
     assert transition["failure_class"] == "oom"
     assert transition["from"] == "native"
-    assert transition["to"] == "segmented"
+    assert transition["to"] == "iterative"
     # run journal carries it
     assert model.run_journal["degradations"] == model.degradations
     with open(model.run_journal["path"]) as fh:
@@ -190,7 +211,9 @@ def test_persistent_oom_raises_single_classified_error(problem):
             _gp().fit(x, y)
     err = excinfo.value
     assert err.failure_class == fallback.OOM
-    assert [d["to"] for d in err.degradations] == ["segmented", "host_f64"]
+    assert [d["to"] for d in err.degradations] == [
+        "iterative", "segmented", "host_f64",
+    ]
     assert err.__cause__ is not None
     assert fallback.classify_failure(err) == fallback.OOM
 

@@ -168,9 +168,14 @@ def test_oom_exhausted_fit_dumps_exactly_one_schema_valid_bundle(
     # the failing span tree: rooted at the fit's own root span
     names = {n["name"] for n in _tree_nodes(bundle["spans"])}
     assert "fit.GaussianProcessRegression" in names
-    # the rung history: native -> segmented -> host_f64, as the ladder ran
+    # the rung history as the ladder ran (ISSUE 14: the oom class tries
+    # the iterative solver rung first)
     rungs = [(d["from"], d["to"]) for d in bundle["degradations"]]
-    assert rungs == [("native", "segmented"), ("segmented", "host_f64")]
+    assert rungs == [
+        ("native", "iterative"),
+        ("iterative", "segmented"),
+        ("segmented", "host_f64"),
+    ]
     # the last-N recorder events include the classified-failure sequence
     event_names = [e["name"] for e in bundle["events"]]
     assert "fallback.failure" in event_names
@@ -344,7 +349,7 @@ def test_bundle_still_dumped_with_tracing_off(tmp_path, monkeypatch):
     assert bundle["failure_class"] == "oom"
     assert bundle["spans"] == []  # no tracer, no tree — by design
     assert [d["to"] for d in bundle["degradations"]] == [
-        "segmented", "host_f64",
+        "iterative", "segmented", "host_f64",
     ]
 
 

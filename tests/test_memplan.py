@@ -75,7 +75,8 @@ def _counters():
 
 
 def _fit_limit_between_segment_and_native(x):
-    """A budget only the segmented dispatch fits under (f32 stack)."""
+    """A budget under which the native dispatch is over but both smaller
+    rungs (iterative solver / segmented) fit (f32 stack)."""
     e = num_experts_for(x.shape[0], EXPERT)
     native_raw = memplan.fit_dispatch_bytes(
         e, EXPERT, x.shape[1], _itemsize(), "native"
@@ -83,14 +84,17 @@ def _fit_limit_between_segment_and_native(x):
     seg_pred = memplan.predicted_bytes(
         memplan.fit_dispatch_bytes(e, EXPERT, x.shape[1], _itemsize(), "segmented")
     )
-    assert seg_pred < native_raw
-    return (seg_pred + native_raw) / 2.0
+    iter_pred = memplan.predicted_bytes(
+        memplan.fit_dispatch_bytes(e, EXPERT, x.shape[1], _itemsize(), "iterative")
+    )
+    assert seg_pred < native_raw and iter_pred < native_raw
+    return (max(seg_pred, iter_pred) + native_raw) / 2.0
 
 
 # -- fit dispatch pre-sizing -------------------------------------------------
 
 
-def test_fit_plan_presizes_segmented_no_oom(problem):
+def test_fit_plan_presizes_iterative_no_oom(problem):
     x, y = problem
     clean = _gp().fit(x, y)
     limit = _fit_limit_between_segment_and_native(x)
@@ -107,14 +111,18 @@ def test_fit_plan_presizes_segmented_no_oom(problem):
     assert after.get("plan.hit", 0.0) == before.get("plan.hit", 0.0) + 1
     # provenance: the decision rows, predicted >= modeled actual <= budget
     rows = model.instr.memory_plan
-    assert rows[0]["chosen"] == "segmented" and rows[0]["fits"] is True
+    # ISSUE 14: the iterative solver rung is the preferred pre-sized
+    # smaller configuration (same dispatch shape, skinny CG workspace)
+    assert rows[0]["chosen"] == "iterative" and rows[0]["fits"] is True
     assert rows[0]["raw_bytes"] <= rows[0]["predicted_bytes"] <= limit
     names = [c["name"] for c in rows[0]["candidates"]]
-    assert names == ["native", "segmented"]
-    # the segmented rung is the SAME L-BFGS trajectory: exact theta parity
-    np.testing.assert_allclose(
-        model.raw_predictor.theta, clean.raw_predictor.theta, atol=1e-6
-    )
+    assert names == ["native", "iterative", "segmented"]
+    # the iterative rung changes numerics within its documented bar:
+    # objective-level parity (theta itself is ill-determined on this
+    # workload's flat amplitude ridge at a 3-iteration budget)
+    nll_clean = float(clean.instr.metrics["final_nll"])
+    nll_plan = float(model.instr.metrics["final_nll"])
+    assert abs(nll_plan - nll_clean) / max(abs(nll_clean), 1.0) <= 1e-2
 
 
 def test_fit_kill_switch_restores_reactive_ladder(problem):
@@ -125,12 +133,13 @@ def test_fit_kill_switch_restores_reactive_ladder(problem):
     with chaos.memory_limit_bytes(limit) as fired:
         model = _gp().fit(x, y)
     after = _counters()
-    # today's behavior bit-for-bit: crash at native, degrade to segmented
+    # today's behavior bit-for-bit: crash at native, degrade reactively
+    # (the oom class's first rung is now the iterative solver lane)
     assert fired[0] >= 1
     assert after.get("fallback.failures.oom", 0.0) > before.get(
         "fallback.failures.oom", 0.0
     )
-    assert [d["to"] for d in model.degradations] == ["segmented"]
+    assert [d["to"] for d in model.degradations] == ["iterative"]
     assert not getattr(model.instr, "memory_plan", None)
     assert after.get("plan.hit", 0.0) == before.get("plan.hit", 0.0)
 
@@ -152,8 +161,9 @@ def test_fit_plan_miss_counted_when_nothing_fits(problem):
     assert after.get("plan.miss", 0.0) > before.get("plan.miss", 0.0)
     rows = model.instr.memory_plan
     assert rows and rows[0]["fits"] is False
-    # the backstop carried the fit: host rung, provenance-stamped
-    assert [d["to"] for d in model.degradations] == ["host_f64"]
+    # the backstop carried the fit, walking the remaining rungs (the
+    # staged budget also rejects the iterative re-fit's modeled bytes)
+    assert [d["to"] for d in model.degradations][-1] == "host_f64"
 
 
 # -- predict chunk pre-sizing ------------------------------------------------
@@ -408,7 +418,7 @@ def test_journal_stamps_predicted_vs_actual(problem, tmp_path, monkeypatch):
     with open(path, encoding="utf-8") as fh:
         journal = json.load(fh)
     rows = journal["memory_plan"]
-    assert rows and rows[0]["chosen"] == "segmented"
+    assert rows and rows[0]["chosen"] == "iterative"
     assert rows[0]["predicted_bytes"] >= rows[0]["raw_bytes"]
     # actuals stamped at journal time (device peak is None on CPU — the
     # key must still be present, like-for-like comparisons only)
@@ -459,7 +469,7 @@ def test_gpctl_plan_renders_predicted_vs_actual(
         capture_output=True, text=True, timeout=60, cwd=root,
     )
     assert out.returncode == 0, out.stderr
-    assert "segmented" in out.stdout and "predicted" in out.stdout
+    assert "iterative" in out.stdout and "predicted" in out.stdout
     empty = subprocess.run(
         [sys.executable, "-m", "tools.gpctl", "plan", str(tmp_path / "nope")],
         capture_output=True, text=True, timeout=60, cwd=root,

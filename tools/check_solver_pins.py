@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Pre-commit-style guard: no raw batched-factorization calls outside ``ops/``.
+
+The solver-lane policy (``spark_gp_tpu/ops/iterative.py``) only works if
+every dense SPD solve in a fit objective actually consults it: one module
+that calls ``jnp.linalg.cholesky`` / ``jax.scipy.linalg.cho_solve``
+directly is invisible to ``GP_SOLVER_LANE`` and silently drags its expert
+stack back to the O(s^3) factorization the iterative lane exists to
+replace (and right past the jitter-escalation / quarantine machinery that
+rides the ``ops.linalg`` wrappers).  This checker walks the package AST —
+the precision-pin checker's contract (``check_precision_pins.py``), but
+structural rather than regex, because the banned names are attribute
+chains (``jnp.linalg.cholesky``, ``jax.scipy.linalg.cho_solve``,
+``lax.linalg.cholesky``) whose spellings prose legitimately mentions —
+and flags every CALL of a banned factorization outside ``spark_gp_tpu/ops/``.
+
+Host-side ``numpy.linalg`` is exempt (the jitter ladder's own numpy
+leg and the chaos injector patch it deliberately); only jax-rooted
+chains (``jax``, ``jnp``, ``lax``) are solver-policy territory.
+
+Run standalone (``python tools/check_solver_pins.py``; exit 1 on
+violations) or through its tier-1 wrapper
+(``tests/test_iterative.py::test_no_raw_cholesky_outside_ops``), so a
+new objective bypassing the solver policy fails CI before review.
+
+A line that genuinely must factor directly (a reference oracle, a
+deliberately lane-immune one-time build) opts out with a trailing
+``# solver-pin-ok`` comment — greppable, so every exemption stays
+auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: attribute-chain tails that name a raw batched factorization / solve
+_BANNED_TAILS = (
+    ("linalg", "cholesky"),
+    ("linalg", "cho_solve"),
+    ("linalg", "cho_factor"),
+)
+#: jax-rooted module aliases — a chain must START here to be policy
+#: territory (np.linalg.cholesky is host-side and exempt)
+_JAX_ROOTS = {"jax", "jnp", "lax", "jsp", "jscipy"}
+
+_ALLOW = "solver-pin-ok"
+
+#: directory (relative to the package root) whose files own the wrappers
+_SANCTIONED_DIR = "ops"
+
+
+def _attr_chain(node: ast.AST) -> list:
+    """``jnp.linalg.cholesky`` -> ["jnp", "linalg", "cholesky"] (empty
+    when the callee is not a plain dotted name)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _is_banned(chain: list) -> bool:
+    if len(chain) < 3 or chain[0] not in _JAX_ROOTS:
+        return False
+    return tuple(chain[-2:]) in _BANNED_TAILS
+
+
+def find_pins(package_root: str) -> list:
+    """``(relative_path, lineno, stripped_line)`` for every raw
+    jax-rooted ``*.linalg.cholesky`` / ``*.linalg.cho_solve`` CALL in a
+    ``.py`` file outside ``ops/``."""
+    violations = []
+    package_root = os.path.abspath(package_root)
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        rel_dir = os.path.relpath(dirpath, package_root)
+        parts = [] if rel_dir == "." else rel_dir.split(os.sep)
+        if parts and parts[0] == _SANCTIONED_DIR:
+            dirnames[:] = []
+            continue
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            lines = source.splitlines()
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError:
+                continue  # not this tool's job to report
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not _is_banned(_attr_chain(node.func)):
+                    continue
+                line = (
+                    lines[node.lineno - 1] if node.lineno <= len(lines)
+                    else ""
+                )
+                if _ALLOW in line:
+                    continue
+                rel = os.path.relpath(
+                    path, os.path.dirname(package_root)
+                )
+                violations.append((rel, node.lineno, line.strip()))
+    return sorted(violations)
+
+
+def main(argv=None) -> int:
+    root = (argv or sys.argv[1:]) or [
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "spark_gp_tpu")
+    ]
+    violations = find_pins(root[0])
+    if violations:
+        print(
+            "raw batched-factorization calls outside spark_gp_tpu/ops/ — "
+            "route these through the solver policy (ops/linalg.cholesky / "
+            "chol_solve for the exact path; ops/iterative for the CG lane) "
+            f"or mark a deliberate exemption with '# {_ALLOW}':",
+            file=sys.stderr,
+        )
+        for rel, lineno, line in violations:
+            print(f"  {rel}:{lineno}: {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -145,9 +145,22 @@ def cmd_show(args) -> int:
     doc["_path"] = args.path
     kind = _kind_of(doc)
     print(_one_line(doc))
-    for key in ("precision_lane", "failure_class", "error", "reason"):
+    for key in ("precision_lane", "solver_lane", "failure_class", "error",
+                "reason"):
         if doc.get(key) is not None:
             print(f"  {key}: {doc[key]}")
+    metrics = doc.get("metrics") or {}
+    solver_stats = {
+        k.split(".", 1)[1]: v
+        for k, v in sorted(metrics.items())
+        if k.startswith("solver.")
+    }
+    if solver_stats:
+        # the iterative lane's convergence probe (models/common.py
+        # _emit_solver_stats): knobs + achieved residual at theta*
+        print("  solver: " + " ".join(
+            f"{k}={v:g}" for k, v in solver_stats.items()
+        ))
     build = doc.get("build_info") or {}
     if build:
         pairs = " ".join(f"{k}={v}" for k, v in sorted(build.items()))

@@ -801,10 +801,15 @@ def _run_campaign_body(
             counters = telemetry.snapshot()["counters"]
             oom_before = counters.get("fallback.failures.oom", 0.0)
             trans_before = counters.get("fallback.transitions", 0.0)
-            # a budget only the segmented dispatch fits under: the plan
-            # must size down BEFORE the first dispatch — the acceptance
+            # a budget only the smaller rungs fit under: the plan must
+            # size down BEFORE the first dispatch — the acceptance
             # invariant is zero injected OOMs and zero reactive rungs
-            with chaos.memory_limit_bytes((seg_pred + native) / 2.0) as fired:
+            iter_pred = memplan.predicted_bytes(
+                memplan.fit_dispatch_bytes(e, expert, x.shape[1], itemsize,
+                                           "iterative")
+            )
+            limit = (max(seg_pred, iter_pred) + native) / 2.0
+            with chaos.memory_limit_bytes(limit) as fired:
                 model = _make_gp(expert, "device").fit(x, y)
             counters = telemetry.snapshot()["counters"]
             if fired[0] or counters.get(
@@ -816,9 +821,12 @@ def _run_campaign_body(
             if getattr(model, "degradations", None):
                 raise Violation("plan-sized fit stamped degradations")
             rows = getattr(model.instr, "memory_plan", None) or []
-            if not rows or rows[0].get("chosen") != "segmented" or not (
-                rows[0].get("fits")
-            ):
+            # the preferred pre-sized choice is the iterative solver rung
+            # (ISSUE 14); segmented remains legal when the knobs make the
+            # iterative rung inapplicable (GP_SOLVER_LANE=iterative)
+            if not rows or rows[0].get("chosen") not in (
+                "iterative", "segmented",
+            ) or not rows[0].get("fits"):
                 raise Violation(f"missing/wrong plan provenance: {rows}")
             # predicted >= modeled-actual on the clean run, by contract
             if rows[0]["predicted_bytes"] < rows[0]["raw_bytes"]:
