@@ -1122,6 +1122,174 @@ def worker() -> None:
     else:
         solver_lanes = {"skipped": "BENCH_SOLVER_LANES != 1"}
 
+    # Expert aggregation plane (models/aggregation.py): predict-time
+    # policy quality on the clustered stand-in at E = 64 — the disjoint-
+    # expert regime where plain PoE's variance collapses — plus fit-time
+    # correlation-aware selection on the redundant-chunks workload.  The
+    # contract bars (test_bench_contract): healed beats PoE on held-out
+    # NLPD and lands 90% coverage near-calibrated while PoE is
+    # overconfident; selection drops >= 25% of the duplicated experts,
+    # speeds the objective evaluation >= 1.5x, and costs <= 1% NLPD.
+    def _aggregation_section():
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from spark_gp_tpu import ARDRBFKernel, WhiteNoiseKernel
+        from spark_gp_tpu.data.datasets import make_clustered
+        from spark_gp_tpu.models import aggregation as agg
+        from spark_gp_tpu.models.likelihood import make_value_and_grad
+        from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+
+        def agg_gp(p, ls):
+            return (
+                GaussianProcessRegression()
+                .setKernel(
+                    lambda: 1.0 * ARDRBFKernel(p, ls)
+                    + WhiteNoiseKernel(0.1, 0.0, 1.0)
+                )
+                .setDatasetSizeForExpert(64)
+                .setActiveSetSize(256)
+                .setMaxIter(15)
+                .setSeed(13)
+            )
+
+        def scores(gp_a, model_a, x_a, y_a, x_t, y_t, mode):
+            pred = gp_a.poe_predictor(x_a, y_a, model=model_a, mode=mode)
+            mu_a, var_a = pred.predict_with_var(x_t)
+            var_a = np.maximum(np.asarray(var_a, np.float64), 1e-12)
+            err = np.asarray(y_t, np.float64) - np.asarray(mu_a, np.float64)
+            return {
+                "nlpd": float(np.mean(
+                    0.5 * np.log(2 * np.pi * var_a) + err ** 2 / (2 * var_a)
+                )),
+                "coverage90": float(
+                    np.mean(np.abs(err) <= 1.6449 * np.sqrt(var_a))
+                ),
+            }
+
+        # --- policies at E = 64: same fitted theta, only the predict-time
+        # combination differs ---
+        n_tr, n_te = int(os.environ.get("BENCH_AGG_N", 4096)), 1024
+        xc, yc = make_clustered(n_tr + n_te)
+        c_mean, c_std = yc[:n_tr].mean(), yc[:n_tr].std()
+        ysc = (yc - c_mean) / c_std
+        gp_c = agg_gp(xc.shape[1], 0.7)
+        model_c = gp_c.fit(xc[:n_tr], ysc[:n_tr])
+        policies = {
+            mode: scores(
+                gp_c, model_c, xc[:n_tr], ysc[:n_tr], xc[n_tr:], ysc[n_tr:],
+                mode,
+            )
+            for mode in ("poe", "gpoe", "rbcm", "healed")
+        }
+
+        # --- selection on the redundant-chunks workload: iid base rows
+        # duplicated pairwise, so expert 2j+1 is expert 2j bit-for-bit
+        # under the round-robin grouping and HALF the stack is redundant
+        # by construction (vs the clustered set, where same-cluster
+        # experts are merely correlated and dropping them costs NLL) ---
+        rng_a = np.random.default_rng(29)
+        base_n = int(os.environ.get("BENCH_AGG_SELECT_BASE", 2048))
+        xb = rng_a.normal(size=(base_n, 3))
+        yb = np.sin(xb.sum(axis=1)) + 0.1 * rng_a.normal(size=base_n)
+        xd, yd = np.repeat(xb, 2, axis=0), np.repeat(yb, 2)
+        data_full = group_for_experts(xd, yd, 64)
+        t0 = time.perf_counter()
+        report = agg.select_experts(data_full, mode="drop", seed=13)
+        sketch_seconds = time.perf_counter() - t0
+        keep = _jnp.asarray(np.flatnonzero(~report.drop))
+        data_kept = ExpertData(
+            x=data_full.x[keep], y=data_full.y[keep],
+            mask=data_full.mask[keep],
+        )
+
+        # the speedup selection buys is the objective evaluation it never
+        # pays: per-eval NLL+grad rate on the full vs compacted stack
+        # (end-to-end fit wall-clock is compile-dominated at bench sizes)
+        kernel_a = 1.0 * RBFKernel(0.5, 1e-6, 10.0)
+        reps_a = int(os.environ.get("BENCH_AGG_REPS", 3))
+
+        def evals_per_sec(data_a):
+            vag = make_value_and_grad(kernel_a, data_a)
+            theta_a = _jnp.asarray(
+                kernel_a.init_theta(), dtype=data_a.x.dtype
+            )
+            _jax.block_until_ready(vag(theta_a)[1])  # compile+warm
+            t1 = time.perf_counter()
+            out = None
+            for _ in range(reps_a):
+                out = vag(theta_a)
+            _jax.block_until_ready(out[1])
+            return reps_a / (time.perf_counter() - t1)
+
+        rate_full = evals_per_sec(data_full)
+        rate_kept = evals_per_sec(data_kept)
+
+        # end-to-end NLPD parity: the duplicated experts' objective terms
+        # are identical copies, so dropping them must not move the
+        # optimum (<= 1% held-out NLPD degradation, the contract bar)
+        xt = rng_a.normal(size=(512, 3))
+        yt = np.sin(xt.sum(axis=1)) + 0.1 * rng_a.normal(size=512)
+
+        def fit_nlpd(select: bool):
+            prev = os.environ.pop("GP_AGG_SELECT", None)
+            if select:
+                os.environ["GP_AGG_SELECT"] = "1"
+            try:
+                gp_s = agg_gp(3, 3 ** -0.5)
+                model_s = gp_s.fit(xd, yd)
+                mu_s, var_s = model_s.predict_with_var(xt)
+                var_s = np.maximum(np.asarray(var_s, np.float64), 1e-12)
+                err_s = yt - np.asarray(mu_s, np.float64)
+                return float(np.mean(
+                    0.5 * np.log(2 * np.pi * var_s)
+                    + err_s ** 2 / (2 * var_s)
+                ))
+            finally:
+                os.environ.pop("GP_AGG_SELECT", None)
+                if prev is not None:
+                    os.environ["GP_AGG_SELECT"] = prev
+
+        nlpd_off = fit_nlpd(False)
+        nlpd_on = fit_nlpd(True)
+
+        return {
+            "num_experts": n_tr // 64,
+            "policies": policies,
+            "selection": {
+                "experts": int(data_full.num_experts),
+                "dropped": int(report.num_dropped),
+                "dropped_fraction": report.num_dropped
+                / data_full.num_experts,
+                "threshold": report.threshold,
+                "sketch_seconds": sketch_seconds,
+                "nll_evals_per_sec": {
+                    "full": rate_full, "selected": rate_kept,
+                },
+                "eval_speedup": rate_kept / rate_full,
+                "fit_nlpd": {"off": nlpd_off, "on": nlpd_on},
+                "nlpd_rel_delta": (nlpd_on - nlpd_off)
+                / max(abs(nlpd_off), 1e-9),
+            },
+            "note": (
+                "policies = held-out NLPD / 90% coverage per aggregation "
+                "policy at the SAME fitted theta on the clustered "
+                "stand-in (GP_AGG_POLICY, models/aggregation.py); "
+                "selection = correlation-aware expert subset selection "
+                "on pairwise-duplicated iid chunks (GP_AGG_SELECT) — "
+                "eval_speedup is the batched NLL+grad rate after the "
+                "redundant experts' factorizations stop being paid."
+            ),
+        }
+
+    if os.environ.get("BENCH_AGGREGATION", "1") == "1":
+        try:
+            aggregation = _aggregation_section()
+        except Exception as exc:  # noqa: BLE001 — secondary metric only
+            aggregation = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    else:
+        aggregation = {"skipped": "BENCH_AGGREGATION != 1"}
+
     # Observability overhead (the ISSUE 4 tracing layer): the SAME fit and
     # serve burst with the tracer on vs off (obs/trace.py set_tracing), at
     # a capped size so the section stays cheap.  The contract bar — <2%
@@ -1928,6 +2096,7 @@ def worker() -> None:
             "precision_lanes": precision_lanes,
             "fit_hot_loop": fit_hot_loop,
             "solver_lanes": solver_lanes,
+            "aggregation": aggregation,
             "observability": observability,
             "multihost_resilience": multihost_resilience,
             "lifecycle": lifecycle,

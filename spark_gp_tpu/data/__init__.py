@@ -10,6 +10,8 @@ from spark_gp_tpu.data.datasets import (
     load_protein,
     load_year_msd,
     make_benchmark_data,
+    make_clustered,
+    make_heteroscedastic,
     make_synthetics,
 )
 
@@ -21,6 +23,8 @@ __all__ = [
     "load_protein",
     "load_year_msd",
     "make_benchmark_data",
+    "make_clustered",
+    "make_heteroscedastic",
     "DATASET_FILES",
     "find_dataset_file",
     "dataset_provenance",

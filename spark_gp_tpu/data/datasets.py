@@ -177,6 +177,84 @@ def load_mnist_binary(path: str | None = None, digits=(6, 8), seed: int = 0):
     return x[perm], y[perm]
 
 
+#: planted additive noise of the clustered stand-in — the calibrated side
+#: of its aggregation-quality bars (clustered_noise_floor derives the rest)
+CLUSTERED_NOISE = 0.05
+
+#: noise ramp endpoints of the heteroscedastic stand-in: sigma(t) runs
+#: linearly from LOW to HIGH across the input range, so the AVERAGE
+#: predictive variance a stationary GP can honestly learn is the mean of
+#: sigma^2(t) — the coverage bars in quality.py are stated against that
+#: planted profile, not a free constant
+HETERO_NOISE_LOW = 0.02
+HETERO_NOISE_HIGH = 0.40
+
+
+def make_clustered(
+    n: int = 4096, p: int = 2, n_clusters: int = 8, seed: int = 3,
+    noise: float = CLUSTERED_NOISE, spread: float = 0.15,
+):
+    """Disjoint-cluster regression — the aggregation plane's canary.
+
+    ``n_clusters`` well-separated Gaussian blobs (centers ~ 4 sigma
+    apart vs ``spread``), each carrying its own local response (a
+    cluster-specific sinusoid plus offset) and the PLANTED additive
+    noise.  Why this shape: with experts covering disjoint regions,
+    every expert reverts to the prior far from its own data, and the
+    plain product-of-experts multiplies E near-prior precisions into a
+    variance ~k**/E — overconfident by construction (Healing PoGPs,
+    PAPERS.md) — while rBCM/healed entropy weights zero the uninformed
+    votes.  ``models/aggregation.py``'s policy bars and bench.py's
+    ``aggregation`` section are measured on exactly this generator, so
+    the planted noise/spread double as their calibration constants.
+    Returns ``(x [n, p], y [n])``; row ``i`` belongs to cluster
+    ``i % n_clusters``, so the round-robin expert grouping (expert ``j``
+    takes rows ``j, j+E, ...`` — parallel/experts.py) pins every expert
+    to a single cluster whenever ``n_clusters`` divides ``E``.
+    """
+    rng = np.random.default_rng(seed)
+    centers = 4.0 * rng.normal(size=(n_clusters, p))
+    assign = np.arange(n) % n_clusters
+    x = centers[assign] + spread * rng.normal(size=(n, p))
+    w = rng.normal(size=(n_clusters, p))
+    offsets = 2.0 * rng.normal(size=n_clusters)
+    y = (
+        np.sin(np.einsum("np,np->n", x - centers[assign], w[assign]) * 3.0)
+        + offsets[assign]
+        + noise * rng.normal(size=n)
+    )
+    return x, y
+
+
+def clustered_noise_floor(n: int = 4096) -> float:
+    """Irreducible scaled RMSE of :func:`make_clustered` — planted noise
+    over target std, the same derivation as :func:`standin_noise_floor`
+    (quality.py states the aggregation bars against it)."""
+    _, y = make_clustered(n)
+    return CLUSTERED_NOISE / float(np.std(y))
+
+
+def make_heteroscedastic(n: int = 4096, seed: int = 5):
+    """1-d regression with input-dependent noise — the calibration canary.
+
+    ``y = sin(6 t) + sigma(t) eps`` with ``sigma(t)`` ramping linearly
+    from :data:`HETERO_NOISE_LOW` to :data:`HETERO_NOISE_HIGH` across
+    ``t in [0, 1]``.  A stationary GP can only learn ONE noise level, so
+    its predictive sigmas are honest on average but over-cover the quiet
+    end and under-cover the loud end; the quality bars assert the
+    AVERAGE 90% coverage stays inside a band derived from this planted
+    profile (anything tighter would assert what the model class cannot
+    deliver).  Returns ``(x [n, 1], y [n], sigma [n])`` — the true
+    per-point noise rides along so calibration can be scored against
+    ground truth, not just empirically.
+    """
+    rng = np.random.default_rng(seed)
+    t = np.sort(rng.uniform(size=n))
+    sigma = HETERO_NOISE_LOW + (HETERO_NOISE_HIGH - HETERO_NOISE_LOW) * t
+    y = np.sin(6.0 * t) + sigma * rng.normal(size=n)
+    return t[:, None], y, sigma
+
+
 def make_benchmark_data(n: int, n_features: int = 3, seed: int = 13):
     """PerformanceBenchmark.scala:19-36: uniform features,
     y = sin(sum(x) / 1000)."""

@@ -1,0 +1,400 @@
+"""Expert aggregation plane: predict-time weighting + fit-time selection.
+
+The reference's plain product-of-experts sum (GPC.scala:73-78,
+GaussianProcessCommons.scala:73-78) treats every expert identically: at
+predict time each expert's precision enters the product at unit weight
+(overconfident in data voids — *Healing Products of Gaussian Processes*,
+arXiv 2102.07106), and at fit time every expert pays its full
+Cholesky/CG evaluation even when its chunk duplicates another expert's
+information (*Gaussian Experts Selection using Graphical Models*,
+arXiv 2102.01496).  This module is the ONE home of both remedies:
+
+**Predict-time policy** — ``GP_AGG_POLICY`` in {``poe`` (default, today's
+plain product bit-for-bit), ``gpoe`` (differential-entropy/uniform
+beta = 1/E weights), ``rbcm`` (prior-corrected entropy-weighted
+precisions, Deisenroth & Ng ICML'15), ``healed`` (normalized entropy
+weights — a convex combination of expert precisions that can never be
+more confident than its sharpest expert)}.  Selection mirrors the
+precision (``ops/precision.py``) and solver (``ops/iterative.py``)
+lanes: :func:`set_agg_policy` process-wide /
+``GaussianProcessParams.setAggregationPolicy`` fluent veneer /
+:func:`agg_policy_scope` trace-local, resolved into the predict
+programs' jit cache keys via :func:`agg_jit_key` so a policy switch
+recompiles instead of reusing the old policy's executables.  The weight
+formulas themselves live in ``models/poe.py`` (`_local_moments` /
+`_aggregate`) — they are vmapped per-expert reductions riding the
+existing chunking, sharding and precision lanes.
+
+**Fit-time selection** — ``GP_AGG_SELECT=1`` scores expert redundancy
+from order-invariant random-feature sketches of each expert's (x, y)
+rows BEFORE any Cholesky/CG evaluation is paid, and drops (or
+down-weights, ``GP_AGG_SELECT_MODE=downweight``) the redundant ones.
+Drop mode physically compacts the stack to the kept experts — the
+``[E, s, s]`` batch shrinks, so the redundant experts' factorizations
+are never paid at all — while the weight ALGEBRA is shared with
+quarantine (``ExpertData.with_experts_masked``: a masked expert's Gram
+becomes an inert identity block contributing exactly 0 to every
+reduction, so mid-fit ``w_e = 0`` composes with the weighted-NLL sum by
+construction).  ``quarantine.renorm_factor`` generalizes to
+:func:`weighted_renorm_factor` and the per-expert weights ride the fit
+objectives as the optional ``weights`` operand of
+``likelihood.batched_nll`` / ``loo.batched_loo_nll`` / the Laplace
+families' :func:`weighted_expert_sum`.
+
+Why sketches are centered: round-robin grouping deals experts iid rows
+of ONE distribution, so every expert's mean feature vector converges to
+the same expectation — raw cosine similarity of mean-feature sketches
+would read "everything is redundant".  Centering across the stack keeps
+only each expert's sampling fluctuation: independent chunks give
+near-orthogonal residuals (cosine ~ 0 in high sketch dimension) while
+duplicated/overlapping chunks share their fluctuation (cosine ~ 1).
+Exactly-identical sketches are additionally caught on the raw vectors
+(their centered residuals can cancel when nearly the whole stack is one
+duplicate class).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# the aggregation-policy lane (the solver-lane pattern, ops/iterative.py)
+# --------------------------------------------------------------------------
+
+AGG_POLICIES = ("poe", "gpoe", "rbcm", "healed")
+
+_POLICY_OVERRIDE: Optional[str] = None
+_SCOPE = threading.local()
+
+
+def _validate_policy(policy, source: str) -> str:
+    policy = str(policy).strip().lower()
+    if policy not in AGG_POLICIES:
+        raise ValueError(
+            f"{source}={policy!r} is not an aggregation policy; use one of "
+            f"{sorted(AGG_POLICIES)}"
+        )
+    return policy
+
+
+def active_agg_policy() -> str:
+    """The policy in effect: innermost :func:`agg_policy_scope`, else the
+    :func:`set_agg_policy` process override, else ``GP_AGG_POLICY``, else
+    ``poe`` (today's plain product, bit-for-bit)."""
+    scoped = getattr(_SCOPE, "policy", None)
+    if scoped is not None:
+        return scoped
+    if _POLICY_OVERRIDE is not None:
+        return _POLICY_OVERRIDE
+    env = os.environ.get("GP_AGG_POLICY")
+    if env is None or not env.strip():
+        return "poe"
+    return _validate_policy(env, "GP_AGG_POLICY")
+
+
+def set_agg_policy(policy):
+    """Process-wide policy setter (the programmatic twin of
+    ``GP_AGG_POLICY``).  ``None`` clears the override.  Returns the
+    previous override so callers can restore it.  The PoE predictor
+    carries the resolved policy in its jit cache keys (it is a static
+    argument of the predict programs), so switching between fits/builds
+    recompiles."""
+    global _POLICY_OVERRIDE
+    previous = _POLICY_OVERRIDE
+    _POLICY_OVERRIDE = (
+        None if policy is None else _validate_policy(policy, "set_agg_policy")
+    )
+    return previous
+
+
+def policy_engaged() -> bool:
+    """True when an aggregation policy was EXPLICITLY bound (scope,
+    process override, or ``GP_AGG_POLICY``).  Consumers with a
+    historical non-``poe`` default (``gpr.poe_predictor``'s documented
+    robust-BCM default) defer to the plane only when it was engaged —
+    an untouched plane never silently changes their behavior."""
+    return (
+        getattr(_SCOPE, "policy", None) is not None
+        or _POLICY_OVERRIDE is not None
+        or bool(os.environ.get("GP_AGG_POLICY", "").strip())
+    )
+
+
+def resolve_predictor_mode(mode=None, default: str = "rbcm") -> str:
+    """The PoE predict mode for a ``mode=None`` caller: the explicitly
+    engaged policy wins; otherwise ``default`` (the consumer's
+    historical behavior).  An explicit ``mode`` passes through
+    untouched (``models/poe.py`` validates it)."""
+    if mode is not None:
+        return str(mode)
+    return active_agg_policy() if policy_engaged() else default
+
+
+@contextlib.contextmanager
+def agg_policy_scope(policy):
+    """Pin the policy for the duration of a trace/block.  ``None`` is a
+    no-op — the ambient policy applies."""
+    if policy is None:
+        yield
+        return
+    policy = _validate_policy(policy, "agg_policy_scope")
+    prev = getattr(_SCOPE, "policy", None)
+    _SCOPE.policy = policy
+    try:
+        yield
+    finally:
+        _SCOPE.policy = prev
+
+
+def agg_jit_key() -> str:
+    """The hashable static the PoE predict entry points carry in their
+    jit cache keys — the resolved policy string (every policy is one
+    distinct compiled reduction; there are no trace-time tuning knobs on
+    the predict side).  Resolved at CALL time, exactly like the
+    precision and solver lanes."""
+    return active_agg_policy()
+
+
+# --------------------------------------------------------------------------
+# fit-time correlation-aware expert subset selection
+# --------------------------------------------------------------------------
+
+
+def selection_enabled() -> bool:
+    """``GP_AGG_SELECT`` truthy engages fit-time selection; default off —
+    the clean fit path stays bit-for-bit."""
+    return os.environ.get("GP_AGG_SELECT", "").strip().lower() in (
+        "1", "on", "true", "yes",
+    )
+
+
+def selection_threshold() -> float:
+    """Centered-sketch cosine similarity at (or above) which two experts
+    count as redundant (``GP_AGG_SELECT_THRESHOLD``, default 0.95)."""
+    raw = os.environ.get("GP_AGG_SELECT_THRESHOLD", "").strip()
+    try:
+        return float(raw) if raw else 0.95
+    except ValueError:
+        return 0.95
+
+
+def selection_mode() -> str:
+    """``drop`` (default): redundant experts are removed from the stack
+    before any factorization (w_e = 0 exactly, realized by compaction —
+    the batched ``[E, s, s]`` work shrinks and their Cholesky/CG is
+    never paid).  ``downweight``: every member of a redundancy group of
+    size g keeps its data but enters the weighted NLL at w_e = 1/g."""
+    raw = os.environ.get("GP_AGG_SELECT_MODE", "").strip().lower()
+    if raw in ("", "drop"):
+        return "drop"
+    if raw == "downweight":
+        return "downweight"
+    raise ValueError(
+        f"GP_AGG_SELECT_MODE={raw!r} is not a selection mode; use 'drop' "
+        "or 'downweight'"
+    )
+
+
+def sketch_dim() -> int:
+    """Random-feature sketch width (``GP_AGG_SKETCH_DIM``, default 64 —
+    pairs of cos/sin features, so the effective dimension is 2 * 32)."""
+    raw = os.environ.get("GP_AGG_SKETCH_DIM", "").strip()
+    try:
+        return max(4, int(raw)) if raw else 64
+    except ValueError:
+        return 64
+
+
+def expert_sketches(data, dim: Optional[int] = None, seed: int = 0):
+    """Order-invariant per-expert random-feature sketches ``[E, d]``.
+
+    Each expert's sketch is the masked MEAN of random Fourier features
+    ``[cos(z W), sin(z W)]`` over its rows ``z = (x, y)`` (standardized
+    against the whole stack's masked moments so the fixed N(0,1)
+    frequencies are scale-appropriate).  A mean over rows is invariant
+    to row order and robust to the ragged tail, so two experts holding
+    the same points — in any order, at any padding — sketch identically.
+    Pure host numpy: selection is a pre-fit host step, O(E s d) flops,
+    noise next to one objective evaluation."""
+    x = np.asarray(data.x, dtype=np.float64)
+    y = np.asarray(data.y, dtype=np.float64)
+    m = np.asarray(data.mask, dtype=np.float64)
+    if y.ndim == 3:  # multi-head latent stacks sketch head 0 (a
+        y = y[..., 0]  # redundancy diagnostic, not a statistic)
+    z = np.concatenate([x, y[..., None]], axis=-1)  # [E, s, p+1]
+    w = m[..., None]
+    n = max(float(w.sum()), 1.0)
+    mu = (z * w).sum(axis=(0, 1)) / n
+    var = (np.square(z - mu) * w).sum(axis=(0, 1)) / n
+    z = (z - mu) / np.sqrt(var + 1e-12)
+    half = max(2, (dim if dim is not None else sketch_dim()) // 2)
+    rng = np.random.default_rng(seed)
+    freqs = rng.normal(size=(z.shape[-1], half))
+    proj = z @ freqs  # [E, s, half]
+    feats = np.concatenate([np.cos(proj), np.sin(proj)], axis=-1)
+    n_e = np.maximum(m.sum(axis=1), 1.0)[:, None]
+    return (feats * w).sum(axis=1) / n_e  # [E, 2*half]
+
+
+def redundancy_matrix(sketches: np.ndarray) -> np.ndarray:
+    """``[E, E]`` pairwise redundancy scores in [-1, 1].
+
+    Cosine similarity of the ACROSS-STACK-CENTERED sketches (module
+    docstring: raw mean-feature sketches of iid chunks all converge to
+    the same expectation; only the residual fluctuation identifies
+    shared data), with (near-)identical RAW sketches forced to 1.0 —
+    when nearly every expert is one duplicate class the centered
+    residuals cancel to zero and the cosine alone would miss them."""
+    s = np.asarray(sketches, dtype=np.float64)
+    resid = s - s.mean(axis=0, keepdims=True)
+    norms = np.linalg.norm(resid, axis=1)
+    floor = 1e-12 + 1e-9 * np.linalg.norm(s, axis=1)
+    unit = resid / np.maximum(norms, floor)[:, None]
+    sim = unit @ unit.T
+    # raw-identity catch: ||s_i - s_j||^2 via the gram, no [E, E, d] blow-up
+    sq = np.sum(np.square(s), axis=1)
+    d2 = np.maximum(sq[:, None] + sq[None, :] - 2.0 * (s @ s.T), 0.0)
+    scale = np.maximum(np.maximum(sq[:, None], sq[None, :]), 1e-24)
+    sim = np.where(d2 <= 1e-12 * scale, 1.0, sim)
+    np.fill_diagonal(sim, 1.0)
+    return sim
+
+
+@dataclass(frozen=True)
+class SelectionReport:
+    """Outcome of one correlation-aware selection pass over the stack."""
+
+    drop: np.ndarray      # bool [E] — redundant, masked out (drop mode)
+    weights: np.ndarray   # f64 [E] — post-selection per-expert weights
+    num_active: int       # experts with any unmasked points beforehand
+    mode: str             # 'drop' | 'downweight'
+    threshold: float
+
+    @property
+    def num_dropped(self) -> int:
+        return int(self.drop.sum())
+
+    @property
+    def num_kept(self) -> int:
+        return self.num_active - self.num_dropped
+
+    @property
+    def renorm(self) -> float:
+        """``E_active / sum(w)`` — the weighted generalization of the
+        quarantine renormalization (``quarantine.renorm_factor``),
+        mapping the weighted/reduced NLL sum back to a full-stack
+        comparable figure.  Exactly 1.0 when selection changed nothing."""
+        return weighted_renorm_factor(self.weights, self.num_active)
+
+    @property
+    def clean(self) -> bool:
+        return self.num_dropped == 0 and bool(
+            np.all(self.weights[self.weights > 0] == 1.0)
+        )
+
+
+def weighted_renorm_factor(weights, active: float) -> float:
+    """``E_active / sum(w_e)`` — :func:`quarantine.renorm_factor`
+    generalized from a dropped-expert COUNT to arbitrary per-expert
+    weights: uniform w_e = 1 with d drops gives exactly
+    ``active / (active - d)``, the quarantine factor.  Raises the same
+    :class:`~spark_gp_tpu.resilience.quarantine.ExpertQuarantineError`
+    when no weight remains."""
+    from spark_gp_tpu.resilience.quarantine import (
+        GLOBAL_FAILURE_ADVICE,
+        ExpertQuarantineError,
+    )
+
+    total = float(np.sum(np.asarray(weights, dtype=np.float64)))
+    if total <= 0:
+        raise ExpertQuarantineError(
+            f"aggregation weights sum to {total} over {int(active)} active "
+            "expert(s) — " + GLOBAL_FAILURE_ADVICE
+        )
+    return float(active) / total
+
+
+def effective_expert_count(weights) -> float:
+    """Participation ratio ``(sum w)^2 / sum w^2`` — E for uniform
+    weights, 1.0 when one expert carries everything, 0.0 for an empty
+    weight vector.  THE scalar the health/quality snapshots and the
+    ``agg.effective_experts`` metric report."""
+    w = np.asarray(weights, dtype=np.float64)
+    denom = float(np.sum(np.square(w)))
+    if denom <= 0:
+        return 0.0
+    return float(np.square(np.sum(w)) / denom)
+
+
+def select_experts(
+    data, threshold: Optional[float] = None, mode: Optional[str] = None,
+    seed: int = 0,
+) -> SelectionReport:
+    """Score redundancy and pick the expert subset, greedily first-kept:
+    walking experts in stack order, each kept expert claims every
+    not-yet-claimed expert whose similarity reaches the threshold as its
+    redundancy group; claimed experts are dropped (w_e = 0, ``drop``
+    mode) or down-weighted to 1/|group| (``downweight`` mode).  Already
+    fully-masked experts (mesh padding, prior quarantine) stay at
+    w_e = 0 and never claim anyone."""
+    mask = np.asarray(data.mask, dtype=np.float64)
+    active = mask.sum(axis=1) > 0
+    e = mask.shape[0]
+    thr = selection_threshold() if threshold is None else float(threshold)
+    mode = selection_mode() if mode is None else str(mode)
+    sim = redundancy_matrix(expert_sketches(data, seed=seed))
+    drop = np.zeros(e, dtype=bool)
+    weights = np.where(active, 1.0, 0.0)
+    claimed = ~active  # inactive experts are out of the game entirely
+    for i in range(e):
+        if claimed[i]:
+            continue
+        claimed[i] = True
+        dups = np.flatnonzero((sim[i] >= thr) & ~claimed)
+        claimed[dups] = True
+        if dups.size == 0:
+            continue
+        if mode == "drop":
+            drop[dups] = True
+            weights[dups] = 0.0
+        else:
+            group_w = 1.0 / (1.0 + dups.size)
+            weights[i] = group_w
+            weights[dups] = group_w
+    return SelectionReport(
+        drop=drop,
+        weights=weights,
+        num_active=int(active.sum()),
+        mode=mode,
+        threshold=thr,
+    )
+
+
+# --------------------------------------------------------------------------
+# the one weighted reduction the fit objectives share
+# --------------------------------------------------------------------------
+
+
+def weighted_expert_sum(per_expert, weights=None):
+    """``sum_e w_e v_e`` over a ``[E, ...]`` per-expert stack, reducing
+    every axis — the ONE weighted-sum home the marginal NLL
+    (``likelihood.batched_nll``), the LOO pseudo-likelihood
+    (``loo.batched_loo_nll``) and the Laplace families' evidence sums
+    share, so resilience (quarantine's w_e = 0 via masking) and
+    aggregation (selection's fractional w_e) compose through a single
+    reduction.  ``weights=None`` is the exact unweighted ``jnp.sum`` —
+    callers keep their bit-for-bit default path by not passing it."""
+    import jax.numpy as jnp
+
+    if weights is None:
+        return jnp.sum(per_expert)
+    w = jnp.asarray(weights, per_expert.dtype)
+    return jnp.sum(
+        w.reshape(w.shape + (1,) * (per_expert.ndim - 1)) * per_expert
+    )

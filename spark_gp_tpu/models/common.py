@@ -236,6 +236,29 @@ class GaussianProcessParams:
         set_solver_lane(value)
         return self
 
+    def setAggregationPolicy(self, value: str):
+        """Expert-aggregation policy for the prediction plane
+        (:mod:`spark_gp_tpu.models.aggregation`): ``"poe"`` (default —
+        the reference's plain product-of-experts, bit-for-bit today's
+        numerics), ``"gpoe"`` (generalized PoE: uniform 1/E tempering,
+        calibrated variances at any E), ``"rbcm"`` (robust Bayesian
+        committee machine: entropy-weighted experts with the prior
+        correction — the strongest default for disjoint experts), or
+        ``"healed"`` (rBCM entropy weights clamped >= 0 and renormalized
+        to a convex combination: removes rBCM's variance blow-up when
+        experts are weak far from data).  The setter is a fluent veneer
+        over the PROCESS-wide knob (``set_agg_policy`` /
+        ``GP_AGG_POLICY``); predictors resolve the policy at build time
+        and carry it in their jit cache keys, so the setting takes
+        effect from the next fit/predict on.  The engaged policy and
+        the fit-time selection weights (``agg.*``) land in the fit
+        metrics, the run journal, and the saved model's
+        ``provenance_json``."""
+        from spark_gp_tpu.models.aggregation import set_agg_policy
+
+        set_agg_policy(value)
+        return self
+
     def setOptimizer(self, value: str):
         """``"host"`` — SciPy L-BFGS-B driving the jitted objective (one
         device dispatch per evaluation; bitwise closest to the reference's
@@ -315,7 +338,10 @@ class GaussianProcessParams:
             return jnp.asarray(np.asarray(a), dtype=jnp.float64)
 
         data64 = ExpertData(x=cast(data.x), y=cast(data.y), mask=cast(data.mask))
-        return data64, tuple(cast(e) for e in extra), None
+        # extras may carry a None placeholder slot (the aggregation
+        # plane's (None, weights) marginal-extras shape) — pass it through
+        extra64 = tuple(cast(e) if e is not None else None for e in extra)
+        return data64, extra64, None
 
     def _device_fit_op(self) -> str:
         """Chaos choke-point name of the device-fit dispatch about to run
@@ -413,6 +439,7 @@ class GaussianProcessParams:
     set_optimizer = setOptimizer
     set_precision_lane = setPrecisionLane
     set_solver_lane = setSolverLane
+    set_aggregation_policy = setAggregationPolicy
     set_hyper_space = setHyperSpace
     set_num_restarts = setNumRestarts
     set_expert_quarantine = setExpertQuarantine
@@ -736,6 +763,12 @@ class GaussianProcessCommons(GaussianProcessParams):
         if instr is None:
             return
         renorm = instr.metrics.get("bcm_renorm")
+        # selection's weighted renormalization (agg.renorm — the
+        # quarantine factor's weighted generalization) composes
+        # multiplicatively: both map a reduced sum back to full-stack
+        agg_renorm = instr.metrics.get("agg.renorm")
+        if agg_renorm is not None and float(agg_renorm) != 1.0:
+            renorm = (1.0 if renorm is None else renorm) * float(agg_renorm)
         if renorm is not None and "final_nll" in instr.metrics:
             instr.log_metric(
                 "final_nll_renormalized", instr.metrics["final_nll"] * renorm
@@ -818,6 +851,17 @@ class GaussianProcessCommons(GaussianProcessParams):
         instr.log_metric("experts_quarantined", dropped)
         renorm = renorm_factor(base, dropped)
         instr.log_metric("bcm_renorm", renorm)
+        if getattr(instr, "agg_weights", None) is not None:
+            # quarantine composes with the aggregation plane through the
+            # same masking: a quarantined expert's weight is exactly 0
+            from spark_gp_tpu.models.aggregation import effective_expert_count
+
+            w = np.asarray(instr.agg_weights, dtype=np.float64).copy()
+            w[bad] = 0.0
+            instr.agg_weights = w
+            instr.log_metric(
+                "agg.effective_experts", effective_expert_count(w)
+            )
         instr.log_warning(
             f"{source}: quarantined {n_bad} non-finite expert(s) "
             f"({int(dropped)}/{int(base)} total dropped); BCM objective "
@@ -832,6 +876,91 @@ class GaussianProcessCommons(GaussianProcessParams):
             count=n_bad, source=source, total_dropped=int(dropped),
         )
         return data
+
+    def _apply_expert_selection(self, instr, data):
+        """Correlation-aware expert subset selection (the aggregation
+        plane's fit-time half, ``models/aggregation.py``) — scores expert
+        redundancy from order-invariant sketches BEFORE any objective
+        evaluation is paid, then either physically compacts the stack to
+        the kept experts (``drop`` mode: the redundant experts' w_e = 0
+        is realized by never paying their Cholesky/CG evaluations at
+        all — the [E, s, s] batch shrinks, unlike quarantine's inert
+        identity blocks which must preserve compiled shapes mid-fit) or
+        hands back fractional per-expert weights for the marginal
+        objective's weighted-NLL operand (``downweight`` mode).
+
+        Returns ``(data, extra)`` where ``extra`` is ``()`` (clean /
+        drop) or the marginal extras tail ``(None, weights)`` — slot 0
+        is the resilience layer's jitter operand, filled in by
+        ``recover`` if an escalation retry happens.  Off by default
+        (``GP_AGG_SELECT``): the clean fit path stays bit-for-bit."""
+        from spark_gp_tpu.models import aggregation as agg
+
+        if not agg.selection_enabled():
+            return data, ()
+        mode = agg.selection_mode()
+        objective = getattr(self, "_objective", "marginal")
+        if mode == "downweight" and objective != "marginal":
+            # only the marginal fit drivers thread the weight operand;
+            # masking is objective-independent (the inert identity blocks
+            # contribute exactly 0 to every family's reduction)
+            if instr is not None:
+                instr.log_warning(
+                    "aggregation selection: downweight mode requires the "
+                    "marginal objective; falling back to drop semantics "
+                    f"for objective {objective!r}"
+                )
+            mode = "drop"
+        report = agg.select_experts(data, mode=mode, seed=self._seed)
+        weights = np.asarray(report.weights, dtype=np.float64)
+        if instr is not None:
+            # the ACTUAL policy weights, for _emit_expert_quality and the
+            # run journal — not the uniform-renorm approximation
+            instr.agg_weights = weights
+            instr.log_metric("agg.selection_dropped", float(report.num_dropped))
+            instr.log_metric("agg.renorm", report.renorm)
+            instr.log_metric(
+                "agg.effective_experts", agg.effective_expert_count(weights)
+            )
+        if report.clean:
+            return data, ()
+        from spark_gp_tpu.obs import trace as obs_trace
+
+        obs_trace.add_event(
+            "experts.deselected",
+            dropped=report.num_dropped, mode=report.mode,
+            threshold=report.threshold,
+        )
+        if report.mode == "downweight":
+            import jax.numpy as jnp
+
+            if instr is not None:
+                instr.log_warning(
+                    "aggregation selection: "
+                    f"{int(np.sum((weights > 0) & (weights < 1.0)))} "
+                    f"expert(s) down-weighted of {report.num_active} "
+                    f"(threshold {report.threshold:.2f}); weighted "
+                    f"objective renormalizes by {report.renorm:.4f}"
+                )
+            return data, (None, jnp.asarray(weights, dtype=data.x.dtype))
+        keep = np.flatnonzero(~report.drop)
+        if instr is not None:
+            # kept experts all carry w_e = 1 in the compacted stack — the
+            # quality rows must line up with the stack the fit actually ran
+            instr.agg_weights = weights[keep]
+            instr.log_warning(
+                f"aggregation selection: dropped {report.num_dropped} "
+                f"redundant expert(s) of {report.num_active} before "
+                f"factorization (threshold {report.threshold:.2f}); "
+                f"objective renormalizes by {report.renorm:.4f}"
+            )
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(keep)
+        return (
+            ExpertData(x=data.x[idx], y=data.y[idx], mask=data.mask[idx]),
+            (),
+        )
 
     def _run_with_expert_resilience(self, instr, data, run_fit):
         """Bounded recovery driver around one COMPLETE fit attempt.
@@ -855,9 +984,13 @@ class GaussianProcessCommons(GaussianProcessParams):
         rebuilt anyway so the cached path can never read poisoned
         distances the uncached path would not).
         """
+        # fit-time expert selection runs FIRST (models/aggregation.py):
+        # the gram cache must be built from the post-selection stack, and
+        # drop-mode masking must be in place before any objective runs
+        data, sel_extra = self._apply_expert_selection(instr, data)
         cache = self._gram_cache(instr, data)
         if not self._expert_quarantine or self._fit_retries < 1:
-            return run_fit(data, (), cache)
+            return run_fit(data, sel_extra, cache)
         from spark_gp_tpu.ops.linalg import NotPositiveDefiniteException
         from spark_gp_tpu.resilience.quarantine import (
             NonFiniteFitError,
@@ -868,7 +1001,7 @@ class GaussianProcessCommons(GaussianProcessParams):
             retry_with_backoff,
         )
 
-        state = {"data": data, "extra": (), "cache": cache}
+        state = {"data": data, "extra": sel_extra, "cache": cache}
         objective = getattr(self, "_objective", "marginal")
 
         def attempt():
@@ -911,9 +1044,12 @@ class GaussianProcessCommons(GaussianProcessParams):
                     "repaired by adaptive jitter escalation "
                     f"(max relative jitter {report.jitter.max():.1e})"
                 )
+                # slot 0 is the jitter operand; any trailing aggregation
+                # weights (the selection extras tail) must survive the
+                # escalation retry
                 state["extra"] = (
                     jnp.asarray(report.jitter, dtype=state["data"].x.dtype),
-                )
+                ) + tuple(state["extra"][1:])
                 # per-expert jitter levels ride into the post-fit quality
                 # telemetry (_emit_expert_quality) and the run journal
                 instr.expert_jitter = np.asarray(
@@ -1468,6 +1604,7 @@ class GaussianProcessCommons(GaussianProcessParams):
             instr, kernel, theta, active64, magic_vector, data
         )
         self._emit_solver_stats(instr, kernel, theta, data)
+        self._emit_aggregation_stats(instr, data)
         self._emit_expert_quality(instr, kernel, theta, data)
         self._emit_covariate_summary(instr, data, active64)
         keep_stats = self._keeps_update_statistics
@@ -1670,6 +1807,42 @@ class GaussianProcessCommons(GaussianProcessParams):
                 "iterative-solver convergence probe failed", exc_info=True
             )
 
+    def _emit_aggregation_stats(self, instr, data) -> None:
+        """The aggregation plane's fit-time provenance
+        (``models/aggregation.py``).
+
+        ALWAYS stamps the engaged predict policy (``agg.policy``) so
+        every artifact can prove which expert aggregation the model's
+        predictions will run under — mirroring ``solver_lane`` /
+        ``precision_lane``.  When fit-time selection ran, the selection
+        telemetry (``agg.selection_dropped`` / ``agg.renorm`` /
+        ``agg.effective_experts``) was already logged by
+        ``_apply_expert_selection``; otherwise the effective expert
+        count falls back to the active-expert count (uniform unit
+        weights).  Never fails a fit."""
+        from spark_gp_tpu.models import aggregation as agg
+
+        if instr is None:
+            return
+        instr.metrics["agg.policy"] = agg.active_agg_policy()
+        if (
+            "agg.effective_experts" in instr.metrics
+            or not self._probeable_stack(data)
+        ):
+            return
+        try:
+            active = np.asarray(data.mask).sum(axis=1) > 0
+            instr.log_metric(
+                "agg.effective_experts",
+                agg.effective_expert_count(active.astype(np.float64)),
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never fail a fit
+            import logging
+
+            logging.getLogger("spark_gp_tpu").warning(
+                "aggregation telemetry failed", exc_info=True
+            )
+
     def _probeable_stack(self, data) -> bool:
         """Whether the fitted stack can be host-probed for post-fit
         telemetry — the same restriction as the precision guard and the
@@ -1694,8 +1867,11 @@ class GaussianProcessCommons(GaussianProcessParams):
         (``resilience/quarantine.expert_health``; the marginal objective
         is the documented proxy for the non-decomposable families) —
         plus the per-expert adaptive-jitter level the recovery driver
-        settled on and the effective BCM weight (renormalization for
-        active experts, 0 for quarantined ones).  Stamped onto the instr
+        settled on and the ACTUAL aggregation weight w_e the expert
+        entered the objective with (``instr.agg_weights`` when the
+        aggregation plane's selection ran — quarantine composed in as
+        w_e = 0; the uniform renormalization otherwise).  Stamped onto
+        the instr
         as ``expert_quality`` (the run journal persists it —
         ``gpctl quality`` renders the table) with scalar spread metrics
         for dashboards.  Cost: one extra objective-evaluation-sized
@@ -1724,7 +1900,15 @@ class GaussianProcessCommons(GaussianProcessParams):
             mask = np.asarray(data.mask)
             active = mask.sum(axis=1) > 0
             renorm = float(instr.metrics.get("bcm_renorm", 1.0))
-            weights = np.where(active, renorm, 0.0)
+            agg_w = getattr(instr, "agg_weights", None)
+            if agg_w is not None and np.asarray(agg_w).shape[0] == len(active):
+                # the ACTUAL aggregation-plane weight w_e each expert
+                # enters the weighted objective with (fit-time selection
+                # and quarantine composed) — not the uniform-renorm
+                # approximation this column used to report
+                weights = np.asarray(agg_w, dtype=np.float64)
+            else:
+                weights = np.where(active, renorm, 0.0)
             jit_arr = (
                 np.zeros(nll.shape[0]) if jitter is None
                 else np.broadcast_to(

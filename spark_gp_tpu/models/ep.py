@@ -224,11 +224,17 @@ def _ep_log_z(kmat, y_pm, mask, tau, nu):
     return term_sites + term_norm + term_match - half_logdet_b - quad
 
 
-def batched_neg_logz_ep(kernel: Kernel, tol, theta, data: ExpertData, sites0):
+def batched_neg_logz_ep(
+    kernel: Kernel, tol, theta, data: ExpertData, sites0, weights=None
+):
     """Summed ``-log Z_EP`` over the local expert stack with gradient via
     the converged-sites stop_gradient trick; returns
     ``(nll, grad, (tau, nu))`` with the sites as the optimizer's warm-start
-    carry (the Laplace latents' pattern)."""
+    carry (the Laplace latents' pattern).  ``weights`` is the aggregation
+    plane's ``[E]`` per-expert vector (``models/aggregation.py``);
+    ``None`` keeps the sum bit-for-bit."""
+    from spark_gp_tpu.models.aggregation import weighted_expert_sum
+
     tau0, nu0 = sites0
     y_pm = (2.0 * data.y - 1.0) * data.mask  # {0,1} -> {-1,+1}, masked
 
@@ -242,7 +248,7 @@ def batched_neg_logz_ep(kernel: Kernel, tol, theta, data: ExpertData, sites0):
         tau = jax.lax.stop_gradient(tau)
         nu = jax.lax.stop_gradient(nu)
         log_z = _ep_log_z(kmat, y_pm, data.mask, tau, nu)
-        return -jnp.sum(log_z), (tau, nu)
+        return -weighted_expert_sum(log_z, weights), (tau, nu)
 
     (value, sites), grad = jax.value_and_grad(nll, has_aux=True)(theta)
     return value, grad, sites

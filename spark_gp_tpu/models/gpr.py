@@ -123,6 +123,9 @@ class GaussianProcessRegression(GaussianProcessCommons):
 
         h = hashlib.sha1()
         for e in extra:
+            if e is None:  # the aggregation plane's placeholder slot
+                h.update(b"none")
+                continue
             h.update(np.asarray(e, dtype=np.float64).tobytes())
         return h.hexdigest()[:10]
 
@@ -251,14 +254,18 @@ class GaussianProcessRegression(GaussianProcessCommons):
         x: np.ndarray,
         y: np.ndarray,
         model: "Optional[GaussianProcessRegressionModel]" = None,
-        mode: str = "rbcm",
+        mode: Optional[str] = None,
     ):
         """Product-of-experts predictor (Deisenroth & Ng ICML'15) over this
         estimator's expert split — the inducing-set-free alternative to the
         PPA model: each expert answers from its exact s-point posterior and
         the answers combine by precision weighting (``mode``: ``"rbcm"``
-        [robust default] / ``"gpoe"`` / ``"bcm"`` / ``"poe"``).  Evaluated
-        at ``model``'s fitted hyperparameters when given, else at the
+        [robust default] / ``"gpoe"`` / ``"bcm"`` / ``"poe"`` /
+        ``"healed"``).  ``mode=None`` resolves through the aggregation
+        plane (``models/aggregation.py``): the explicitly engaged policy
+        (``setAggregationPolicy`` / ``GP_AGG_POLICY``) when one is set,
+        else the documented ``"rbcm"`` robust default.  Evaluated at
+        ``model``'s fitted hyperparameters when given, else at the
         kernel's initial theta.  See :mod:`spark_gp_tpu.models.poe`.
         """
         from spark_gp_tpu.models.poe import make_poe_predictor
@@ -390,6 +397,10 @@ class GaussianProcessRegression(GaussianProcessCommons):
                     vag = make_sharded_value_and_grad(
                         kernel, data, self._mesh, self._objective,
                         cache=cache,
+                        # extras slot 1 is the aggregation plane's weight
+                        # vector (slot 0, jitter, cannot ride shard_map —
+                        # common._run_with_expert_resilience gates it off)
+                        weights=extra[1] if len(extra) > 1 else None,
                     )
                 else:
                     # the ELBO (a nonlinear function of global sums) rides
@@ -518,6 +529,7 @@ class GaussianProcessRegression(GaussianProcessCommons):
                     kernel, self._mesh, log_space, theta0, lower, upper,
                     data.x, data.y, data.mask, max_iter, tol, cache,
                     objective=self._objective,
+                    weights=extra[1] if len(extra) > 1 else None,
                 )
             else:
                 # elbo + mesh lands here too: jit/GSPMD partitions the

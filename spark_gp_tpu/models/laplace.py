@@ -246,9 +246,17 @@ def _dk_stack(kernel: Kernel, theta, x, mask, cache=None):
 
 
 def batched_neg_logz(
-    kernel: Kernel, tol, theta, data: ExpertData, f0, cache=None
+    kernel: Kernel, tol, theta, data: ExpertData, f0, cache=None,
+    weights=None,
 ):
     """Sum over the local expert stack; returns (nll, grad, f_stack).
+
+    ``weights`` ([E]) is the aggregation plane's per-expert weight
+    operand (``models/aggregation.py``): the evidence and its gradient
+    become ``sum_e w_e (.)_e`` — one weighted reduction shared with the
+    marginal/LOO objectives, so quarantine masking (w_e = 0 via the
+    inert identity block) and selection down-weighting compose
+    identically here.  ``None`` keeps the unweighted sums bit-for-bit.
 
     Everything batch-level — the Newton loop, the Algorithm 5.1 gradient
     assembly (GPClf.scala:113-128) and the dK/dtheta stack — so the inner
@@ -295,7 +303,16 @@ def batched_neg_logz(
     )
     grad_log_z = s1 + jnp.einsum("es,esh->eh", s2, s3)
 
-    return -jnp.sum(log_z), -jnp.sum(grad_log_z, axis=0), f
+    from spark_gp_tpu.models.aggregation import weighted_expert_sum
+
+    if weights is None:
+        return -jnp.sum(log_z), -jnp.sum(grad_log_z, axis=0), f
+    w = jnp.asarray(weights, log_z.dtype)
+    return (
+        -weighted_expert_sum(log_z, w),
+        -jnp.sum(w[:, None] * grad_log_z, axis=0),
+        f,
+    )
 
 
 # --- single-expert wrappers (tests / parity oracles) ----------------------

@@ -288,7 +288,8 @@ def _gram_stack(kernel: Kernel, theta, x, mask, cache=None):
 
 
 def batched_neg_logz_generic(
-    lik: Likelihood, kernel: Kernel, tol, theta, x, y, mask, f0, cache=None
+    lik: Likelihood, kernel: Kernel, tol, theta, x, y, mask, f0,
+    cache=None, weights=None,
 ):
     """Summed ``-log Z`` with gradient over the local stack for any
     likelihood; returns ``(nll, grad, f_modes)``.  Newton-fixed-point
@@ -296,7 +297,11 @@ def batched_neg_logz_generic(
     step, determinant re-evaluated at the differentiable iterate.
     ``cache`` is the theta-invariant gram cache (kernels/base.py): the
     differentiated gram build then runs through ``gram_from_cache`` and
-    autodiff never traverses the distance contraction."""
+    autodiff never traverses the distance contraction.  ``weights`` is
+    the aggregation plane's per-expert ``[E]`` vector
+    (``models/aggregation.py``); ``None`` keeps the unweighted sum
+    bit-for-bit."""
+    from spark_gp_tpu.models.aggregation import weighted_expert_sum
 
     def nll(theta_):
         kmat = masked_gram_stack(kernel, theta_, x, mask, cache)
@@ -311,7 +316,7 @@ def batched_neg_logz_generic(
             _gen_objective(lik, stp.a, stp.f_new, y, mask)
             - det.half_logdet_b
         )
-        return -jnp.sum(log_z), f_hat
+        return -weighted_expert_sum(log_z, weights), f_hat
 
     (value, f_hat), grad = jax.value_and_grad(nll, has_aux=True)(theta)
     return value, grad, f_hat

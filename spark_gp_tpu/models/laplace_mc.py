@@ -264,7 +264,7 @@ def _gram_stack(kernel: Kernel, theta, x, mask, cache=None):
 
 
 def batched_neg_logz_mc(
-    kernel: Kernel, tol, theta, x, y1h, mask, f0, cache=None
+    kernel: Kernel, tol, theta, x, y1h, mask, f0, cache=None, weights=None
 ):
     """Summed multiclass ``-log Z`` with gradient, over the local stack.
 
@@ -275,7 +275,10 @@ def batched_neg_logz_mc(
     f-dependence (the binary path's s2/s3 correction) is carried too.
     ``cache`` is the theta-invariant gram cache (kernels/base.py): the
     differentiated gram build then skips the distance contraction.
+    ``weights`` is the aggregation plane's ``[E]`` per-expert vector
+    (``models/aggregation.py``); ``None`` keeps the sum bit-for-bit.
     """
+    from spark_gp_tpu.models.aggregation import weighted_expert_sum
 
     def nll(theta_):
         kmat = masked_gram_stack(kernel, theta_, x, mask, cache)
@@ -293,7 +296,7 @@ def batched_neg_logz_mc(
             - det.half_logdet_b
             - det.half_logdet_m
         )
-        return -jnp.sum(log_z), f_hat
+        return -weighted_expert_sum(log_z, weights), f_hat
 
     (value, f_hat), grad = jax.value_and_grad(nll, has_aux=True)(theta)
     return value, grad, f_hat

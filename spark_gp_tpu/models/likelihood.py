@@ -36,7 +36,11 @@ from spark_gp_tpu.ops.linalg import chol_logdet, chol_solve, cholesky
 from spark_gp_tpu.ops.precision import active_lane, precision_lane_scope
 from spark_gp_tpu.optimize.lbfgs_device import lbfgs_state_donation
 from spark_gp_tpu.parallel.experts import ExpertData
-from spark_gp_tpu.parallel.mesh import EXPERT_AXIS, sharded_cache_operand
+from spark_gp_tpu.parallel.mesh import (
+    EXPERT_AXIS,
+    sharded_cache_operand,
+    sharded_weights_operand,
+)
 
 # Every jitted fit entry point below carries the resolved precision lane
 # (ops/precision.py) as a STATIC argument and re-pins it with
@@ -51,7 +55,7 @@ from spark_gp_tpu.parallel.mesh import EXPERT_AXIS, sharded_cache_operand
 
 
 def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
-                cache=None):
+                weights=None, cache=None):
     """Sum of per-expert NLLs over the local ``[E, s, ...]`` stack.
 
     On TPU the factor/solve/invert chain for the whole Gram stack runs as
@@ -70,6 +74,16 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
     escalation operand (``resilience/quarantine.py``): a *traced* value,
     so recovery retries reuse the compiled program, and the default
     ``None`` path — the clean hot loop — carries zero extra work.
+
+    ``weights`` ([E], traced) is the expert aggregation plane's
+    per-expert weight operand (``models/aggregation.py``): the objective
+    becomes ``sum_e w_e NLL_e`` — ONE weighted sum shared by resilience
+    (a quarantined expert's inert identity block contributes NLL_e = 0,
+    so its w_e is irrelevant and masking IS w_e = 0) and fit-time
+    selection (``downweight`` mode's fractional w_e).  ``None`` — every
+    clean fit and the ``GP_AGG_POLICY=poe`` kill switch — keeps today's
+    unweighted reduction bit-for-bit (a Python-level branch: the
+    unweighted program is a distinct, unchanged trace).
 
     ``cache`` (a :func:`kernels.base.prepare_gram_cache` pytree, traced)
     is the theta-invariant precompute plane: when present, the Gram stack
@@ -98,15 +112,30 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
         # The jittered, cache-fed kmat above is shared verbatim, so
         # jitter escalation and the gram cache ride both lanes.
         quad, logdet = it_ops.inv_quad_logdet(kmat, ym)
-        return 0.5 * jnp.sum(quad) + 0.5 * jnp.sum(logdet)
+        if weights is None:
+            return 0.5 * jnp.sum(quad) + 0.5 * jnp.sum(logdet)
+        w = jnp.asarray(weights, kmat.dtype)
+        return 0.5 * jnp.sum(w * quad) + 0.5 * jnp.sum(w * logdet)
     if _use_pallas(kmat):
         kinv, logdet = spd_inv_logdet(kmat)
         alpha = jnp.einsum("eij,ej->ei", kinv, ym)
-        return 0.5 * jnp.einsum("ei,ei->", ym, alpha) + 0.5 * jnp.sum(logdet)
+        if weights is None:
+            return 0.5 * jnp.einsum("ei,ei->", ym, alpha) + 0.5 * jnp.sum(
+                logdet
+            )
+        w = jnp.asarray(weights, kmat.dtype)
+        return 0.5 * jnp.einsum("ei,ei,e->", ym, alpha, w) + 0.5 * jnp.sum(
+            w * logdet
+        )
     chol_l = cholesky(kmat)
     alpha = chol_solve(chol_l, ym)
-    return 0.5 * jnp.einsum("ei,ei->", ym, alpha) + 0.5 * jnp.sum(
-        chol_logdet(chol_l)
+    if weights is None:
+        return 0.5 * jnp.einsum("ei,ei->", ym, alpha) + 0.5 * jnp.sum(
+            chol_logdet(chol_l)
+        )
+    w = jnp.asarray(weights, kmat.dtype)
+    return 0.5 * jnp.einsum("ei,ei,e->", ym, alpha, w) + 0.5 * jnp.sum(
+        w * chol_logdet(chol_l)
     )
 
 
@@ -141,8 +170,11 @@ def objective_fn(objective: str):
     dominated by cross-kernel terms against the inducing set, which the
     self-distance cache does not cover)."""
     if objective == "marginal":
-        # extra, when present, is the (jitter,) escalation operand of the
-        # resilience layer — absent on every clean fit
+        # extra, when present, is (jitter,) — the resilience layer's
+        # escalation operand — or (jitter, weights) when the aggregation
+        # plane's per-expert weights ride along (jitter None when only
+        # weights engaged; None is a valid empty-pytree operand) — absent
+        # on every clean fit
         return lambda kernel, theta, data, *extra, cache=None: batched_nll(
             kernel, theta, data, *extra, cache=cache
         )
@@ -242,18 +274,24 @@ def guard_probe_value_and_grad(
 
 def _make_sharded_vag(
     kernel: Kernel, mesh, objective: str = "marginal", cache_specs=(),
-    cache_of=lambda maybe_cache: None,
+    cache_of=lambda maybe_cache: None, weight_specs=(),
+    weight_of=lambda maybe_w: None,
 ):
-    """shard_map'd ``(theta, x, y, mask[, cache]) -> (nll, grad)`` core,
-    reusable inside larger jitted programs (the one-dispatch fits, the
-    segmented checkpointing loop).  ``(cache_specs, cache_of)`` come from
-    :func:`parallel.mesh.sharded_cache_operand` — the one home of the
-    optional expert-sharded gram-cache operand convention."""
+    """shard_map'd ``(theta, x, y, mask[, cache][, weights]) ->
+    (nll, grad)`` core, reusable inside larger jitted programs (the
+    one-dispatch fits, the segmented checkpointing loop).
+    ``(cache_specs, cache_of)`` come from
+    :func:`parallel.mesh.sharded_cache_operand` and ``(weight_specs,
+    weight_of)`` from :func:`parallel.mesh.sharded_weights_operand` —
+    the two homes of the optional expert-sharded operand conventions.
+    The weights shard exactly like the stack, so each device's local
+    weighted partial sum psums to the global ``sum_e w_e NLL_e``."""
     _require_shard_map_support(objective)
 
+    n_cache = len(tuple(cache_specs))
     in_specs = (
         P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS)
-    ) + tuple(cache_specs)
+    ) + tuple(cache_specs) + tuple(weight_specs)
 
     @partial(
         jax.shard_map,
@@ -261,12 +299,21 @@ def _make_sharded_vag(
         in_specs=in_specs,
         out_specs=(P(), P()),
     )
-    def sharded(theta_, x_, y_, mask_, *maybe_cache):
+    def sharded(theta_, x_, y_, mask_, *trailing):
         local = ExpertData(x=x_, y=y_, mask=mask_)
-        cache = cache_of(maybe_cache)
+        cache = cache_of(trailing[:n_cache])
+        weights = weight_of(trailing[n_cache:])
         obj = objective_fn(objective)
+        # the marginal objective's positional extras are (jitter, weights)
+        # — jitter cannot ride the sharded signature (quarantine docs), so
+        # its slot pins to None when only weights are aboard.  The fit
+        # drivers engage weights for the marginal objective only.
+        obj_extra = (
+            (None, weights)
+            if weights is not None and objective == "marginal" else ()
+        )
         value, grad = jax.value_and_grad(
-            lambda t: obj(kernel, t, local, cache=cache)
+            lambda t: obj(kernel, t, local, *obj_extra, cache=cache)
         )(theta_)
         # theta is replicated (P()): shard_map's transpose already inserts
         # the cross-device psum for its gradient, so only the value needs an
@@ -291,18 +338,24 @@ def _make_sharded_vag(
     static_argnames=("objective", "lane", "solver"),
 )
 def _sharded_vag_impl(
-    kernel: Kernel, mesh, theta, x, y, mask, cache=None, *,
+    kernel: Kernel, mesh, theta, x, y, mask, cache=None, weights=None, *,
     objective="marginal", lane=None, solver=None,
 ):
     with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
-        core = _make_sharded_vag(kernel, mesh, objective, cache_specs, cache_of)
-        return core(theta, x, y, mask, *cache_args)
+        weight_specs, weight_args, weight_of = sharded_weights_operand(
+            weights
+        )
+        core = _make_sharded_vag(
+            kernel, mesh, objective, cache_specs, cache_of, weight_specs,
+            weight_of,
+        )
+        return core(theta, x, y, mask, *cache_args, *weight_args)
 
 
 def make_sharded_value_and_grad(
     kernel: Kernel, data: ExpertData, mesh, objective: str = "marginal",
-    cache=None,
+    cache=None, weights=None,
 ):
     """Multi-chip ``theta -> (nll, grad)`` via ``shard_map`` + ``psum``.
 
@@ -312,14 +365,17 @@ def make_sharded_value_and_grad(
     the reference's ``treeAggregate`` of ``(Double, BDV)``
     (GaussianProcessCommons.scala:73-78), minus the driver round-trip.
     ``cache`` (expert-sharded like the stack) rides into the local programs
-    so each evaluation skips the distance contraction.
+    so each evaluation skips the distance contraction.  ``weights``
+    ([E], expert-sharded) turns the psum'd objective into the
+    aggregation plane's ``sum_e w_e NLL_e`` (``models/aggregation.py``);
+    ``None`` keeps today's unweighted reduction bit-for-bit.
     """
 
     def vag(theta):
         theta = jnp.asarray(theta, dtype=data.x.dtype)
         return obs_cost.observed_call(
             "fit.sharded_objective", _sharded_vag_impl,
-            kernel, mesh, theta, data.x, data.y, data.mask, cache,
+            kernel, mesh, theta, data.x, data.y, data.mask, cache, weights,
             objective=objective, lane=active_lane(),
             solver=it_ops.solver_jit_key(),
         )
@@ -467,10 +523,22 @@ def _gpr_segment_vag(
 
     else:
         cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
-        core = _make_sharded_vag(kernel, mesh, objective, cache_specs, cache_of)
+        # the sharded signature cannot carry the jitter extra (quarantine
+        # docs) but DOES carry the aggregation plane's weights — slot 1 of
+        # the marginal extras convention (jitter, weights)
+        weights = extra[1] if len(extra) > 1 else None
+        weight_specs, weight_args, weight_of = sharded_weights_operand(
+            weights
+        )
+        core = _make_sharded_vag(
+            kernel, mesh, objective, cache_specs, cache_of, weight_specs,
+            weight_of,
+        )
 
         def base(theta, aux):
-            value, grad = core(theta, data.x, data.y, data.mask, *cache_args)
+            value, grad = core(
+                theta, data.x, data.y, data.mask, *cache_args, *weight_args
+            )
             return value, grad, aux
 
     return log_transform_vag(base) if log_space else base
@@ -556,8 +624,13 @@ def fit_gpr_device_checkpointed(
     family = "gpr" if objective == "marginal" else f"gpr-{objective}"
     import numpy as np
 
+    # a None slot (the unjittered (None, weights) extras of the
+    # aggregation plane) fingerprints as an empty list — present in the
+    # meta so slot positions stay distinguishable, nothing to hash
     extra_meta = {
-        f"objective_extra_{i}": [float(v) for v in np.asarray(e).ravel()]
+        f"objective_extra_{i}": (
+            [] if e is None else [float(v) for v in np.asarray(e).ravel()]
+        )
         for i, e in enumerate(extra)
     }
     meta = segment_meta(
@@ -596,19 +669,19 @@ def fit_gpr_device_checkpointed(
 )
 def _fit_gpr_device_sharded_impl(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, tol, cache=None, *, objective="marginal", lane=None,
-    solver=None,
+    max_iter, tol, cache=None, weights=None, *, objective="marginal",
+    lane=None, solver=None,
 ):
     with precision_lane_scope(lane), it_ops.solver_lane_scope(solver):
         return _fit_gpr_device_sharded_body(
             kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-            max_iter, tol, cache, objective, lane, solver,
+            max_iter, tol, cache, objective, lane, solver, weights,
         )
 
 
 def _fit_gpr_device_sharded_body(
     kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-    max_iter, tol, cache, objective, lane, solver=None,
+    max_iter, tol, cache, objective, lane, solver=None, weights=None,
 ):
     from spark_gp_tpu.optimize.lbfgs_device import (
         lbfgs_minimize_device,
@@ -621,19 +694,23 @@ def _fit_gpr_device_sharded_body(
     if not whole_loop_shard_map_supported():
         # old-jax compat (utils/compat.py): the L-BFGS while_loop inside
         # shard_map wedges the compile; the plain jitted fit partitions
-        # the same sharded stack via GSPMD instead
+        # the same sharded stack via GSPMD instead (the weights ride as
+        # the marginal extras' slot-1 operand)
+        extra = () if weights is None else (None, weights)
         return fit_gpr_device(
             kernel, log_space, theta0, lower, upper, x, y, mask,
-            max_iter, tol, (), cache, objective=objective, lane=lane,
+            max_iter, tol, extra, cache, objective=objective, lane=lane,
             solver=solver,
         )
 
     cache_specs, cache_args, cache_of = sharded_cache_operand(cache)
+    weight_specs, weight_args, weight_of = sharded_weights_operand(weights)
+    n_cache = len(cache_specs)
     in_specs = (
         P(), P(), P(),
         P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
         P(), P(),
-    ) + cache_specs
+    ) + cache_specs + weight_specs
 
     @partial(
         jax.shard_map,
@@ -642,14 +719,19 @@ def _fit_gpr_device_sharded_body(
         out_specs=(P(), P(), P(), P(), P()),
     )
     def run(theta0_, lower_, upper_, x_, y_, mask_, max_iter_, tol_,
-            *maybe_cache):
+            *trailing):
         local = ExpertData(x=x_, y=y_, mask=mask_)
-        local_cache = cache_of(maybe_cache)
+        local_cache = cache_of(trailing[:n_cache])
+        local_w = weight_of(trailing[n_cache:])
         obj = objective_fn(objective)
+        obj_extra = (
+            (None, local_w)
+            if local_w is not None and objective == "marginal" else ()
+        )
 
         def vag(theta, aux):
             value, grad = jax.value_and_grad(
-                lambda t: obj(kernel, t, local, cache=local_cache)
+                lambda t: obj(kernel, t, local, *obj_extra, cache=local_cache)
             )(theta)
             # value is the local shard's partial sum -> explicit psum;
             # grad w.r.t. replicated theta is already globally reduced by
@@ -666,13 +748,16 @@ def _fit_gpr_device_sharded_body(
         )
         return from_u(theta), f, n_iter, n_fev, stalled
 
-    return run(theta0, lower, upper, x, y, mask, max_iter, tol, *cache_args)
+    return run(
+        theta0, lower, upper, x, y, mask, max_iter, tol,
+        *cache_args, *weight_args,
+    )
 
 
 def fit_gpr_device_sharded(
     kernel: Kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
     max_iter, tol, cache=None, *, objective="marginal", lane=None,
-    solver=None,
+    solver=None, weights=None,
 ):
     """Multi-chip on-device fit: the WHOLE optimizer runs inside shard_map —
     per-iteration communication is exactly one psum of the scalar NLL plus
@@ -680,10 +765,13 @@ def fit_gpr_device_sharded(
     ``lane=None`` / ``solver=None`` resolve the ambient precision/solver
     lanes at call time into the jit key (module note above); ``cache``
     (expert-sharded) rides into each device's local program and is reused
-    every iteration."""
+    every iteration.  ``weights`` ([E], expert-sharded like the stack) is
+    the aggregation plane's per-expert weight operand
+    (``models/aggregation.py``) — ``None`` keeps today's reduction
+    bit-for-bit."""
     return _fit_gpr_device_sharded_impl(
         kernel, mesh, log_space, theta0, lower, upper, x, y, mask,
-        max_iter, tol, cache, objective=objective,
+        max_iter, tol, cache, weights, objective=objective,
         lane=active_lane() if lane is None else lane,
         solver=it_ops.solver_jit_key() if solver is None else solver,
     )

@@ -63,7 +63,7 @@ def loo_moments(kernel: Kernel, theta, x, y, mask, cache=None):
     return mu, var, log_density
 
 
-def batched_loo_nll(kernel: Kernel, theta, data, cache=None):
+def batched_loo_nll(kernel: Kernel, theta, data, weights=None, cache=None):
     """Negative LOO log pseudo-likelihood over the expert stack.
 
     ``-L_LOO(theta)`` of R&W eq. 5.13 — the alternative hyperparameter
@@ -71,13 +71,17 @@ def batched_loo_nll(kernel: Kernel, theta, data, cache=None):
     NLL (``models/likelihood.batched_nll``).  More robust under model
     misspecification: it scores held-out predictive density rather than
     data fit (R&W §5.4.2 discussion).  Same signature as ``batched_nll``
-    (including the theta-invariant ``cache`` operand) so every fit entry
-    point can swap it in.
+    (including the theta-invariant ``cache`` operand and the aggregation
+    plane's per-expert ``weights`` — ``models/aggregation.py``; ``None``
+    keeps the unweighted sum bit-for-bit) so every fit entry point can
+    swap it in.
     """
+    from spark_gp_tpu.models.aggregation import weighted_expert_sum
+
     _, _, log_density = loo_moments(
         kernel, theta, data.x, data.y, data.mask, cache
     )
-    return -jnp.sum(log_density * data.mask)
+    return -weighted_expert_sum(log_density * data.mask, weights)
 
 
 @partial(jax.jit, static_argnums=0)

@@ -15,10 +15,20 @@ arXiv:2102.07106, for the failure modes the robust variants patch):
                                                      still overcorrect)
     rbcm   b_e = 0.5(log k** - log s2_e) per point  (entropy-weighted;
            prec = sum_e b_e/s2_e + (1-sum_e b_e)/k**  the robust default)
+    healed b_e = max(0, rbcm entropy weight), normalized:
+           prec = sum_e b_e/s2_e / sum_e b_e — a CONVEX combination of
+           expert precisions (never sharper than its most confident
+           expert, never a negative precision; the "healed product"
+           repair of arXiv 2102.07106's failure modes), falling back to
+           the prior where no expert carries information
 
 where ``k**`` is the prior variance ``kernel.self_diag`` — the same
 (noise-inclusive) convention as the PPA variance, so the two predictors
-are directly comparable.  Cost: O(E s²) per test point, embarrassingly
+are directly comparable.  Mode selection is the expert aggregation
+plane's policy (``models/aggregation.py``): ``mode=None`` resolves
+``GP_AGG_POLICY`` / ``setAggregationPolicy`` when engaged, and the mode
+is a static argument of the jitted predict programs, so a policy switch
+recompiles rather than reusing the old reduction.  Cost: O(E s²) per test point, embarrassingly
 parallel over the expert axis — no O(m³) build, no inducing set; the
 natural choice when the active-set budget, not the data, limits PPA
 fidelity.
@@ -49,7 +59,7 @@ from spark_gp_tpu.ops.linalg import (
 )
 from spark_gp_tpu.parallel.experts import ExpertData
 
-_MODES = ("poe", "gpoe", "bcm", "rbcm")
+_MODES = ("poe", "gpoe", "bcm", "rbcm", "healed")
 
 
 @partial(jax.jit, static_argnums=0)
@@ -96,8 +106,15 @@ def _local_moments(kernel: Kernel, mode, theta, x, mask, chol_l, alpha,
     n_alive = jnp.sum(alive)
     prec_e = alive / var_e  # [E, t]
 
-    if mode == "rbcm":
+    if mode in ("rbcm", "healed"):
         beta = alive * 0.5 * (jnp.log(k_ss)[None, :] - jnp.log(var_e))
+        if mode == "healed":
+            # the healed convex combination admits only non-negative
+            # weights: an expert LESS confident than the prior carries no
+            # information about this test point and must not vote with a
+            # negative coefficient (it would flip the sign of its
+            # precision contribution under the normalization below)
+            beta = jnp.maximum(beta, 0.0)
     else:  # poe / bcm / gpoe: unit weights here.  gpoe's 1/E_global weight
         # cannot be applied per shard (the local expert count is wrong under
         # sharding) — _aggregate divides by n_alive AFTER the reduction.
@@ -126,6 +143,16 @@ def _aggregate(mode, sums, k_ss):
         prior_w = 0.0
     elif mode == "bcm":
         prior_w = 1.0 - n_alive
+    elif mode == "healed":
+        # normalize AFTER the (possibly psum'd) reduction — the weights
+        # then form a global convex combination whatever the sharding.
+        # Test points where every expert reverted to the prior
+        # (beta_sum == 0) fall back to the prior moments exactly.
+        safe = jnp.maximum(beta_sum, jnp.finfo(k_ss.dtype).tiny)
+        informed = beta_sum > 0
+        prec = jnp.where(informed, prec_sum / safe, 1.0 / k_ss)
+        wmean = jnp.where(informed, wmean_sum / safe, 0.0)
+        return wmean / prec, 1.0 / prec
     else:  # rbcm
         prior_w = 1.0 - beta_sum
     prec = prec_sum + prior_w / k_ss  # [t]
@@ -184,9 +211,15 @@ class PoEPredictor:
         kernel: Kernel,
         theta,
         data: ExpertData,
-        mode: str = "rbcm",
+        mode=None,
         mesh=None,
     ):
+        if mode is None:
+            # the aggregation plane's policy (models/aggregation.py) when
+            # engaged; the predictor's documented robust default otherwise
+            from spark_gp_tpu.models.aggregation import resolve_predictor_mode
+
+            mode = resolve_predictor_mode(None, default="rbcm")
         if mode not in _MODES:
             raise ValueError(
                 f"unknown PoE mode {mode!r}; expected one of {_MODES}"
@@ -265,10 +298,13 @@ def make_poe_predictor(
     x: np.ndarray,
     y: np.ndarray,
     dataset_size_for_expert: int,
-    mode: str = "rbcm",
+    mode=None,
     dtype=None,
     mesh=None,
 ) -> PoEPredictor:
+    """Group + factor + wrap.  ``mode=None`` resolves the engaged
+    aggregation policy (``models/aggregation.py``), falling back to the
+    documented robust default ``rbcm``."""
     from spark_gp_tpu.parallel.experts import group_for_experts
 
     data = group_for_experts(x, y, dataset_size_for_expert, dtype=dtype)

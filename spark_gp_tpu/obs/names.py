@@ -117,6 +117,10 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("solver.probes", "metric", "iterative lane: Hutchinson/SLQ probe vectors per log-det estimate"),
     MetricName("solver.residual", "metric", "iterative lane: max relative CG residual at the fitted theta"),
     MetricName("gram_cache_engaged", "metric", "1 when the theta-invariant gram cache served the fit hot loop"),
+    MetricName("agg.policy", "metric", "expert aggregation policy the fit engaged (poe/gpoe/rbcm/healed — models/aggregation.py)"),
+    MetricName("agg.effective_experts", "metric", "participation ratio (sum w)^2 / sum w^2 of the per-expert weights"),
+    MetricName("agg.selection_dropped", "metric", "experts masked out by fit-time redundancy selection"),
+    MetricName("agg.renorm", "metric", "E_active / sum(w) weighted renormalization factor (quarantine.renorm_factor generalized)"),
     MetricName("mixed_precision_guard.delta_nll_rel", "metric", "guard: relative NLL delta vs strict"),
     MetricName("mixed_precision_guard.delta_grad_rel", "metric", "guard: relative gradient delta vs strict"),
     MetricName("mixed_precision_guard.delta_predict_rel", "metric", "guard: relative predict delta vs strict"),
@@ -221,6 +225,7 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("error", "event", "span closed with an escaping exception"),
     MetricName("experts.quarantined", "event", "experts dropped by screen/recovery"),
     MetricName("experts.jittered", "event", "experts repaired by adaptive jitter"),
+    MetricName("experts.deselected", "event", "redundant experts dropped/down-weighted by aggregation selection"),
     MetricName("fit.retry", "event", "recovery re-dispatch of a fit attempt"),
     MetricName("fallback.failure", "event", "classified execution failure observed"),
     MetricName("plan.decision", "event", "memory-plan admission decision (chosen config, predicted bytes, budget)"),

@@ -53,6 +53,19 @@ def sharded_cache_operand(cache):
     return (P(EXPERT_AXIS),), (cache,), (lambda maybe_cache: maybe_cache[0])
 
 
+def sharded_weights_operand(weights):
+    """The per-expert aggregation-weight twin of
+    :func:`sharded_cache_operand` (``models/aggregation.py``): a ``[E]``
+    weight vector shards on the expert axis exactly like the stack, so
+    each device's local weighted partial sum psums to the global
+    ``sum_e w_e NLL_e``.  Same ``(extra_specs, extra_args, unpack)``
+    contract; ``None`` (every clean fit) contributes nothing to the
+    program signature."""
+    if weights is None:
+        return (), (), (lambda maybe_w: None)
+    return (P(EXPERT_AXIS),), (weights,), (lambda maybe_w: maybe_w[0])
+
+
 def shard_experts(data, mesh: Mesh):
     """Place an :class:`ExpertData`-like pytree with leading expert axes onto
     the mesh, sharded on the leading axis, padding E to a device multiple."""

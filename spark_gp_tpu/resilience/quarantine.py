@@ -66,7 +66,11 @@ def renorm_factor(active: float, dropped: float) -> float:
     to a full-stack-comparable NLL.  Exactly 1.0 when nothing is dropped;
     raises :class:`ExpertQuarantineError` when nothing would be kept.
     The single implementation behind ``QuarantineReport.renorm`` and the
-    fit drivers' ``bcm_renorm`` metric."""
+    fit drivers' ``bcm_renorm`` metric.  The aggregation plane
+    generalizes this count-based factor to arbitrary per-expert weights
+    (``models/aggregation.weighted_renorm_factor`` — uniform unit
+    weights with d drops reduce to exactly this quotient); both compose
+    multiplicatively in ``final_nll_renormalized``."""
     kept = active - dropped
     if kept <= 0:
         raise ExpertQuarantineError(

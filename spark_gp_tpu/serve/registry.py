@@ -40,10 +40,35 @@ class ServableModel:
         self.predictor = predictor
         self.kind = kind
         self.loaded_at = time.time()
+        # the aggregation plane's per-version binding (models/
+        # aggregation.py): the policy the model was FITTED under rides
+        # its provenance_json, and every predict through this entry is
+        # scoped to it — a process-wide policy switch (or two co-served
+        # versions fitted under different policies) can never silently
+        # change a published version's aggregation semantics
+        prov = getattr(model, "provenance", None)
+        agg = prov.get("aggregation", {}) if isinstance(prov, dict) else {}
+        self.agg_policy = self._validated_policy(agg.get("agg.policy"))
+        self.effective_experts = agg.get("agg.effective_experts")
+
+    @staticmethod
+    def _validated_policy(policy):
+        if policy is None:
+            return None
+        from spark_gp_tpu.models.aggregation import AGG_POLICIES
+
+        return policy if policy in AGG_POLICIES else None
 
     def predict(self, x: np.ndarray):
-        """``(mean [t], var [t] | None)`` through the bucketed path."""
-        return self.predictor.predict(x)
+        """``(mean [t], var [t] | None)`` through the bucketed path,
+        under this version's bound aggregation policy (when it carries
+        one)."""
+        if self.agg_policy is None:
+            return self.predictor.predict(x)
+        from spark_gp_tpu.models.aggregation import agg_policy_scope
+
+        with agg_policy_scope(self.agg_policy):
+            return self.predictor.predict(x)
 
     def describe(self) -> dict:
         return {
@@ -55,6 +80,8 @@ class ServableModel:
             "buckets": list(self.predictor.buckets),
             "mean_only": self.predictor.mean_only,
             "compiles": dict(self.predictor.compile_counts),
+            "agg_policy": self.agg_policy,
+            "effective_experts": self.effective_experts,
         }
 
 

@@ -77,6 +77,19 @@ def save_model(path: str, model, kind: str) -> None:
         )
         if key in fit_metrics
     }
+    # the aggregation plane's provenance (models/aggregation.py): the
+    # predict policy the model was fitted under plus the fit-time
+    # selection outcome — serve's registry binds the policy per version
+    # from this record, so a model fitted under rbcm predicts under rbcm
+    # wherever it is loaded
+    aggregation = {
+        key: fit_metrics[key]
+        for key in (
+            "agg.policy", "agg.effective_experts", "agg.selection_dropped",
+            "agg.renorm",
+        )
+        if key in fit_metrics
+    }
     extras["provenance_json"] = np.frombuffer(
         json.dumps({
             "process_count": jax.process_count(),
@@ -85,6 +98,7 @@ def save_model(path: str, model, kind: str) -> None:
             # says so permanently — [] for a clean fit
             "degradations": list(getattr(model, "degradations", None) or ()),
             **({"solver": solver} if solver else {}),
+            **({"aggregation": aggregation} if aggregation else {}),
             **(
                 {"covariate_summary": covariate_summary}
                 if covariate_summary else {}

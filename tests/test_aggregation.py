@@ -1,0 +1,409 @@
+"""Expert aggregation plane (models/aggregation.py).
+
+Five contract groups from ISSUE 16: the ``GP_AGG_POLICY=poe`` kill
+switch is bit-for-bit with the unconfigured path; gPoE/rBCM/healed
+predict-time aggregation matches numpy closed forms on tiny E (resolved
+through the policy lane, not just the explicit ``mode=``); the weighted
+NLL composes with quarantine masking (a masked expert contributes
+exactly 0 whatever its weight); host / one-dispatch device / sharded
+fits land the same theta under uniform fractional weights; and fit-time
+correlation-aware selection drops the duplicated half of a redundant
+stack at no held-out quality loss.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_gp_tpu import (
+    GaussianProcessRegression,
+    RBFKernel,
+    WhiteNoiseKernel,
+)
+from spark_gp_tpu.models import aggregation as agg
+from spark_gp_tpu.models.likelihood import batched_nll
+from spark_gp_tpu.models.poe import make_poe_predictor
+from spark_gp_tpu.parallel.experts import group_for_experts
+from spark_gp_tpu.resilience.quarantine import (
+    ExpertQuarantineError,
+    renorm_factor,
+)
+
+
+def _make_kernel():
+    return 1.0 * RBFKernel(0.7, 1e-6, 10) + WhiteNoiseKernel(0.1, 0.0, 1.0)
+
+
+def _regression(rng, n=240):
+    x = rng.normal(size=(n, 2))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=n)
+    return x, y
+
+
+def _estimator(optimizer="host", mesh=None):
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(40)
+        .setActiveSetSize(30)
+        .setSigma2(1e-3)
+        .setMaxIter(5)
+        .setSeed(7)
+        .setOptimizer(optimizer)
+    )
+    if mesh is not None:
+        gp.setMesh(mesh)
+    return gp
+
+
+def _duplicated(rng, base_n=160):
+    """Pairwise-duplicated rows: under round-robin grouping with an even
+    expert count, expert 2j+1 holds exactly expert 2j's points — half the
+    stack is redundant by construction."""
+    xb = rng.normal(size=(base_n, 2))
+    yb = np.sin(xb.sum(axis=1)) + 0.05 * rng.normal(size=base_n)
+    return np.repeat(xb, 2, axis=0), np.repeat(yb, 2)
+
+
+# -- the poe kill switch ----------------------------------------------------
+
+
+def test_poe_policy_is_bit_for_bit(rng, monkeypatch):
+    """GP_AGG_POLICY=poe (the explicit kill switch) must reproduce the
+    unconfigured fit AND its predictions bitwise — the plane's default
+    path is today's code, not a near-copy."""
+    x, y = _regression(rng)
+    xq = rng.normal(size=(9, 2))
+
+    monkeypatch.delenv("GP_AGG_POLICY", raising=False)
+    base = _estimator().fit(x, y)
+    assert base.instr.metrics["agg.policy"] == "poe"
+
+    monkeypatch.setenv("GP_AGG_POLICY", "poe")
+    pinned = _estimator().fit(x, y)
+    assert pinned.instr.metrics["agg.policy"] == "poe"
+
+    np.testing.assert_array_equal(
+        np.asarray(base.raw_predictor.theta),
+        np.asarray(pinned.raw_predictor.theta),
+    )
+    np.testing.assert_array_equal(base.predict(xq), pinned.predict(xq))
+
+
+def test_policy_lane_resolution_order(monkeypatch):
+    """scope > process override > env > poe default, and the jit key is
+    the resolved policy."""
+    monkeypatch.delenv("GP_AGG_POLICY", raising=False)
+    assert agg.active_agg_policy() == "poe"
+    assert not agg.policy_engaged()
+    # an unengaged plane leaves mode=None consumers on their own default
+    assert agg.resolve_predictor_mode(None, default="rbcm") == "rbcm"
+
+    monkeypatch.setenv("GP_AGG_POLICY", "gpoe")
+    assert agg.active_agg_policy() == "gpoe"
+    assert agg.policy_engaged()
+    assert agg.resolve_predictor_mode(None, default="rbcm") == "gpoe"
+
+    prev = agg.set_agg_policy("rbcm")
+    try:
+        assert agg.active_agg_policy() == "rbcm"
+        with agg.agg_policy_scope("healed"):
+            assert agg.active_agg_policy() == "healed"
+            assert agg.agg_jit_key() == "healed"
+        assert agg.active_agg_policy() == "rbcm"
+    finally:
+        agg.set_agg_policy(prev)
+    # explicit mode always wins over the lane
+    assert agg.resolve_predictor_mode("poe") == "poe"
+
+    with pytest.raises(ValueError):
+        agg.set_agg_policy("bayes")
+
+
+# -- closed-form parity through the policy lane -----------------------------
+
+
+def _dense_posterior(kernel, theta, xs, ys, x_test):
+    t = jnp.asarray(theta)
+    k = np.asarray(kernel.gram(t, jnp.asarray(xs)), dtype=np.float64)
+    k_cross = np.asarray(
+        kernel.cross(t, jnp.asarray(x_test), jnp.asarray(xs)),
+        dtype=np.float64,
+    )
+    k_ss = np.asarray(
+        kernel.self_diag(t, jnp.asarray(x_test)), dtype=np.float64
+    )
+    sol = np.linalg.solve(k, np.asarray(ys, dtype=np.float64))
+    mean = k_cross @ sol
+    var = k_ss - np.einsum("ts,st->t", k_cross, np.linalg.solve(k, k_cross.T))
+    return mean, var, k_ss
+
+
+@pytest.mark.parametrize("mode", ["gpoe", "rbcm", "healed"])
+def test_policy_closed_form_parity(rng, mode):
+    """Each robust policy — resolved through the aggregation LANE with
+    ``mode=None`` — matches its numpy closed form built from dense
+    per-expert posteriors."""
+    n, s = 30, 10  # E = 3
+    x, y = _regression(rng, n=n)
+    x_test = rng.normal(size=(6, 2))
+    kernel = _make_kernel()
+    theta = kernel.init_theta()
+
+    with agg.agg_policy_scope(mode):
+        pred = make_poe_predictor(kernel, theta, x, y, s, mode=None)
+        assert pred.mode == mode
+        mean, var = pred.predict_with_var(x_test)
+
+    e = 3
+    mus, vs = [], []
+    for j in range(e):
+        members = np.arange(j, n, e)
+        m_j, v_j, k_ss = _dense_posterior(
+            kernel, theta, x[members], y[members], x_test
+        )
+        mus.append(m_j)
+        vs.append(v_j)
+    mus, vs = np.asarray(mus), np.asarray(vs)
+
+    if mode == "gpoe":
+        prec = np.sum((1.0 / e) / vs, axis=0)
+        m_ref = np.sum((1.0 / e) * mus / vs, axis=0) / prec
+    else:
+        beta = 0.5 * (np.log(k_ss)[None, :] - np.log(vs))
+        if mode == "healed":
+            beta = np.maximum(beta, 0.0)
+            bs = beta.sum(axis=0)
+            prec = np.where(
+                bs > 0, np.sum(beta / vs, axis=0) / np.where(bs > 0, bs, 1.0),
+                1.0 / k_ss,
+            )
+            m_ref = np.where(
+                bs > 0,
+                np.sum(beta * mus / vs, axis=0) / np.where(bs > 0, bs, 1.0),
+                0.0,
+            ) / prec
+        else:  # rbcm
+            prec = np.sum(beta / vs, axis=0) + (1.0 - beta.sum(axis=0)) / k_ss
+            m_ref = np.sum(beta * mus / vs, axis=0) / prec
+
+    np.testing.assert_allclose(mean, m_ref, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(var, 1.0 / prec, rtol=1e-6, atol=1e-8)
+
+
+def test_healed_is_convex_never_sharper_than_best_expert(rng):
+    """The healed product's variance can never undercut its sharpest
+    expert (a convex combination of precisions) — the defining repair of
+    PoE/rBCM overconfidence."""
+    n, s = 40, 10  # E = 4
+    x, y = _regression(rng, n=n)
+    x_test = rng.normal(size=(25, 2)) * 2.0  # include points far from data
+    kernel = _make_kernel()
+    theta = kernel.init_theta()
+
+    _, var = make_poe_predictor(
+        kernel, theta, x, y, s, mode="healed"
+    ).predict_with_var(x_test)
+    e = 4
+    expert_vars = np.stack([
+        _dense_posterior(kernel, theta, x[np.arange(j, n, e)],
+                         y[np.arange(j, n, e)], x_test)[1]
+        for j in range(e)
+    ])
+    assert np.all(var >= expert_vars.min(axis=0) * (1.0 - 1e-8))
+
+
+# -- weighted NLL + quarantine composition ----------------------------------
+
+
+def test_masked_expert_contributes_exactly_zero(rng):
+    """Quarantine masking IS w_e = 0: a masked expert's inert identity
+    block contributes NLL_e = 0 exactly, so its weight slot is
+    irrelevant — the two mechanisms compose through one reduction."""
+    x, y = _regression(rng, n=160)
+    data = group_for_experts(x, y, 40)  # E = 4
+    kernel = _make_kernel()
+    theta = jnp.asarray(kernel.init_theta(), dtype=data.x.dtype)
+
+    masked = data.with_experts_masked(np.array([False, True, False, False]))
+    w_zero = jnp.asarray([1.0, 0.0, 1.0, 1.0], dtype=data.x.dtype)
+    w_wild = jnp.asarray([1.0, 7.25, 1.0, 1.0], dtype=data.x.dtype)
+    nll_zero = float(batched_nll(kernel, theta, masked, weights=w_zero))
+    nll_wild = float(batched_nll(kernel, theta, masked, weights=w_wild))
+    assert nll_zero == nll_wild  # w * 0 == 0 bitwise, not approximately
+
+    # and the weighted sum equals the manual per-expert recomputation
+    per_expert = [
+        float(batched_nll(
+            kernel, theta,
+            data.with_experts_masked(np.arange(4) != j),
+        ))
+        for j in range(4)
+    ]
+    w = jnp.asarray([0.25, 0.5, 1.0, 2.0], dtype=data.x.dtype)
+    manual = float(np.dot(np.asarray(w), per_expert))
+    weighted = float(batched_nll(kernel, theta, data, weights=w))
+    np.testing.assert_allclose(weighted, manual, rtol=1e-10)
+
+
+def test_weighted_renorm_generalizes_quarantine_factor():
+    """Uniform unit weights with d zeros reduce weighted_renorm_factor to
+    quarantine's count-based renorm_factor exactly."""
+    w = np.array([1.0, 0.0, 1.0, 1.0])
+    assert agg.weighted_renorm_factor(w, 4) == renorm_factor(4, 1)
+    assert agg.weighted_renorm_factor(np.ones(6), 6) == 1.0
+    with pytest.raises(ExpertQuarantineError):
+        agg.weighted_renorm_factor(np.zeros(3), 3)
+
+
+def test_effective_expert_count():
+    assert agg.effective_expert_count(np.ones(8)) == pytest.approx(8.0)
+    assert agg.effective_expert_count([1.0, 0.0, 0.0]) == pytest.approx(1.0)
+    assert agg.effective_expert_count(np.zeros(4)) == 0.0
+    # halving half the weights: (3)^2 / (2*1 + 2*0.25) = 3.6
+    assert agg.effective_expert_count([1, 1, 0.5, 0.5]) == pytest.approx(3.6)
+
+
+def test_weighted_expert_sum_none_is_exact_sum(rng):
+    v = jnp.asarray(rng.normal(size=(5, 3)))
+    assert float(agg.weighted_expert_sum(v)) == float(jnp.sum(v))
+    w = jnp.asarray([1.0, 0.5, 0.0, 2.0, 1.0])
+    np.testing.assert_allclose(
+        float(agg.weighted_expert_sum(v, w)),
+        float(jnp.sum(w[:, None] * v)),
+        rtol=1e-12,
+    )
+
+
+# -- host / device / sharded parity under uniform weights -------------------
+
+
+def test_uniform_weight_parity_host_device_sharded(
+    rng, eight_device_mesh, monkeypatch
+):
+    """Downweight selection on the pairwise-duplicated stack hands every
+    expert w_e = 1/2 — a uniform weight vector threaded through the
+    host, one-dispatch device, and shard_map fit drivers.  All three
+    must land the same theta, and (the objective being an exact global
+    rescale) the same optimum as the unweighted fit."""
+    x, y = _duplicated(rng, base_n=160)  # E = 8 experts of 40, all paired
+
+    monkeypatch.delenv("GP_AGG_POLICY", raising=False)
+    monkeypatch.delenv("GP_AGG_SELECT", raising=False)
+    base = _estimator("host").fit(x, y)
+
+    monkeypatch.setenv("GP_AGG_SELECT", "1")
+    monkeypatch.setenv("GP_AGG_SELECT_MODE", "downweight")
+    thetas = {}
+    for name, kwargs in (
+        ("host", {}),
+        ("device", {}),
+        ("sharded", {"mesh": eight_device_mesh}),
+    ):
+        optimizer = "host" if name == "host" else "device"
+        model = _estimator(optimizer, **kwargs).fit(x, y)
+        thetas[name] = np.asarray(model.raw_predictor.theta)
+        w = np.asarray(model.instr.agg_weights)
+        np.testing.assert_allclose(w, 0.5)  # every expert in a pair of 2
+        assert model.instr.metrics["agg.renorm"] == pytest.approx(2.0)
+        assert model.instr.metrics["agg.effective_experts"] == pytest.approx(
+            8.0
+        )
+
+    # host scipy and device-resident L-BFGS take different float paths;
+    # 5e-3 is an order above the observed delta and an order below the
+    # repo-wide THETA_REL_BAR used for the solver lanes
+    scale = max(np.max(np.abs(thetas["host"])), 1e-12)
+    for name in ("device", "sharded"):
+        delta = np.max(np.abs(thetas[name] - thetas["host"])) / scale
+        assert delta <= 5e-3, (name, delta)
+    # w = c * ones rescales the objective; the optimizer must find the
+    # unweighted optimum (path differences allowed, hence the looser bar)
+    base_delta = np.max(
+        np.abs(thetas["host"] - np.asarray(base.raw_predictor.theta))
+    ) / scale
+    assert base_delta <= 5e-3, base_delta
+
+
+# -- fit-time correlation-aware selection -----------------------------------
+
+
+def test_select_experts_keeps_independent_chunks(rng):
+    """iid chunks are NOT redundant: centered sketches decorrelate and
+    selection must keep the whole stack (the do-no-harm contract)."""
+    x, y = _regression(rng, n=320)
+    report = agg.select_experts(
+        group_for_experts(x, y, 40), mode="drop", seed=3
+    )
+    assert report.num_dropped == 0
+    assert report.clean
+    assert report.renorm == 1.0
+
+
+def test_select_experts_drops_duplicated_half(rng):
+    x, y = _duplicated(rng, base_n=160)
+    data = group_for_experts(x, y, 40)  # E = 8, experts 2j/2j+1 identical
+    report = agg.select_experts(data, mode="drop", seed=3)
+    assert report.num_dropped == 4
+    np.testing.assert_array_equal(
+        report.drop, np.tile([False, True], 4)
+    )
+    assert report.renorm == pytest.approx(2.0)
+
+    down = agg.select_experts(data, mode="downweight", seed=3)
+    assert down.num_dropped == 0
+    np.testing.assert_allclose(down.weights, 0.5)
+
+
+def test_select_experts_ignores_fully_masked_experts(rng):
+    """Already-quarantined (fully masked) experts stay at w_e = 0 and
+    never claim a live expert as redundant."""
+    x, y = _duplicated(rng, base_n=160)
+    data = group_for_experts(x, y, 40).with_experts_masked(
+        np.array([True, False, False, False, False, False, False, False])
+    )
+    report = agg.select_experts(data, mode="drop", seed=3)
+    assert report.num_active == 7
+    assert report.weights[0] == 0.0
+    assert not report.drop[0]  # masked beforehand, not dropped by selection
+    # expert 1 (the masked expert's duplicate) survives: its partner is
+    # out of the game, and every other pair still collapses
+    assert not report.drop[1]
+    assert report.num_dropped == 3
+
+
+def test_selection_fit_drops_quarter_at_one_percent_nll(rng, monkeypatch):
+    """Acceptance: on the redundant-chunks workload the fit drops >= 25%
+    of the experts (here exactly half) and the held-out NLPD moves by
+    <= 1% versus the selection-off fit."""
+    x, y = _duplicated(rng, base_n=240)  # E = 12 experts of 40
+    x_te = rng.normal(size=(160, 2))
+    y_te = np.sin(x_te.sum(axis=1)) + 0.05 * rng.normal(size=160)
+
+    def fit_nlpd():
+        model = _estimator("host").fit(x, y)
+        mean, var = model.predict_with_var(x_te)
+        var = np.maximum(np.asarray(var, np.float64), 1e-12)
+        err = y_te - np.asarray(mean, np.float64)
+        nlpd = float(
+            np.mean(0.5 * np.log(2 * np.pi * var) + err ** 2 / (2 * var))
+        )
+        return model, nlpd
+
+    monkeypatch.delenv("GP_AGG_SELECT", raising=False)
+    monkeypatch.delenv("GP_AGG_SELECT_MODE", raising=False)
+    off_model, nlpd_off = fit_nlpd()
+    assert "agg.selection_dropped" not in off_model.instr.metrics
+
+    monkeypatch.setenv("GP_AGG_SELECT", "1")
+    on_model, nlpd_on = fit_nlpd()
+    m = on_model.instr.metrics
+    assert m["agg.selection_dropped"] >= 0.25 * 12
+    assert m["agg.renorm"] == pytest.approx(2.0)
+    # signed: selection may only DEGRADE held-out NLPD by <= 1%
+    assert nlpd_on - nlpd_off <= 0.01 * max(abs(nlpd_off), 1e-9), (
+        nlpd_off, nlpd_on,
+    )
+    # provenance: the saved-model stamp carries the selection outcome
+    assert m["agg.policy"] == "poe"

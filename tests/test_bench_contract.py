@@ -60,6 +60,11 @@ def _run(script, env_extra, args=(), timeout=900):
     env.pop("GP_MEMPLAN", None)
     env.pop("GP_MEMPLAN_MARGIN", None)
     env.pop("GP_MEMPLAN_LIMIT_BYTES", None)
+    # an exported aggregation policy would flip the poe-default primary
+    # fit (and the policy comparison rows); an exported selection knob
+    # would break the aggregation section's selection-off baseline
+    for var in [v for v in env if v.startswith("GP_AGG_")]:
+        env.pop(var)
     for var in list(env):
         # GP_CHAOS_*: a staged fault (dead host / kill counter) from a
         # chaos shell would kill the bench worker mid-measurement;
@@ -224,6 +229,25 @@ def test_bench_emits_one_parseable_result_line():
     assert sl["fitted_theta"]["rel_delta"] <= 5e-2, sl
     assert sl["solver_metrics"].get("solver_lane") == "iterative", sl
     assert sl["solver_metrics"].get("solver.residual", 1.0) <= 1e-2, sl
+    # the expert aggregation plane (ISSUE 16, models/aggregation.py): on
+    # the clustered stand-in at E = 64 the healed product beats plain PoE
+    # on held-out NLPD and lands 90% coverage near-calibrated while PoE's
+    # overconfidence is demonstrated outside the band; correlation-aware
+    # selection drops >= 25% of the pairwise-duplicated experts, speeds
+    # the objective evaluation >= 1.5x, and costs <= 1% held-out NLPD
+    ag = detail["aggregation"]
+    assert "error" not in ag, ag
+    assert ag["num_experts"] >= 64, ag
+    pol = ag["policies"]
+    assert pol["healed"]["nlpd"] < pol["poe"]["nlpd"], pol
+    assert 0.84 <= pol["healed"]["coverage90"] <= 0.97, pol
+    assert pol["poe"]["coverage90"] < 0.80, pol
+    sel = ag["selection"]
+    assert sel["dropped_fraction"] >= 0.25, sel
+    assert sel["eval_speedup"] >= 1.5, sel
+    # signed: positive = degradation; selection may legitimately IMPROVE
+    # held-out NLPD (the deduplicated objective is better conditioned)
+    assert sel["nlpd_rel_delta"] <= 1e-2, sel
     # the observability contract: the span/journal/telemetry layer stays
     # out of the hot path — <2% on fit and serve_predict (min-of-reps,
     # interleaved; obs/trace.py) — while provably ON (spans recorded)

@@ -455,12 +455,27 @@ def _half_rows(pid):
     return x, y
 
 
+def _host_mesh(pid):
+    # each in-process "host" owns a DISJOINT half of the virtual devices,
+    # exactly like real processes own their local chips.  Sharing one
+    # mesh between the two fit threads would run two collective programs
+    # concurrently over the same devices — XLA rendezvous can interleave
+    # their schedules and deadlock (observed on single-core CI hosts).
+    import jax
+
+    from spark_gp_tpu.parallel.mesh import expert_mesh
+
+    devs = jax.devices()
+    half = max(1, len(devs) // 2)
+    return expert_mesh(devs[pid * half:(pid + 1) * half])
+
+
 def _local_stack(pid):
     from spark_gp_tpu.parallel.experts import group_for_experts
-    from spark_gp_tpu.parallel.mesh import expert_mesh, shard_experts
+    from spark_gp_tpu.parallel.mesh import shard_experts
 
     x, y = _half_rows(pid)
-    mesh = expert_mesh()
+    mesh = _host_mesh(pid)
     return shard_experts(group_for_experts(x, y, 16), mesh), mesh
 
 
@@ -596,12 +611,20 @@ def _union_stack():
     mesh = expert_mesh()
     stacks = []
     for pid in range(2):
+        # reproduce each host's LOCAL padded layout (its _host_mesh) so
+        # the union is the same global expert assignment the 2-process
+        # checkpoints were written against
         x, y = _half_rows(pid)
-        stacks.append(shard_experts(group_for_experts(x, y, 16), mesh))
+        stacks.append(
+            shard_experts(group_for_experts(x, y, 16), _host_mesh(pid))
+        )
+    # host-side concat: the two stacks live on disjoint device halves
     union = ExpertData(
-        x=jnp.concatenate([s.x for s in stacks]),
-        y=jnp.concatenate([s.y for s in stacks]),
-        mask=jnp.concatenate([s.mask for s in stacks]),
+        x=jnp.asarray(np.concatenate([np.asarray(s.x) for s in stacks])),
+        y=jnp.asarray(np.concatenate([np.asarray(s.y) for s in stacks])),
+        mask=jnp.asarray(
+            np.concatenate([np.asarray(s.mask) for s in stacks])
+        ),
     )
     return shard_experts(union, mesh), mesh
 

@@ -260,7 +260,14 @@ def test_dead_host_coord_timeout_dumps_one_bundle(tmp_path, monkeypatch):
             n = 144 if pid == 0 else 112
             x = rng.normal(size=(n, 2))
             y = np.sin(x.sum(axis=1))
-            mesh = expert_mesh()
+            # disjoint device halves per logical host: concurrent
+            # collective programs over a SHARED mesh can deadlock XLA's
+            # rendezvous on small hosts (see tests/test_coord._host_mesh)
+            import jax
+
+            devs = jax.devices()
+            half = max(1, len(devs) // 2)
+            mesh = expert_mesh(devs[pid * half:(pid + 1) * half])
             data = shard_experts(group_for_experts(x, y, 16), mesh)
             results[pid] = (
                 _tiny_gp(max_iter=30).setMesh(mesh).fit_distributed(data)
@@ -409,7 +416,14 @@ def test_two_process_fit_shares_one_stitched_trace_id(tmp_path, monkeypatch):
             n = 144 if pid == 0 else 112
             x = rng.normal(size=(n, 2))
             y = np.sin(x.sum(axis=1))
-            mesh = expert_mesh()
+            # disjoint device halves per logical host: concurrent
+            # collective programs over a SHARED mesh can deadlock XLA's
+            # rendezvous on small hosts (see tests/test_coord._host_mesh)
+            import jax
+
+            devs = jax.devices()
+            half = max(1, len(devs) // 2)
+            mesh = expert_mesh(devs[pid * half:(pid + 1) * half])
             data = shard_experts(group_for_experts(x, y, 16), mesh)
             results[pid] = (
                 _tiny_gp(max_iter=8).setMesh(mesh).fit_distributed(data)
