@@ -1984,6 +1984,331 @@ def worker() -> None:
     else:
         fleet = {"skipped": "BENCH_FLEET != 1"}
 
+    # Numerical integrity plane (ISSUE 17): what the SDC defenses cost
+    # when nothing is wrong.  Two hot paths: every DCN collective now
+    # carries a digest+identity+round seal (attested before the
+    # deterministic sum), and the fleet router cross-checks a sampled
+    # fraction of answered (μ, σ²) against a second replica.  Same
+    # two-estimator discipline as the observability section: the
+    # interleaved on/off wall-clock differential is reported but
+    # noise-dominated (thread rendezvous jitter on a shared host is
+    # several % of these sub-100ms paths); the ASSERTED numbers divide
+    # the directly-measured per-round / per-request integrity work by
+    # the measured path wall-clock, which resolves far below the 2% bar.
+    def _integrity_section():
+        import random as _random
+        import statistics
+        import tempfile
+        import threading as _threading
+
+        from spark_gp_tpu import GaussianProcessRegression as _GPR
+        from spark_gp_tpu.data import make_benchmark_data as _make_data
+        from spark_gp_tpu.kernels.rbf import RBFKernel as _RBF
+        from spark_gp_tpu.ops.precision import GUARD_BARS as _BARS
+        from spark_gp_tpu.parallel import coord as _coord
+        from spark_gp_tpu.parallel.experts import group_for_experts
+        from spark_gp_tpu.parallel.mesh import expert_mesh, shard_experts
+        from spark_gp_tpu.resilience import integrity as _integrity
+        from spark_gp_tpu.serve import GPServeServer
+        from spark_gp_tpu.serve.fleet import FleetMembership, LocalReplica
+        from spark_gp_tpu.serve.router import FleetRouter
+
+        rounds_i = int(os.environ.get("BENCH_INTEGRITY_ROUNDS", 40))
+        reps_i = int(os.environ.get("BENCH_INTEGRITY_REPS", 3))
+        saved_env = {
+            k: os.environ.get(k)
+            for k in ("GP_INTEGRITY", "GP_INTEGRITY_SERVE_FRACTION")
+        }
+
+        def _set(key, value):
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+        def two_hosts(fn, timeout_s=60.0):
+            """fn(pid, ctx) on two lockstep logical hosts; returns
+            (host 0's wall seconds, host 0's DcnContext)."""
+            store = _coord.InProcessCoordStore()
+            ctxs = [
+                _coord.DcnContext(
+                    _coord.InProcessCoordClient(store, pid, 2),
+                    timeout_s=timeout_s,
+                )
+                for pid in range(2)
+            ]
+            timings = {}
+
+            def runner(pid):
+                t0 = time.perf_counter()
+                fn(pid, ctxs[pid])
+                timings[pid] = time.perf_counter() - t0
+
+            threads = [
+                _threading.Thread(target=runner, args=(pid,))
+                for pid in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return timings[0], ctxs[0]
+
+        # -- (a) attested vs unattested allreduce rounds (informational) --
+        def allreduce_rounds(pid, ctx):
+            grad = np.full(4, float(pid + 1))
+            for _ in range(rounds_i):
+                ctx.allreduce_arrays("bench_ivag", np.ones(1), grad)
+
+        try:
+            attested_us, raw_us = [], []
+            for _ in range(reps_i):
+                _set("GP_INTEGRITY", None)
+                s, _ctx = two_hosts(allreduce_rounds)
+                attested_us.append(s / rounds_i * 1e6)
+                _set("GP_INTEGRITY", "0")
+                s, _ctx = two_hosts(allreduce_rounds)
+                raw_us.append(s / rounds_i * 1e6)
+            _set("GP_INTEGRITY", None)
+
+            # -- (b) clean two-host DCN fit, plane on vs off -------------
+            ix, iy = _make_data(480)
+            ix, iy = np.asarray(ix), np.asarray(iy)
+            devs = jax.devices()
+            half = len(devs) // 2
+            fit_rows = ix.shape[0] // 2
+            fit_expert = 40
+
+            def host_fit(pid, ctx):
+                _coord.set_dcn_context_for_testing(ctx)
+                try:
+                    mesh = expert_mesh(
+                        devs[pid * half : (pid + 1) * half] if half else devs
+                    )
+                    lo = pid * fit_rows
+                    data = shard_experts(
+                        group_for_experts(
+                            ix[lo : lo + fit_rows],
+                            iy[lo : lo + fit_rows],
+                            fit_expert,
+                        ),
+                        mesh,
+                    )
+                    gp = (
+                        _GPR()
+                        .setKernel(lambda: _RBF(0.1))
+                        .setDatasetSizeForExpert(fit_expert)
+                        .setActiveSetSize(fit_expert)
+                        .setSeed(13)
+                        .setSigma2(1e-3)
+                        .setMaxIter(4)
+                        .setMesh(mesh)
+                    )
+                    gp.fit_distributed(data)
+                finally:
+                    _coord.set_dcn_context_for_testing(None)
+
+            two_hosts(host_fit)  # warm (compile shared across on/off)
+            fit_on, fit_off = [], []
+            vag_rounds = 1
+            for _ in range(reps_i):
+                _set("GP_INTEGRITY", None)
+                s, ctx0 = two_hosts(host_fit)
+                fit_on.append(s)
+                vag_rounds = max(
+                    vag_rounds,
+                    int(getattr(ctx0, "_rounds", {}).get("vag", 0)),
+                )
+                _set("GP_INTEGRITY", "0")
+                s, _ctx = two_hosts(host_fit)
+                fit_off.append(s)
+            _set("GP_INTEGRITY", None)
+            fit_delta = statistics.median(
+                (t_on - t_off) / t_off * 100.0
+                for t_off, t_on in zip(fit_off, fit_on)
+            )
+
+            # direct measurement of the per-round attestation work on a
+            # representative payload: one seal (publish) + one unseal per
+            # peer (verify) + one bounds scan per peer + the pure-hash
+            # spot-check decision.  All strictly additive host-side code.
+            payload = np.ones(64, dtype=np.float64).tobytes()
+            micro = 4000
+            t0 = time.perf_counter()
+            for _ in range(micro):
+                blob = _integrity.seal("bench/0", 0, payload)
+            seal_s = (time.perf_counter() - t0) / micro
+            t0 = time.perf_counter()
+            for _ in range(micro):
+                _integrity.unseal("bench/0", 0, blob)
+            unseal_s = (time.perf_counter() - t0) / micro
+            bounds_arrays = [np.ones(1), np.full(4, 1.0)]
+            t0 = time.perf_counter()
+            for _ in range(micro):
+                _integrity.bounds_violation(bounds_arrays)
+            bounds_s = (time.perf_counter() - t0) / micro
+            t0 = time.perf_counter()
+            for k in range(micro):
+                _integrity.should_spot_check(k)
+            spot_s = (time.perf_counter() - t0) / micro
+            attest_round_s = seal_s + 2 * unseal_s + 2 * bounds_s + spot_s
+            fit_wall = min(fit_on)
+            fit_overhead = (
+                vag_rounds * attest_round_s / fit_wall * 100.0
+            )
+
+            # -- (c) serve burst through a 3-replica fleet ----------------
+            # shadow verification at the default GP_INTEGRITY_SERVE_FRACTION
+            # vs fraction 0 (interleaved, informational) + the asserted
+            # direct expectation: per-request sampling decision for every
+            # request, plus fraction x (one extra replica predict + the
+            # answers_agree compare) for the sampled ones.
+            frac_default = None
+            _set("GP_INTEGRITY_SERVE_FRACTION", None)
+            frac_default = _integrity.serve_verify_fraction()
+            burst_total = 120
+
+            def serve_burst(router, replicas):
+                t0 = time.perf_counter()
+                for i in range(burst_total):
+                    for r in replicas:
+                        r.heartbeat()
+                    row = (i * 23) % max(1, n - 8)
+                    router.predict("ifleet", x[row : row + 4])
+                return time.perf_counter() - t0
+
+            membership = FleetMembership(
+                _coord.InProcessCoordClient(_coord.InProcessCoordStore(), 0, 1),
+                fleet="bench_integrity", interval_s=0.05,
+                straggler_after_s=0.15, dead_after_s=0.35,
+            )
+            replicas = []
+            burst_on, burst_off = [], []
+            with tempfile.TemporaryDirectory() as tmp:
+                mpath = os.path.join(tmp, "bench_integrity.npz")
+                model.save(mpath)
+                try:
+                    for i in range(3):
+                        server = GPServeServer(
+                            max_batch=64, min_bucket=8, max_wait_ms=1.0,
+                            capacity=4096, request_timeout_ms=10_000.0,
+                            hang_timeout_s=None, replica_id=f"ibench-r{i}",
+                        )
+                        server.register("ifleet", mpath)
+                        server.start()
+                        replica = LocalReplica(
+                            server, f"ibench-r{i}", membership
+                        )
+                        replica.register()
+                        replicas.append(replica)
+                    router = FleetRouter(
+                        membership,
+                        transports={
+                            r.replica_id: r.transport for r in replicas
+                        },
+                        max_batch=64, min_bucket=8,
+                        default_timeout_ms=10_000.0, poll_interval_s=0.0,
+                    )
+                    serve_burst(router, replicas)  # warm
+                    for _ in range(reps_i):
+                        _set("GP_INTEGRITY_SERVE_FRACTION", None)
+                        burst_on.append(serve_burst(router, replicas))
+                        _set("GP_INTEGRITY_SERVE_FRACTION", "0")
+                        burst_off.append(serve_burst(router, replicas))
+                    verifications = router.metrics.counter(
+                        "router.verifications"
+                    )
+                finally:
+                    _set("GP_INTEGRITY_SERVE_FRACTION", None)
+                    for r in replicas:
+                        try:
+                            r.stop()
+                        except Exception:  # noqa: BLE001 — teardown only
+                            pass
+            serve_delta = statistics.median(
+                (t_on - t_off) / t_off * 100.0
+                for t_off, t_on in zip(burst_off, burst_on)
+            )
+
+            # per-request sampling decision (env read + locked rng draw)
+            dec_rng = _random.Random(13)
+            dec_lock = _threading.Lock()
+            t0 = time.perf_counter()
+            for _ in range(micro):
+                f = _integrity.serve_verify_fraction()
+                with dec_lock:
+                    bool(f > 0.0 and dec_rng.random() < f)
+            decision_s = (time.perf_counter() - t0) / micro
+            # the (μ, σ²) agreement compare on a representative 4-row answer
+            mu4 = np.zeros(4)
+            var4 = np.ones(4)
+            bar = _BARS["mixed"]
+            t0 = time.perf_counter()
+            for _ in range(micro):
+                _integrity.answers_agree(mu4, var4, mu4, var4, bar)
+            agree_s = (time.perf_counter() - t0) / micro
+            burst_wall = min(burst_on)
+            req_s = burst_wall / burst_total
+            # EXPECTED verification work at the default config: the 2ms
+            # shadow-poll quantum is sleep (the replicas keep serving),
+            # so the throughput cost of a sampled request is one extra
+            # replica predict plus the compare — fraction of them pay it.
+            serve_overhead = (
+                burst_total * decision_s
+                + frac_default * burst_total * (req_s + agree_s)
+            ) / burst_wall * 100.0
+
+            return {
+                "allreduce_attested_us_min": min(attested_us),
+                "allreduce_raw_us_min": min(raw_us),
+                "fit": {
+                    "seconds_on_min": fit_wall,
+                    "seconds_off_min": min(fit_off),
+                    "measured_delta_pct": fit_delta,
+                    "vag_rounds": vag_rounds,
+                    "seal_us": seal_s * 1e6,
+                    "unseal_us": unseal_s * 1e6,
+                    "bounds_us": bounds_s * 1e6,
+                    "attest_round_us": attest_round_s * 1e6,
+                    "overhead_pct": fit_overhead,
+                },
+                "serve": {
+                    "requests": burst_total,
+                    "seconds_on_min": burst_wall,
+                    "seconds_off_min": min(burst_off),
+                    "measured_delta_pct": serve_delta,
+                    "verify_fraction": frac_default,
+                    "verifications_observed": verifications,
+                    "decision_us": decision_s * 1e6,
+                    "answers_agree_us": agree_s * 1e6,
+                    "overhead_pct": serve_overhead,
+                },
+                "note": (
+                    "on = attested collectives + sampled shadow "
+                    "verification (GP_INTEGRITY default); off = "
+                    "GP_INTEGRITY=0 / GP_INTEGRITY_SERVE_FRACTION=0.  "
+                    "overhead_pct (asserted <2% in test_bench_contract) "
+                    "divides the directly-measured integrity work "
+                    "(seal+unseal+bounds+spot-decision per DCN round; "
+                    "sampling decision per request + fraction x one extra "
+                    "replica predict) by the measured path wall-clock; "
+                    "measured_delta_pct is the raw interleaved "
+                    "differential, thread-rendezvous-noise-dominated on "
+                    "these sub-100ms paths"
+                ),
+            }
+        finally:
+            for k, v in saved_env.items():
+                _set(k, v)
+
+    if os.environ.get("BENCH_INTEGRITY", "1") == "1":
+        try:
+            integrity_plane = _integrity_section()
+        except Exception as exc:  # noqa: BLE001 — secondary metric only
+            integrity_plane = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    else:
+        integrity_plane = {"skipped": "BENCH_INTEGRITY != 1"}
+
     def _classifier_fit_seconds(estimator_cls, labels):
         """Warm-up + timed fit of a classifier at the same shape/config as
         the primary metric (one definition, so the binary and multiclass
@@ -2101,6 +2426,7 @@ def worker() -> None:
             "multihost_resilience": multihost_resilience,
             "lifecycle": lifecycle,
             "fleet": fleet,
+            "integrity": integrity_plane,
             "cpu_f64_proxy_fit_seconds": cpu_fit_seconds,
             "cpu_proxy_workers": _PROXY_WORKERS,
             "cpu_proxy_host_cores": host_cores,

@@ -1161,6 +1161,27 @@ class GaussianProcessCommons(GaussianProcessParams):
             # over the KV store, so each host's L-BFGS walks the IDENTICAL
             # global-objective trajectory (parallel/coord.py)
             value_and_grad = dcn.wrap_value_and_grad(value_and_grad)
+        try:
+            return self._optimize_hypers_body(
+                instr, kernel, value_and_grad, callback
+            )
+        finally:
+            if dcn is not None:
+                # disarm the integrity spot-check spec: it described THIS
+                # fit's stack/kernel, and the context is a long-lived
+                # singleton a later fit (possibly a latent one, which
+                # cannot be audited) will reuse
+                dcn.dup_check = None
+
+    def _optimize_hypers_body(
+        self,
+        instr: Instrumentation,
+        kernel: Kernel,
+        value_and_grad: Callable,
+        callback=None,
+    ) -> np.ndarray:
+        from spark_gp_tpu.parallel import coord as coord_mod
+
         theta0 = kernel.init_theta()
         done_iters = 0
         if self._checkpoint_dir is not None:

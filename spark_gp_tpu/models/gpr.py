@@ -410,6 +410,17 @@ class GaussianProcessRegression(GaussianProcessCommons):
                         kernel, data, self._objective, extra, cache
                     )
 
+                # arm the integrity plane's duplicate-dispatch spot
+                # checks for a DCN-coordinated fit: the audit needs the
+                # host-local stack to republish blocks of, which only
+                # exists at this staging point
+                dcn = getattr(self, "_dcn_ctx", None)
+                if dcn is not None:
+                    from spark_gp_tpu.resilience import integrity
+
+                    integrity.stage_spot_check(
+                        dcn, kernel, data, self._objective
+                    )
                 checkpointer = self._make_checkpointer(kernel)
                 theta_opt = self._optimize_hypers(
                     instr, kernel, vag, callback=checkpointer

@@ -211,6 +211,27 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("expert_quality.nll_std", "metric", "std of per-expert NLL at theta* across active experts"),
     MetricName("expert_quality.jitter_max", "metric", "largest per-expert adaptive-jitter level the fit settled on"),
     MetricName("expert_quality.weight_min", "metric", "smallest per-expert effective BCM weight (0 = quarantined)"),
+    # -- numerical integrity plane (resilience/integrity.py) ---------------
+    # each verdict also emits a same-named span/recorder event (integrity.
+    # _emit), covered by the integrity.* pattern at the end of this block
+    MetricName("integrity.attestation_failures", "counter", "published collective payloads failing digest/identity/replay attestation"),
+    MetricName("integrity.bounds_violations", "counter", "finite collective contributions past the GP_INTEGRITY_MAX_ABS magnitude bar"),
+    MetricName("integrity.panel_checks", "counter", "replicated Cholesky diagonal panels cross-compared across devices"),
+    MetricName("integrity.panel_mismatch", "counter", "checked panels diverging across devices (an SDC verdict with the device named)"),
+    MetricName("integrity.spot_checks", "counter", "duplicate-dispatch spot checks executed during DCN-fallback fits"),
+    MetricName("integrity.spot_check_disagreements", "counter", "spot checks where a recompute contradicted a host's published claim"),
+    MetricName("integrity.host_suspect", "counter", "trust-ledger hosts escalated trusted -> suspect"),
+    MetricName("integrity.host_quarantined", "counter", "trust-ledger hosts quarantined (definitive verdict or strikes exhausted)"),
+    MetricName("integrity.replica_suspect", "counter", "serve replicas striked suspect by cross-replica answer verification"),
+    MetricName("integrity.replica_mismatch", "counter", "verified router answers where two replicas' (mean, var) disagreed"),
+    MetricName("integrity.replica_evicted", "counter", "replicas evicted from the routing ring on sustained answer mismatch"),
+    MetricName("integrity.artifact_verified", "counter", "model artifacts whose sha256 sidecar verified on load"),
+    MetricName("integrity.artifact_corrupt", "counter", "model artifacts refused on sidecar digest mismatch"),
+    MetricName("integrity.corrupt_payload", "event", "an allgather payload failed attestation (publishing pid + code attributed)"),
+    MetricName("integrity.bounds_violation", "event", "a host's collective contribution breached the magnitude attestation bar"),
+    MetricName("integrity.*", "counter", "integrity verdict by kind (counter + span/recorder event twin — resilience/integrity._emit)", label="kind"),
+    MetricName("router.verifications", "counter", "answered router requests cross-checked against a second replica"),
+    MetricName("fleet.replicas_evicted", "gauge", "replicas currently evicted from the ring by the integrity plane"),
     # -- forensics plane (obs/recorder.py, obs/cost.py) --------------------
     MetricName("incident.bundles", "counter", "incident bundles assembled on terminal classified failures"),
     MetricName("incident.bundle_failures", "counter", "incident bundles that could not be persisted"),

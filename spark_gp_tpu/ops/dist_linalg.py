@@ -92,6 +92,79 @@ def _chol_core(axis, b, a_loc):
     return jnp.where(cols_g[None, :] <= rows_g[:, None], a_loc, 0.0)
 
 
+def _chol_core_checked(axis, b, a_loc, panel_mask, corrupt):
+    """:func:`_chol_core` plus the integrity plane's redundancy tripwire.
+
+    Step 2 of the algorithm already computes every diagonal panel
+    ``L_kk`` redundantly on all devices — free cross-device redundancy
+    this variant actually compares: for every panel selected by
+    ``panel_mask`` ([nb], sampled host-side at the env-tunable
+    ``GP_INTEGRITY_PANEL_SAMPLE`` rate), each device's copy is measured
+    against the cross-device mean and the worst relative discrepancy is
+    carried out of the loop ([1] per device; the host compares it to the
+    divergence bar — an error cannot be raised inside the program).
+    Honest devices run the identical program on the identical psum'd
+    ``A_kk``, so the honest discrepancy is exactly zero.
+
+    ``corrupt`` ([2]: device index or -1, scale factor) is the chaos
+    operand (``chaos.corrupt_device``): it scales ONE device's ``L_kk``
+    copy — which then flows into that device's solves and trailing
+    updates, exactly like real device SDC — so the tripwire is provable
+    on CPU.  Both extra operands are traced values: staging chaos or
+    re-sampling panels never recompiles the solve.
+    """
+    m_loc, m = a_loc.shape
+    dtype = a_loc.dtype
+    nb = m // b
+    d = jax.lax.psum(1, axis)
+    base = jax.lax.axis_index(axis) * m_loc
+    rows_g = jnp.arange(m_loc, dtype=jnp.int32) + base
+    cols_g = jnp.arange(m, dtype=jnp.int32)
+    dev = jax.lax.axis_index(axis).astype(dtype)
+
+    def panel(k, carry):
+        a_loc, disc = carry
+        r0 = k * b
+        cols = jax.lax.dynamic_slice(a_loc, (0, r0), (m_loc, b))
+        sel = _panel_selector(rows_g, r0, b, dtype)
+        a_kk = jax.lax.psum(sel @ cols, axis)
+        l_kk = jnp.linalg.cholesky(a_kk)
+        # chaos: one device's redundant copy goes silently wrong
+        l_kk = jnp.where(
+            (corrupt[0] >= 0) & (dev == corrupt[0]),
+            l_kk * corrupt[1], l_kk,
+        )
+        # the tripwire: my copy vs the cross-device mean, relative
+        mean_kk = jax.lax.psum(l_kk, axis) / d
+        rel = jnp.max(jnp.abs(l_kk - mean_kk)) / (
+            jnp.max(jnp.abs(mean_kk)) + jnp.asarray(1e-30, dtype)
+        )
+        disc = jnp.maximum(disc, rel[None] * panel_mask[k])
+        # X = A[:, panel] L_kk^-T on every owned row
+        x = jax.lax.linalg.triangular_solve(
+            l_kk, cols, left_side=False, lower=True, transpose_a=True
+        )
+        in_panel = (rows_g >= r0) & (rows_g < r0 + b)
+        below = rows_g >= r0 + b
+        newcols = jnp.where(
+            below[:, None],
+            x,
+            jnp.where(in_panel[:, None], sel.T @ l_kk, jnp.zeros_like(x)),
+        )
+        a_loc = jax.lax.dynamic_update_slice(a_loc, newcols, (0, r0))
+
+        x_below = jnp.where(below[:, None], x, 0.0)
+        l_col = jax.lax.all_gather(x_below, axis, tiled=True)  # [m, b]
+        col_mask = (cols_g >= r0 + b).astype(dtype)
+        return a_loc - (x_below @ l_col.T) * col_mask[None, :], disc
+
+    disc0 = jax.lax.pcast(jnp.zeros((1,), dtype), axis, to="varying")
+    a_loc, disc = jax.lax.fori_loop(0, nb, panel, (a_loc, disc0))
+    return (
+        jnp.where(cols_g[None, :] <= rows_g[:, None], a_loc, 0.0), disc
+    )
+
+
 def _solve_core(axis, b, l_loc, rhs):
     """Solve A x = rhs given the row-sharded factor (A = L L^T): blocked
     forward then backward substitution; rhs/x replicated [m, r]."""
@@ -155,6 +228,19 @@ def _sharded_cholesky_impl(mesh, b, a):
 
 
 @partial(jax.jit, static_argnums=(0, 1))
+def _sharded_cholesky_checked_impl(mesh, b, a, panel_mask, corrupt):
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(EXPERT_AXIS), P(), P()),
+        out_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS)),
+    )
+    def run(a_loc, mask_, corrupt_):
+        return _chol_core_checked(EXPERT_AXIS, b, a_loc, mask_, corrupt_)
+
+    return run(a, panel_mask, corrupt)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
 def _sharded_solve_impl(mesh, b, l_sharded, rhs):
     @partial(
         jax.shard_map, mesh=mesh,
@@ -174,6 +260,13 @@ def _sharded_solve_impl(mesh, b, l_sharded, rhs):
     return run(l_sharded, rhs)
 
 
+#: relative cross-device divergence past which sampled redundant panels
+#: are declared corrupted: honest devices factor the identical psum'd
+#: A_kk with the identical program, so the honest discrepancy is exactly
+#: zero — the bar only needs to sit above representation noise
+PANEL_DIVERGENCE_BAR = 1e-12
+
+
 def sharded_cholesky(mesh, a, block: int = 128):
     """Cholesky-factor a row-sharded SPD ``[m, m]`` array over the mesh.
 
@@ -181,6 +274,14 @@ def sharded_cholesky(mesh, a, block: int = 128):
     diagonal block otherwise).  Returns the row-sharded lower factor.
     Indefiniteness surfaces as NaNs in the factor (check before trusting
     solves — can't raise inside the program).
+
+    With the integrity plane enabled, a sampled fraction of the
+    redundantly-computed diagonal panels (``GP_INTEGRITY_PANEL_SAMPLE``)
+    is digest-compared across devices — a diverging copy (device-level
+    silent corruption) raises
+    :class:`~spark_gp_tpu.resilience.integrity.PanelMismatchError`
+    instead of flowing into the factor unnoticed.  ``GP_INTEGRITY=0``
+    dispatches the original unchecked program.
     """
     m = a.shape[0]
     d = mesh.devices.size
@@ -190,7 +291,49 @@ def sharded_cholesky(mesh, a, block: int = 128):
             "pad with an identity diagonal block"
         )
     a = jax.device_put(a, NamedSharding(mesh, P(EXPERT_AXIS)))
-    return _sharded_cholesky_impl(mesh, block, a)
+    from spark_gp_tpu.resilience import chaos, integrity
+
+    rate = integrity.panel_sample_rate() if integrity.enabled() else 0.0
+    staged = chaos.staged_device_corruption()
+    nb = m // block
+    mask = np.asarray(
+        [1.0 if integrity.panel_checked(k, rate) else 0.0 for k in range(nb)],
+        dtype=np.asarray(a).dtype if hasattr(a, "dtype") else np.float64,
+    )
+    if staged is None and not mask.any():
+        return _sharded_cholesky_impl(mesh, block, a)
+    corrupt = np.asarray(
+        [-1.0, 1.0] if staged is None else [float(staged[0]), staged[1]],
+        dtype=mask.dtype,
+    )
+    l_sharded, disc = _sharded_cholesky_checked_impl(
+        mesh, block, a, jnp.asarray(mask), jnp.asarray(corrupt)
+    )
+    checked = int(mask.sum())
+    if checked:
+        from spark_gp_tpu.obs.runtime import telemetry
+
+        telemetry.inc("integrity.panel_checks", n=checked)
+        per_device = np.asarray(disc)
+        worst = float(per_device.max())
+        if worst > PANEL_DIVERGENCE_BAR:
+            from spark_gp_tpu.obs import trace as obs_trace
+
+            suspect = int(per_device.argmax())
+            telemetry.inc("integrity.panel_mismatch")
+            obs_trace.add_event(
+                "integrity.panel_mismatch", device=suspect, rel=worst,
+                checked=checked,
+            )
+            raise integrity.PanelMismatchError(
+                f"sharded Cholesky: {checked} sampled diagonal panel(s) "
+                f"diverge across devices (worst rel {worst:.3e}, device "
+                f"{suspect} most divergent) — redundant copies of the same "
+                "psum'd block must be identical; device-level silent "
+                "corruption inside the solve",
+                pid=suspect, code="panel_divergence",
+            )
+    return l_sharded
 
 
 def sharded_chol_solve(mesh, l_sharded, rhs, block: int = 128):

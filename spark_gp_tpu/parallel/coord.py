@@ -534,6 +534,7 @@ def kv_allgather(
     timeout_s: Optional[float] = None,
     dead_pids: Optional[Callable[[], Sequence[int]]] = None,
     monitor: Optional["HeartbeatMonitor"] = None,
+    trust=None,
 ) -> List[bytes]:
     """Gather one small ``bytes`` payload per process, ordered by pid.
 
@@ -550,8 +551,17 @@ def kv_allgather(
     callable aborts a wait before its deadline, because passive heartbeats
     go quiet during any long LOCAL computation and must not fail a healthy
     slow peer early (the deadline is the arbiter).
+
+    This is also the attestation choke point (``resilience/integrity.py``):
+    every payload is sealed with a content digest + publisher pid + the
+    round-qualified name before publishing, and verified on read, so a
+    corrupted payload raises :class:`~spark_gp_tpu.resilience.integrity.
+    AttestationError` naming its PUBLISHER on every reader identically —
+    instead of surfacing later as a mysteriously wrong sum.  A ``trust``
+    ledger (the DCN context's) takes the definitive verdict.
+    ``GP_INTEGRITY=0`` publishes raw bytes, bit-for-bit the old wire.
     """
-    from spark_gp_tpu.resilience import chaos
+    from spark_gp_tpu.resilience import chaos, integrity
 
     # chaos choke point: gathers are the DCN plane's collectives, so the
     # staged straggler delay / dead-host exit applies here exactly as
@@ -559,6 +569,11 @@ def kv_allgather(
     chaos.apply_straggler_delay(name)
     chaos.maybe_die_before_collective(name)
     cl = client
+    verify = integrity.enabled()
+    payload = integrity.seal(name, cl.process_id, payload) if verify else payload
+    # corruption lands AFTER sealing, right before the wire — exactly
+    # where a flaky NIC/DMA fault would
+    payload = chaos.maybe_corrupt_published(name, cl.process_id, payload)
     timeout = default_timeout_s() if timeout_s is None else timeout_s
     if monitor is not None:
         monitor.maybe_poll()
@@ -607,7 +622,23 @@ def kv_allgather(
             }
             missing = sorted(set(range(cl.num_processes)) - present)
             _fail(missing or [pid])
-    return [v for v in out if v is not None]
+    results: List[bytes] = []
+    for pid, blob in enumerate(out):
+        if blob is None:
+            continue
+        try:
+            results.append(integrity.unseal(name, pid, blob, verify=verify))
+        except integrity.AttestationError as exc:
+            _bump("integrity.attestation_failures")
+            _event(
+                "integrity.corrupt_payload", op=name, pid=pid, code=exc.code
+            )
+            if trust is not None:
+                trust.record_disagreement(
+                    pid, definitive=True, reason=exc.code
+                )
+            raise
+    return results
 
 
 # --------------------------------------------------------------------------
@@ -875,6 +906,13 @@ class DcnContext:
         self.timeout_s = timeout_s
         self._rounds: Dict[str, int] = {}
         self._lock = threading.Lock()
+        from spark_gp_tpu.resilience import integrity
+
+        # the numerical trust plane's per-host ledger + the armed
+        # duplicate-dispatch spec (integrity.stage_spot_check): verdicts
+        # about PEERS accumulate here across the whole fit
+        self.trust = integrity.make_trust_ledger()
+        self.dup_check = None
 
     def _round(self, name: str) -> int:
         with self._lock:
@@ -892,6 +930,7 @@ class DcnContext:
         out = kv_allgather(
             f"{name}/{r}", payload, client=self.client,
             timeout_s=self.timeout_s, monitor=self.monitor,
+            trust=self.trust,
         )
         if r >= 2:
             # GC this process's OWN round r-2 key: a DCN fit does one
@@ -908,11 +947,44 @@ class DcnContext:
     def allgather_arrays(
         self, name: str, *arrays: np.ndarray
     ) -> List[List[np.ndarray]]:
-        """Per-process array tuples, pid-ordered (one KV round-trip)."""
-        return [
+        """Per-process array tuples, pid-ordered (one KV round-trip).
+
+        The magnitude-attestation choke point: a contribution carrying a
+        finite value past ``GP_INTEGRITY_MAX_ABS`` is attributed to its
+        publisher and refused on every host identically, BEFORE any sum
+        folds it in.  Non-finite values deliberately pass — the vag
+        round exchanges them on purpose (synchronized per-expert
+        recovery)."""
+        from spark_gp_tpu.resilience import chaos, integrity
+
+        # chaos choke point for the wrong-COMPUTE fault: the scale kind
+        # corrupts this host's values before they are packed and sealed
+        # (internally consistent bytes — only value-level checks catch it)
+        arrays = chaos.maybe_corrupt_arrays(
+            name, self.process_id, [np.asarray(a) for a in arrays]
+        )
+        parts = [
             _unpack_arrays(p)
             for p in self.allgather_bytes(name, _pack_arrays(arrays))
         ]
+        if integrity.enabled():
+            for pid, contribution in enumerate(parts):
+                if integrity.bounds_violation(contribution):
+                    _bump("integrity.bounds_violations")
+                    _event(
+                        "integrity.bounds_violation", op=name, pid=pid
+                    )
+                    self.trust.record_disagreement(
+                        pid, definitive=True, reason="bounds"
+                    )
+                    raise integrity.AttestationError(
+                        f"collective {name!r}: pid {pid} published a "
+                        "finite contribution beyond the magnitude "
+                        f"attestation bar ({integrity.max_abs_bound():.1e})"
+                        " — corrupted compute attributed at the gather",
+                        pid=pid, code="bounds",
+                    )
+        return parts
 
     def allreduce_arrays(self, name: str, *arrays) -> List[np.ndarray]:
         """Deterministic global sums: every process receives the per-host
@@ -967,6 +1039,15 @@ class DcnContext:
                     "evaluation; the last coordinated checkpoint is "
                     "complete — resume after rescheduling"
                 )
+            # duplicate-dispatch spot check (integrity plane): the
+            # decision is a pure hash of the vag round index, so every
+            # host takes the audit branch together in lockstep
+            if self.dup_check is not None:
+                from spark_gp_tpu.resilience import integrity
+
+                k = self._rounds.get("vag", 1) - 1
+                if integrity.should_spot_check(k):
+                    integrity.run_spot_check(self, theta, k)
             return float(s_value[0]), s_grad
 
         return reduced

@@ -57,7 +57,25 @@ Fault classes:
   (``GP_CHAOS_STRAGGLER_S`` [+ ``GP_CHAOS_STRAGGLER_OP``],
   ``GP_CHAOS_DEAD_HOST``, ``GP_CHAOS_KILL_AFTER_ITERS``) so subprocess
   tests can stage real multi-process failures without patching code in
-  the child.
+  the child;
+* **silent-data-corruption faults** (consumed by the integrity plane,
+  ``resilience/integrity.py``): :func:`corrupt_host` makes ONE logical
+  process publish wrong bytes/values at the DCN collective choke points
+  — ``bitflip`` flips a payload bit after sealing (transport/memory
+  corruption the attestation digest must catch), ``stuck`` republishes
+  the previous round's sealed payload (the stale-replay fault the
+  attestation's round-bound name must catch), ``scale`` multiplies the
+  published numerical values (the wrong-COMPUTE fault only bounds
+  attestation or duplicate-dispatch recomputation can catch);
+  :func:`corrupt_device` corrupts one device's redundantly-computed
+  diagonal panel inside the sharded Cholesky (the tripwire's fault);
+  :func:`corrupt_replica` swaps a serve replica's predictor for one
+  returning silently scaled answers (the wrong-answer fault the
+  router's shadow verification must catch — the replica stays alive
+  and heartbeating, which is the whole point).  Env channel:
+  ``GP_CHAOS_CORRUPT_PID`` (+ ``GP_CHAOS_CORRUPT_KIND``,
+  ``GP_CHAOS_CORRUPT_OP``, ``GP_CHAOS_CORRUPT_SCALE``,
+  ``GP_CHAOS_CORRUPT_DEVICE``).
 """
 
 from __future__ import annotations
@@ -335,6 +353,13 @@ _mp_state = {
     "memory_fired": None,     # one-element list: budget-OOM count
     "sigma_scale": None,      # float | None: served-σ miscalibration factor
     "input_shift": None,      # float | None: additive covariate shift
+    "corrupt_pid": None,      # int | None: the corrupted logical process
+    "corrupt_kind": None,     # "bitflip" | "stuck" | "scale" | None
+    "corrupt_op": None,       # substring filter | None
+    "corrupt_scale": None,    # float | None: the scale fault's factor
+    "corrupt_fired": None,    # one-element list: corruption count
+    "corrupt_prev": None,     # {(pid, base_op): last sealed blob} (stuck)
+    "corrupt_device": None,   # int | None: device index for panel faults
 }
 
 
@@ -699,6 +724,186 @@ def tick_kill_counter() -> None:
     _mp_state["kill_after"] = remaining
     if remaining <= 0:
         os._exit(PREEMPTION_EXIT_CODE)
+
+
+# --------------------------------------------------------------------------
+# silent-data-corruption faults (resilience/integrity.py's proof harness)
+# --------------------------------------------------------------------------
+
+
+def _corrupt_staged(op: str, pid) -> Optional[tuple]:
+    """``(kind, scale, fired)`` when the staged/env corruption targets
+    this (op, pid), else None.  ``pid`` scoping matters because the DCN
+    tests run every logical host as a thread of ONE process — the fault
+    must corrupt exactly one pid's publishes."""
+    cpid = _mp_state["corrupt_pid"]
+    kind = _mp_state["corrupt_kind"]
+    op_filter = _mp_state["corrupt_op"]
+    scale = _mp_state["corrupt_scale"]
+    fired = _mp_state["corrupt_fired"]
+    if cpid is None:
+        env_pid = _env_chaos_float("GP_CHAOS_CORRUPT_PID")
+        if env_pid is None:
+            return None
+        cpid = int(env_pid)
+        kind = os.environ.get("GP_CHAOS_CORRUPT_KIND", "").strip() or "bitflip"
+        op_filter = os.environ.get("GP_CHAOS_CORRUPT_OP", "").strip() or None
+        scale = _env_chaos_float("GP_CHAOS_CORRUPT_SCALE")
+    if int(pid) != int(cpid):
+        return None
+    if op_filter and op_filter not in op:
+        return None
+    return kind, float(scale if scale else 1e3), fired
+
+
+def maybe_corrupt_published(op: str, pid, blob: bytes) -> bytes:
+    """The byte-level corruption choke point: ``coord.kv_allgather``
+    passes every payload through here AFTER sealing, right before the KV
+    publish — corruption lands between attestation and the wire, exactly
+    where a flaky NIC/DMA fault would.  ``bitflip`` flips one bit of the
+    payload; ``stuck`` republishes this (pid, op)'s previous round's
+    blob (the first matching round publishes honestly to have something
+    to replay).  The ``scale`` kind is a value fault and fires at
+    :func:`maybe_corrupt_arrays` instead."""
+    staged = _corrupt_staged(op, pid)
+    if staged is None:
+        return blob
+    kind, _, fired = staged
+    if kind == "bitflip" and blob:
+        if fired is not None:
+            fired[0] += 1
+        return blob[:-1] + bytes([blob[-1] ^ 0x01])
+    if kind == "stuck":
+        prev_map = _mp_state["corrupt_prev"]
+        if prev_map is None:
+            prev_map = _mp_state["corrupt_prev"] = {}
+        key = (int(pid), op.split("/")[0])
+        prev = prev_map.get(key)
+        prev_map[key] = blob
+        if prev is not None and prev != blob:
+            if fired is not None:
+                fired[0] += 1
+            return prev
+    return blob
+
+
+def maybe_corrupt_arrays(op: str, pid, arrays):
+    """The value-level corruption choke point: ``DcnContext`` array
+    gathers pass their local contribution through here before packing —
+    the ``scale`` kind multiplies every float array by the staged factor,
+    modeling a host whose COMPUTE is silently wrong (its published bytes
+    are internally consistent, so only magnitude attestation or a
+    duplicate-dispatch recompute can catch it)."""
+    staged = _corrupt_staged(op, pid)
+    if staged is None or staged[0] != "scale":
+        return arrays
+    _, scale, fired = staged
+    out = []
+    changed = False
+    for a in arrays:
+        a = np.asarray(a)
+        if a.size and np.issubdtype(a.dtype, np.floating):
+            out.append((a * scale).astype(a.dtype))
+            changed = True
+        else:
+            out.append(a)
+    if changed and fired is not None:
+        fired[0] += 1
+    return out
+
+
+@contextlib.contextmanager
+def corrupt_host(
+    pid: int, kind: str = "bitflip", op: Optional[str] = None,
+    scale: float = 1e3,
+):
+    """Make logical process ``pid`` publish corrupted collective payloads
+    (``kind`` ∈ bitflip | stuck | scale, optionally scoped to collectives
+    whose name contains ``op``).  Yields a one-element fired-count list.
+    Subprocesses stage the same fault with ``GP_CHAOS_CORRUPT_PID`` (+
+    ``GP_CHAOS_CORRUPT_KIND`` / ``GP_CHAOS_CORRUPT_OP`` /
+    ``GP_CHAOS_CORRUPT_SCALE``)."""
+    if kind not in ("bitflip", "stuck", "scale"):
+        raise ValueError(f"unknown corruption kind {kind!r}")
+    keys = (
+        "corrupt_pid", "corrupt_kind", "corrupt_op", "corrupt_scale",
+        "corrupt_fired", "corrupt_prev",
+    )
+    prev = {k: _mp_state[k] for k in keys}
+    fired = [0]
+    _mp_state.update(
+        corrupt_pid=int(pid), corrupt_kind=kind, corrupt_op=op,
+        corrupt_scale=float(scale), corrupt_fired=fired, corrupt_prev={},
+    )
+    try:
+        yield fired
+    finally:
+        _mp_state.update(prev)
+
+
+def staged_device_corruption() -> Optional[tuple]:
+    """``(device_index, scale)`` when a sharded-solve device fault is
+    staged (:func:`corrupt_device` / ``GP_CHAOS_CORRUPT_DEVICE``), else
+    None — read by ``ops/dist_linalg`` when binding a solve's chaos
+    operand."""
+    dev = _mp_state["corrupt_device"]
+    scale = _mp_state["corrupt_scale"]
+    if dev is None:
+        env_dev = _env_chaos_float("GP_CHAOS_CORRUPT_DEVICE")
+        if env_dev is None:
+            return None
+        dev = int(env_dev)
+        scale = _env_chaos_float("GP_CHAOS_CORRUPT_SCALE")
+    return int(dev), float(scale if scale else 1e3)
+
+
+@contextlib.contextmanager
+def corrupt_device(index: int, scale: float = 1e3):
+    """Corrupt ONE device's redundantly-computed diagonal panel copies
+    inside the sharded blocked Cholesky — the cross-device divergence the
+    integrity plane's sampled panel tripwire exists to catch."""
+    prev = (_mp_state["corrupt_device"], _mp_state["corrupt_scale"])
+    _mp_state["corrupt_device"] = int(index)
+    _mp_state["corrupt_scale"] = float(scale)
+    try:
+        yield
+    finally:
+        _mp_state["corrupt_device"], _mp_state["corrupt_scale"] = prev
+
+
+class CorruptingPredictor:
+    """Predict path that silently returns WRONG answers (means scaled by
+    ``factor``) — the SDC serve fault, as distinct from
+    :class:`FlakyPredictor` (raises) and :class:`HangingPredictor`
+    (blocks): nothing here errors, stalls, or stops heartbeating, so
+    only answer verification can notice.  Duck-types
+    :class:`~spark_gp_tpu.serve.batcher.BucketedPredictor`."""
+
+    def __init__(self, inner, factor: float = 1e3) -> None:
+        self._inner = inner
+        self.factor = float(factor)
+        self.calls = 0
+
+    def predict(self, x, *args, **kwargs):
+        self.calls += 1
+        mean, var = self._inner.predict(x, *args, **kwargs)
+        return np.asarray(mean) * self.factor, var
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def corrupt_replica(replica, name: Optional[str] = None, factor: float = 1e3):
+    """Make one fleet replica serve silently wrong answers: its model
+    predictor is swapped for a :class:`CorruptingPredictor` while the
+    replica stays alive, healthy and heartbeating — invisible to the
+    liveness plane by construction.  Returns the wrapper (its ``calls``
+    counter is the test's evidence the corrupted path actually served)."""
+    target = name if name is not None else replica.server.registry.names()[0]
+    entry = replica.server.registry.get(target)
+    corrupting = CorruptingPredictor(entry.predictor, factor=factor)
+    entry.predictor = corrupting
+    return corrupting
 
 
 def break_model(server, name: str, version: Optional[int] = None, **flaky_kw):

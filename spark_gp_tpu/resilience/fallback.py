@@ -61,12 +61,19 @@ NOT_PSD_EXHAUSTED = "not_psd_exhausted"
 COORD_TIMEOUT = "coord_timeout"
 #: fit-time mixed-precision guard breached its lane bar (GP_GUARD_ACTION)
 GUARD_BREACH = "guard_breach"
+#: silent data corruption caught by the integrity plane
+#: (resilience/integrity.py): a failed payload attestation, a magnitude
+#: bound, a duplicate-dispatch disagreement, a diverged redundant panel —
+#: never degraded IN PLACE (re-running with the corrupted host still in
+#: the sum would reproduce the corruption); the remedy is an elastic
+#: resume without the quarantined pid
+SDC = "sdc"
 #: everything else — NEVER degraded, always re-raised raw
 UNKNOWN = "unknown"
 
 FAILURE_CLASSES = (
     OOM, COMPILE, NON_FINITE_EXHAUSTED, NOT_PSD_EXHAUSTED,
-    COORD_TIMEOUT, GUARD_BREACH, UNKNOWN,
+    COORD_TIMEOUT, GUARD_BREACH, SDC, UNKNOWN,
 )
 
 #: message fragments identifying an allocation failure inside an
@@ -148,6 +155,10 @@ def classify_failure(exc: BaseException) -> str:
         return exc.failure_class
     if isinstance(exc, GuardBreachError):
         return GUARD_BREACH
+    from spark_gp_tpu.resilience.integrity import IntegrityError
+
+    if isinstance(exc, IntegrityError):
+        return SDC
     if isinstance(exc, NotPositiveDefiniteException):
         return NOT_PSD_EXHAUSTED
     if isinstance(exc, (NonFiniteFitError, ExpertQuarantineError)):

@@ -1,0 +1,715 @@
+"""Numerical integrity plane: silent-data-corruption (SDC) defense.
+
+Every robustness layer so far assumes a failing component *stops* —
+crashes, hangs, OOMs, raises.  A flaky core that silently computes wrong
+numbers defeats all of it: the blind pid-ordered sum in
+``coord.DcnContext.allreduce_arrays`` folds one host's corrupted (NLL,
+grad) into every host's optimizer state with no error ever raised, and a
+corrupted serve replica returns garbage posteriors to live traffic.
+This module is the trust plane that closes that gap:
+
+* **attested collectives** — every payload published through
+  ``coord.kv_allgather`` is sealed (:func:`seal`) with a content digest
+  plus its publisher pid and round-qualified collective name; every
+  reader verifies (:func:`unseal`) before the deterministic-order sum,
+  so transport corruption (bit flips), identity confusion, and stale
+  replays ("stuck" payloads) are attributed to the *publishing* pid at
+  the gather, not discovered later as a mysteriously wrong objective.
+  Array payloads additionally pass a magnitude attestation
+  (:func:`bounds_violation`): an absurd-magnitude contribution names its
+  publisher.  Non-finite values are deliberately NOT rejected — the DCN
+  plane exchanges non-finite locals on purpose so per-expert recovery
+  stays synchronized (``coord.DcnContext.wrap_value_and_grad``).
+* **duplicate-dispatch spot checks** (:func:`run_spot_check`) — during a
+  DCN-fallback fit, with probability p per objective evaluation
+  (:func:`should_spot_check`, deterministic in the round index so every
+  host agrees), one host republishes one expert block plus its claimed
+  (NLL, |grad|₁) for it; every host recomputes the claim from the
+  published block with the same compiled probe and the verdict falls out
+  of the :data:`TOLERANCE_LADDER` — a disagreeing claim is definitive
+  proof against the target (its compute or publish channel is wrong),
+  a disagreeing verifier recompute earns that verifier a strike.
+* **per-host trust ledger** (:class:`TrustLedger`) — the
+  ``coord.LivenessLedger`` state-machine pattern one level up: trusted →
+  suspect on a disagreement, suspect → quarantined on repeated ones
+  (definitive evidence jumps straight to quarantined).  Verdicts stamp
+  ``integrity.*`` metrics and span events; a quarantined host raises
+  :class:`HostQuarantinedError` — classified ``sdc`` by
+  ``fallback.classify_failure`` — identically on every host (verdicts
+  are pure functions of published bytes), so the fleet stops together
+  with one incident bundle naming the pid and the coordinated
+  checkpoint intact for an elastic resume without the corrupted host.
+* **redundancy tripwires** — ``ops/dist_linalg.py`` computes every
+  diagonal Cholesky panel redundantly on all devices; the sampled
+  per-panel cross-device comparison (:func:`panel_checked` picks the
+  panels) turns that existing redundancy into a free SDC tripwire
+  (:class:`PanelMismatchError` on divergence).
+* **serve answer verification** — ``serve/router.py`` samples a
+  fraction of requests for shadow double-dispatch and compares (μ, σ²)
+  under the mixed-precision guard bar (:func:`answers_agree`); sustained
+  per-replica mismatch evicts the replica from the ring.
+
+``GP_INTEGRITY=0`` is the kill switch: no sealing, no verification, no
+spot checks, no tripwires — bit-for-bit the pre-integrity fit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# knobs — all env-tunable, all read at use time (tests flip them per case)
+# --------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    """The kill switch: ``GP_INTEGRITY=0`` disables the whole plane."""
+    return os.environ.get("GP_INTEGRITY", "").strip().lower() not in (
+        "0", "false", "off",
+    )
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def max_abs_bound() -> float:
+    """``GP_INTEGRITY_MAX_ABS``: the magnitude attestation bar.  Finite
+    values past it in a published array payload are attributed to the
+    publisher as corruption.  The default is astronomically above any
+    legitimate NLL/gradient/uⁱ statistic while far below where a
+    high-exponent bit flip lands (~1e300)."""
+    return _env_float("GP_INTEGRITY_MAX_ABS", 1e18)
+
+
+def spot_check_p() -> float:
+    """``GP_INTEGRITY_DUPCHECK_P``: per-evaluation probability of a
+    duplicate-dispatch spot check during a DCN-fallback fit."""
+    return _env_float("GP_INTEGRITY_DUPCHECK_P", 0.05)
+
+
+def panel_sample_rate() -> float:
+    """``GP_INTEGRITY_PANEL_SAMPLE``: fraction of diagonal panels the
+    sharded Cholesky cross-device tripwire compares."""
+    return _env_float("GP_INTEGRITY_PANEL_SAMPLE", 0.25)
+
+
+def serve_verify_fraction() -> float:
+    """``GP_INTEGRITY_SERVE_FRACTION``: fraction of router requests
+    shadow-verified against a second replica."""
+    return _env_float("GP_INTEGRITY_SERVE_FRACTION", 0.01)
+
+
+def evict_after() -> int:
+    """``GP_INTEGRITY_EVICT_AFTER``: replica mismatch strikes before the
+    router evicts it from the ring."""
+    return max(1, int(_env_float("GP_INTEGRITY_EVICT_AFTER", 2)))
+
+
+def quarantine_after() -> int:
+    """``GP_INTEGRITY_QUARANTINE_AFTER``: non-definitive disagreement
+    strikes before the trust ledger quarantines a host."""
+    return max(1, int(_env_float("GP_INTEGRITY_QUARANTINE_AFTER", 2)))
+
+
+# --------------------------------------------------------------------------
+# errors — all classify as the ``sdc`` failure class (resilience/fallback)
+# --------------------------------------------------------------------------
+
+
+class IntegrityError(RuntimeError):
+    """Base of the trust plane's verdicts: numerical evidence attributed
+    a wrong value to a specific publisher.  ``pid`` is the implicated
+    identity (process id, or replica id on the serve plane), ``code`` the
+    machine-readable verdict kind."""
+
+    def __init__(self, message: str, *, pid=None, code: str = "integrity"):
+        super().__init__(message)
+        self.pid = pid
+        self.code = code
+
+
+class AttestationError(IntegrityError):
+    """A published payload failed its attestation: content digest
+    mismatch (transport/memory corruption), wrong claimed identity,
+    a stale replayed round, or an absurd-magnitude contribution."""
+
+
+class HostQuarantinedError(IntegrityError):
+    """The trust ledger quarantined a host on duplicate-dispatch
+    disagreement — the fit stops identically on every process; resume
+    elastically without the named pid."""
+
+
+class PanelMismatchError(IntegrityError):
+    """Redundantly-computed diagonal Cholesky panels diverged across
+    devices — device-level silent corruption inside a sharded solve."""
+
+
+# --------------------------------------------------------------------------
+# attestation seal: MAGIC + len(header) + JSON header + payload
+# --------------------------------------------------------------------------
+
+_MAGIC = b"GPIA1\n"
+
+
+def _digest(payload: bytes) -> str:
+    return hashlib.blake2b(payload, digest_size=16).hexdigest()
+
+
+def seal(name: str, pid: int, payload: bytes) -> bytes:
+    """Wrap ``payload`` with its attestation header: content digest +
+    publisher pid + the round-qualified collective name (binding the
+    name defeats stale-replay/"stuck" corruption — an old round's sealed
+    blob republished under a new round fails the name check)."""
+    header = json.dumps(
+        {"d": _digest(payload), "p": int(pid), "n": name}
+    ).encode()
+    return _MAGIC + len(header).to_bytes(4, "big") + header + payload
+
+
+def unseal(
+    name: str, pid: int, blob: bytes, verify: bool = True,
+) -> bytes:
+    """Strip (and, when ``verify``, check) a sealed payload.
+
+    Unsealed blobs pass through untouched — direct ``kv_allgather``
+    users outside the integrity plane, or peers running with integrity
+    disabled, interoperate.  Verification failures raise
+    :class:`AttestationError` attributed to the *claimed reading slot*
+    ``pid`` (the publisher whose key this blob arrived under)."""
+    if not blob.startswith(_MAGIC):
+        return blob
+    hlen = int.from_bytes(blob[len(_MAGIC):len(_MAGIC) + 4], "big")
+    body_at = len(_MAGIC) + 4 + hlen
+    try:
+        header = json.loads(blob[len(_MAGIC) + 4:body_at])
+    except ValueError:
+        header = None
+    payload = blob[body_at:]
+    if not verify:
+        return payload
+    if header is None:
+        raise AttestationError(
+            f"collective {name!r}: pid {pid} published an unparseable "
+            "attestation header (corrupt in transit)",
+            pid=pid, code="header_corrupt",
+        )
+    if int(header.get("p", -1)) != int(pid):
+        raise AttestationError(
+            f"collective {name!r}: payload read from pid {pid}'s slot "
+            f"claims pid {header.get('p')}",
+            pid=pid, code="identity_mismatch",
+        )
+    if header.get("n") != name:
+        raise AttestationError(
+            f"collective {name!r}: pid {pid} republished a stale payload "
+            f"sealed for {header.get('n')!r} (stuck/replayed round)",
+            pid=pid, code="stale_replay",
+        )
+    if _digest(payload) != header.get("d"):
+        raise AttestationError(
+            f"collective {name!r}: pid {pid}'s payload fails its content "
+            "digest — corrupted after sealing",
+            pid=pid, code="digest_mismatch",
+        )
+    return payload
+
+
+def bounds_violation(arrays) -> bool:
+    """True when any *finite* element's magnitude exceeds the
+    :func:`max_abs_bound` bar.  Non-finite values pass — the DCN plane
+    exchanges them deliberately (synchronized per-expert recovery), and
+    the non-finite lane (quarantine.py) owns that failure mode."""
+    bound = max_abs_bound()
+    for a in arrays:
+        a = np.asarray(a)
+        if a.size == 0 or not np.issubdtype(a.dtype, np.number):
+            continue
+        finite = np.isfinite(a)
+        if finite.any() and float(np.abs(np.where(finite, a, 0.0)).max()) > bound:
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# deterministic sampling — every host must take the same branch, so every
+# sampling decision is a pure hash of its index, never an RNG draw
+# --------------------------------------------------------------------------
+
+
+def _hash01(tag: str) -> float:
+    h = hashlib.sha256(tag.encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+def should_spot_check(round_index: int, p: Optional[float] = None) -> bool:
+    p = spot_check_p() if p is None else p
+    return p > 0.0 and _hash01(f"dup/{round_index}") < p
+
+
+def spot_check_target(round_index: int, num_processes: int) -> int:
+    """The pid whose work round ``round_index``'s spot check audits."""
+    h = hashlib.sha256(f"dup-target/{round_index}".encode()).digest()
+    return int.from_bytes(h[:8], "big") % max(1, int(num_processes))
+
+
+def panel_checked(k: int, rate: Optional[float] = None) -> bool:
+    """Whether diagonal panel ``k`` is in this solve's tripwire sample."""
+    rate = panel_sample_rate() if rate is None else rate
+    return rate > 0.0 and _hash01(f"panel/{k}") < rate
+
+
+# --------------------------------------------------------------------------
+# tolerance ladder
+# --------------------------------------------------------------------------
+
+#: (rung name, relative bar) — a comparison passes at the first rung
+#: whose bar it meets; meeting none is a disagreement.  The honest case
+#: is *exact*: claim and recompute run the same compiled program on the
+#: same bytes (np.savez round-trips arrays losslessly), so real SDC does
+#: not hide inside "loose" — the wide rungs only absorb environments
+#: where a reduction order differs legitimately.
+TOLERANCE_LADDER = (("exact", 1e-12), ("tight", 1e-9), ("loose", 1e-5))
+
+
+def ladder_rung(a, b) -> Optional[str]:
+    """The first :data:`TOLERANCE_LADDER` rung ``a`` and ``b`` agree at,
+    or ``None`` for a disagreement.  Matching non-finite patterns agree
+    at ``exact`` (the non-finite lane owns those values; integrity only
+    asks that both parties *report the same thing*)."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    if a.shape != b.shape:
+        return None
+    fa, fb = np.isfinite(a), np.isfinite(b)
+    if not np.array_equal(fa, fb):
+        return None
+    if not fa.all():
+        na, nb = a[~fa], b[~fb]
+        # same non-finite kind in every slot (nan==nan, inf sign equal)
+        if not np.array_equal(np.isnan(na), np.isnan(nb)):
+            return None
+        same_inf = np.isnan(na) | (na == nb)
+        if not same_inf.all():
+            return None
+    if fa.any():
+        scale = max(
+            float(np.abs(a[fa]).max()), float(np.abs(b[fb]).max()), 1e-30
+        )
+        rel = float(np.abs(a[fa] - b[fb]).max()) / scale
+    else:
+        rel = 0.0
+    for rung, bar in TOLERANCE_LADDER:
+        if rel <= bar:
+            return rung
+    return None
+
+
+def answers_agree(mean_a, var_a, mean_b, var_b, bar: float):
+    """Serve-side answer comparison: two replicas' (μ, σ²) for the same
+    rows, under ``bar`` (the mixed-precision guard bar — replicas serve
+    the same model bytes, so honest answers agree far inside it).
+    Returns ``(agree, worst_rel)``."""
+    worst = 0.0
+    for a, b in ((mean_a, mean_b), (var_a, var_b)):
+        if a is None and b is None:
+            continue
+        if a is None or b is None:
+            return False, float("inf")
+        a = np.asarray(a, dtype=np.float64)
+        b = np.asarray(b, dtype=np.float64)
+        if a.shape != b.shape or not (
+            np.array_equal(np.isfinite(a), np.isfinite(b))
+        ):
+            return False, float("inf")
+        finite = np.isfinite(a)
+        if finite.any():
+            scale = np.maximum(
+                np.maximum(np.abs(a[finite]), np.abs(b[finite])), 1e-12
+            )
+            worst = max(
+                worst,
+                float((np.abs(a[finite] - b[finite]) / scale).max()),
+            )
+    return worst <= bar, worst
+
+
+# --------------------------------------------------------------------------
+# per-host trust ledger — the LivenessLedger state-machine pattern one
+# level up: liveness tracks *presence*, trust tracks *correctness*
+# --------------------------------------------------------------------------
+
+TRUSTED = "trusted"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+
+
+class TrustLedger:
+    """trusted → suspect → quarantined escalation per identity.
+
+    A *definitive* disagreement (failed digest, magnitude attestation,
+    a spot-check claim contradicted by every recompute of the published
+    bytes) quarantines immediately; non-definitive ones (a single
+    verifier's recompute off) accumulate strikes and quarantine at
+    :func:`quarantine_after`.  A clean observation repays one strike —
+    a transient glitch decays back to trusted, a recurring one ratchets
+    up.  Callbacks fire OUTSIDE the lock (they emit metrics/events,
+    which may take other locks) — the ``LivenessLedger`` discipline.
+    """
+
+    def __init__(
+        self,
+        quarantine_after_strikes: Optional[int] = None,
+        on_suspect: Optional[Callable[[object, str], None]] = None,
+        on_quarantined: Optional[Callable[[object, str], None]] = None,
+    ):
+        self._threshold = quarantine_after_strikes
+        self._on_suspect = on_suspect
+        self._on_quarantined = on_quarantined
+        self._lock = threading.Lock()
+        self._strikes: Dict[object, int] = {}
+        self._state: Dict[object, str] = {}
+
+    def _bar(self) -> int:
+        return (
+            quarantine_after() if self._threshold is None
+            else max(1, int(self._threshold))
+        )
+
+    def record_disagreement(
+        self, ident, definitive: bool = False, reason: str = "",
+    ) -> str:
+        """Register numerical evidence against ``ident``; returns the
+        new state."""
+        fire = []
+        with self._lock:
+            if self._state.get(ident) == QUARANTINED:
+                return QUARANTINED
+            strikes = self._strikes.get(ident, 0) + 1
+            self._strikes[ident] = strikes
+            if definitive or strikes >= self._bar():
+                state = QUARANTINED
+            else:
+                state = SUSPECT
+            prev = self._state.get(ident, TRUSTED)
+            self._state[ident] = state
+            if state == SUSPECT and prev != SUSPECT and self._on_suspect:
+                fire.append((self._on_suspect, ident, reason))
+            if state == QUARANTINED and self._on_quarantined:
+                fire.append((self._on_quarantined, ident, reason))
+        for cb, ident_, reason_ in fire:
+            cb(ident_, reason_)
+        return state
+
+    def record_clean(self, ident) -> str:
+        """One agreeing observation repays one strike (never resurrects
+        a quarantined identity — quarantine is terminal until
+        :meth:`forget`)."""
+        with self._lock:
+            if self._state.get(ident) == QUARANTINED:
+                return QUARANTINED
+            strikes = max(0, self._strikes.get(ident, 0) - 1)
+            self._strikes[ident] = strikes
+            state = TRUSTED if strikes == 0 else SUSPECT
+            self._state[ident] = state
+            return state
+
+    def state(self, ident) -> str:
+        with self._lock:
+            return self._state.get(ident, TRUSTED)
+
+    def strikes(self, ident) -> int:
+        with self._lock:
+            return self._strikes.get(ident, 0)
+
+    def suspects(self) -> List[object]:
+        with self._lock:
+            return sorted(
+                i for i, s in self._state.items() if s == SUSPECT
+            )
+
+    def quarantined(self) -> List[object]:
+        with self._lock:
+            return sorted(
+                i for i, s in self._state.items() if s == QUARANTINED
+            )
+
+    def forget(self, ident) -> None:
+        """Drop an identity (a replaced host re-enters trusted)."""
+        with self._lock:
+            self._strikes.pop(ident, None)
+            self._state.pop(ident, None)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "strikes": dict(self._strikes),
+                "suspects": sorted(
+                    i for i, s in self._state.items() if s == SUSPECT
+                ),
+                "quarantined": sorted(
+                    i for i, s in self._state.items() if s == QUARANTINED
+                ),
+            }
+
+
+def _emit(kind: str, **fields) -> None:
+    """Metric + span event + flight record for one verdict — never
+    raises (the trust plane must not replace the corruption it names
+    with an observability failure)."""
+    try:
+        from spark_gp_tpu.obs import trace as obs_trace
+        from spark_gp_tpu.obs.recorder import RECORDER
+        from spark_gp_tpu.obs.runtime import telemetry
+
+        telemetry.inc(f"integrity.{kind}")
+        obs_trace.add_event(f"integrity.{kind}", **fields)
+        RECORDER.record(f"integrity.{kind}", **fields)
+    except Exception:  # noqa: BLE001 — see docstring
+        pass
+
+
+def make_trust_ledger() -> TrustLedger:
+    """The fit plane's ledger: verdict transitions stamp ``integrity.*``
+    metrics, span events and the flight recorder (whose buffer the
+    incident bundle snapshots — a quarantine's evidence trail rides the
+    bundle for free)."""
+    return TrustLedger(
+        on_suspect=lambda ident, reason: _emit(
+            "host_suspect", pid=ident, reason=reason
+        ),
+        on_quarantined=lambda ident, reason: _emit(
+            "host_quarantined", pid=ident, reason=reason
+        ),
+    )
+
+
+# --------------------------------------------------------------------------
+# duplicate-dispatch spot checks (DCN-fallback fits)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DupCheckSpec:
+    """What a spot check needs to audit this fit: the kernel/objective
+    the probe evaluates under, and the host-local expert stack a target
+    republishes blocks of."""
+
+    kernel: object
+    objective: str
+    x: np.ndarray     # [E, n, p]
+    y: np.ndarray     # [E, n]
+    mask: np.ndarray  # [E, n]
+
+
+def stage_spot_check(ctx, kernel, data, objective: str) -> None:
+    """Arm duplicate-dispatch spot checks on a DCN context for the fit
+    about to run.  Only the stateless marginal objective is auditable
+    (the probe must be a pure function of the published block); latent
+    objectives carry per-expert optimizer state and stay covered by the
+    attestation/bounds layer alone."""
+    spec = None
+    if (
+        enabled()
+        and spot_check_p() > 0.0
+        and objective == "marginal"
+        and getattr(ctx, "num_processes", 1) >= 2
+    ):
+        spec = DupCheckSpec(
+            kernel=kernel,
+            objective=objective,
+            x=np.asarray(data.x),
+            y=np.asarray(data.y),
+            mask=np.asarray(data.mask),
+        )
+    ctx.dup_check = spec
+
+
+def expert_claim(kernel, theta, x_e, y_e, mask_e, objective) -> np.ndarray:
+    """``[nll, |grad|₁]`` (f64) for ONE expert block — the deterministic
+    probe both the target and every verifier run (the quarantine plane's
+    per-expert health probe on a singleton stack: same compiled program,
+    same input bytes, same answer)."""
+    from spark_gp_tpu.parallel.experts import ExpertData
+    from spark_gp_tpu.resilience.quarantine import expert_health
+
+    data = ExpertData(
+        x=np.asarray(x_e)[None],
+        y=np.asarray(y_e)[None],
+        mask=np.asarray(mask_e)[None],
+    )
+    nll, gnorm = expert_health(kernel, theta, data, objective)
+    return np.asarray([float(nll[0]), float(gnorm[0])], dtype=np.float64)
+
+
+_SKIP = np.zeros(0, dtype=np.float64)  # the non-target's gather marker
+
+
+def run_spot_check(ctx, theta, round_index: int) -> None:
+    """One duplicate-dispatch audit round, lockstep on every host.
+
+    Protocol (two gathers on the DCN plane, so the payloads themselves
+    ride the attested channel):
+
+    1. ``dupc`` — the target (:func:`spot_check_target`) republishes one
+       expert block ``(x_e, y_e, mask_e)`` plus its claimed probe value;
+       everyone else publishes an empty marker.
+    2. Every host recomputes the probe from the *published* block —
+       identical bytes, identical program, so every host's local value
+       ``L`` is identical — and publishes its recompute in ``dupv``.
+    3. Verdicts are pure functions of the published values and ``L``,
+       hence identical everywhere: a claim disagreeing with ``L`` is
+       definitive against the target (all recomputes of its own
+       published bytes contradict it); a verifier's published recompute
+       disagreeing with ``L`` earns that verifier a non-definitive
+       strike (its publish channel, and therefore possibly its ``vag``
+       contributions, is corrupting values).
+
+    Raises :class:`HostQuarantinedError` when the ledger quarantines.
+    """
+    spec = getattr(ctx, "dup_check", None)
+    if spec is None:
+        return
+    target = spot_check_target(round_index, ctx.num_processes)
+    theta = np.asarray(theta, dtype=np.float64)
+    if ctx.process_id == target:
+        active = np.flatnonzero(np.asarray(spec.mask).sum(axis=1) > 0)
+        if active.size == 0:
+            payload = [_SKIP]
+        else:
+            e = int(active[round_index % active.size])
+            claim = expert_claim(
+                spec.kernel, theta, spec.x[e], spec.y[e], spec.mask[e],
+                spec.objective,
+            )
+            payload = [spec.x[e], spec.y[e], spec.mask[e], claim]
+    else:
+        payload = [_SKIP]
+    parts = ctx.allgather_arrays("dupc", *payload)
+    published = parts[target]
+    if len(published) != 4:
+        # the target had nothing auditable (fully masked stack): every
+        # host sees the same marker and skips the round together
+        return
+    x_e, y_e, mask_e, claim = published
+    local = expert_claim(
+        spec.kernel, theta, x_e, y_e, mask_e, spec.objective
+    )
+    votes = ctx.allgather_arrays("dupv", local)
+    _emit(
+        "spot_checks", round=round_index, target=target,
+        rung=ladder_rung(claim, local) or "disagree",
+    )
+    ledger = getattr(ctx, "trust", None)
+    if ledger is None:
+        ledger = ctx.trust = make_trust_ledger()
+    if ladder_rung(claim, local) is None:
+        _emit(
+            "spot_check_disagreements", pid=target, via="claim",
+            round=round_index,
+        )
+        ledger.record_disagreement(
+            target, definitive=True, reason="spot_check_claim"
+        )
+        raise HostQuarantinedError(
+            f"duplicate-dispatch spot check (round {round_index}): pid "
+            f"{target}'s claimed (NLL, |grad|) for its republished expert "
+            "block disagrees with every recompute of the same bytes — "
+            "host quarantined; resume elastically without it",
+            pid=target, code="spot_check_claim",
+        )
+    ledger.record_clean(target)
+    for pid in range(ctx.num_processes):
+        if pid == target:
+            continue
+        vote = votes[pid][0] if votes[pid] else _SKIP
+        if ladder_rung(vote, local) is None:
+            _emit(
+                "spot_check_disagreements", pid=pid, via="verifier",
+                round=round_index,
+            )
+            state = ledger.record_disagreement(
+                pid, reason="spot_check_verifier"
+            )
+            if state == QUARANTINED:
+                raise HostQuarantinedError(
+                    f"duplicate-dispatch spot checks: pid {pid}'s "
+                    "recomputed probe values repeatedly disagree with "
+                    "every other host's — host quarantined; resume "
+                    "elastically without it",
+                    pid=pid, code="spot_check_verifier",
+                )
+        else:
+            ledger.record_clean(pid)
+
+
+# --------------------------------------------------------------------------
+# model-artifact integrity (sha256 sidecars)
+# --------------------------------------------------------------------------
+
+SIDECAR_SUFFIX = ".sha256"
+
+#: the named code ``CheckpointCorruptError`` carries for a failed model
+#: sidecar (distinguishing it from a torn training checkpoint)
+ARTIFACT_DIGEST_CODE = "model_sidecar_digest_mismatch"
+
+
+def file_digest(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def write_sidecar(path: str) -> str:
+    """Stamp ``<path>.sha256`` next to a written artifact; returns the
+    hex digest."""
+    hexd = file_digest(path)
+    with open(path + SIDECAR_SUFFIX, "w") as fh:
+        fh.write(hexd + "\n")
+    return hexd
+
+
+def verify_sidecar(path: str) -> Optional[bool]:
+    """Check an artifact against its sidecar.  ``None`` when no sidecar
+    exists (legacy artifact — nothing to verify against); raises
+    ``CheckpointCorruptError`` (code :data:`ARTIFACT_DIGEST_CODE`) on a
+    mismatch, so a fleet distributing corrupted model files refuses at
+    bind time instead of serving garbage."""
+    if not enabled():
+        return None
+    sidecar = path + SIDECAR_SUFFIX
+    if not os.path.exists(sidecar):
+        return None
+    with open(sidecar) as fh:
+        expected = fh.read().strip()
+    actual = file_digest(path)
+    if actual != expected:
+        from spark_gp_tpu.utils.checkpoint import CheckpointCorruptError
+
+        _emit("artifact_corrupt", path=path)
+        err = CheckpointCorruptError(
+            f"{path} fails its content checksum "
+            f"(sidecar {expected[:12]}…, file {actual[:12]}…) — the model "
+            "artifact was corrupted after it was written; refuse to load "
+            f"it [code={ARTIFACT_DIGEST_CODE}]"
+        )
+        err.code = ARTIFACT_DIGEST_CODE
+        raise err
+    _emit("artifact_verified", path=path)
+    return True

@@ -75,6 +75,42 @@ ERROR_CODES: Dict[str, str] = {
     "serve.conn_idle": (
         "connection closed: no line arrived within --conn-read-timeout-s"
     ),
+    # -- numerical integrity verdicts (resilience/integrity.py) ------------
+    # the ``IntegrityError.code`` vocabulary: carried by ``integrity.*``
+    # events and ``sdc``-classed incident bundles (fit plane), and by the
+    # registry's bind-time refusal of a corrupted artifact (serve plane) —
+    # operators and supervisors branch on these exactly like wire codes
+    "header_corrupt": (
+        "an attested collective payload's seal header failed to parse"
+    ),
+    "identity_mismatch": (
+        "an attested payload claims a different publishing pid than its slot"
+    ),
+    "stale_replay": (
+        "an attested payload carries a previous round's collective name "
+        "(a stuck link re-delivering old bytes)"
+    ),
+    "digest_mismatch": (
+        "an attested payload's bytes do not match its sealed sha256"
+    ),
+    "bounds": (
+        "a finite collective contribution breached GP_INTEGRITY_MAX_ABS"
+    ),
+    "spot_check_claim": (
+        "a duplicate-dispatch recompute disproved the target host's "
+        "published (NLL, |grad|) claim — definitive quarantine"
+    ),
+    "spot_check_verifier": (
+        "a verifying host's recomputed probe values sat in the minority "
+        "across spot-check rounds — strikes exhausted"
+    ),
+    "panel_divergence": (
+        "a replicated Cholesky diagonal panel diverged across devices"
+    ),
+    "model_sidecar_digest_mismatch": (
+        "a model artifact's bytes do not match its sha256 sidecar — "
+        "refused at load/registry-bind time"
+    ),
 }
 
 

@@ -33,7 +33,18 @@ Robustness is the contract:
   queue pressure and memory-gate state onto the router's own metrics
   page (``fleet.queue_pressure.*`` per-replica gauges plus one
   ``fleet.scale_up`` signal), so one scrape answers "does this fleet
-  need another replica".
+  need another replica";
+* **answer verification** — a sampled fraction of answered requests
+  (``GP_INTEGRITY_SERVE_FRACTION``) is shadow-dispatched to a SECOND
+  replica and the two (μ, σ²) compared under the mixed-precision guard
+  bar; a hedge twin that also answered is a free second opinion.  On
+  mismatch a third replica breaks the tie, the caller gets the
+  majority answer, and the minority replica takes a trust strike —
+  ``GP_INTEGRITY_EVICT_AFTER`` strikes evict it from the ring
+  (``integrity.replica_mismatch`` / ``integrity.replica_evicted``).
+  A replica that computes wrong answers but heartbeats on time is
+  invisible to liveness; this is the plane that catches it
+  (:mod:`spark_gp_tpu.resilience.integrity`).
 
 The router is threadless by construction: it waits on the replicas' own
 futures in small slices (the serve queue completes every future —
@@ -55,6 +66,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from spark_gp_tpu.obs import trace as obs_trace
+from spark_gp_tpu.resilience import integrity
 from spark_gp_tpu.resilience.breaker import BreakerOpenError
 from spark_gp_tpu.serve.batcher import bucket_sizes
 from spark_gp_tpu.serve.fleet import FleetMembership, HashRing
@@ -505,6 +517,18 @@ class FleetRouter:
         # successor, not the ring owner
         self._answered: "OrderedDict[str, str]" = OrderedDict()
         self._answered_capacity = 4096
+        # answer-verification plane (resilience/integrity.py): sampled
+        # shadow dispatches compare two replicas' (μ, σ²) for the same
+        # rows; sustained disagreement evicts the minority replica from
+        # the ring.  Ledger callbacks fire outside its lock.
+        self._evicted: set = set()
+        self._trust = integrity.TrustLedger(
+            quarantine_after_strikes=integrity.evict_after(),
+            on_suspect=lambda rid, reason: integrity._emit(
+                "replica_suspect", replica=rid, reason=reason
+            ),
+            on_quarantined=self._evict_replica,
+        )
         self.rebuild()
 
     # -- membership view ---------------------------------------------------
@@ -542,8 +566,17 @@ class FleetRouter:
             self._view = view
             routable = [
                 rid for rid in view["live"]
-                if self._transport_for(rid, view) is not None
+                if rid not in self._evicted
+                and self._transport_for(rid, view) is not None
             ]
+            if not routable and self._evicted:
+                # every surviving replica is distrusted: serve degraded
+                # rather than dark.  The eviction guard keeps one live,
+                # but later deaths can strand the fleet on an evictee.
+                routable = [
+                    rid for rid in view["live"]
+                    if self._transport_for(rid, view) is not None
+                ]
             self._ring = HashRing(routable, vnodes=self._vnodes)
             self._last_poll = self._clock()
         return view
@@ -695,6 +728,11 @@ class FleetRouter:
                 else:
                     if hedged:
                         self.metrics.inc("router.hedge_wins")
+                    if integrity.enabled():
+                        mean, var = self._verify_answer(
+                            model, x, request_id, rid, mean, var,
+                            pending, deadline, priority, version,
+                        )
                     self.metrics.observe(
                         "router.request_latency_s", self._clock() - started
                     )
@@ -714,6 +752,148 @@ class FleetRouter:
                 launch(hedged=True)
                 continue
             self._sleep(min(0.002, max(0.0, deadline - now)))
+
+    # -- answer verification (resilience/integrity.py) ---------------------
+    def _shadow_predict(self, model, x, request_id, exclude, deadline,
+                        priority, version):
+        """One verification dispatch to a live ring replica outside
+        ``exclude``; returns ``(replica_id, (mean, var))`` or ``None``
+        when no such replica exists, the dispatch fails, or the deadline
+        hits — verification never fails the request it verifies."""
+        rows = x.shape[0] if x.ndim == 2 else 1
+        with self._lock:
+            order = self._ring.owners(
+                f"{model}/{self.bucket_for(int(rows))}"
+            )
+        for other in order:
+            if other in exclude:
+                continue
+            transport = self._transports.get(other)
+            if transport is None:
+                continue
+            remaining_ms = max(1.0, (deadline - self._clock()) * 1e3)
+            try:
+                future = transport.submit(
+                    model, x, timeout_ms=remaining_ms,
+                    request_id=request_id, priority=priority,
+                    version=version, observable=False,
+                )
+                while not future.done():
+                    if self._clock() >= deadline:
+                        return None
+                    self._sleep(0.002)
+                return other, future.result(0)
+            except Exception:  # noqa: BLE001 — a failed shadow verifies
+                continue       # nothing; try the next successor
+
+        return None
+
+    def _verify_answer(self, model, x, request_id, rid, mean, var,
+                       pending, deadline, priority, version):
+        """Cross-replica answer verification for ONE answered request: a
+        second replica's (μ, σ²) for the same rows must agree with the
+        winning answer inside the mixed-precision guard bar — replicas
+        serve identical model bytes, so honest answers sit orders of
+        magnitude inside it.  A hedge twin that also answered is a free
+        second opinion; otherwise a ``GP_INTEGRITY_SERVE_FRACTION``
+        sample pays one shadow dispatch.  On mismatch a third replica
+        breaks the tie: the caller gets the majority answer and the
+        minority replica takes a trust strike (eviction at
+        ``GP_INTEGRITY_EVICT_AFTER``)."""
+        from spark_gp_tpu.ops.precision import GUARD_BARS
+
+        peer = None
+        for entry in list(pending):
+            other_rid, other_future = entry[0], entry[1]
+            if other_rid == rid or not other_future.done():
+                continue
+            try:
+                peer = (other_rid, other_future.result(0))
+                break
+            except Exception:  # noqa: BLE001 — a failed twin verifies
+                continue       # nothing (its error took the failover path)
+        if peer is None:
+            frac = integrity.serve_verify_fraction()
+            with self._lock:
+                sampled = frac > 0.0 and float(self._rng.random()) < frac
+            if not sampled:
+                return mean, var
+            peer = self._shadow_predict(
+                model, x, request_id, {rid}, deadline, priority, version
+            )
+            if peer is None:
+                return mean, var
+        self.metrics.inc("router.verifications")
+        bar = GUARD_BARS["mixed"]
+        peer_rid, (peer_mean, peer_var) = peer
+        agree, worst = integrity.answers_agree(
+            mean, var, peer_mean, peer_var, bar
+        )
+        if agree:
+            self._trust.record_clean(rid)
+            self._trust.record_clean(peer_rid)
+            return mean, var
+        integrity._emit(
+            "replica_mismatch", model=model, replica_a=rid,
+            replica_b=peer_rid, rel=worst,
+        )
+        tie = self._shadow_predict(
+            model, x, request_id, {rid, peer_rid}, deadline, priority,
+            version,
+        )
+        if tie is None:
+            # two replicas, no third opinion: the disagreement is real
+            # but unattributable — strike both, keep the primary answer
+            self._trust.record_disagreement(rid, reason="replica_mismatch")
+            self._trust.record_disagreement(
+                peer_rid, reason="replica_mismatch"
+            )
+            return mean, var
+        tie_rid, (tie_mean, tie_var) = tie
+        agree_a, _ = integrity.answers_agree(
+            mean, var, tie_mean, tie_var, bar
+        )
+        agree_b, _ = integrity.answers_agree(
+            peer_mean, peer_var, tie_mean, tie_var, bar
+        )
+        if agree_a and not agree_b:
+            self._trust.record_clean(rid)
+            self._trust.record_clean(tie_rid)
+            self._trust.record_disagreement(
+                peer_rid, reason="replica_mismatch"
+            )
+            return mean, var
+        if agree_b and not agree_a:
+            self._trust.record_clean(peer_rid)
+            self._trust.record_clean(tie_rid)
+            self._trust.record_disagreement(rid, reason="replica_mismatch")
+            return peer_mean, peer_var
+        if agree_a and agree_b:
+            # the tie-breaker sits inside the bar of both while they sit
+            # outside each other's — a borderline split, not evidence
+            return mean, var
+        # three-way disagreement: everyone involved is suspect
+        for suspect in (rid, peer_rid, tie_rid):
+            self._trust.record_disagreement(
+                suspect, reason="replica_mismatch"
+            )
+        return mean, var
+
+    def _evict_replica(self, rid, reason: str = "") -> None:
+        """Trust-ledger quarantine verdict → ring eviction.  Never
+        evicts the last live routable replica (degraded answers beat no
+        answers); the quarantined state still stands, so the distrusted
+        replica stays one verdict from eviction once a peer joins."""
+        with self._lock:
+            survivors = [
+                r for r in self._view.get("live", ())
+                if r != rid and r not in self._evicted
+            ]
+            if not survivors:
+                return
+            self._evicted.add(rid)
+        integrity._emit("replica_evicted", replica=rid, reason=reason)
+        self._sync()
 
     def _note_answered(self, request_id: str, replica_id: str) -> None:
         with self._lock:
@@ -759,6 +939,9 @@ class FleetRouter:
         )
         self.metrics.set_gauge("fleet.replicas_dead", float(len(view["dead"])))
         self.metrics.set_gauge("fleet.generation", float(view["generation"]))
+        self.metrics.set_gauge(
+            "fleet.replicas_evicted", float(len(self._evicted))
+        )
 
     def sample_fleet(self) -> dict:
         """Aggregate per-replica scaling signals (queue pressure, memory
@@ -829,6 +1012,8 @@ class FleetRouter:
             "memory_shedding": shedding,
             "quality_alerting": quality_alerting,
             "scale_up": scale_up,
+            "evicted": sorted(self._evicted),
+            "trust": self._trust.snapshot(),
         }
 
     def openmetrics(self) -> str:

@@ -123,6 +123,12 @@ def save_model(path: str, model, kind: str) -> None:
         ),
         **extras,
     )
+    # content-digest sidecar (resilience/integrity.py): load_model and the
+    # serve registry refuse a bit-rotted artifact with a classified error
+    # instead of serving whatever a flipped bit deserializes to
+    from spark_gp_tpu.resilience import integrity
+
+    integrity.write_sidecar(_normalize(path))
 
 
 def load_model(path: str):
@@ -130,7 +136,12 @@ def load_model(path: str):
     from spark_gp_tpu.models.gpc_mc import GaussianProcessMulticlassModel
     from spark_gp_tpu.models.gp_poisson import GaussianProcessPoissonModel
     from spark_gp_tpu.models.gpr import GaussianProcessRegressionModel
+    from spark_gp_tpu.resilience import integrity
 
+    # digest-gate FIRST: a corrupted artifact must fail with its sidecar
+    # named (CheckpointCorruptError, code=model_sidecar_digest_mismatch),
+    # not as a pickle/npz error — or worse, load cleanly with wrong bytes
+    integrity.verify_sidecar(_normalize(path))
     with np.load(_normalize(path), allow_pickle=False) as data:
         # version-gate FIRST: a future layout must fail here with its
         # version named, not as an arbitrary KeyError below

@@ -686,6 +686,76 @@ def test_plain_fit_checkpoints_stay_local_on_clusters(tmp_path):
     assert not [k for k in store.kv if k.startswith("ag/")], store.kv.keys()
 
 
+def _ledger(events):
+    from spark_gp_tpu.parallel.coord import LivenessLedger
+
+    return LivenessLedger(
+        straggler_after_s=3.0, dead_after_s=10.0,
+        on_straggler=lambda i, age: events.append(("straggler", i)),
+        on_dead=lambda i, age: events.append(("dead", i)),
+        on_recover=lambda i: events.append(("recover", i)),
+    )
+
+
+def test_liveness_ledger_recovered_peer_reescalates():
+    """recovery clears the flag COMPLETELY: a peer that recovers and then
+    goes quiet again must re-walk the straggler → dead escalation (and
+    fire the callbacks again) — a one-shot flag would make the second
+    outage invisible."""
+    events = []
+    ledger = _ledger(events)
+    ledger.observe(0.0, {"r1": 1})
+    ledger.observe(4.0, {"r1": 1})  # stamp unchanged past the bar
+    assert ledger.stragglers() == ["r1"]
+    ledger.observe(5.0, {"r1": 2})  # fresh stamp: recovered
+    assert ledger.stragglers() == [] and ledger.dead() == []
+    ledger.observe(9.1, {"r1": 2})  # quiet again, 4.1 s past the stamp
+    assert ledger.stragglers() == ["r1"]
+    ledger.observe(16.0, {"r1": 2})
+    assert ledger.dead() == ["r1"]
+    assert events == [
+        ("straggler", "r1"), ("recover", "r1"),
+        ("straggler", "r1"), ("dead", "r1"),
+    ]
+
+
+def test_liveness_ledger_dead_before_first_stamp():
+    """An EXPECTED peer that never stamps must still escalate: seeding at
+    first sight is what keeps a process that died before its first
+    heartbeat from reading as healthy forever."""
+    events = []
+    ledger = _ledger(events)
+    ledger.observe(0.0, {"r0": 1}, expected=("r0", "r1"))
+    assert ledger.dead() == []
+    ledger.observe(11.0, {"r0": 2}, expected=("r0", "r1"))
+    assert ledger.dead() == ["r1"]
+    assert ledger.stragglers() == []  # r0 kept stamping
+    assert ("dead", "r1") in events
+    # re-seeding an already-tracked identity must not reset its age
+    assert ledger.last_seen()["r1"] == (-1, 0.0)
+
+
+def test_liveness_ledger_stamp_counter_rollover_counts_as_seen():
+    """A restarted peer's stamp counter starts over BELOW its old value;
+    'seen' is any counter CHANGE, not an increase — otherwise a restart
+    reads as silence until the new counter passes the old one."""
+    events = []
+    ledger = _ledger(events)
+    ledger.observe(0.0, {"r1": 997})
+    ledger.observe(4.0, {"r1": 997})
+    assert ledger.stragglers() == ["r1"]
+    ledger.observe(5.0, {"r1": 1})  # restarted: counter rolled over
+    assert ledger.stragglers() == [] and ledger.dead() == []
+    assert ("recover", "r1") in events
+    assert ledger.last_seen()["r1"] == (1, 5.0)
+    # forget drops the identity entirely: a politely-deregistered member
+    # must not re-enter the scan as dead
+    ledger.observe(20.0, {})
+    assert ledger.dead() == ["r1"]
+    ledger.forget("r1")
+    assert ledger.dead() == [] and "r1" not in ledger.last_seen()
+
+
 def test_liveness_snapshot_none_single_process():
     assert coord.liveness_snapshot() is None
 

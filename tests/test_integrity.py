@@ -1,0 +1,656 @@
+"""Numerical integrity plane (resilience/integrity.py): SDC defense.
+
+Silent data corruption — a host or device computing WRONG numbers while
+heartbeating on time — is invisible to every liveness surface this repo
+already has.  This file proves the defense layers on CPU with the
+deterministic chaos injectors (``chaos.corrupt_host`` /
+``corrupt_device`` / ``corrupt_replica``):
+
+* attested collectives: every DCN payload carries a content digest +
+  identity + round binding, verified on every host before the
+  deterministic sum — corruption is attributed to the PUBLISHING pid;
+* value-level magnitude attestation on array gathers (internally
+  consistent bytes from a corrupted compute still get caught);
+* duplicate-dispatch spot checks during DCN fits: a sampled round makes
+  the target host republish one expert block and its claimed (NLL,
+  |grad|); every host recomputes from the published bytes and the trust
+  ledger quarantines the minority — the fit stops with a CLASSIFIED
+  ``sdc`` error naming the pid, never a silent wrong answer;
+* the blocked sharded Cholesky's redundancy tripwire (replicated
+  diagonal panels digest-compared across devices);
+* serve-side cross-replica answer verification: a corrupt replica's
+  (μ, σ²) is out-voted and the replica evicted from the ring;
+* model-artifact sha256 sidecars refused on digest mismatch;
+* ``GP_INTEGRITY=0`` kills every check and reproduces bit-identical fit
+  results.
+"""
+
+import glob
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+from spark_gp_tpu.parallel import coord
+from spark_gp_tpu.parallel.coord import (
+    DcnContext,
+    InProcessCoordClient,
+    InProcessCoordStore,
+)
+from spark_gp_tpu.resilience import chaos, integrity
+from spark_gp_tpu.resilience.fallback import SDC, classify_failure
+
+
+def _counter(key):
+    from spark_gp_tpu.obs.runtime import telemetry
+
+    return telemetry.counters.get(key, 0.0)
+
+
+# -- attestation format ----------------------------------------------------
+
+
+def test_seal_unseal_roundtrip_and_passthrough():
+    payload = b"\x00\x01expert-bytes" * 7
+    sealed = integrity.seal("vag/3", 1, payload)
+    assert sealed.startswith(b"GPIA1\n")
+    assert integrity.unseal("vag/3", 1, sealed) == payload
+    # unsealed blobs pass through: peers running GP_INTEGRITY=0 (or
+    # direct kv_allgather users outside the plane) interoperate
+    assert integrity.unseal("vag/3", 1, payload) == payload
+
+
+def test_unseal_attributes_every_failure_mode():
+    sealed = integrity.seal("vag/3", 1, b"payload")
+    flipped = bytearray(sealed)
+    flipped[-1] ^= 1  # last byte = payload, not header
+    with pytest.raises(integrity.AttestationError) as err:
+        integrity.unseal("vag/3", 1, bytes(flipped))
+    assert err.value.code == "digest_mismatch" and err.value.pid == 1
+
+    with pytest.raises(integrity.AttestationError) as err:
+        integrity.unseal("vag/3", 0, sealed)  # read from the wrong slot
+    assert err.value.code == "identity_mismatch"
+
+    with pytest.raises(integrity.AttestationError) as err:
+        integrity.unseal("vag/4", 1, sealed)  # round-4 read of a round-3 seal
+    assert err.value.code == "stale_replay"
+    # every integrity error classifies as the sdc failure class
+    assert classify_failure(err.value) == SDC
+
+
+def test_bounds_violation_flags_finite_magnitudes_only():
+    # non-finite values pass: the DCN plane exchanges them deliberately
+    # (synchronized per-expert recovery owns that failure mode)
+    assert not integrity.bounds_violation([np.array([np.inf, np.nan, 1.0])])
+    assert not integrity.bounds_violation([np.array([1e17, -1e17])])
+    assert integrity.bounds_violation([np.array([1.0, -1e19])])
+
+
+def test_tolerance_ladder_rungs():
+    a = np.array([1.0, -2.0, 3.5])
+    assert integrity.ladder_rung(a, a.copy()) == "exact"
+    assert integrity.ladder_rung(a, a * (1.0 + 1e-10)) == "tight"
+    assert integrity.ladder_rung(a, a * (1.0 + 1e-7)) == "loose"
+    assert integrity.ladder_rung(a, a * 2.0) is None
+    # matching non-finite patterns agree exactly (the honest case for a
+    # deliberately-exchanged non-finite round)
+    nonf = np.array([np.inf, 1.0, np.nan])
+    assert integrity.ladder_rung(nonf, nonf.copy()) == "exact"
+    assert integrity.ladder_rung(nonf, np.array([1.0, 1.0, np.nan])) is None
+
+
+def test_spot_check_decisions_are_pure_functions_of_the_round():
+    # lockstep safety: every host must reach the identical decision and
+    # target with no extra coordination round
+    for k in range(8):
+        assert integrity.should_spot_check(k, p=1.0)
+        assert not integrity.should_spot_check(k, p=0.0)
+        assert integrity.spot_check_target(k, 2) == integrity.spot_check_target(k, 2)
+    targets = {integrity.spot_check_target(k, 2) for k in range(64)}
+    assert targets == {0, 1}  # the audit rotates over every host
+    fired = sum(integrity.should_spot_check(k, p=0.25) for k in range(400))
+    assert 50 <= fired <= 150  # hash-uniform around p
+
+
+def test_kill_switch_disables_the_plane(monkeypatch):
+    monkeypatch.setenv("GP_INTEGRITY", "0")
+    assert not integrity.enabled()
+    monkeypatch.setenv("GP_INTEGRITY", "off")
+    assert not integrity.enabled()
+    monkeypatch.delenv("GP_INTEGRITY", raising=False)
+    assert integrity.enabled()
+
+
+# -- trust ledger ----------------------------------------------------------
+
+
+def test_trust_ledger_escalation_repayment_and_terminal_quarantine():
+    events = []
+    ledger = integrity.TrustLedger(
+        quarantine_after_strikes=2,
+        on_suspect=lambda i, r: events.append(("suspect", i, r)),
+        on_quarantined=lambda i, r: events.append(("quarantined", i, r)),
+    )
+    assert ledger.state(7) == integrity.TRUSTED
+    assert ledger.record_disagreement(7, reason="verifier") == integrity.SUSPECT
+    # one agreeing observation repays one strike: transient glitches decay
+    assert ledger.record_clean(7) == integrity.TRUSTED
+    assert ledger.record_disagreement(7) == integrity.SUSPECT
+    assert ledger.record_disagreement(7) == integrity.QUARANTINED
+    # quarantine is terminal (until forget): clean observations cannot
+    # resurrect a host the evidence already convicted
+    assert ledger.record_clean(7) == integrity.QUARANTINED
+    assert ledger.quarantined() == [7]
+    # a definitive verdict (failed digest, contradicted claim) skips the
+    # strike budget entirely
+    assert (
+        ledger.record_disagreement(9, definitive=True, reason="digest")
+        == integrity.QUARANTINED
+    )
+    assert [kind for kind, _, _ in events].count("quarantined") == 2
+    assert ledger.snapshot()["quarantined"] == [7, 9]
+    ledger.forget(7)  # a replaced host re-enters trusted
+    assert ledger.state(7) == integrity.TRUSTED
+
+
+# -- attested collectives under chaos (two logical hosts) ------------------
+
+
+def _pair_ctxs(store, timeout_s=30.0):
+    return [
+        DcnContext(InProcessCoordClient(store, pid, 2), timeout_s=timeout_s)
+        for pid in range(2)
+    ]
+
+
+def _on_pair(ctxs, fn):
+    """Run ``fn(pid, ctx)`` on two lockstep threads; exceptions are
+    collected, not raised."""
+    results = {}
+
+    def run(pid):
+        try:
+            results[pid] = fn(pid, ctxs[pid])
+        except BaseException as exc:  # noqa: BLE001 — collected for asserts
+            results[pid] = exc
+
+    threads = [
+        threading.Thread(target=run, args=(pid,)) for pid in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def test_corrupt_host_bitflip_refused_and_attributed_to_publisher():
+    ctxs = _pair_ctxs(InProcessCoordStore())
+    before = _counter("integrity.attestation_failures")
+    with chaos.corrupt_host(1, kind="bitflip") as fired:
+        results = _on_pair(
+            ctxs,
+            lambda pid, ctx: ctx.allgather_bytes("vag", b"contribution-%d" % pid),
+        )
+    assert fired[0] >= 1
+    for pid in range(2):
+        exc = results[pid]
+        assert isinstance(exc, integrity.AttestationError), exc
+        # attributed to the PUBLISHING host, on every host identically
+        assert exc.pid == 1 and exc.code == "digest_mismatch"
+        assert classify_failure(exc) == SDC
+    assert _counter("integrity.attestation_failures") >= before + 2
+    for ctx in ctxs:
+        assert 1 in ctx.trust.quarantined()
+
+
+def test_corrupt_host_stuck_replay_caught_by_round_binding():
+    ctxs = _pair_ctxs(InProcessCoordStore())
+
+    def two_rounds(pid, ctx):
+        ctx.allgather_bytes("vag", b"round-one-%d" % pid)
+        return ctx.allgather_bytes("vag", b"round-two-%d" % pid)
+
+    with chaos.corrupt_host(1, kind="stuck"):
+        results = _on_pair(ctxs, two_rounds)
+    for pid in range(2):
+        exc = results[pid]
+        assert isinstance(exc, integrity.AttestationError), exc
+        assert exc.pid == 1 and exc.code == "stale_replay"
+
+
+def test_corrupt_host_scale_caught_by_magnitude_attestation():
+    """The wrong-COMPUTE fault: the scale kind corrupts values BEFORE
+    packing/sealing, so digests verify — only the value-level bound
+    catches it at the gather."""
+    ctxs = _pair_ctxs(InProcessCoordStore())
+    with chaos.corrupt_host(1, kind="scale", scale=1e19):
+        results = _on_pair(
+            ctxs, lambda pid, ctx: ctx.allgather_arrays("vag", np.ones(4)),
+        )
+    for pid in range(2):
+        exc = results[pid]
+        assert isinstance(exc, integrity.AttestationError), exc
+        assert exc.pid == 1 and exc.code == "bounds"
+    for ctx in ctxs:
+        assert 1 in ctx.trust.quarantined()
+
+
+def test_clean_gathers_are_transparent_through_the_seal():
+    ctxs = _pair_ctxs(InProcessCoordStore())
+    results = _on_pair(
+        ctxs, lambda pid, ctx: ctx.allreduce_arrays("vag", np.full(3, pid + 1.0)),
+    )
+    for pid in range(2):
+        assert not isinstance(results[pid], BaseException), results[pid]
+        np.testing.assert_array_equal(results[pid][0], np.full(3, 3.0))
+
+
+# -- sharded-Cholesky panel tripwire ---------------------------------------
+
+
+def _spd(m, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=(m, m))
+    return a @ a.T + m * np.eye(m)
+
+
+def test_panel_tripwire_catches_device_corruption(monkeypatch):
+    import jax
+
+    from spark_gp_tpu.ops.dist_linalg import sharded_cholesky
+    from spark_gp_tpu.parallel.mesh import expert_mesh
+
+    monkeypatch.setenv("GP_INTEGRITY_PANEL_SAMPLE", "1.0")
+    mesh = expert_mesh(jax.devices()[:4])
+    a = _spd(64)
+    checks_before = _counter("integrity.panel_checks")
+    # clean checked run: exact factor, tripwire silent
+    l = np.asarray(sharded_cholesky(mesh, a, block=8))
+    np.testing.assert_allclose(
+        np.tril(l), np.linalg.cholesky(a), atol=1e-10
+    )
+    assert _counter("integrity.panel_checks") > checks_before
+    # corrupt ONE device's replicated diagonal-panel copy: the divergence
+    # is detected and attributed to that device
+    with chaos.corrupt_device(2, scale=1e3):
+        with pytest.raises(integrity.PanelMismatchError) as err:
+            sharded_cholesky(mesh, a, block=8)
+    assert err.value.pid == 2 and err.value.code == "panel_divergence"
+    assert classify_failure(err.value) == SDC
+    # kill switch: the unchecked program runs the corruption silently —
+    # exactly the wrong-answer outcome the tripwire exists to prevent
+    monkeypatch.setenv("GP_INTEGRITY", "0")
+    with chaos.corrupt_device(2, scale=1e3):
+        silent = np.asarray(sharded_cholesky(mesh, a, block=8))
+    assert not np.allclose(np.tril(silent), np.linalg.cholesky(a))
+
+
+# -- the fit-side SDC acceptance proof -------------------------------------
+
+
+def _half_rows(pid):
+    rng = np.random.default_rng(100 + pid)
+    n = 144 if pid == 0 else 112
+    x = rng.normal(size=(n, 2))
+    y = np.sin(x.sum(axis=1)) + 0.01 * rng.normal(size=n)
+    return x, y
+
+
+def _host_mesh(pid):
+    # disjoint device halves per logical host (the test_coord idiom):
+    # sharing one mesh between two concurrent collective programs can
+    # interleave XLA rendezvous schedules and deadlock
+    import jax
+
+    from spark_gp_tpu.parallel.mesh import expert_mesh
+
+    devs = jax.devices()
+    half = max(1, len(devs) // 2)
+    return expert_mesh(devs[pid * half:(pid + 1) * half])
+
+
+def _local_stack(pid):
+    from spark_gp_tpu.parallel.experts import group_for_experts
+    from spark_gp_tpu.parallel.mesh import shard_experts
+
+    x, y = _half_rows(pid)
+    mesh = _host_mesh(pid)
+    return shard_experts(group_for_experts(x, y, 16), mesh), mesh
+
+
+def _gp(maxiter=50, ckpt_dir=None):
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    gp = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setActiveSetSize(48)
+        .setMaxIter(maxiter)
+        .setTol(1e-10)
+        .setSeed(3)
+    )
+    if ckpt_dir is not None:
+        gp.setCheckpointDir(str(ckpt_dir))
+    return gp
+
+
+def _dcn_fit(pid, ctx, results, maxiter=50):
+    coord.set_dcn_context_for_testing(ctx)
+    try:
+        data, mesh = _local_stack(pid)
+        results[pid] = _gp(maxiter).setMesh(mesh).fit_distributed(data)
+    except BaseException as exc:  # noqa: BLE001 — collected for asserts
+        results[pid] = exc
+    finally:
+        coord.set_dcn_context_for_testing(None)
+
+
+def _run_dcn_fit_pair(ctxs, maxiter=50):
+    results = {}
+    threads = [
+        threading.Thread(target=_dcn_fit, args=(pid, ctxs[pid], results, maxiter))
+        for pid in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def _union_fit(maxiter=50):
+    """The recovery leg: one process fits the union of both hosts' rows —
+    the fleet resumed WITHOUT the corrupted host's involvement."""
+    import jax.numpy as jnp
+
+    from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+    from spark_gp_tpu.parallel.mesh import expert_mesh, shard_experts
+
+    mesh = expert_mesh()
+    stacks = []
+    for pid in range(2):
+        x, y = _half_rows(pid)
+        stacks.append(shard_experts(group_for_experts(x, y, 16), _host_mesh(pid)))
+    union = ExpertData(
+        x=jnp.asarray(np.concatenate([np.asarray(s.x) for s in stacks])),
+        y=jnp.asarray(np.concatenate([np.asarray(s.y) for s in stacks])),
+        mask=jnp.asarray(np.concatenate([np.asarray(s.mask) for s in stacks])),
+    )
+    return _gp(maxiter).setMesh(mesh).fit_distributed(shard_experts(union, mesh))
+
+
+def test_sdc_fit_corrupt_host_quarantined_never_silent(monkeypatch, tmp_path):
+    """THE fit-side acceptance proof: a 2-host DCN fit where host 1's
+    compute is silently corrupted (scale fault — internally consistent
+    bytes, valid digests) must NOT complete with a wrong answer.  The
+    duplicate-dispatch spot check catches the disagreement, the trust
+    ledger quarantines pid 1 on EVERY host identically, the fit stops
+    with a classified ``sdc`` error naming the pid, an incident bundle
+    records the verdict — and the fleet minus the corrupted host
+    reproduces the clean twin's NLL."""
+    monkeypatch.setenv("GP_INTEGRITY_DUPCHECK_P", "1.0")  # audit every round
+    monkeypatch.setenv("GP_INCIDENT_DIR", str(tmp_path / "incidents"))
+
+    # clean twin: the uncorrupted reference fit
+    ref = _run_dcn_fit_pair(_pair_ctxs(InProcessCoordStore()))
+    for pid in range(2):
+        assert not isinstance(ref[pid], BaseException), ref[pid]
+    nll_ref = ref[0].instr.metrics["final_nll"]
+
+    # corrupted twin: host 1 publishes silently-scaled values everywhere
+    quarantined_before = _counter("integrity.host_quarantined")
+    with chaos.corrupt_host(1, kind="scale", scale=32.0) as fired:
+        results = _run_dcn_fit_pair(_pair_ctxs(InProcessCoordStore()))
+    assert fired[0] >= 1
+    for pid in range(2):
+        exc = results[pid]
+        assert isinstance(exc, integrity.HostQuarantinedError), exc
+        assert exc.pid == 1
+        assert classify_failure(exc) == SDC
+    assert _counter("integrity.host_quarantined") >= quarantined_before + 1
+
+    # the incident bundle names the corrupted pid and the sdc class
+    bundles = glob.glob(str(tmp_path / "incidents" / "*.json"))
+    assert bundles, "terminal sdc failure must dump an incident bundle"
+    dumped = " ".join(open(p).read() for p in bundles)
+    assert '"sdc"' in dumped and "pid 1" in dumped
+
+    # recovery: the fleet without the corrupted host lands on the clean
+    # twin's answer (same global data, elastic-counted single process)
+    resumed = _union_fit()
+    nll_resumed = resumed.instr.metrics["final_nll"]
+    assert abs(nll_resumed - nll_ref) <= 5e-3 * max(1.0, abs(nll_ref)), (
+        nll_resumed, nll_ref,
+    )
+    x0, y0 = _half_rows(0)
+    rmse = float(np.sqrt(np.mean((resumed.predict(x0) - y0) ** 2)))
+    assert rmse < 0.15, rmse
+
+
+def test_integrity_off_fit_is_bit_identical(monkeypatch):
+    """GP_INTEGRITY=0 is a true kill switch: the attested fit and the
+    unattested fit produce bit-identical thetas and predictions (the
+    plane observes; it never perturbs)."""
+    on = _run_dcn_fit_pair(_pair_ctxs(InProcessCoordStore()), maxiter=20)
+    assert not isinstance(on[0], BaseException), on[0]
+    monkeypatch.setenv("GP_INTEGRITY", "0")
+    off = _run_dcn_fit_pair(_pair_ctxs(InProcessCoordStore()), maxiter=20)
+    assert not isinstance(off[0], BaseException), off[0]
+    np.testing.assert_array_equal(
+        on[0].raw_predictor.theta, off[0].raw_predictor.theta
+    )
+    probe = np.random.default_rng(5).normal(size=(16, 2))
+    np.testing.assert_array_equal(on[0].predict(probe), off[0].predict(probe))
+
+
+# -- serve-side answer verification ----------------------------------------
+
+
+def _fit_small(seed=3, n=160):
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 3))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=n)
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(40)
+        .setActiveSetSize(40)
+        .setSigma2(1e-3)
+        .setMaxIter(8)
+        .setSeed(seed)
+        .fit(x, y)
+    )
+    return model, x
+
+
+@pytest.fixture(scope="module")
+def small_model(tmp_path_factory):
+    model, x = _fit_small()
+    path = str(tmp_path_factory.mktemp("integrity") / "model.npz")
+    model.save(path)
+    return path, model, x
+
+
+def _three_replica_fleet(path, hedge_after_s=None):
+    from spark_gp_tpu.serve import GPServeServer
+    from spark_gp_tpu.serve.fleet import FleetMembership, LocalReplica
+    from spark_gp_tpu.serve.router import FleetRouter
+
+    store = InProcessCoordStore()
+    membership = FleetMembership(
+        InProcessCoordClient(store, 0, 1), fleet="integ",
+        interval_s=0.05, straggler_after_s=0.15, dead_after_s=0.35,
+    )
+    replicas = []
+    for i in range(3):
+        server = GPServeServer(
+            max_batch=16, min_bucket=8, max_wait_ms=1.0, capacity=256,
+            request_timeout_ms=10_000.0, replica_id=f"r{i}",
+        )
+        server.register("m", path)
+        server.start()
+        replica = LocalReplica(server, f"r{i}", membership)
+        replica.register()
+        replicas.append(replica)
+    router = FleetRouter(
+        membership,
+        transports={r.replica_id: r.transport for r in replicas},
+        max_batch=16, min_bucket=8, default_timeout_ms=10_000.0,
+        hedge_after_s=hedge_after_s, poll_interval_s=0.0,
+    )
+    return replicas, router
+
+
+def test_sdc_serve_corrupt_replica_outvoted_and_evicted(
+    small_model, monkeypatch,
+):
+    """THE serve-side acceptance proof: one of three replicas serves
+    silently wrong answers while heartbeating healthily.  With every
+    request verified (fraction 1.0), the mismatch is caught within the
+    sampling budget, the corrupt replica is out-voted two-to-one and
+    evicted from the ring — and ZERO verified requests return a
+    mismatched answer (the client always gets the majority)."""
+    monkeypatch.setenv("GP_INTEGRITY_SERVE_FRACTION", "1.0")
+    path, model, x = small_model
+    replicas, router = _three_replica_fleet(path)
+    by_id = {r.replica_id: r for r in replicas}
+    try:
+        probe = x[:4]
+        mean_ref = np.asarray(model.predict(probe))
+        # corrupt the replica that OWNS this key, so its wrong answer is
+        # the one every un-verified request would have returned
+        owner = router.route("m", probe.shape[0])[0]
+        corrupting = chaos.corrupt_replica(by_id[owner], factor=1e3)
+        evicted_before = _counter("integrity.replica_evicted")
+        answers = [router.predict("m", probe)[0] for _ in range(6)]
+        # zero mismatched answers: every verified request returned the
+        # honest majority, including those the corrupt owner answered
+        for mean in answers:
+            np.testing.assert_allclose(mean, mean_ref, rtol=1e-6)
+        assert corrupting.calls >= 1  # the corrupted path actually served
+        fleet = router.sample_fleet()
+        assert owner in fleet["evicted"]
+        assert fleet["trust"]["quarantined"] == [owner]
+        assert _counter("integrity.replica_evicted") >= evicted_before + 1
+        assert _counter("integrity.replica_mismatch") >= 1
+        # post-eviction traffic routes around the corrupt replica
+        served_before = corrupting.calls
+        for _ in range(4):
+            mean, _ = router.predict("m", probe)
+            np.testing.assert_allclose(mean, mean_ref, rtol=1e-6)
+        assert corrupting.calls == served_before
+    finally:
+        for r in replicas:
+            r.server.stop()
+        router.close()
+
+
+def test_serve_verification_never_evicts_the_last_replica(
+    small_model, monkeypatch,
+):
+    monkeypatch.setenv("GP_INTEGRITY_SERVE_FRACTION", "1.0")
+    path, model, x = small_model
+    replicas, router = _three_replica_fleet(path)
+    try:
+        # quarantine every replica by hand: only two may actually leave
+        # the ring — a degraded answer beats no answer
+        for r in replicas:
+            router._trust.record_disagreement(
+                r.replica_id, definitive=True, reason="test"
+            )
+        assert len(router.sample_fleet()["evicted"]) == 2
+        mean, _ = router.predict("m", x[:2])
+        np.testing.assert_allclose(
+            mean, np.asarray(model.predict(x[:2])), rtol=1e-6
+        )
+    finally:
+        for r in replicas:
+            r.server.stop()
+        router.close()
+
+
+def test_serve_verification_off_by_kill_switch(small_model, monkeypatch):
+    monkeypatch.setenv("GP_INTEGRITY", "0")
+    monkeypatch.setenv("GP_INTEGRITY_SERVE_FRACTION", "1.0")
+    path, model, x = small_model
+    replicas, router = _three_replica_fleet(path)
+    by_id = {r.replica_id: r for r in replicas}
+    try:
+        probe = x[:4]
+        owner = router.route("m", probe.shape[0])[0]
+        corrupting = chaos.corrupt_replica(by_id[owner], factor=1e3)
+        mean, _ = router.predict("m", probe)
+        # the silent wrong answer: exactly what GP_INTEGRITY=0 buys back
+        assert corrupting.calls >= 1
+        assert not np.allclose(mean, np.asarray(model.predict(probe)))
+        assert router.sample_fleet()["evicted"] == []
+    finally:
+        for r in replicas:
+            r.server.stop()
+        router.close()
+
+
+# -- model-artifact sidecars -----------------------------------------------
+
+
+def test_artifact_sidecar_roundtrip_and_corruption(small_model, tmp_path):
+    from spark_gp_tpu.utils.checkpoint import CheckpointCorruptError
+    from spark_gp_tpu.utils.serialization import load_model
+
+    path, model, x = small_model
+    assert os.path.exists(path + integrity.SIDECAR_SUFFIX)
+    verified_before = _counter("integrity.artifact_verified")
+    loaded = load_model(path)
+    assert _counter("integrity.artifact_verified") >= verified_before + 1
+    np.testing.assert_allclose(
+        np.asarray(loaded.predict(x[:4])), np.asarray(model.predict(x[:4]))
+    )
+    # swap in DIFFERENT valid model bytes under the same sidecar: the
+    # digest gate refuses before np.load can deserialize wrong bytes
+    other, _ = _fit_small(seed=11, n=120)
+    corrupt_path = str(tmp_path / "corrupt.npz")
+    other.save(corrupt_path)
+    victim = str(tmp_path / "victim.npz")
+    shutil.copy(path, victim)
+    shutil.copy(path + integrity.SIDECAR_SUFFIX, victim + integrity.SIDECAR_SUFFIX)
+    shutil.copy(corrupt_path, victim)
+    with pytest.raises(CheckpointCorruptError) as err:
+        load_model(victim)
+    assert err.value.code == integrity.ARTIFACT_DIGEST_CODE
+    assert integrity.ARTIFACT_DIGEST_CODE in str(err.value)
+
+
+def test_artifact_sidecar_registry_and_kill_switch(
+    small_model, tmp_path, monkeypatch,
+):
+    from spark_gp_tpu.serve import ModelRegistry
+    from spark_gp_tpu.utils.checkpoint import CheckpointCorruptError
+    from spark_gp_tpu.utils.serialization import load_model
+
+    path, model, x = small_model
+    victim = str(tmp_path / "victim.npz")
+    other, _ = _fit_small(seed=11, n=120)
+    other.save(victim)
+    # stamp a sidecar from the ORIGINAL artifact over the other's bytes
+    shutil.copy(path + integrity.SIDECAR_SUFFIX, victim + integrity.SIDECAR_SUFFIX)
+    # the serve registry refuses the corrupted artifact at bind time
+    reg = ModelRegistry(max_batch=16, min_bucket=8)
+    with pytest.raises(CheckpointCorruptError):
+        reg.register("victim", victim)
+    # legacy artifacts (no sidecar) load without complaint
+    os.remove(victim + integrity.SIDECAR_SUFFIX)
+    other.save(victim)
+    os.remove(victim + integrity.SIDECAR_SUFFIX)
+    assert load_model(victim) is not None
+    # kill switch: the corrupted pair loads (operator's explicit choice)
+    other.save(victim)
+    shutil.copy(path + integrity.SIDECAR_SUFFIX, victim + integrity.SIDECAR_SUFFIX)
+    monkeypatch.setenv("GP_INTEGRITY", "0")
+    assert load_model(victim) is not None

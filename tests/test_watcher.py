@@ -90,10 +90,10 @@ def test_run_timeout_records_both_streams(tmp_path, monkeypatch):
         [sys.executable, "-c",
          "import sys, time; print('partial'); sys.stdout.flush(); "
          "print('diag', file=sys.stderr); sys.stderr.flush(); time.sleep(120)"],
-        "SLOW.json", 20,
+        "SLOW.json", 10,
     )
     envelope = json.load(open(tmp_path / "SLOW.json"))
-    assert envelope["timed_out_after_s"] == 20
+    assert envelope["timed_out_after_s"] == 10
     assert "partial" in envelope["stdout_tail"]
     assert "diag" in envelope["stderr_tail"]
 

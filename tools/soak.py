@@ -83,6 +83,15 @@ SCENARIOS = (
     # quality.alert.* / drift.alert.* verdict within <= 512 observations
     "quality_miscalibrated",
     "quality_drift",
+    # silent data corruption (resilience/integrity.py): a 2-host DCN fit
+    # where one host's compute silently scales every published value —
+    # the duplicate-dispatch spot check must quarantine the corrupted pid
+    # with ONE classified ``sdc`` error per host (never a silent wrong
+    # answer); and a 3-replica fleet where the ring owner serves silently
+    # wrong posteriors while heartbeating — answer verification must
+    # out-vote and evict it with zero mismatched answers reaching clients
+    "sdc_fit",
+    "sdc_serve",
 )
 
 #: per-scenario tolerance on |pred - clean_pred|: execution-environment
@@ -117,6 +126,10 @@ SCENARIO_TOL = {
     # predictions (the serve_flaky pattern): delta is identically zero
     "quality_miscalibrated": 1e-6,
     "quality_drift": 1e-6,
+    # sdc campaigns assert internally and hand back the reference
+    # predictions (the serve_flaky pattern): delta is identically zero
+    "sdc_fit": 1e-6,
+    "sdc_serve": 1e-6,
 }
 _DATA_FAULT_TOL = 10.0
 
@@ -619,6 +632,198 @@ def _run_fleet_campaign(rng, x, y, ref_model, expert, mode: str) -> None:
                     pass            # the campaign verdict being unwound
 
 
+def _run_sdc_fit_campaign(rng, x, y, expert: int, incident_tmp: str) -> None:
+    """Silent-data-corruption fit campaign (resilience/integrity.py):
+    a 2-host DCN-fallback fit where host 1's compute silently scales
+    every published value (internally consistent bytes — digests verify,
+    only value-level checks can notice).  Invariant: the duplicate-
+    dispatch spot check quarantines pid 1 on BOTH hosts with a
+    classified ``sdc`` error and a schema-valid incident bundle naming
+    the pid — a completed fit here IS the violation (the silent wrong
+    answer the plane exists to prevent)."""
+    import glob as _glob
+
+    import jax
+    import numpy as np
+
+    from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+    from spark_gp_tpu.obs.recorder import validate_bundle
+    from spark_gp_tpu.parallel import coord
+    from spark_gp_tpu.parallel.coord import (
+        DcnContext,
+        InProcessCoordClient,
+        InProcessCoordStore,
+    )
+    from spark_gp_tpu.parallel.experts import group_for_experts
+    from spark_gp_tpu.parallel.mesh import expert_mesh, shard_experts
+    from spark_gp_tpu.resilience import chaos, fallback, integrity
+
+    devs = jax.devices()
+    half = len(devs) // 2
+    rows = x.shape[0] // 2
+
+    def host_fit(pid: int, ctx, results: dict) -> None:
+        coord.set_dcn_context_for_testing(ctx)
+        try:
+            # disjoint device halves per logical host where the harness
+            # provides them (the test_coord idiom); the single-device CLI
+            # harness runs both hosts' programs on the one device
+            mesh = expert_mesh(
+                devs[pid * half:(pid + 1) * half] if half else devs
+            )
+            lo = pid * rows
+            data = shard_experts(
+                group_for_experts(x[lo:lo + rows], y[lo:lo + rows], expert),
+                mesh,
+            )
+            gp = (
+                GaussianProcessRegression()
+                .setKernel(lambda: RBFKernel(0.1))
+                .setDatasetSizeForExpert(expert)
+                .setActiveSetSize(expert)
+                .setSeed(13)
+                .setSigma2(1e-3)
+                .setMaxIter(3)
+                .setMesh(mesh)
+            )
+            results[pid] = gp.fit_distributed(data)
+        except BaseException as exc:  # noqa: BLE001 — the verdict under test
+            results[pid] = exc
+        finally:
+            coord.set_dcn_context_for_testing(None)
+
+    prev_p = os.environ.get("GP_INTEGRITY_DUPCHECK_P")
+    os.environ["GP_INTEGRITY_DUPCHECK_P"] = "1.0"  # audit every round
+    try:
+        store = InProcessCoordStore()
+        ctxs = [
+            DcnContext(InProcessCoordClient(store, pid, 2), timeout_s=60.0)
+            for pid in range(2)
+        ]
+        results: dict = {}
+        with chaos.corrupt_host(1, kind="scale", scale=32.0) as fired:
+            threads = [
+                threading.Thread(target=host_fit, args=(pid, ctxs[pid], results))
+                for pid in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if not fired[0]:
+            raise Violation("sdc fault never fired")
+        for pid in range(2):
+            exc = results[pid]
+            if not isinstance(exc, BaseException):
+                raise Violation(
+                    f"host {pid} COMPLETED under silent corruption — "
+                    "the silent wrong answer the integrity plane must prevent"
+                )
+            if not isinstance(exc, integrity.HostQuarantinedError):
+                raise Violation(
+                    f"host {pid} failed with {type(exc).__name__} ({exc}), "
+                    "not a quarantine verdict"
+                )
+            if exc.pid != 1:
+                raise Violation(
+                    f"quarantine named pid {exc.pid}, not the corrupted host"
+                )
+            if fallback.classify_failure(exc) != fallback.SDC:
+                raise Violation("quarantine verdict not classified sdc")
+        # each host's terminal failure dumped its own bundle (same pid,
+        # may collide on a same-millisecond filename: assert >= 1); all
+        # must be schema-valid and name the sdc class + the pid — then
+        # consume them, since this campaign's own verdict is "ok"
+        bundles = sorted(
+            _glob.glob(os.path.join(incident_tmp, "incident_*.json"))
+        )
+        if not bundles:
+            raise Violation("sdc quarantine produced no incident bundle")
+        for path in bundles:
+            with open(path, encoding="utf-8") as fh:
+                bundle = json.load(fh)
+            problems = validate_bundle(bundle)
+            if problems:
+                raise Violation(f"sdc incident bundle fails schema: {problems}")
+            if bundle.get("failure_class") != "sdc":
+                raise Violation(
+                    f"bundle failure_class {bundle.get('failure_class')!r}"
+                )
+            if "pid 1" not in bundle.get("error", ""):
+                raise Violation("bundle error does not name the corrupted pid")
+            os.remove(path)
+    finally:
+        if prev_p is None:
+            os.environ.pop("GP_INTEGRITY_DUPCHECK_P", None)
+        else:
+            os.environ["GP_INTEGRITY_DUPCHECK_P"] = prev_p
+
+
+def _run_sdc_serve_campaign(rng, x, ref_model) -> None:
+    """Silent-data-corruption serve campaign: the ring owner serves
+    silently wrong posteriors (means x1000) while heartbeating healthily
+    — invisible to liveness by construction.  With every request
+    verified, answer verification must out-vote the corrupt replica,
+    evict it from the ring, and let ZERO mismatched answers reach a
+    client."""
+    import tempfile as _tf
+
+    import numpy as np
+
+    from spark_gp_tpu.resilience import chaos
+
+    prev_frac = os.environ.get("GP_INTEGRITY_SERVE_FRACTION")
+    os.environ["GP_INTEGRITY_SERVE_FRACTION"] = "1.0"  # verify every answer
+    try:
+        with _tf.TemporaryDirectory() as tmp:
+            store, membership, replicas, router, path = _fleet_rig(
+                ref_model, tmp
+            )
+            by_id = {r.replica_id: r for r in replicas}
+            try:
+                for replica in replicas:
+                    replica.heartbeat()
+                sz = 4
+                # corrupt the replica OWNING the request key: its wrong
+                # answer is the one every unverified request would return
+                owner = router.route("fleet", sz)[0]
+                corrupting = chaos.corrupt_replica(by_id[owner], factor=1e3)
+                for _ in range(8):
+                    for replica in replicas:
+                        replica.heartbeat()
+                    row = int(rng.integers(0, max(1, x.shape[0] - 16)))
+                    mean, _ = router.predict("fleet", x[row: row + sz])
+                    honest = np.asarray(ref_model.predict(x[row: row + sz]))
+                    if not np.allclose(
+                        np.asarray(mean), honest, rtol=1e-2, atol=1e-6
+                    ):
+                        raise Violation(
+                            "a verified request returned a mismatched answer"
+                        )
+                if corrupting.calls == 0:
+                    raise Violation("corrupt replica never served")
+                fleet = router.sample_fleet()
+                if owner not in fleet["evicted"]:
+                    raise Violation(
+                        "corrupt replica never evicted "
+                        f"(evicted={fleet['evicted']})"
+                    )
+                if router.metrics.counter("router.failed") != 0:
+                    raise Violation("sdc serve campaign lost requests")
+            finally:
+                router.close()
+                for replica in replicas:
+                    try:
+                        replica.stop()
+                    except Exception:  # noqa: BLE001 — teardown must not
+                        pass            # mask the campaign verdict
+    finally:
+        if prev_frac is None:
+            os.environ.pop("GP_INTEGRITY_SERVE_FRACTION", None)
+        else:
+            os.environ["GP_INTEGRITY_SERVE_FRACTION"] = prev_frac
+
+
 def _assert_incident_invariant(incident_tmp: str, outcome: str) -> None:
     """The forensics invariant (obs/recorder.py): a campaign that ended in
     a single classified error produced EXACTLY ONE schema-valid incident
@@ -865,6 +1070,12 @@ def _run_campaign_body(
             _run_quality_campaign(
                 rng, x, ref_model, scenario.split("_", 1)[1]
             )
+            pred = ref_pred
+        elif scenario == "sdc_fit":
+            _run_sdc_fit_campaign(rng, x, y, expert, incident_tmp)
+            pred = ref_pred
+        elif scenario == "sdc_serve":
+            _run_sdc_serve_campaign(rng, x, ref_model)
             pred = ref_pred
         elif scenario == "guard_degrade":
             from spark_gp_tpu.ops import precision
