@@ -1019,22 +1019,28 @@ def worker() -> None:
 
             exact_rate = evals_per_sec("exact")
             iter_rate = evals_per_sec("iterative")
+            matfree_rate = evals_per_sec("matfree")
             itemsize = int(np.dtype(np.asarray(data_s.x).dtype).itemsize)
             per_size[str(s)] = {
                 "experts": n_experts,
                 "nll_evals_per_sec": {
                     "exact": exact_rate, "iterative": iter_rate,
+                    "matfree": matfree_rate,
                 },
                 "speedup": iter_rate / exact_rate,
                 # analytic peak-byte rows (resilience/memplan.py): the
                 # exact native dispatch's factor-stack liveness vs the
-                # iterative rung's skinny CG workspace
+                # iterative rung's skinny CG workspace vs the matfree
+                # rung's gram-less streaming footprint
                 "modeled_fit_bytes": {
                     "exact_native": memplan.fit_dispatch_bytes(
                         n_experts, s, 3, itemsize, "native"
                     ),
                     "iterative": memplan.fit_dispatch_bytes(
                         n_experts, s, 3, itemsize, "iterative"
+                    ),
+                    "matfree": memplan.fit_dispatch_bytes(
+                        n_experts, s, 3, itemsize, "matfree"
                     ),
                 },
             }
@@ -1052,6 +1058,23 @@ def worker() -> None:
             "exact_fits": bool(
                 memplan.predicted_bytes(big["exact_native"]) <= budget
             ),
+            "matfree_fits": bool(
+                memplan.predicted_bytes(big["matfree"]) <= budget
+            ),
+        }
+        # the matfree demo: a TIGHTER budget — 1.5x headroom over the
+        # matfree prediction — still admits the gram-less streaming rung
+        # while the iterative rung's [E, s, s] gram stack is predicted
+        # over it; this is the O(E*s^2) ceiling the lane breaks
+        tight = 1.5 * memplan.predicted_bytes(big["matfree"])
+        per_size[largest]["matfree_budget_demo"] = {
+            "budget_bytes": tight,
+            "matfree_fits": bool(
+                memplan.predicted_bytes(big["matfree"]) <= tight
+            ),
+            "iterative_fits": bool(
+                memplan.predicted_bytes(big["iterative"]) <= tight
+            ),
         }
 
         # fitted-theta parity: one small host-optimizer GPR fit per lane
@@ -1066,7 +1089,8 @@ def worker() -> None:
         yp_s = np.sin(xp_s.sum(axis=1)) + 0.05 * rng_s.normal(size=par_n)
         thetas = {}
         solver_metrics = {}
-        for lane in ("exact", "iterative"):
+        solver_metrics_matfree = {}
+        for lane in ("exact", "iterative", "matfree"):
             prev = it_ops.set_solver_lane(lane)
             try:
                 m_l = (
@@ -1088,6 +1112,11 @@ def worker() -> None:
                     k: v for k, v in m_l.instr.metrics.items()
                     if k == "solver_lane" or k.startswith("solver.")
                 }
+            elif lane == "matfree":
+                solver_metrics_matfree = {
+                    k: v for k, v in m_l.instr.metrics.items()
+                    if k == "solver_lane" or k.startswith("solver.")
+                }
         theta_scale = max(float(np.max(np.abs(thetas["exact"]))), 1e-12)
         return {
             "sizes": per_size,
@@ -1096,21 +1125,31 @@ def worker() -> None:
             "fitted_theta": {
                 "exact": [float(v) for v in thetas["exact"]],
                 "iterative": [float(v) for v in thetas["iterative"]],
+                "matfree": [float(v) for v in thetas["matfree"]],
                 "rel_delta": float(
                     np.max(np.abs(thetas["exact"] - thetas["iterative"]))
                     / theta_scale
                 ),
+                "rel_delta_matfree": float(
+                    np.max(np.abs(thetas["exact"] - thetas["matfree"]))
+                    / theta_scale
+                ),
             },
             "solver_metrics": solver_metrics,
+            "solver_metrics_matfree": solver_metrics_matfree,
             "note": (
                 "exact = one batched [E, s, s] Cholesky per evaluation; "
                 "iterative = multi-RHS preconditioned CG + SLQ log-det "
-                "over the same gram stack (GP_SOLVER_LANE, "
-                "ops/iterative.py).  Speedup grows with s (O(s^3) vs "
-                "O(t s^2)); the contract bar is >= 1.3x at the largest "
-                "probed s on CPU, theta parity within the documented "
-                "5e-2 stochastic bar, and the memory model admitting "
-                "the iterative rung under a budget native exceeds."
+                "over the same gram stack; matfree = the same CG/SLQ "
+                "program with the gram never materialized — the matvec "
+                "streams row tiles through ops/pallas_matvec.py "
+                "(GP_SOLVER_LANE, ops/iterative.py).  Speedup grows "
+                "with s (O(s^3) vs O(t s^2)); the contract bar is >= "
+                "1.3x at the largest probed s on CPU, theta parity "
+                "within the documented 5e-2 stochastic bar, the memory "
+                "model admitting the iterative rung under a budget "
+                "native exceeds, and the matfree rung under a tighter "
+                "budget the iterative gram stack exceeds."
             ),
         }
 

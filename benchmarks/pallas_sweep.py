@@ -87,6 +87,61 @@ def sweep(sizes=(32, 64, 100, 128, 200, 256, 512), iters: int = 20,
     return rows
 
 
+def sweep_matvec(sizes=(512, 1024, 2048), iters: int = 20,
+                 on_row=None) -> list:
+    """Time the fused gram·vector streaming kernel (ops/pallas_matvec.py)
+    against its bit-equivalent ``lax.scan`` row-panel fallback at each
+    expert size — the matfree solver lane's engine (ISSUE 20).  Rows carry
+    ``"lane": "matvec"`` so watcher/bench consumers can split them from
+    the factorization sweep's rows.  Off-TPU the Pallas path runs in
+    interpret mode (timings prove plumbing, not performance — same
+    contract as :func:`sweep`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_gp_tpu.ops.pallas_matvec import (
+        TILE_TRANSFORMS,
+        matvec_tile,
+        streamed_matvec,
+    )
+
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+
+    rng = np.random.default_rng(1)
+    rows = []
+    for n in sizes:
+        tile = matvec_tile(n)
+        x = jnp.asarray(rng.normal(size=(n, 8)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(n, 4)).astype(np.float32))
+        params = jnp.asarray([0.5], dtype=jnp.float32)
+
+        transform = TILE_TRANSFORMS["rbf"]
+        pallas_fn = jax.jit(lambda xx, vv: streamed_matvec(
+            xx, vv, transform, params, kind="sqdist",
+            interpret=interpret or None,
+        ))
+        scan_fn = jax.jit(lambda xx, vv: streamed_matvec(
+            xx, vv, transform, params, kind="sqdist", differentiable=True
+        ))
+        t_pallas = _bench(lambda k: pallas_fn(x, k), v, iters)
+        t_scan = _bench(lambda k: scan_fn(x, k), v, iters)
+
+        row = {
+            "lane": "matvec",
+            "n": n,
+            "tile": tile,
+            "pallas_us_per_matvec": round(t_pallas * 1e6, 2),
+            "scan_us_per_matvec": round(t_scan * 1e6, 2),
+            "speedup": round(t_scan / t_pallas, 2),
+            "backend": backend,
+        }
+        rows.append(row)
+        if on_row is not None:
+            on_row(row)
+    return rows
+
+
 def main() -> None:
     import jax
 
@@ -105,7 +160,16 @@ def main() -> None:
     iters_env = _os.environ.get("PALLAS_SWEEP_ITERS", "").strip()
     if iters_env:
         kwargs["iters"] = int(iters_env)
-    sweep(on_row=lambda row: print(json.dumps(row), flush=True), **kwargs)
+    emit = lambda row: print(json.dumps(row), flush=True)  # noqa: E731
+    sweep(on_row=emit, **kwargs)
+    # the fused gram·vector streaming lane rides the same knobs: the
+    # watcher rehearsal pins tiny sizes so the interpret-mode pass stays
+    # inside the rehearsal budget while still proving lane 5's plumbing
+    mv_sizes_env = _os.environ.get("PALLAS_SWEEP_MATVEC_SIZES", "").strip()
+    mv_kwargs = dict(kwargs)
+    if mv_sizes_env:
+        mv_kwargs["sizes"] = tuple(int(s) for s in mv_sizes_env.split(","))
+    sweep_matvec(on_row=emit, **mv_kwargs)
 
 
 if __name__ == "__main__":

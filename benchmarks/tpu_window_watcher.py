@@ -211,6 +211,9 @@ REHEARSAL_ENV = {
     "MATCHED_N": "2000", "MATCHED_EXPERT": "50", "MATCHED_MAXITER": "3",
     "LARGE_M": "2048", "LARGE_M_N": "12000", "LARGE_M_MAXITER": "2",
     "PALLAS_SWEEP_SIZES": "32,64", "PALLAS_SWEEP_ITERS": "2",
+    # the fused gram·vector streaming lane (ISSUE 20) rides the same
+    # sweep subprocess; tiny sizes keep the interpret-mode pass cheap
+    "PALLAS_SWEEP_MATVEC_SIZES": "32,64",
 }
 
 
@@ -268,6 +271,14 @@ def rehearse(out_dir: str, note=print) -> dict:
                     timed_out=("timed_out_after_s" in envelope),
                     platform=_captured_platform(envelope),
                 )
+                if name == "PALLAS":
+                    # the sweep's fused gram·vector rows (ISSUE 20):
+                    # rehearsal proof that lane 5 now carries the
+                    # streaming-matvec measurements too
+                    lane["matvec_rows"] = (
+                        '"lane": "matvec"'
+                        in (envelope.get("stdout_tail") or "")
+                    )
             except ValueError as exc:
                 lane.update(valid_envelope=False, error=str(exc)[:200])
         lanes[name] = lane

@@ -86,6 +86,23 @@ class Kernel:
     #: ``kernel.prepare is None`` is THE capability test everywhere.
     prepare = None
 
+    #: Matrix-free streaming hooks (the matfree solver lane,
+    #: ops/pallas_matvec.py).  ``prepare_matvec(x)`` returns the SKINNY
+    #: theta-invariant streaming operand — for every shipped family the
+    #: ``[s, p]`` row stack itself (NOT the ``prepare()`` cache: that
+    #: cache is the O(s²) distance block the matfree lane exists to never
+    #: build).  ``matvec_from_prepared(theta, mcache, v, **kw)`` computes
+    #: ``gram(theta, x) @ v`` by streaming row tiles (``**kw`` threads
+    #: ``differentiable``/``tile``/``interpret`` through to
+    #: ``ops.pallas_matvec.streamed_matvec``) and must match the
+    #: materialized gram action to float rounding.  ``None`` (the class
+    #: default) means "cannot stream" and keeps the materialized path
+    #: bit-for-bit; composites null both per-instance when any child
+    #: lacks them, so ``kernel.matvec_from_prepared is None`` is the one
+    #: capability test (``supports_matfree``).
+    prepare_matvec = None
+    matvec_from_prepared = None
+
     def _spec(self) -> tuple:
         """Hashable identity of this kernel spec.  Kernels are immutable, so
         (type, spec) equality lets them be ``static_argnums`` of module-level
@@ -251,6 +268,14 @@ class EyeKernel(Kernel):
     def gram_from_cache(self, theta, cache):
         return jnp.eye(cache.shape[0], dtype=cache.dtype)
 
+    def prepare_matvec(self, x):
+        # same zero-byte carrier trick as prepare(): the identity matvec
+        # needs nothing, but the protocol transports arrays under vmap
+        return jnp.zeros((x.shape[0], 0), dtype=x.dtype)
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        return v
+
     def describe(self, theta) -> str:
         return "I"
 
@@ -284,6 +309,9 @@ class ThetaOverrideKernel(Kernel):
         self.n_hypers = inner.n_hypers
         if inner.prepare is None:
             self.prepare = None
+        if inner.matvec_from_prepared is None:
+            self.prepare_matvec = None
+            self.matvec_from_prepared = None
 
     def _spec(self) -> tuple:
         return (self.inner,)
@@ -314,6 +342,12 @@ class ThetaOverrideKernel(Kernel):
     def gram_from_cache(self, theta, cache):
         return self.inner.gram_from_cache(theta, cache)
 
+    def prepare_matvec(self, x):
+        return self.inner.prepare_matvec(x)
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        return self.inner.matvec_from_prepared(theta, mcache, v, **kw)
+
     def white_noise_var(self, theta):
         return self.inner.white_noise_var(theta)
 
@@ -334,6 +368,13 @@ class _PairKernel(Kernel):
             # the composite's cache is the tuple of child caches, so it
             # only exists when BOTH children carry an invariant
             self.prepare = None
+        if (
+            k1.matvec_from_prepared is None
+            or k2.matvec_from_prepared is None
+        ):
+            # streaming composes the same way: both children or neither
+            self.prepare_matvec = None
+            self.matvec_from_prepared = None
 
     def _spec(self) -> tuple:
         return (self.k1, self.k2)
@@ -451,6 +492,17 @@ class SumKernel(_PairKernel):
             t2, c2
         )
 
+    def prepare_matvec(self, x):
+        return (self.k1.prepare_matvec(x), self.k2.prepare_matvec(x))
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        # (K1 + K2) v = K1 v + K2 v — two streamed passes, still no gram
+        t1, t2 = self._split(theta)
+        c1, c2 = mcache
+        return self.k1.matvec_from_prepared(
+            t1, c1, v, **kw
+        ) + self.k2.matvec_from_prepared(t2, c2, v, **kw)
+
     def cross(self, theta, x_test, x_train):
         t1, t2 = self._split(theta)
         return self.k1.cross(t1, x_test, x_train) + self.k2.cross(t2, x_test, x_train)
@@ -487,6 +539,9 @@ class TrainableScaleKernel(Kernel):
         self.n_hypers = 1 + kernel.n_hypers
         if kernel.prepare is None:
             self.prepare = None
+        if kernel.matvec_from_prepared is None:
+            self.prepare_matvec = None
+            self.matvec_from_prepared = None
 
     def _spec(self) -> tuple:
         return (self.kernel, self.c0, self.lower, self.upper)
@@ -509,6 +564,15 @@ class TrainableScaleKernel(Kernel):
 
     def gram_from_cache(self, theta, cache):
         return theta[0] * self.kernel.gram_from_cache(theta[1:], cache)
+
+    def prepare_matvec(self, x):
+        return self.kernel.prepare_matvec(x)
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        # (C K) v = C (K v): the scale rides outside the stream
+        return theta[0] * self.kernel.matvec_from_prepared(
+            theta[1:], mcache, v, **kw
+        )
 
     def cross(self, theta, x_test, x_train):
         return theta[0] * self.kernel.cross(theta[1:], x_test, x_train)
@@ -539,6 +603,9 @@ class ConstScaleKernel(Kernel):
         self.n_hypers = kernel.n_hypers
         if kernel.prepare is None:
             self.prepare = None
+        if kernel.matvec_from_prepared is None:
+            self.prepare_matvec = None
+            self.matvec_from_prepared = None
 
     def _spec(self) -> tuple:
         return (self.kernel, self.c)
@@ -557,6 +624,14 @@ class ConstScaleKernel(Kernel):
 
     def gram_from_cache(self, theta, cache):
         return self.c * self.kernel.gram_from_cache(theta, cache)
+
+    def prepare_matvec(self, x):
+        return self.kernel.prepare_matvec(x)
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        return self.c * self.kernel.matvec_from_prepared(
+            theta, mcache, v, **kw
+        )
 
     def cross(self, theta, x_test, x_train):
         return self.c * self.kernel.cross(theta, x_test, x_train)
@@ -654,6 +729,14 @@ def supports_gram_cache(kernel: Kernel) -> bool:
     """True when ``kernel`` declares a theta-invariant structure AND the
     process knob has not disabled the plane."""
     return kernel.prepare is not None and gram_cache_enabled()
+
+
+def supports_matfree(kernel: Kernel) -> bool:
+    """True when ``kernel`` can run the matrix-free solver lane: it (and,
+    for composites, every child) implements the streaming
+    ``matvec_from_prepared`` protocol.  ``False`` — ARD metrics, custom
+    kernels, products — keeps the materialized path bit-for-bit."""
+    return kernel.matvec_from_prepared is not None
 
 
 @functools.partial(jax.jit, static_argnums=0, static_argnames=("lane",))

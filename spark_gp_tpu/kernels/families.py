@@ -42,6 +42,28 @@ from spark_gp_tpu.ops.distance import (
     sq_dist_self,
     weighted_sq_dist,
 )
+from spark_gp_tpu.ops.pallas_matvec import (
+    register_tile_transform,
+    streamed_matvec,
+)
+
+
+@register_tile_transform("rq")
+def _rq_tile(theta, sqd):
+    """The rational-quadratic elementwise map — one definition shared by
+    gram / gram_from_cache / cross and the matfree streamed tiles."""
+    sigma, alpha = theta[0], theta[1]
+    base = 1.0 + sqd / (2.0 * alpha * sigma * sigma)
+    # exp/log form: ``base ** -alpha`` with a traced exponent lowers to
+    # the same, but the explicit form keeps the alpha-gradient stable
+    # (d/dalpha goes through log(base), never through pow's 0^0 corner).
+    return jnp.exp(-alpha * jnp.log(base))
+
+
+@register_tile_transform("dot")
+def _dot_tile(theta, inner):
+    """Dot-product elementwise map over an inner-product tile."""
+    return theta[0] * theta[0] + inner
 
 
 def _pair(value, default: float) -> tuple:
@@ -93,12 +115,7 @@ class RationalQuadraticKernel(_TwoHyperStationary):
         super().__init__(sigma, alpha, lower, upper)
 
     def _k(self, theta, sqd):
-        sigma, alpha = theta[0], theta[1]
-        base = 1.0 + sqd / (2.0 * alpha * sigma * sigma)
-        # exp/log form: ``base ** -alpha`` with a traced exponent lowers to
-        # the same, but the explicit form keeps the alpha-gradient stable
-        # (d/dalpha goes through log(base), never through pow's 0^0 corner).
-        return jnp.exp(-alpha * jnp.log(base))
+        return _rq_tile(theta, sqd)
 
     def gram(self, theta, x):
         return self._k(theta, sq_dist_self(x))
@@ -110,6 +127,14 @@ class RationalQuadraticKernel(_TwoHyperStationary):
 
     def gram_from_cache(self, theta, cache):
         return self._k(theta, cache)
+
+    def prepare_matvec(self, x):
+        return x
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        return streamed_matvec(
+            mcache, v, _rq_tile, theta, kind="sqdist", **kw
+        )
 
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))
@@ -262,6 +287,14 @@ class DotProductKernel(Kernel):
     def gram_from_cache(self, theta, cache):
         return theta[0] * theta[0] + cache
 
+    def prepare_matvec(self, x):
+        return x
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        return streamed_matvec(
+            mcache, v, _dot_tile, theta, kind="inner", **kw
+        )
+
     def cross(self, theta, x_test, x_train):
         return theta[0] * theta[0] + mxu_inner(x_test, x_train)
 
@@ -324,6 +357,17 @@ class PolynomialKernel(Kernel):
 
     def gram_from_cache(self, theta, cache):
         return self._pow(cache + theta[0])
+
+    def prepare_matvec(self, x):
+        return x
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        # degree is a static spec attribute, so the closure carries only
+        # python constants — legal inside the Pallas kernel body too
+        return streamed_matvec(
+            mcache, v, lambda par, inner: self._pow(inner + par[0]),
+            theta, kind="inner", **kw
+        )
 
     def cross(self, theta, x_test, x_train):
         return self._pow(mxu_inner(x_test, x_train) + theta[0])

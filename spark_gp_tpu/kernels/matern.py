@@ -26,6 +26,7 @@ tests/test_kernels.py like every other kernel.
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax.numpy as jnp
@@ -37,6 +38,10 @@ from spark_gp_tpu.ops.distance import (
     sq_dist_self,
     weighted_sq_dist,
     weighted_sq_dist_self,
+)
+from spark_gp_tpu.ops.pallas_matvec import (
+    register_tile_transform,
+    streamed_matvec,
 )
 
 _R2_FLOOR = 1e-24  # sqrt grad guard; sqrt(floor) = 1e-12 off the true diag
@@ -57,6 +62,19 @@ def _safe_r(r2):
     return jnp.sqrt(jnp.maximum(r2, _R2_FLOOR))
 
 
+def _matern_tile(nu2: int, theta, sqd):
+    """The Matérn elementwise map — shared by gram / gram_from_cache /
+    cross and the matfree lane's streamed tiles."""
+    a = math.sqrt(nu2) * _safe_r(sqd) / theta[0]
+    return _matern_of_a(nu2, a)
+
+
+for _nu2 in (1, 3, 5):
+    register_tile_transform(f"matern{_nu2}2")(
+        functools.partial(_matern_tile, _nu2)
+    )
+
+
 class _MaternIso(ScalarLengthscaleHypers):
     """One trainable length-scale ``sigma`` in ``[lower, upper]``.  The
     subclass type distinguishes the ν variants for jit caching (Kernel
@@ -65,8 +83,7 @@ class _MaternIso(ScalarLengthscaleHypers):
     _nu2: int  # 2 * nu, set by subclasses
 
     def _k(self, theta, sqd):
-        a = math.sqrt(self._nu2) * _safe_r(sqd) / theta[0]
-        return _matern_of_a(self._nu2, a)
+        return _matern_tile(self._nu2, theta, sqd)
 
     def gram(self, theta, x):
         return self._k(theta, sq_dist_self(x))
@@ -79,6 +96,17 @@ class _MaternIso(ScalarLengthscaleHypers):
 
     def gram_from_cache(self, theta, cache):
         return self._k(theta, cache)
+
+    def prepare_matvec(self, x):
+        return x
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        from spark_gp_tpu.ops.pallas_matvec import TILE_TRANSFORMS
+
+        return streamed_matvec(
+            mcache, v, TILE_TRANSFORMS[f"matern{self._nu2}2"], theta,
+            kind="sqdist", **kw
+        )
 
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))

@@ -23,6 +23,18 @@ from spark_gp_tpu.ops.distance import (
     weighted_sq_dist,
     weighted_sq_dist_self,
 )
+from spark_gp_tpu.ops.pallas_matvec import (
+    register_tile_transform,
+    streamed_matvec,
+)
+
+
+@register_tile_transform("rbf")
+def _rbf_tile(theta, sqd):
+    """The RBF elementwise map — ONE definition shared by gram /
+    gram_from_cache / cross and the matfree lane's streamed tiles."""
+    sigma = theta[0]
+    return jnp.exp(sqd / (-2.0 * sigma * sigma))
 
 
 class RBFKernel(ScalarLengthscaleHypers):
@@ -31,8 +43,7 @@ class RBFKernel(ScalarLengthscaleHypers):
     (RBFKernel.scala:14-54; default bounds :15-16)."""
 
     def _k(self, theta, sqd):
-        sigma = theta[0]
-        return jnp.exp(sqd / (-2.0 * sigma * sigma))
+        return _rbf_tile(theta, sqd)
 
     def gram(self, theta, x):
         return self._k(theta, sq_dist_self(x))
@@ -45,6 +56,14 @@ class RBFKernel(ScalarLengthscaleHypers):
 
     def gram_from_cache(self, theta, cache):
         return self._k(theta, cache)
+
+    def prepare_matvec(self, x):
+        return x
+
+    def matvec_from_prepared(self, theta, mcache, v, **kw):
+        return streamed_matvec(
+            mcache, v, _rbf_tile, theta, kind="sqdist", **kw
+        )
 
     def cross(self, theta, x_test, x_train):
         return self._k(theta, sq_dist(x_test, x_train))

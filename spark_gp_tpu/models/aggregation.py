@@ -242,15 +242,11 @@ def expert_sketches(data, dim: Optional[int] = None, seed: int = 0):
     return (feats * w).sum(axis=1) / n_e  # [E, 2*half]
 
 
-def redundancy_matrix(sketches: np.ndarray) -> np.ndarray:
-    """``[E, E]`` pairwise redundancy scores in [-1, 1].
-
-    Cosine similarity of the ACROSS-STACK-CENTERED sketches (module
-    docstring: raw mean-feature sketches of iid chunks all converge to
-    the same expectation; only the residual fluctuation identifies
-    shared data), with (near-)identical RAW sketches forced to 1.0 —
-    when nearly every expert is one duplicate class the centered
-    residuals cancel to zero and the cosine alone would miss them."""
+def redundancy_matrix_host(sketches: np.ndarray) -> np.ndarray:
+    """Host-numpy redundancy scorer — the PARITY ORACLE for the jitted
+    device scorer below, and the ``GP_AGG_DEVICE_SCORE=0`` fallback.
+    Same math as :func:`redundancy_matrix` (which dispatches here when
+    the device path is disabled or unavailable)."""
     s = np.asarray(sketches, dtype=np.float64)
     resid = s - s.mean(axis=0, keepdims=True)
     norms = np.linalg.norm(resid, axis=1)
@@ -264,6 +260,55 @@ def redundancy_matrix(sketches: np.ndarray) -> np.ndarray:
     sim = np.where(d2 <= 1e-12 * scale, 1.0, sim)
     np.fill_diagonal(sim, 1.0)
     return sim
+
+
+def _redundancy_matrix_jax(s):
+    """The jitted device scorer's trace body: one batched centered-cosine
+    over the ``[E, d]`` sketch block — two [E, d] matmuls and elementwise
+    dressing, all on-device, replacing the host round-trip for the O(E^2 d)
+    part of selection.  Mirrors :func:`redundancy_matrix_host` term for
+    term (tests/test_aggregation.py holds them to parity)."""
+    import jax.numpy as jnp
+
+    resid = s - jnp.mean(s, axis=0, keepdims=True)
+    norms = jnp.linalg.norm(resid, axis=1)
+    floor = 1e-12 + 1e-9 * jnp.linalg.norm(s, axis=1)
+    unit = resid / jnp.maximum(norms, floor)[:, None]
+    sim = unit @ unit.T
+    sq = jnp.sum(jnp.square(s), axis=1)
+    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (s @ s.T), 0.0)
+    scale = jnp.maximum(jnp.maximum(sq[:, None], sq[None, :]), 1e-24)
+    sim = jnp.where(d2 <= 1e-12 * scale, 1.0, sim)
+    eye = jnp.eye(s.shape[0], dtype=bool)
+    return jnp.where(eye, 1.0, sim)
+
+
+def redundancy_matrix(sketches: np.ndarray) -> np.ndarray:
+    """``[E, E]`` pairwise redundancy scores in [-1, 1].
+
+    Cosine similarity of the ACROSS-STACK-CENTERED sketches (module
+    docstring: raw mean-feature sketches of iid chunks all converge to
+    the same expectation; only the residual fluctuation identifies
+    shared data), with (near-)identical RAW sketches forced to 1.0 —
+    when nearly every expert is one duplicate class the centered
+    residuals cancel to zero and the cosine alone would miss them.
+
+    The scoring runs ON DEVICE by default (one jitted batched
+    centered-cosine — the matmul-shaped O(E^2 d) work the host loop used
+    to round-trip); ``GP_AGG_DEVICE_SCORE=0`` or any device failure
+    falls back to the bit-for-bit host oracle
+    (:func:`redundancy_matrix_host`)."""
+    if os.environ.get("GP_AGG_DEVICE_SCORE", "").strip() == "0":
+        return redundancy_matrix_host(sketches)
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        s = jnp.asarray(np.asarray(sketches, dtype=np.float64))
+        sim = np.asarray(jax.jit(_redundancy_matrix_jax)(s))
+        return sim.astype(np.float64)
+    except Exception:  # noqa: BLE001 — scoring must never fail selection
+        return redundancy_matrix_host(sketches)
 
 
 @dataclass(frozen=True)

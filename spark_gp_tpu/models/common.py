@@ -367,9 +367,25 @@ class GaussianProcessParams:
         from spark_gp_tpu.ops.iterative import resolve_solver
         from spark_gp_tpu.resilience import memplan
 
+        resolved = resolve_solver(
+            int(data.x.shape[1]),
+            num_experts=int(data.x.shape[0]),
+            n_features=int(data.x.shape[2]),
+            itemsize=int(np.dtype(data.x.dtype).itemsize),
+        )
         if self._checkpoint_dir is not None or self._fallback_segmented():
             rung = "segmented"
-        elif resolve_solver(int(data.x.shape[1])) == "iterative":
+        elif resolved == "matfree":
+            # the matrix-free lane streams the gram — O(E·s·(k+r+tile))
+            # resident — but only for matvec-capable kernels; others run
+            # the materialized iterative program and must be priced as it
+            from spark_gp_tpu.kernels.base import supports_matfree
+
+            rung = (
+                "matfree" if supports_matfree(self._get_kernel())
+                else "iterative"
+            )
+        elif resolved == "iterative":
             # the CG/Lanczos solver lane (by knob, auto-threshold, or the
             # ladder's iterative rung — all of which resolve here) has
             # the skinny-workspace byte model, not the factor-stack one
@@ -803,13 +819,29 @@ class GaussianProcessCommons(GaussianProcessParams):
         self-distance cache does not cover).  Memory cost: one extra
         ``[E, s, s]`` stack (docs/ROOFLINE.md).  The decision is recorded
         as the ``gram_cache_engaged`` metric so artifacts can prove which
-        path a fit ran."""
-        from spark_gp_tpu.kernels.base import prepare_gram_cache
+        path a fit ran.  A fit resolving to the MATFREE lane with a
+        matvec-capable kernel also skips the build: the prepare() cache
+        IS the O(E·s²) distance block that lane refuses to materialize —
+        building it would reinstate the exact allocation the lane was
+        admitted to avoid."""
+        from spark_gp_tpu.kernels.base import (
+            prepare_gram_cache,
+            supports_matfree,
+        )
+        from spark_gp_tpu.ops.iterative import resolve_solver
 
+        kernel = self._get_kernel()
         if getattr(self, "_objective", "marginal") == "elbo":
             cache = None
+        elif supports_matfree(kernel) and resolve_solver(
+            int(data.x.shape[1]),
+            num_experts=int(data.x.shape[0]),
+            n_features=int(data.x.shape[2]),
+            itemsize=int(np.dtype(data.x.dtype).itemsize),
+        ) == "matfree":
+            cache = None
         else:
-            cache = prepare_gram_cache(self._get_kernel(), data.x)
+            cache = prepare_gram_cache(kernel, data.x)
         if instr is not None:
             instr.log_metric("gram_cache_engaged", float(cache is not None))
         return cache
@@ -1776,32 +1808,46 @@ class GaussianProcessCommons(GaussianProcessParams):
         """The solver lane's fit-time provenance (ops/iterative.py).
 
         ALWAYS stamps the engaged lane (``solver_lane`` — ``exact`` /
-        ``iterative``, resolved against the fitted stack's expert size
-        for ``auto``) so every artifact can prove which solver produced
-        the model, mirroring ``gram_cache_engaged``.  On the iterative
-        lane additionally runs one post-fit PCG convergence probe at the
-        FITTED hyperparameters over a bounded expert sub-stack and
-        publishes the knobs + achieved residuals: ``solver.cg_iters``,
-        ``solver.precond_rank``, ``solver.probes``, ``solver.residual``
-        (obs/names.py catalog; the run journal and the saved model's
-        ``provenance_json`` carry them).  Cost: one objective-sized
-        dispatch on <= 8 experts; never fails a fit."""
+        ``iterative`` / ``matfree``, resolved against the fitted stack's
+        expert size for ``auto``) so every artifact can prove which
+        solver produced the model, mirroring ``gram_cache_engaged``.  On
+        the iterative/matfree lanes additionally runs one post-fit PCG
+        convergence probe at the FITTED hyperparameters over a bounded
+        expert sub-stack and publishes the knobs + achieved residuals:
+        ``solver.cg_iters``, ``solver.precond_rank``, ``solver.probes``,
+        ``solver.residual`` (obs/names.py catalog; the run journal and
+        the saved model's ``provenance_json`` carry them).  A matfree
+        fit's probe runs through the SAME injected streamed matvec the
+        fit executed — never a materialized stand-in — and additionally
+        stamps ``solver.matfree_engaged`` / ``solver.matvec_tiles``.
+        Cost: one objective-sized dispatch on <= 8 experts; never fails
+        a fit."""
         from spark_gp_tpu.ops import iterative as it_ops
 
         if instr is None:
             return
         lane = it_ops.active_solver_lane()
         resolved = (
-            it_ops.resolve_solver(int(data.x.shape[1]), lane)
+            it_ops.resolve_solver(
+                int(data.x.shape[1]), lane,
+                num_experts=int(data.x.shape[0]),
+                n_features=int(data.x.shape[2]),
+                itemsize=int(np.dtype(data.x.dtype).itemsize),
+            )
             if data is not None else lane if lane != "auto" else "exact"
         )
         instr.metrics["solver_lane"] = resolved
-        if resolved != "iterative" or not self._probeable_stack(data):
+        if resolved not in ("iterative", "matfree") or not (
+            self._probeable_stack(data)
+        ):
             return
         try:
             import jax.numpy as jnp
 
-            from spark_gp_tpu.kernels.base import masked_gram_stack
+            from spark_gp_tpu.kernels.base import (
+                masked_gram_stack,
+                supports_matfree,
+            )
 
             probe = min(8, int(data.x.shape[0]))
             x_p = data.x[:probe]
@@ -1813,8 +1859,32 @@ class GaussianProcessCommons(GaussianProcessParams):
             theta_p = jnp.asarray(
                 np.asarray(theta, dtype=np.float64), dtype=data.x.dtype
             )
-            kmat = masked_gram_stack(kernel, theta_p, x_p, mask_p)
-            report = it_ops.solver_report(kmat, y_p * mask_p)
+            matfree = resolved == "matfree" and supports_matfree(kernel)
+            if matfree:
+                from spark_gp_tpu.models.likelihood import (
+                    masked_matfree_operator,
+                )
+                from spark_gp_tpu.ops.pallas_matvec import matvec_tiles
+
+                _, mv_sg, diag_sg, col_sg = masked_matfree_operator(
+                    kernel, theta_p, x_p, mask_p
+                )
+                report = it_ops.solver_report(
+                    None, y_p * mask_p,
+                    matvec=mv_sg, diag=diag_sg, col_fn=col_sg,
+                )
+                instr.log_metric("solver.matfree_engaged", 1.0)
+                instr.log_metric(
+                    "solver.matvec_tiles",
+                    float(matvec_tiles(int(data.x.shape[1]))),
+                )
+            else:
+                kmat = masked_gram_stack(kernel, theta_p, x_p, mask_p)
+                report = it_ops.solver_report(kmat, y_p * mask_p)
+                if resolved == "matfree":
+                    # lane requested matfree but the kernel carries no
+                    # matvec: the fit ran the materialized fallback
+                    instr.log_metric("solver.matfree_engaged", 0.0)
             instr.log_metric("solver.cg_iters", float(report["cg_iters"]))
             instr.log_metric(
                 "solver.precond_rank", float(report["precond_rank"])

@@ -84,7 +84,11 @@ def _posterior_terms_batch(kmat, y, mask, f):
     eye = jnp.eye(kmat.shape[-1], dtype=kmat.dtype)
     b_mat = eye[None] + sqw[:, :, None] * kmat * sqw[:, None, :]
     grad_log_p = (y - pi) * mask
-    if it_ops.resolve_solver(kmat.shape[-1]) == "iterative":
+    if it_ops.resolve_solver(kmat.shape[-1]) in ("iterative", "matfree"):
+        # (matfree resolves here too: the Laplace B systems are
+        # materialized-operator solves — the matrix-free memory win is
+        # marginal-NLL-scoped, and regressing to the batched Cholesky
+        # under GP_SOLVER_LANE=matfree would be strictly worse)
         # the CG/Lanczos solver lane (ops/iterative.py): no full
         # factorization — ``B v`` applications become pivoted-Cholesky
         # preconditioned multi-RHS CG solves (B's eigenvalues are >= 1,

@@ -210,7 +210,11 @@ def _gen_newton_quantities(lik: Likelihood, kmat, y, mask, f) -> _GenStep:
     b_mats = eye[None] + sqw[:, :, None] * kmat * sqw[:, None, :]
     b_vec = w * f + grad_log_p
     kb = jnp.einsum("eij,ej->ei", kmat, b_vec)
-    if it_ops.resolve_solver(kmat.shape[-1]) == "iterative":
+    if it_ops.resolve_solver(kmat.shape[-1]) in ("iterative", "matfree"):
+        # (matfree resolves here too: the Laplace B systems are
+        # materialized-operator solves — the matrix-free memory win is
+        # marginal-NLL-scoped, and regressing to the batched Cholesky
+        # under GP_SOLVER_LANE=matfree would be strictly worse)
         # the CG/Lanczos solver lane (ops/iterative.py): the B solve rides
         # preconditioned multi-RHS CG under custom_linear_solve (implicit
         # differentiation — this function is autodiffed by the

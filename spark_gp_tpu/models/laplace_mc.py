@@ -101,7 +101,11 @@ def _mc_newton_quantities(kmat, y1h, mask, f) -> _McStep:
     pi_pos = pi > 0.0
     sqd = jnp.where(pi_pos, jnp.sqrt(jnp.where(pi_pos, pi, 1.0)), 0.0)
 
-    if it_ops.resolve_solver(kmat.shape[-1]) == "iterative":
+    if it_ops.resolve_solver(kmat.shape[-1]) in ("iterative", "matfree"):
+        # (matfree resolves here too: the Laplace B systems are
+        # materialized-operator solves — the matrix-free memory win is
+        # marginal-NLL-scoped, and regressing to the batched Cholesky
+        # under GP_SOLVER_LANE=matfree would be strictly worse)
         return _mc_newton_quantities_iter(kmat, y1h, mask, f, pi, sqd)
 
     # B_c = I + sqrt(D_c) K sqrt(D_c), batched over (expert, class)

@@ -29,7 +29,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from spark_gp_tpu.kernels.base import Kernel, masked_gram_stack
+from spark_gp_tpu.kernels.base import (
+    Kernel,
+    masked_gram_stack,
+    supports_matfree,
+)
 from spark_gp_tpu.obs import cost as obs_cost
 from spark_gp_tpu.ops import iterative as it_ops
 from spark_gp_tpu.ops.linalg import chol_logdet, chol_solve, cholesky
@@ -52,6 +56,79 @@ from spark_gp_tpu.parallel.mesh import (
 # Cholesky vs the CG/Lanczos lane) rides the same contract as a second
 # static argument, so GP_SOLVER_LANE / set_solver_lane switches between
 # fits recompile too.
+
+
+def masked_matfree_operator(kernel: Kernel, theta, x, mask, jitter=None):
+    """The masked + jittered NLL operator as INJECTED closures — the
+    matfree lane's stand-in for ``masked_gram_stack`` + the jitter add.
+
+    With M the 0/1 mask, the materialized operator is
+    ``M K M^T + diag(1 - m) + c I`` (``ops/linalg.masked_kernel_matrix``
+    plus the trace-relative jitter ridge ``c = boost * trace/s``), so
+    its matvec is ``m ⊙ K(m ⊙ v) + (1 - m) ⊙ v + c v`` — the raw
+    kernel matvec streams (``kernels/base.py`` matvec protocol), masking
+    and jitter are O(s) elementwise dressing applied here, ONCE, for
+    every consumer (CG loop, value legs, post-fit report).
+
+    Returns ``(matvec, matvec_sg, diag_sg, col_fn_sg)`` for
+    :func:`ops.iterative.inv_quad_logdet_matfree`: ``matvec`` is
+    differentiable in ``theta`` (checkpointed streaming path),
+    ``matvec_sg`` the stop-gradient twin the CG loop runs on (free to
+    take the fused Pallas path), ``diag_sg`` the ``[E, s]`` masked +
+    jittered diagonal and ``col_fn_sg(piv)`` the pivot-column oracle
+    feeding the streamed pivoted-Cholesky preconditioner.  The column
+    comes from ``kernel.cross`` against the single pivot row with its
+    own diagonal entry pinned from ``diag_sg`` — correct even for
+    kernels whose ``cross`` carries no diagonal term (the EyeKernel
+    ridge's cross is identically zero)."""
+    s = x.shape[-2]
+    mcache = jax.vmap(kernel.prepare_matvec)(x)
+    diag_k = jax.vmap(lambda xe: kernel.diag(theta, xe))(x)  # [E, s]
+    mdiag = mask * diag_k + (1.0 - mask)
+    if jitter is not None:
+        trace = jnp.sum(mdiag, axis=-1)
+        scale = jnp.where(jnp.isfinite(trace) & (trace > 0), trace / s, 1.0)
+        boost = jnp.broadcast_to(jnp.asarray(jitter, x.dtype), trace.shape)
+        c = boost * scale  # [E], differentiable through trace like the
+        # materialized path's jnp.trace(kmat)
+    else:
+        c = jnp.zeros(mask.shape[:-1], dtype=x.dtype)
+    diag_total = mdiag + c[..., None]
+
+    theta_sg = jax.lax.stop_gradient(theta)
+    mcache_sg = jax.lax.stop_gradient(mcache)
+    mask_sg = jax.lax.stop_gradient(mask)
+    c_sg = jax.lax.stop_gradient(c)
+    diag_sg = jax.lax.stop_gradient(diag_total)
+    x_sg = jax.lax.stop_gradient(x)
+
+    def _apply(th, mc, msk, cj, v, **kw):
+        mv = msk[..., None] * kernel.matvec_from_prepared(
+            th, mc, msk[..., None] * v, **kw
+        )
+        return mv + ((1.0 - msk) + cj[..., None])[..., None] * v
+
+    def matvec(v):
+        return _apply(theta, mcache, mask, c, v, differentiable=True)
+
+    def matvec_sg(v):
+        return _apply(theta_sg, mcache_sg, mask_sg, c_sg, v)
+
+    iota = jnp.arange(s)
+
+    def col_fn_sg(piv):
+        x_piv = jnp.take_along_axis(
+            x_sg, piv[..., None, None], axis=-2
+        )  # [E, 1, p]
+        kcol = jax.vmap(
+            lambda xp, xe: kernel.cross(theta_sg, xp, xe)
+        )(x_piv, x_sg)[..., 0, :]  # K[piv, :] = K[:, piv]  [E, s]
+        m_piv = jnp.take_along_axis(mask_sg, piv[..., None], axis=-1)
+        d_piv = jnp.take_along_axis(diag_sg, piv[..., None], axis=-1)
+        col = mask_sg * kcol * m_piv
+        return jnp.where(iota == piv[..., None], d_piv, col)
+
+    return matvec, matvec_sg, diag_sg, col_fn_sg
 
 
 def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
@@ -94,6 +171,36 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
     """
     from spark_gp_tpu.ops.pallas_linalg import _use_pallas, spd_inv_logdet
 
+    resolved = it_ops.resolve_solver(
+        data.x.shape[-2],
+        num_experts=int(data.x.shape[0]),
+        n_features=int(data.x.shape[-1]),
+        itemsize=int(jnp.dtype(data.x.dtype).itemsize),
+    )
+    if resolved == "matfree" and supports_matfree(kernel):
+        # the matrix-free lane (ops/pallas_matvec.py): the [E, s, s] gram
+        # stack is NEVER materialized — this branch runs BEFORE
+        # masked_gram_stack, CG matvecs stream row tiles of the distance
+        # computation + kernel transform, and the preconditioner builds
+        # from streamed pivot columns.  Masking and the trace-relative
+        # jitter live in the injected operator (masked_matfree_operator),
+        # so quarantine escalation rides this lane too.  The theta-
+        # invariant gram cache is irrelevant here by design: that cache
+        # IS the O(s^2) distance block this lane refuses to build.
+        # Kernels without matvec capability fall through to the
+        # materialized iterative path below, bit-for-bit.
+        matvec, matvec_sg, diag_sg, col_fn_sg = masked_matfree_operator(
+            kernel, theta, data.x, data.mask, jitter
+        )
+        ym = data.y * data.mask
+        quad, logdet = it_ops.inv_quad_logdet_matfree(
+            matvec, matvec_sg, diag_sg, col_fn_sg, ym
+        )
+        if weights is None:
+            return 0.5 * jnp.sum(quad) + 0.5 * jnp.sum(logdet)
+        w = jnp.asarray(weights, data.x.dtype)
+        return 0.5 * jnp.sum(w * quad) + 0.5 * jnp.sum(w * logdet)
+
     kmat = masked_gram_stack(kernel, theta, data.x, data.mask, cache)
     if jitter is not None:
         s = kmat.shape[-1]
@@ -104,7 +211,7 @@ def batched_nll(kernel: Kernel, theta, data: ExpertData, jitter=None,
             s, dtype=kmat.dtype
         )
     ym = data.y * data.mask
-    if it_ops.resolve_solver(kmat.shape[-1]) == "iterative":
+    if resolved in ("iterative", "matfree"):
         # the iterative solver lane (ops/iterative.py): one multi-RHS
         # preconditioned-CG stream replaces the batched factorization —
         # O(t s^2) matmul work instead of O(s^3), selected by

@@ -111,11 +111,13 @@ CATALOG: Tuple[MetricName, ...] = (
     MetricName("fit_retries", "metric", "recovery re-dispatches of the fit"),
     MetricName("bcm_renorm", "metric", "E_active / E_kept BCM renormalization factor"),
     MetricName("precision_lane", "metric", "precision lane the fit ran at (strict/mixed/fast)"),
-    MetricName("solver_lane", "metric", "solver lane the fit engaged (exact/iterative — ops/iterative.py, auto resolved)"),
+    MetricName("solver_lane", "metric", "solver lane the fit engaged (exact/iterative/matfree — ops/iterative.py, auto resolved)"),
     MetricName("solver.cg_iters", "metric", "iterative lane: max live CG iterations on the post-fit convergence probe"),
     MetricName("solver.precond_rank", "metric", "iterative lane: pivoted-Cholesky preconditioner rank k"),
     MetricName("solver.probes", "metric", "iterative lane: Hutchinson/SLQ probe vectors per log-det estimate"),
-    MetricName("solver.residual", "metric", "iterative lane: max relative CG residual at the fitted theta"),
+    MetricName("solver.residual", "metric", "iterative lane: max relative CG residual at the fitted theta (matfree fits probe through the same injected streamed matvec the fit ran)"),
+    MetricName("solver.matfree_engaged", "metric", "1 when the matrix-free streamed-matvec lane executed the fit (0: matfree requested but the kernel carries no matvec — materialized fallback ran)"),
+    MetricName("solver.matvec_tiles", "metric", "matfree lane: row panels per streamed gram.vector pass (ceil(s / GP_MATVEC_TILE))"),
     MetricName("gram_cache_engaged", "metric", "1 when the theta-invariant gram cache served the fit hot loop"),
     MetricName("agg.policy", "metric", "expert aggregation policy the fit engaged (poe/gpoe/rbcm/healed — models/aggregation.py)"),
     MetricName("agg.effective_experts", "metric", "participation ratio (sum w)^2 / sum w^2 of the per-expert weights"),

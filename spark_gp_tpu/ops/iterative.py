@@ -42,13 +42,24 @@ Differentiation strategy (no autodiff ever traverses the CG loop):
   estimator, and only three batched einsums touch the autodiff graph.
 
 Lane selection mirrors the precision lanes (``ops/precision.py``):
-``GP_SOLVER_LANE`` in {``exact``, ``iterative``, ``auto``} (env),
-:func:`set_solver_lane` (process-wide), :func:`solver_lane_scope`
+``GP_SOLVER_LANE`` in {``exact``, ``iterative``, ``matfree``, ``auto``}
+(env), :func:`set_solver_lane` (process-wide), :func:`solver_lane_scope`
 (trace-local, pinned by the jitted fit entry points whose cache keys
 carry the lane), default ``exact`` — today's factorization path
-bit-for-bit.  ``auto`` switches to the iterative lane when the expert
-size s reaches ``GP_SOLVER_AUTO_THRESHOLD`` (default 1024): below that
-the batched factorization is competitive and exact.  Tuning knobs (all
+bit-for-bit.  The ``matfree`` lane is the same CG/Lanczos math with the
+matvec INJECTED (:func:`inv_quad_logdet_matfree`): the gram stack is
+never materialized — tiles of the distance computation, the kernel
+transform, and the matvec accumulation stream through one fused pass
+(``ops/pallas_matvec.py``), and the pivoted-Cholesky preconditioner is
+built from streamed pivot columns (:func:`pivoted_cholesky_cols`), so
+the whole objective is O(E·s·(k + r + tile)) resident instead of
+O(E·s²).  ``auto`` switches to the iterative lane when the expert size
+s reaches ``GP_SOLVER_AUTO_THRESHOLD`` (default 1024) — and, when a
+memory budget is known (``resilience/memplan.py``: chaos staged limit >
+``GP_MEMPLAN_LIMIT_BYTES`` > backend stats), on to ``matfree`` when the
+materialized iterative program is predicted over that budget, so a
+tight budget flips s-large fits matrix-free BEFORE the reactive ladder
+has to.  Tuning knobs (all
 read at trace time): ``GP_SOLVER_MAX_ITERS`` (CG/Lanczos steps, default
 min(s, 64)), ``GP_SOLVER_PROBES`` (Hutchinson probes, default 8),
 ``GP_SOLVER_PRECOND_RANK`` (pivoted-Cholesky rank, default min(s, 64)),
@@ -71,7 +82,7 @@ import jax.numpy as jnp
 # the solver-lane policy (the precision-lane pattern, ops/precision.py)
 # --------------------------------------------------------------------------
 
-SOLVER_LANES = ("exact", "iterative", "auto")
+SOLVER_LANES = ("exact", "iterative", "matfree", "auto")
 
 _LANE_OVERRIDE: Optional[str] = None
 _SCOPE = threading.local()
@@ -144,6 +155,7 @@ def solver_lane_scope(lane):
 _KNOB_ENV = (
     "GP_SOLVER_MAX_ITERS", "GP_SOLVER_PROBES", "GP_SOLVER_PRECOND_RANK",
     "GP_SOLVER_CG_TOL", "GP_SOLVER_SEED", "GP_SOLVER_AUTO_THRESHOLD",
+    "GP_MATVEC_TILE", "GP_MATVEC_PALLAS",
 )
 
 
@@ -152,11 +164,20 @@ def solver_jit_key():
     keys: the active lane alone when ``exact`` (today's single program),
     else ``(lane, knob-signature)`` so switching any iterative knob
     between fits compiles a fresh executable.  Resolved at CALL time by
-    the public wrappers, exactly like the precision lane."""
+    the public wrappers, exactly like the precision lane.  Under ``auto``
+    the memory budget is extra salt: budget-aware resolution
+    (:func:`resolve_solver`) can flip the SAME shapes between the
+    materialized and matrix-free programs when ``GP_MEMPLAN_LIMIT_BYTES``
+    (or a staged chaos limit) changes, so the budget must discriminate
+    cache entries too."""
     lane = active_solver_lane()
     if lane == "exact":
         return "exact"
-    return (lane, tuple(os.environ.get(k, "") for k in _KNOB_ENV))
+    knobs = tuple(os.environ.get(k, "") for k in _KNOB_ENV)
+    if lane == "auto":
+        budget = _memplan_budget()
+        return (lane, knobs, None if budget is None else int(budget))
+    return (lane, knobs)
 
 
 def auto_threshold() -> int:
@@ -170,17 +191,69 @@ def auto_threshold() -> int:
         return 1024
 
 
-def resolve_solver(expert_size: int, lane: Optional[str] = None) -> str:
-    """``exact`` or ``iterative`` for an expert of ``expert_size`` rows
-    under ``lane`` (default: the active lane).  Read at TRACE time by
-    the objectives — ``expert_size`` comes from static shapes, so the
-    resolution is part of the compiled program."""
+def _memplan_budget() -> Optional[int]:
+    """The memory budget memplan would plan against, or ``None`` when
+    planning is disabled/unavailable.  Lazy import: memplan imports this
+    module for rung pricing."""
+    try:
+        from spark_gp_tpu.resilience import memplan
+
+        if not memplan.enabled():
+            return None
+        return int(memplan.memory_budget_bytes())
+    except Exception:  # noqa: BLE001 — planning is advisory; any budget probe failure means "no budget"
+        return None
+
+
+def resolve_solver(
+    expert_size: int,
+    lane: Optional[str] = None,
+    *,
+    num_experts: Optional[int] = None,
+    n_features: Optional[int] = None,
+    itemsize: Optional[int] = None,
+) -> str:
+    """``exact``, ``iterative`` or ``matfree`` for an expert of
+    ``expert_size`` rows under ``lane`` (default: the active lane).
+    Read at TRACE time by the objectives — ``expert_size`` comes from
+    static shapes, so the resolution is part of the compiled program.
+
+    ``auto`` resolution is memory-budget-aware: below the size threshold
+    the batched factorization wins (``exact``); at or above it the
+    materialized iterative program is priced against the memplan budget
+    (``memplan.fit_dispatch_bytes`` at the iterative rung, with the
+    optional ``num_experts`` / ``n_features`` / ``itemsize`` shape hints
+    — conservative 1/1/4 defaults when callers only know ``s``) and a
+    predicted overshoot resolves ``matfree`` — the smaller program —
+    before the reactive ladder ever sees an OOM.  With planning disabled
+    the pre-matfree behavior is unchanged: threshold only.
+    """
     lane = active_solver_lane() if lane is None else _validate_lane(
         lane, "resolve_solver"
     )
-    if lane == "auto":
-        return "iterative" if int(expert_size) >= auto_threshold() else "exact"
-    return lane
+    if lane != "auto":
+        return lane
+    s = int(expert_size)
+    if s < auto_threshold():
+        return "exact"
+    budget = _memplan_budget()
+    if budget is None:
+        return "iterative"
+    try:
+        from spark_gp_tpu.resilience import memplan
+
+        raw = memplan.fit_dispatch_bytes(
+            int(num_experts) if num_experts else 1,
+            s,
+            int(n_features) if n_features else 1,
+            int(itemsize) if itemsize else 4,
+            "iterative",
+        )
+        if memplan.predicted_bytes(raw) > budget:
+            return "matfree"
+    except Exception:  # noqa: BLE001 — pricing is advisory; on any failure keep the pre-matfree resolution
+        pass
+    return "iterative"
 
 
 class SolverConfig(NamedTuple):
@@ -227,20 +300,18 @@ def solver_config(expert_size: int) -> SolverConfig:
 # --------------------------------------------------------------------------
 
 
-def pivoted_cholesky(kmat: jax.Array, rank: int):
-    """Greedy rank-``k`` pivoted partial Cholesky of a ``[..., s, s]``
-    SPD stack: ``(L [..., s, k], delta [...])`` with ``L L^T ~= K`` on
-    the k dominant pivots and ``delta`` the mean residual diagonal
-    (floored at a dtype-relative fraction of trace/s, so
-    ``P = L L^T + delta I`` is always SPD).  O(s * k^2) per matrix —
-    matmul-shaped, no factorization.  Callers pass a ``stop_gradient``
-    view: the preconditioner is numerics, never part of the autodiff
-    graph."""
-    s = kmat.shape[-1]
+def pivoted_cholesky_cols(diag0: jax.Array, col_fn, rank: int):
+    """Greedy rank-``k`` pivoted partial Cholesky from a COLUMN ORACLE:
+    ``diag0`` is the ``[..., s]`` diagonal of the SPD stack and
+    ``col_fn(piv)`` returns the ``[..., s]`` column at (per-batch) pivot
+    index ``piv [...]`` — the matfree lane streams columns this way
+    (O(E·s·k) total, no gram), while :func:`pivoted_cholesky` feeds it a
+    ``take_along_axis`` reader over the materialized stack.  Numerics
+    are identical between the two entry points by construction."""
+    s = diag0.shape[-1]
     k = max(1, min(int(rank), s))
-    batch = kmat.shape[:-2]
-    dtype = kmat.dtype
-    diag0 = jnp.diagonal(kmat, axis1=-2, axis2=-1)  # [..., s]
+    batch = diag0.shape[:-1]
+    dtype = diag0.dtype
     trace = jnp.sum(diag0, axis=-1)
     scale = jnp.where(trace > 0, trace / s, 1.0)  # [...]
     eps = 100.0 * jnp.finfo(dtype).eps
@@ -253,9 +324,7 @@ def pivoted_cholesky(kmat: jax.Array, rank: int):
         piv = jnp.argmax(d, axis=-1)  # [...]
         dmax = jnp.take_along_axis(d, piv[..., None], axis=-1)[..., 0]
         ok = dmax > floor
-        col = jnp.take_along_axis(
-            kmat, piv[..., None, None], axis=-1
-        )[..., 0]  # K[:, :, piv] -> [..., s]
+        col = col_fn(piv)  # K[:, :, piv] -> [..., s]
         lrow = jnp.take_along_axis(
             lmat, piv[..., None, None], axis=-2
         )[..., 0, :]  # L[piv, :] -> [..., k]
@@ -275,6 +344,25 @@ def pivoted_cholesky(kmat: jax.Array, rank: int):
     denom = jnp.maximum(float(s - k), 1.0)
     delta = jnp.maximum(jnp.sum(resid, axis=-1) / denom, floor)
     return lmat, delta
+
+
+def pivoted_cholesky(kmat: jax.Array, rank: int):
+    """Greedy rank-``k`` pivoted partial Cholesky of a ``[..., s, s]``
+    SPD stack: ``(L [..., s, k], delta [...])`` with ``L L^T ~= K`` on
+    the k dominant pivots and ``delta`` the mean residual diagonal
+    (floored at a dtype-relative fraction of trace/s, so
+    ``P = L L^T + delta I`` is always SPD).  O(s * k^2) per matrix —
+    matmul-shaped, no factorization.  Callers pass a ``stop_gradient``
+    view: the preconditioner is numerics, never part of the autodiff
+    graph."""
+
+    def col_fn(piv):
+        return jnp.take_along_axis(
+            kmat, piv[..., None, None], axis=-1
+        )[..., 0]
+
+    diag0 = jnp.diagonal(kmat, axis1=-2, axis2=-1)  # [..., s]
+    return pivoted_cholesky_cols(diag0, col_fn, rank)
 
 
 def woodbury_factor(lmat: jax.Array, delta: jax.Array) -> jax.Array:
@@ -499,6 +587,73 @@ def inv_quad_logdet(kmat: jax.Array, y: jax.Array,
     return quad, logdet
 
 
+def inv_quad_logdet_matfree(matvec, matvec_sg, diag_sg, col_fn_sg, y,
+                            config: Optional[SolverConfig] = None):
+    """:func:`inv_quad_logdet` with the operator INJECTED — the matfree
+    lane's marginal-NLL engine.  The ``[E, s, s]`` gram stack never
+    exists; every math step is the materialized function's, op for op
+    (same probes, same PCG, same Woodbury/SLQ split, same
+    stop-gradient/surrogate structure), so lane parity is a numerics
+    statement, not a modeling one.
+
+    ``matvec(v)`` is the DIFFERENTIABLE masked+jittered ``K @ v`` on
+    ``[E, s, n]`` blocks (the checkpointed streaming path — the only
+    place the traced hyperparameters appear); ``matvec_sg`` the
+    stop-gradient twin the CG loop runs on (forward-only, free to take
+    the fused Pallas path); ``diag_sg [E, s]`` / ``col_fn_sg(piv)`` the
+    stop-gradient diagonal and pivot-column oracle feeding
+    :func:`pivoted_cholesky_cols` — O(E·s·k) preconditioner build from
+    streamed columns."""
+    s = y.shape[-1]
+    cfg = config or solver_config(s)
+    y_s = jax.lax.stop_gradient(y)
+    diag_sg = jax.lax.stop_gradient(diag_sg)
+
+    lmat, delta = pivoted_cholesky_cols(diag_sg, col_fn_sg, cfg.rank)
+    cfac = woodbury_factor(lmat, delta)
+
+    k1, k2 = _probe_keys(cfg.seed)
+    batch = y_s.shape[:-1]
+    g1 = jax.random.normal(
+        k1, batch + (lmat.shape[-1], cfg.probes), dtype=y_s.dtype
+    )
+    g2 = jax.random.normal(k2, batch + (s, cfg.probes), dtype=y_s.dtype)
+    z = jnp.einsum("...sk,...kn->...sn", lmat, g1) + jnp.sqrt(delta)[
+        ..., None, None
+    ] * g2
+
+    rhs = jnp.concatenate([y_s[..., None], z], axis=-1)
+    res = batched_pcg(
+        matvec_sg,
+        rhs,
+        precond=lambda v: woodbury_apply(lmat, delta, cfac, v),
+        iters=cfg.iters,
+        tol=cfg.tol,
+    )
+    alpha = res.x[..., 0]           # K^-1 y       [E, s]
+    u = res.x[..., 1:]              # K^-1 Z       [E, s, r]
+    vtil = woodbury_apply(lmat, delta, cfac, z)  # P^-1 Z
+    weights = jnp.sum(z * vtil, axis=-2)         # z^T P^-1 z  [E, r]
+
+    logdet_val = woodbury_logdet(lmat, delta, cfac) + slq_logdet_from_coeffs(
+        res.alphas[..., 1:], res.betas[..., 1:], weights
+    )
+
+    # differentiable legs — the ONLY places the traced operator appears;
+    # a^T K a = sum(a * (K a)) and the Hutchinson surrogate both go
+    # through ONE streamed application each
+    alpha = jax.lax.stop_gradient(alpha)
+    u = jax.lax.stop_gradient(u)
+    vtil = jax.lax.stop_gradient(vtil)
+    ka = matvec(alpha[..., None])[..., 0]
+    quad = 2.0 * jnp.sum(alpha * y, axis=-1) - jnp.sum(
+        alpha * ka, axis=-1
+    )
+    surr = jnp.sum(vtil * matvec(u), axis=(-2, -1)) / cfg.probes
+    logdet = jax.lax.stop_gradient(logdet_val - surr) + surr
+    return quad, logdet
+
+
 # --------------------------------------------------------------------------
 # SPD solve / logdet for materialized operators (the Laplace B systems)
 # --------------------------------------------------------------------------
@@ -707,16 +862,39 @@ def factored_logdet(kmat, smat, config: Optional[SolverConfig] = None):
 # --------------------------------------------------------------------------
 
 
-def solver_report(kmat, y, config: Optional[SolverConfig] = None) -> dict:
+def solver_report(kmat, y, config: Optional[SolverConfig] = None, *,
+                  matvec=None, diag=None, col_fn=None) -> dict:
     """Host-side convergence diagnostics of the iterative lane at the
     FITTED hyperparameters: ONE jitted :func:`inv_quad_logdet`-shaped
     pass over the (sub)stack — the preconditioner build, the multi-RHS
     PCG, and the value legs all come out of the same dispatch —
     reporting the knobs, the achieved residuals, and value finiteness.
     Forward-only; called once per fit by
-    ``models/common._emit_solver_stats``."""
+    ``models/common._emit_solver_stats``.
+
+    Matfree mode: pass ``kmat=None`` with the injected ``matvec`` /
+    ``diag`` / ``col_fn`` operator pieces (the
+    :func:`inv_quad_logdet_matfree` forward-only closures) and the probe
+    reruns THE PROGRAM THAT ACTUALLY EXECUTED — streamed matvecs, no
+    gram — so ``solver.residual`` never reports a materialized stand-in
+    for a matrix-free fit (and never rebuilds the [E, s, s] buffer the
+    fit avoided)."""
     import numpy as np
 
+    if matvec is not None:
+        s = int(y.shape[-1])
+        cfg = config or solver_config(s)
+        quad, logdet, rel, iters = (
+            np.asarray(r)
+            for r in _report_pass_matfree(matvec, diag, col_fn, y, cfg)
+        )
+        return _report_dict(cfg, quad, logdet, rel, iters)
+
+    if kmat is None:
+        raise ValueError(
+            "solver_report: operator mode (kmat=None) requires the "
+            "matvec/diag/col_fn closures"
+        )
     s = int(kmat.shape[-1])
     cfg = config or solver_config(s)
     quad, logdet, rel, iters = (
@@ -724,6 +902,12 @@ def solver_report(kmat, y, config: Optional[SolverConfig] = None) -> dict:
             lambda k_, y_: _report_pass(k_, y_, cfg)
         )(kmat, y)
     )
+    return _report_dict(cfg, quad, logdet, rel, iters)
+
+
+def _report_dict(cfg: SolverConfig, quad, logdet, rel, iters) -> dict:
+    import numpy as np
+
     return {
         "cg_iters": float(iters.max(initial=0.0)),
         "cg_iters_mean": float(iters.mean()) if iters.size else 0.0,
@@ -755,6 +939,42 @@ def _report_pass(kmat, y, cfg: SolverConfig):
     rhs = jnp.concatenate([y[..., None], z], axis=-1)
     res = batched_pcg(
         lambda v: jnp.einsum("...st,...tn->...sn", kmat, v),
+        rhs,
+        precond=lambda v: woodbury_apply(lmat, delta, cfac, v),
+        iters=cfg.iters,
+        tol=cfg.tol,
+    )
+    alpha = res.x[..., 0]
+    vtil = woodbury_apply(lmat, delta, cfac, z)
+    weights = jnp.sum(z * vtil, axis=-2)
+    quad = jnp.einsum("...s,...s->...", alpha, y)
+    logdet = woodbury_logdet(lmat, delta, cfac) + slq_logdet_from_coeffs(
+        res.alphas[..., 1:], res.betas[..., 1:], weights
+    )
+    return quad, logdet, res.rel_resid[..., 0], res.iters_used[..., 0]
+
+
+def _report_pass_matfree(matvec, diag, col_fn, y, cfg: SolverConfig):
+    """:func:`_report_pass` with the operator injected: streamed
+    preconditioner columns + streamed CG matvecs, the exact probe math
+    of the matfree fit.  Runs eagerly — once per fit, and the closures
+    carry concrete fitted arrays, so a jit wrapper would only constant-
+    fold them back in."""
+    s = y.shape[-1]
+    lmat, delta = pivoted_cholesky_cols(diag, col_fn, cfg.rank)
+    cfac = woodbury_factor(lmat, delta)
+    k1, k2 = _probe_keys(cfg.seed)
+    batch = y.shape[:-1]
+    g1 = jax.random.normal(
+        k1, batch + (lmat.shape[-1], cfg.probes), dtype=y.dtype
+    )
+    g2 = jax.random.normal(k2, batch + (s, cfg.probes), dtype=y.dtype)
+    z = jnp.einsum("...sk,...kn->...sn", lmat, g1) + jnp.sqrt(delta)[
+        ..., None, None
+    ] * g2
+    rhs = jnp.concatenate([y[..., None], z], axis=-1)
+    res = batched_pcg(
+        matvec,
         rhs,
         precond=lambda v: woodbury_apply(lmat, delta, cfac, v),
         iters=cfg.iters,

@@ -1,0 +1,274 @@
+"""Fused gram·vector streaming — the matfree solver lane's engine.
+
+The iterative lane (``ops/iterative.py``) broke the Cholesky *compute*
+ceiling but still materializes the full ``[E, s, s]`` gram stack before
+every CG matvec, so expert size is capped by *memory*: at s=4096 each
+expert's f32 gram is 64 MB and memplan refuses fits long before the MXU
+is saturated.  CG only ever needs ``K @ v`` (GPyTorch's BBMM line,
+arXiv 1809.11165), and the TPU distributed-linear-algebra playbook
+(arXiv 2112.09017) gets its wins by streaming tiles through fast memory
+instead of materializing operands.  This module is that stream:
+
+* :func:`streamed_matvec` — ``K(theta) @ v`` for a kernel expressible as
+  ``elementwise_transform(raw_tile)`` of either a squared-distance tile
+  (``kind="sqdist"``: the isotropic RBF/Matérn/RQ families) or an inner-
+  product tile (``kind="inner"``: the dot-product/polynomial families).
+  Row tiles of the distance identity ``|xi|² + |xj|² − 2<xi, xj>``, the
+  kernel transform, and the matvec accumulation run in one fused pass;
+  the full ``[s, s]`` gram never exists.
+
+* On TPU f32 the pass is a Pallas kernel (:func:`_fused_matvec_pallas`),
+  flash-attention-style tiling over the virtual ``[s, s]`` gram with
+  O(tile²) live VMEM bytes: grid ``(s/t, s/t)``, the ``j`` (column) axis
+  innermost so each output row-tile accumulates across column tiles in
+  its VMEM block.
+
+* Everywhere else (CPU tests, f64) a ``lax.scan`` row-panel fallback
+  (:func:`_panel_matvec_scan`) walks the IDENTICAL (i, j) tile schedule
+  — same tile raw values, same per-j accumulation order — so the lane is
+  tier-1-provable off-chip and the Pallas kernel has a bit-equivalence
+  oracle (``tests/test_matfree.py`` runs the Pallas path in interpret
+  mode against it).  The inner column loop is ``jax.checkpoint``-ed:
+  reverse-mode AD recomputes each O(tile²) transform tile instead of
+  storing all of them, so the *gradient* of a streamed matvec is
+  O(s·tile) resident too — without this the saved residuals would
+  silently rebuild the very [s, s] buffer the lane exists to avoid.
+
+Kernels opt in through the ``prepare_matvec`` / ``matvec_from_prepared``
+protocol (kernels/base.py): the prepared operand is the skinny ``[s, p]``
+row stack itself (NOT the PR 7 ``prepare()`` cache — that cache IS the
+O(s²) distance block the lane refuses to build), and each fused family
+contributes its elementwise map to :data:`TILE_TRANSFORMS` at import so
+the per-kernel tile transform and the family's ``gram`` stay one
+definition.  Transforms take ``(params, raw_tile)`` with ``params`` a
+small traced array — inside the Pallas kernel body closures over outer
+tracers are illegal, so hyperparameters travel as a real input.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from spark_gp_tpu.ops.distance import mxu_inner
+
+#: registry of per-kernel-family elementwise tile maps, populated by the
+#: kernel modules at import (``register_tile_transform``): name ->
+#: ``f(params, raw_tile) -> k_tile``.  One definition per family, shared
+#: verbatim by the Pallas kernel body and the scan fallback.
+TILE_TRANSFORMS: Dict[str, Callable] = {}
+
+_TILE_ENV = "GP_MATVEC_TILE"
+_DEFAULT_TILE = 512  # f32: tile² transform block = 1 MB, well under VMEM
+
+
+def register_tile_transform(name: str) -> Callable:
+    """Decorator: register a family's elementwise map under ``name``
+    (idempotent — re-imports overwrite with the same function)."""
+
+    def deco(fn: Callable) -> Callable:
+        TILE_TRANSFORMS[name] = fn
+        return fn
+
+    return deco
+
+
+def matvec_tile(s: int) -> int:
+    """Row/column tile size for an expert of size ``s`` (``GP_MATVEC_TILE``
+    overrides; clamped to ``[8, s]``)."""
+    env = os.environ.get(_TILE_ENV, "").strip()
+    t = int(env) if env else _DEFAULT_TILE
+    return max(8, min(t, int(s)))
+
+
+def matvec_tiles(s: int, tile: int | None = None) -> int:
+    """Number of row panels one streamed matvec walks (the
+    ``solver.matvec_tiles`` metric)."""
+    t = tile or matvec_tile(s)
+    return -(-int(s) // t)
+
+
+def _use_fused(x, tile: int) -> bool:
+    """Pallas-path gate, mirroring ``pallas_linalg._use_pallas``: TPU
+    backend, f32, tile-aligned shapes.  ``GP_MATVEC_PALLAS=0`` is the
+    kill switch (the scan fallback is always available and equivalent)."""
+    if os.environ.get("GP_MATVEC_PALLAS", "").strip() == "0":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if x.dtype != jnp.float32:
+        return False
+    s = x.shape[-2]
+    return s % tile == 0 and tile % 8 == 0
+
+
+def _pad_rows(a, sp: int):
+    """Zero-pad axis -2 (rows) up to ``sp``; padded columns contribute
+    nothing to the accumulation because the padded ``v`` rows are zero."""
+    s = a.shape[-2]
+    if s == sp:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[-2] = (0, sp - s)
+    return jnp.pad(a, widths)
+
+
+def _raw_tile(kind: str, xi, xj, si, sj, rows, cols):
+    """One raw [t_i, t_j] tile: squared distances (diagonal pinned to its
+    analytic 0, matching ``distance.sq_dist_self``) or inner products.
+    ``rows``/``cols`` are global index grids broadcastable to the tile."""
+    inner = mxu_inner(xi, xj)
+    if kind == "inner":
+        return inner
+    raw = jnp.maximum(si[:, None] + sj[None, :] - 2.0 * inner, 0.0)
+    return jnp.where(rows == cols, 0.0, raw)
+
+
+def _panel_matvec_scan(x, v, transform, params, kind: str, tile: int):
+    """The row-panel reference pass: outer scan over row tiles, inner
+    checkpointed scan over column tiles, accumulation order identical to
+    the Pallas grid so the two paths are bit-equivalent."""
+    s, _ = x.shape
+    n = v.shape[-1]
+    nt = matvec_tiles(s, tile)
+    sp = nt * tile
+    xp = _pad_rows(x, sp)
+    vp = _pad_rows(v, sp)
+    sqn = jnp.sum(xp * xp, axis=-1)  # [sp]; zero on padded rows
+    iota = jnp.arange(tile)
+
+    def panel(i):
+        r0 = i * tile
+        xi = jax.lax.dynamic_slice_in_dim(xp, r0, tile, axis=0)
+        si = jax.lax.dynamic_slice_in_dim(sqn, r0, tile, axis=0)
+        rows = r0 + iota
+
+        def col_step(acc, j):
+            c0 = j * tile
+            xj = jax.lax.dynamic_slice_in_dim(xp, c0, tile, axis=0)
+            sj = jax.lax.dynamic_slice_in_dim(sqn, c0, tile, axis=0)
+            vj = jax.lax.dynamic_slice_in_dim(vp, c0, tile, axis=0)
+            cols = c0 + iota
+            raw = _raw_tile(
+                kind, xi, xj, si, sj, rows[:, None], cols[None, :]
+            )
+            ktile = transform(params, raw)
+            return acc + ktile @ vj, None
+
+        acc0 = jnp.zeros((tile, n), dtype=v.dtype)
+        acc, _ = jax.lax.scan(
+            jax.checkpoint(col_step), acc0, jnp.arange(nt)
+        )
+        return acc
+
+    out = jax.lax.map(panel, jnp.arange(nt))  # [nt, tile, n]
+    return out.reshape(sp, n)[:s]
+
+
+def _fused_matvec_pallas(x, v, transform, params, kind: str, tile: int,
+                         interpret: bool = False):
+    """The fused Pallas pass: grid (row tiles, column tiles), ``j``
+    innermost and sequential so each output row-tile block accumulates
+    across column tiles while resident in VMEM — O(tile²) live bytes for
+    the virtual [s, s] gram."""
+    s, p = x.shape
+    n = v.shape[-1]
+    nt = s // tile
+    sqn = jnp.sum(x * x, axis=-1)[:, None]  # [s, 1]
+    par = params.reshape(1, -1)
+    if par.shape[-1] == 0:  # transforms ignore params; keep a real operand
+        par = jnp.zeros((1, 1), dtype=x.dtype)
+
+    def body(par_ref, xi_ref, xj_ref, si_ref, sj_ref, vj_ref, o_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            o_ref[...] = jnp.zeros_like(o_ref)
+
+        # Mosaic has no 1-D iota; build the global index grids in 2-D
+        rows = i * tile + jax.lax.broadcasted_iota(
+            jnp.int32, (tile, tile), 0
+        )
+        cols = j * tile + jax.lax.broadcasted_iota(
+            jnp.int32, (tile, tile), 1
+        )
+        raw = _raw_tile(
+            kind, xi_ref[...], xj_ref[...], si_ref[..., 0], sj_ref[..., 0],
+            rows, cols,
+        )
+        ktile = transform(par_ref[...].reshape(-1), raw)
+        o_ref[...] += ktile @ vj_ref[...]
+
+    grid = (nt, nt)
+    out = pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct((s, n), v.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(par.shape, lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, p), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, p), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, n), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        # no dimension_semantics override: the default sequential grid is
+        # exactly what the cross-j output accumulation requires
+        out_specs=pl.BlockSpec((tile, n), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(par, x, x, sqn, sqn, v)
+    return out
+
+
+def streamed_matvec(x, v, transform, params, kind: str = "sqdist",
+                    tile: int | None = None, differentiable: bool = False,
+                    interpret: bool | None = None):
+    """``K(theta) @ v`` without materializing ``K``.
+
+    ``x`` is the ``[..., s, p]`` row stack (the matfree "prepared"
+    operand), ``v`` the ``[..., s, n]`` RHS block, ``transform`` an
+    elementwise ``(params, raw_tile) -> k_tile`` map from
+    :data:`TILE_TRANSFORMS`, ``kind`` the raw-tile flavor.  Leading batch
+    dims are vmapped.  ``differentiable=True`` pins the scan fallback:
+    the Pallas kernel is forward-only (the CG loop runs on stop-gradient
+    operands and never needs its VJP), while the objective's value legs
+    differentiate through the checkpointed scan.
+    """
+    if v.ndim == x.ndim - 1:
+        return streamed_matvec(
+            x, v[..., None], transform, params, kind=kind, tile=tile,
+            differentiable=differentiable, interpret=interpret,
+        )[..., 0]
+    if x.ndim > 2:
+        return jax.vmap(
+            lambda xe, ve: streamed_matvec(
+                xe, ve, transform, params, kind=kind, tile=tile,
+                differentiable=differentiable, interpret=interpret,
+            )
+        )(x, v)
+    t = tile or matvec_tile(x.shape[-2])
+    params = jnp.asarray(params, dtype=x.dtype)
+    force_pallas = interpret is True
+    if force_pallas or (
+        not differentiable and interpret is None and _use_fused(x, t)
+    ):
+        return _fused_matvec_pallas(
+            x, v, transform, params, kind, t,
+            interpret=bool(interpret) or jax.default_backend() != "tpu",
+        )
+    return _panel_matvec_scan(x, v, transform, params, kind, t)

@@ -267,7 +267,10 @@ def _stamp(instr, model, degradations: List[dict]) -> None:
 #: rung order per entry point; per-class policy below selects which of a
 #: ladder's rungs a failure class may fall to (docs/RESILIENCE.md table)
 LADDERS = {
-    "fit": ("native", "iterative", "segmented", "host_f64", "strict_lane"),
+    "fit": (
+        "native", "iterative", "matfree", "segmented", "host_f64",
+        "strict_lane",
+    ),
     "fit_sharded": ("sharded", "dcn_fallback", "single_host", "strict_lane"),
     "predict": ("chunked", "chunk_halved", "host_solve"),
     "ppa": ("device_solve", "host_solve"),
@@ -279,8 +282,12 @@ LADDERS = {
 #: factorization workspace — the peak resident of every exact fit
 #: program — replaced by O(E s (k + r)) CG state, which is the cheapest
 #: memory axis to degrade along (no smaller dispatches, no host sync).
+#: Next comes ``matfree`` (ops/pallas_matvec.py): the same CG math with
+#: the gram itself streamed — O(E s (k + r + tile)) residents — the rung
+#: for stacks whose [E, s, s] gram alone exceeds memory; only then do
+#: dispatches shrink (``segmented``) or leave the device (``host_f64``).
 _FIT_POLICY = {
-    OOM: ("iterative", "segmented", "host_f64"),
+    OOM: ("iterative", "matfree", "segmented", "host_f64"),
     COMPILE: ("segmented", "host_f64"),
     NON_FINITE_EXHAUSTED: ("host_f64",),
     NOT_PSD_EXHAUSTED: ("host_f64",),
@@ -348,6 +355,27 @@ def _fit_rung_applies(est, rung: str, cls: str, visited,
         if expert_size is not None:
             return resolve_solver(int(expert_size), lane) != "iterative"
         return lane != "iterative"
+    if rung == "matfree":
+        # the matrix-free solver rung (ops/pallas_matvec.py) — applicable
+        # only when the fit was not already running it AND the kernel
+        # carries the streamed-matvec capability (incapable kernels would
+        # silently re-run the materialized iterative program: same bytes,
+        # same OOM, a wasted attempt)
+        from spark_gp_tpu.kernels.base import supports_matfree
+        from spark_gp_tpu.ops.iterative import (
+            active_solver_lane,
+            resolve_solver,
+        )
+
+        try:
+            if not supports_matfree(est._get_kernel()):
+                return False
+        except Exception:  # noqa: BLE001 — capability unknowable: skip rung
+            return False
+        lane = active_solver_lane()
+        if expert_size is not None:
+            return resolve_solver(int(expert_size), lane) != "matfree"
+        return lane != "matfree"
     if rung == "segmented":
         return (
             getattr(est, "_checkpoint_dir", None) is None
@@ -402,13 +430,14 @@ def _fit_rung_scope(est, rung: str):
         finally:
             set_precision_lane(prev_lane)
         return
-    if rung == "iterative":
-        # the solver rung: pin the CG/Lanczos lane for the re-fit (the
-        # fit entry points carry it in their jit keys, so the rung's
-        # dispatch compiles its own executable) and restore after
+    if rung in ("iterative", "matfree"):
+        # the solver rungs: pin the CG/Lanczos (or matrix-free streaming)
+        # lane for the re-fit (the fit entry points carry it in their jit
+        # keys, so the rung's dispatch compiles its own executable) and
+        # restore after
         from spark_gp_tpu.ops.iterative import set_solver_lane
 
-        prev_solver = set_solver_lane("iterative")
+        prev_solver = set_solver_lane(rung)
         try:
             yield
         finally:

@@ -78,6 +78,12 @@ _FIT_RUNG_WORK_FACTOR = {
     # exact rungs' liveness — are replaced by skinny CG state accounted
     # separately below (O(E s (k + r)) workspace, not O(E s^2) factor)
     "iterative": 6.0,
+    # the matrix-free rung (ops/pallas_matvec.py) carries NO gram-sized
+    # resident at all — its byte model in fit_dispatch_bytes drops the
+    # gram term entirely and accounts the streamed workspace separately;
+    # the factor is unused but present so fit_model_key calibration has
+    # a row to ratchet
+    "matfree": 0.0,
 }
 
 
@@ -236,6 +242,25 @@ def fit_dispatch_bytes(
     # conservative, which is the safe direction.  The work term scales
     # with heads^2: the multiclass Laplace dK-stack jacobians cross every
     # latent-head pair.
+    if rung == "matfree":
+        # the matrix-free solver rung (ops/pallas_matvec.py): NO gram
+        # term — not even the theta-invariant cache (the lane skips its
+        # build; that cache IS the O(E s^2) block being refused).  Live
+        # residents are the stack plus skinny per-expert state: the
+        # rank-k preconditioner [E, s, k], the multi-RHS CG block and
+        # carries (as the iterative rung), and the streamed matvec's
+        # O(s·tile) row-panel working set (checkpointed AD recomputes
+        # tiles, so gradients stay panel-sized too) — O(E s (k + r +
+        # tile)) total, the whole point of the lane
+        from spark_gp_tpu.ops.iterative import solver_config
+        from spark_gp_tpu.ops.pallas_matvec import matvec_tile
+
+        cfg = solver_config(int(s))
+        cols = cfg.rank + 5.0 * (1.0 + cfg.probes) + float(
+            matvec_tile(int(s))
+        )
+        raw = stack + e * s * cols * heads * itemsize
+        return _calibrated(fit_model_key(family, rung), raw)
     raw = stack + (1.0 + k * heads * heads) * gram
     if rung == "iterative":
         # the solver rung's extra residents are SKINNY, not square: the
@@ -526,12 +551,26 @@ def plan_fit_dispatch(est, instr, data) -> Optional[PlanDecision]:
     from spark_gp_tpu.ops.iterative import resolve_solver
 
     # the "native" candidate prices the program the fit will ACTUALLY
-    # dispatch first: the iterative-rung byte model when the solver lane
-    # (pinned, or auto over large experts) already resolves there —
-    # mirroring common._dispatch_raw_bytes
-    native_rung = (
-        "iterative" if resolve_solver(s) == "iterative" else "native"
+    # dispatch first: the iterative- (or matfree-) rung byte model when
+    # the solver lane (pinned, or budget-aware auto over large experts)
+    # already resolves there — mirroring common._dispatch_raw_bytes
+    resolved = resolve_solver(
+        s, num_experts=e, n_features=p, itemsize=itemsize
     )
+    if resolved == "matfree":
+        try:
+            from spark_gp_tpu.kernels.base import supports_matfree
+
+            native_rung = (
+                "matfree" if supports_matfree(est._get_kernel())
+                else "iterative"
+            )
+        except Exception:  # noqa: BLE001 — capability unknowable: price big
+            native_rung = "iterative"
+    elif resolved == "iterative":
+        native_rung = "iterative"
+    else:
+        native_rung = "native"
     candidates = [
         ("native",
          fit_dispatch_bytes(e, s, p, itemsize, native_rung, n_targets,
@@ -552,6 +591,18 @@ def plan_fit_dispatch(est, instr, data) -> Optional[PlanDecision]:
         candidates.append((
             "iterative",
             fit_dispatch_bytes(e, s, p, itemsize, "iterative", n_targets,
+                               family),
+        ))
+    if fallback._fit_rung_applies(
+        est, "matfree", fallback.OOM, set(), expert_size=s
+    ):
+        # the matrix-free rung as a PRE-SIZED choice below iterative:
+        # same CG math with the gram streamed, O(E s (k + r + tile))
+        # residents — the rung that admits expert sizes whose gram stack
+        # alone exceeds the budget
+        candidates.append((
+            "matfree",
+            fit_dispatch_bytes(e, s, p, itemsize, "matfree", n_targets,
                                family),
         ))
     if fallback._fit_rung_applies(est, "segmented", fallback.OOM, set()):

@@ -234,6 +234,29 @@ def test_bench_emits_one_parseable_result_line():
     assert sl["fitted_theta"]["rel_delta"] <= 5e-2, sl
     assert sl["solver_metrics"].get("solver_lane") == "iterative", sl
     assert sl["solver_metrics"].get("solver.residual", 1.0) <= 1e-2, sl
+    # the matfree column (ISSUE 20, ops/pallas_matvec.py): the gram-less
+    # streaming lane runs the same CG/SLQ program, so it must produce a
+    # live eval rate, a modeled peak strictly under the iterative gram
+    # stack at s = 2048, admission under a tight budget the iterative
+    # rung exceeds (the O(E*s^2) ceiling the lane breaks), theta parity
+    # within the same stochastic bar, and its own engaged provenance
+    assert largest["nll_evals_per_sec"]["matfree"] > 0, sl
+    from spark_gp_tpu.resilience import memplan as _memplan
+    big = largest["modeled_fit_bytes"]
+    assert _memplan.predicted_bytes(big["matfree"]) < (
+        _memplan.predicted_bytes(big["iterative"])
+    ), big
+    tight = largest["matfree_budget_demo"]
+    assert tight["matfree_fits"] is True, sl
+    assert tight["iterative_fits"] is False, sl
+    assert sl["fitted_theta"]["rel_delta_matfree"] <= 5e-2, sl
+    assert sl["solver_metrics_matfree"].get("solver_lane") == "matfree", sl
+    assert sl["solver_metrics_matfree"].get(
+        "solver.matfree_engaged"
+    ) == 1.0, sl
+    assert sl["solver_metrics_matfree"].get(
+        "solver.residual", 1.0
+    ) <= 1e-2, sl
     # the expert aggregation plane (ISSUE 16, models/aggregation.py): on
     # the clustered stand-in at E = 64 the healed product beats plain PoE
     # on held-out NLPD and lands 90% coverage near-calibrated while PoE's

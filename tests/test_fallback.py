@@ -212,7 +212,7 @@ def test_persistent_oom_raises_single_classified_error(problem):
     err = excinfo.value
     assert err.failure_class == fallback.OOM
     assert [d["to"] for d in err.degradations] == [
-        "iterative", "segmented", "host_f64",
+        "iterative", "matfree", "segmented", "host_f64",
     ]
     assert err.__cause__ is not None
     assert fallback.classify_failure(err) == fallback.OOM

@@ -173,7 +173,8 @@ def test_oom_exhausted_fit_dumps_exactly_one_schema_valid_bundle(
     rungs = [(d["from"], d["to"]) for d in bundle["degradations"]]
     assert rungs == [
         ("native", "iterative"),
-        ("iterative", "segmented"),
+        ("iterative", "matfree"),
+        ("matfree", "segmented"),
         ("segmented", "host_f64"),
     ]
     # the last-N recorder events include the classified-failure sequence
@@ -356,7 +357,7 @@ def test_bundle_still_dumped_with_tracing_off(tmp_path, monkeypatch):
     assert bundle["failure_class"] == "oom"
     assert bundle["spans"] == []  # no tracer, no tree — by design
     assert [d["to"] for d in bundle["degradations"]] == [
-        "iterative", "segmented", "host_f64",
+        "iterative", "matfree", "segmented", "host_f64",
     ]
 
 

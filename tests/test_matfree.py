@@ -1,0 +1,555 @@
+"""Matrix-free expert inference — the streaming gram·vector lane (ISSUE 20).
+
+The acceptance bars as tier-1 assertions: every fused family's streamed
+matvec matches the dense ``K @ v`` product; the Pallas kernel
+(interpret mode) is bit-equivalent to its ``lax.scan`` row-panel oracle;
+the matfree NLL/grad match the iterative lane within 1e-5; the compiled
+matfree objective carries NO ``[E, s, s]`` buffer while the iterative
+compile provably does; a gram-forbidden spy kernel runs the matfree lane
+untouched and a prepare-less custom kernel silently falls back to the
+materialized path bit-for-bit; budget-aware ``auto`` resolution flips
+both directions on ``GP_MEMPLAN_LIMIT_BYTES``; the s = 8192 fit is
+plan-admitted under a staged limit the iterative gram exceeds (zero
+reactive rungs, ``plan.miss`` = 0); the on-device redundancy scorer
+matches the host oracle; and the pin checker bans gram-materializing
+calls inside the solver engine files.
+"""
+
+import os
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_gp_tpu import GaussianProcessRegression, RBFKernel
+from spark_gp_tpu.kernels.base import (
+    Const,
+    EyeKernel,
+    supports_matfree,
+)
+from spark_gp_tpu.kernels.families import (
+    DotProductKernel,
+    PeriodicKernel,
+    PolynomialKernel,
+    RationalQuadraticKernel,
+)
+from spark_gp_tpu.kernels.matern import (
+    Matern12Kernel,
+    Matern32Kernel,
+    Matern52Kernel,
+)
+from spark_gp_tpu.models.likelihood import (
+    batched_nll,
+    make_value_and_grad,
+    masked_matfree_operator,
+)
+from spark_gp_tpu.ops import iterative as it
+from spark_gp_tpu.ops.pallas_matvec import (
+    TILE_TRANSFORMS,
+    matvec_tile,
+    matvec_tiles,
+    streamed_matvec,
+)
+from spark_gp_tpu.parallel.experts import ExpertData, group_for_experts
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: lane parity bar from the ISSUE: matfree and iterative run the SAME
+#: CG/SLQ program (same probes, same seed, same preconditioner rank), so
+#: the only daylight is matvec summation order — float noise, not
+#: estimator bias
+NLL_GRAD_REL_BAR = 1e-5
+
+
+@pytest.fixture(autouse=True)
+def _clean_solver_lane(monkeypatch):
+    """Every test starts and ends on the default (exact) lane, with no
+    inherited solver/matvec/memplan knobs (the test_iterative.py
+    convention — the knobs are process-global state)."""
+    for var in [
+        v for v in os.environ
+        if v.startswith(("GP_SOLVER_", "GP_MATVEC_", "GP_MEMPLAN"))
+    ]:
+        monkeypatch.delenv(var, raising=False)
+    it.set_solver_lane(None)
+    yield
+    it.set_solver_lane(None)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _expert_stack(rng, n=240, s=40, dtype=np.float64):
+    x = rng.normal(size=(n, 3))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=n)
+    data = group_for_experts(x, y, s)
+    return ExpertData(
+        x=jnp.asarray(np.asarray(data.x), dtype=dtype),
+        y=jnp.asarray(np.asarray(data.y), dtype=dtype),
+        mask=jnp.asarray(np.asarray(data.mask), dtype=dtype),
+    )
+
+
+# -- the streaming engine ---------------------------------------------------
+
+
+def _fused_families(rng):
+    """One instance of every family whose tile transform is fused."""
+    return [
+        RBFKernel(0.7, 1e-6, 10.0),
+        Matern12Kernel(0.8, 1e-6, 10.0),
+        Matern32Kernel(0.8, 1e-6, 10.0),
+        Matern52Kernel(0.8, 1e-6, 10.0),
+        RationalQuadraticKernel(0.9, 1.3),
+        DotProductKernel(0.5),
+        PolynomialKernel(2, 0.7),
+    ]
+
+
+def test_streamed_matvec_matches_dense_every_fused_family(rng):
+    """K @ v from streamed tiles == K @ v from the materialized gram, for
+    every registered tile transform, at a tile that does NOT divide s
+    (the ragged last panel is the easy thing to get wrong)."""
+    s, p = 53, 4
+    x = jnp.asarray(rng.normal(size=(s, p)))
+    v = jnp.asarray(rng.normal(size=(s, 2)))
+    for kernel in _fused_families(rng):
+        assert supports_matfree(kernel), kernel
+        theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=x.dtype)
+        dense = kernel.gram(theta, x) @ v
+        mcache = kernel.prepare_matvec(x)
+        for tile in (8, 16, s):
+            streamed = kernel.matvec_from_prepared(
+                theta, mcache, v, tile=tile
+            )
+            np.testing.assert_allclose(
+                np.asarray(streamed), np.asarray(dense),
+                rtol=1e-10, atol=1e-10,
+                err_msg=f"{type(kernel).__name__} tile={tile}",
+            )
+
+
+def test_streamed_matvec_batched_and_vector_rhs(rng):
+    """Leading expert batch dims vmap through; a rank-1 RHS round-trips
+    through the [., 1] column path."""
+    e, s, p = 3, 24, 3
+    x = jnp.asarray(rng.normal(size=(e, s, p)))
+    v = jnp.asarray(rng.normal(size=(e, s)))
+    kernel = RBFKernel(0.6, 1e-6, 10.0)
+    theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=x.dtype)
+    out = streamed_matvec(x, v, TILE_TRANSFORMS["rbf"], theta, tile=8)
+    assert out.shape == (e, s)
+    dense = jnp.einsum(
+        "eij,ej->ei", jax.vmap(lambda xe: kernel.gram(theta, xe))(x), v
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), rtol=1e-10, atol=1e-10
+    )
+
+
+def test_pallas_interpret_bit_equivalent_to_scan(rng):
+    """The fused Pallas kernel (interpret mode off-TPU) walks the same
+    (i, j) tile schedule in the same accumulation order as the scan
+    fallback — bitwise identical output, the oracle that makes the lane
+    tier-1-provable without hardware."""
+    s, p = 64, 4
+    x = jnp.asarray(rng.normal(size=(s, p)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(s, 3)).astype(np.float32))
+    for name in ("rbf", "matern32", "rq"):
+        theta = jnp.asarray([0.8, 1.3][: 2 if name == "rq" else 1],
+                            dtype=jnp.float32)
+        scan = streamed_matvec(
+            x, v, TILE_TRANSFORMS[name],
+            theta, kind="sqdist", tile=16,
+        )
+        fused = streamed_matvec(
+            x, v, TILE_TRANSFORMS[name],
+            theta, kind="sqdist", tile=16, interpret=True,
+        )
+        assert np.array_equal(np.asarray(scan), np.asarray(fused)), name
+
+
+def test_matvec_tile_knob_and_tile_count(monkeypatch):
+    assert matvec_tile(4096) == 512  # default
+    assert matvec_tile(100) == 100  # clamped to s
+    monkeypatch.setenv("GP_MATVEC_TILE", "128")
+    assert matvec_tile(4096) == 128
+    assert matvec_tiles(4096, 128) == 32
+    assert matvec_tiles(100) == 1
+
+
+def test_incapable_families_stay_materialized():
+    """ARD metrics / periodic / products have no streaming form — the
+    capability gate must say so (the fallback contract rides on it)."""
+    assert not supports_matfree(PeriodicKernel(1.0, 1.0))
+    assert not supports_matfree(
+        RBFKernel(0.7, 1e-6, 10.0) * Matern32Kernel(0.8, 1e-6, 10.0)
+    )
+    # composites of capable children compose
+    assert supports_matfree(
+        1.0 * RBFKernel(0.7, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    )
+
+
+# -- the matfree solver program ---------------------------------------------
+
+
+def test_pivoted_cholesky_cols_bitwise_vs_materialized(rng):
+    """The column-oracle factorization is the SAME scan as the dense one
+    — bit-for-bit, so the matfree preconditioner is not a new numeric."""
+    e, s = 3, 48
+    x = rng.normal(size=(e, s, 3))
+    d = ((x[:, :, None, :] - x[:, None, :, :]) ** 2).sum(-1)
+    k = jnp.asarray(np.exp(-d / 2.0) + 1e-2 * np.eye(s)[None])
+    dense_l, dense_delta = it.pivoted_cholesky(k, 10)
+    diag0 = jnp.diagonal(k, axis1=-2, axis2=-1)
+
+    def col_fn(piv):
+        return jnp.take_along_axis(k, piv[..., None, None], axis=-1)[..., 0]
+
+    streamed_l, streamed_delta = it.pivoted_cholesky_cols(diag0, col_fn, 10)
+    assert np.array_equal(np.asarray(dense_l), np.asarray(streamed_l))
+    assert np.array_equal(np.asarray(dense_delta), np.asarray(streamed_delta))
+
+
+def test_matfree_nll_and_grad_parity_vs_iterative(rng):
+    """The lane-vs-lane bar: same CG/SLQ program, injected matvec vs
+    materialized gram — NLL and gradient within 1e-5 (measured ~1e-14;
+    the bar leaves headroom for f32 accelerators), with a ragged masked
+    expert and the jitter operand engaged."""
+    kernel = 1.0 * RBFKernel(0.7, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    data = _expert_stack(rng, n=230, s=48)  # last expert ragged
+    theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=data.x.dtype)
+    for jitter in (None, 1e-3):
+        vals = {}
+        for lane in ("iterative", "matfree"):
+            it.set_solver_lane(lane)
+            try:
+                fn = jax.value_and_grad(
+                    lambda th: batched_nll(
+                        kernel, th, data, jitter=jitter
+                    )
+                )
+                vals[lane] = fn(theta)
+            finally:
+                it.set_solver_lane(None)
+        (v_it, g_it), (v_mf, g_mf) = vals["iterative"], vals["matfree"]
+        assert abs(float(v_it - v_mf)) / abs(float(v_it)) < NLL_GRAD_REL_BAR
+        g_scale = max(float(np.max(np.abs(np.asarray(g_it)))), 1e-12)
+        assert (
+            float(np.max(np.abs(np.asarray(g_it - g_mf)))) / g_scale
+            < NLL_GRAD_REL_BAR
+        ), (jitter, np.asarray(g_it), np.asarray(g_mf))
+
+
+def test_compiled_matfree_objective_has_no_ess_buffer(rng):
+    """The memory proof: the lowered+compiled matfree objective contains
+    NO [E, s, s]-shaped tensor anywhere in its optimized HLO, while the
+    iterative compile provably does (the self-test that the probe can
+    see gram buffers at all).  CPU's memory_analysis() reports zero
+    temps, so the buffer scan is on the compiled module text."""
+    kernel = 1.0 * RBFKernel(0.7, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    data = _expert_stack(rng, n=512, s=256)
+    theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=data.x.dtype)
+    s = int(data.x.shape[1])
+    gram_shape = re.compile(rf"\[(?:\d+,)?{s},{s}\]")
+
+    def compiled_text(lane, tile):
+        it.set_solver_lane(lane)
+        try:
+            os.environ["GP_MATVEC_TILE"] = str(tile)
+            fn = jax.value_and_grad(
+                lambda th: batched_nll(kernel, th, data, jitter=1e-3)
+            )
+            return jax.jit(fn).lower(theta).compile().as_text()
+        finally:
+            os.environ.pop("GP_MATVEC_TILE", None)
+            it.set_solver_lane(None)
+
+    assert gram_shape.search(compiled_text("iterative", 64)), (
+        "probe self-test: the iterative compile should carry the "
+        "materialized [E, s, s] gram"
+    )
+    hits = gram_shape.findall(compiled_text("matfree", 64))
+    assert not hits, (
+        f"[.., {s}, {s}] buffers survived in the compiled matfree "
+        f"objective: {hits[:5]}"
+    )
+
+
+class _GramForbiddenRBF(RBFKernel):
+    """RBF whose materialized-gram entry points refuse to trace: proves
+    the matfree objective touches the operator only through the
+    streaming protocol (prepare_matvec / matvec_from_prepared / diag /
+    cross columns)."""
+
+    def gram(self, theta, x):
+        raise AssertionError("kernel.gram inside a matfree objective")
+
+    def gram_from_cache(self, theta, cache):
+        raise AssertionError(
+            "kernel.gram_from_cache inside a matfree objective"
+        )
+
+
+def test_matfree_lane_never_materializes_spy_kernel(rng):
+    data = _expert_stack(rng)
+    kernel = (
+        1.0 * _GramForbiddenRBF(0.6, 1e-6, 10.0)
+        + Const(1e-2) * EyeKernel()
+    )
+    theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=data.x.dtype)
+    it.set_solver_lane("matfree")
+    try:
+        value, grad = make_value_and_grad(kernel, data)(theta)
+    finally:
+        it.set_solver_lane(None)
+    assert np.isfinite(float(value))
+    assert np.all(np.isfinite(np.asarray(grad)))
+    # the spy bites on the materialized lane — the test tests itself
+    it.set_solver_lane("iterative")
+    try:
+        with pytest.raises(AssertionError, match="matfree objective"):
+            make_value_and_grad(kernel, data)(theta)
+    finally:
+        it.set_solver_lane(None)
+
+
+class _PrepareLessRBF(RBFKernel):
+    """A user kernel predating the streaming protocol: no
+    prepare_matvec/matvec_from_prepared.  The matfree lane must fall
+    back to the materialized iterative path bit-for-bit."""
+
+    prepare_matvec = None
+    matvec_from_prepared = None
+
+
+def test_prepare_less_kernel_falls_back_bit_for_bit(rng):
+    kernel = (
+        1.0 * _PrepareLessRBF(0.6, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    )
+    assert not supports_matfree(kernel)
+    data = _expert_stack(rng)
+    theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=data.x.dtype)
+    out = {}
+    for lane in ("iterative", "matfree"):
+        it.set_solver_lane(lane)
+        try:
+            out[lane] = make_value_and_grad(kernel, data)(theta)
+        finally:
+            it.set_solver_lane(None)
+    assert np.array_equal(
+        np.asarray(out["iterative"][0]), np.asarray(out["matfree"][0])
+    )
+    assert np.array_equal(
+        np.asarray(out["iterative"][1]), np.asarray(out["matfree"][1])
+    )
+
+
+def test_solver_report_matvec_mode(rng):
+    """solver_report with an injected operator (no kmat) reports the
+    program that executed — residual at the CG tolerance, same dict
+    shape as the materialized mode."""
+    kernel = 1.0 * RBFKernel(0.7, 1e-6, 10.0) + Const(1e-2) * EyeKernel()
+    data = _expert_stack(rng, n=120, s=24)
+    theta = jnp.asarray(np.asarray(kernel.init_theta()), dtype=data.x.dtype)
+    mv, mv_sg, diag_sg, col_sg = masked_matfree_operator(
+        kernel, theta, data.x, data.mask, jitter=None
+    )
+    report = it.solver_report(
+        None, data.y * data.mask, matvec=mv_sg, diag=diag_sg, col_fn=col_sg
+    )
+    assert report["residual"] <= 1e-2
+    assert report["cg_iters"] >= 1
+    assert report["quad_finite"] and report["logdet_finite"]
+    for key in ("precond_rank", "probes"):
+        assert key in report
+    with pytest.raises(ValueError):
+        it.solver_report(None, data.y)  # operator mode needs the closures
+
+
+# -- budget-aware auto resolution -------------------------------------------
+
+
+def test_auto_resolution_flips_both_ways_on_budget(rng, monkeypatch):
+    """A tight GP_MEMPLAN_LIMIT_BYTES flips an s-large auto fit to
+    matfree BEFORE the reactive ladder reacts; a generous budget (or no
+    budget) keeps the iterative lane.  Both directions, same shapes."""
+    from spark_gp_tpu.resilience import memplan
+
+    s, e, p, itemsize = 4096, 4, 3, 8
+    iter_pred = memplan.predicted_bytes(
+        memplan.fit_dispatch_bytes(e, s, p, itemsize, "iterative")
+    )
+    matfree_pred = memplan.predicted_bytes(
+        memplan.fit_dispatch_bytes(e, s, p, itemsize, "matfree")
+    )
+    assert matfree_pred < iter_pred
+    monkeypatch.setenv("GP_SOLVER_LANE", "auto")
+    kwargs = dict(num_experts=e, n_features=p, itemsize=itemsize)
+    # no budget: size-threshold behavior is unchanged
+    assert it.resolve_solver(s, **kwargs) == "iterative"
+    assert it.resolve_solver(64, **kwargs) == "exact"
+    # tight budget (between the two predictions): flips to matfree
+    monkeypatch.setenv(
+        "GP_MEMPLAN_LIMIT_BYTES",
+        str(int((matfree_pred + iter_pred) / 2)),
+    )
+    assert it.resolve_solver(s, **kwargs) == "matfree"
+    assert it.resolve_solver(64, **kwargs) == "exact"  # exact still wins
+    # generous budget: flips back
+    monkeypatch.setenv("GP_MEMPLAN_LIMIT_BYTES", str(int(2 * iter_pred)))
+    assert it.resolve_solver(s, **kwargs) == "iterative"
+    # the budget salts the jit key so retrace happens on flip
+    monkeypatch.setenv(
+        "GP_MEMPLAN_LIMIT_BYTES",
+        str(int((matfree_pred + iter_pred) / 2)),
+    )
+    key_tight = it.solver_jit_key()
+    monkeypatch.setenv("GP_MEMPLAN_LIMIT_BYTES", str(int(2 * iter_pred)))
+    key_loose = it.solver_jit_key()
+    assert key_tight != key_loose
+
+
+def test_memplan_matfree_rung_rows():
+    """The matfree byte model carries NO gram term: its rows undercut
+    the iterative rung ever more steeply with s (O(s) vs O(s^2))."""
+    from spark_gp_tpu.resilience import memplan
+
+    for s in (256, 2048, 8192):
+        matfree = memplan.fit_dispatch_bytes(4, s, 3, 4, "matfree")
+        iterative = memplan.fit_dispatch_bytes(4, s, 3, 4, "iterative")
+        assert matfree < iterative, (s, matfree, iterative)
+    r_small = memplan.fit_dispatch_bytes(4, 256, 3, 4, "iterative") / (
+        memplan.fit_dispatch_bytes(4, 256, 3, 4, "matfree")
+    )
+    r_big = memplan.fit_dispatch_bytes(4, 8192, 3, 4, "iterative") / (
+        memplan.fit_dispatch_bytes(4, 8192, 3, 4, "matfree")
+    )
+    assert r_big > r_small
+
+
+def test_s8192_fit_plan_admitted_under_staged_limit(rng, monkeypatch):
+    """The acceptance run: one s = 8192 expert under a staged memory
+    limit the iterative gram stack exceeds.  The fit must be
+    plan-admitted onto the matfree rung up front — plan.miss 0, zero
+    reactive ladder rungs — and stamp solver_lane=matfree.  The device
+    one-dispatch optimizer is the planned path (the host optimizer's
+    per-evaluation programs are exempt from planning); tiny
+    CG/probe/rank/L-BFGS budgets keep the CPU walltime down; they do
+    not change what is being proven (the program's memory shape)."""
+    from spark_gp_tpu.resilience import memplan
+
+    n, s = 8192, 8192
+    x = rng.normal(size=(n, 2))
+    y = np.sin(x.sum(axis=1)) + 0.05 * rng.normal(size=n)
+    itemsize = 8  # tests run x64
+    iter_pred = memplan.predicted_bytes(
+        memplan.fit_dispatch_bytes(1, s, 2, itemsize, "iterative")
+    )
+    matfree_pred = memplan.predicted_bytes(
+        memplan.fit_dispatch_bytes(1, s, 2, itemsize, "matfree")
+    )
+    budget = (matfree_pred + iter_pred) / 2
+    assert matfree_pred <= budget < iter_pred
+    monkeypatch.setenv("GP_MEMPLAN_LIMIT_BYTES", str(int(budget)))
+    monkeypatch.setenv("GP_SOLVER_LANE", "auto")
+    monkeypatch.setenv("GP_SOLVER_MAX_ITERS", "3")
+    monkeypatch.setenv("GP_SOLVER_PROBES", "1")
+    monkeypatch.setenv("GP_SOLVER_PRECOND_RANK", "2")
+    monkeypatch.setenv("GP_SOLVER_CG_TOL", "1e-3")
+    monkeypatch.setenv("GP_MATVEC_TILE", "1024")
+    model = (
+        GaussianProcessRegression()
+        .setKernel(lambda: RBFKernel(1.0))
+        .setDatasetSizeForExpert(s)
+        .setActiveSetSize(16)
+        .setSeed(3)
+        .setTol(1e-3)
+        .setMaxIter(1)
+        .setOptimizer("device")
+        .fit(x, y)
+    )
+    metrics = model.instr.metrics
+    assert metrics["solver_lane"] == "matfree", metrics
+    assert metrics.get("solver.matfree_engaged") == 1.0
+    assert metrics.get("plan.miss", 0.0) == 0.0, metrics
+    assert metrics.get("fallback.engaged", 0.0) == 0.0, metrics
+    assert metrics.get("fallback.transitions", 0.0) == 0.0, metrics
+    rows = [r for r in model.instr.memory_plan if r["entry"] == "fit"]
+    assert rows and rows[-1]["fits"] is True, rows
+    # the plan's starting ("native") candidate IS the matfree-priced
+    # program — resolve_solver already flipped the auto lane, so the
+    # first rung is admitted at the streaming byte model while every
+    # materialized candidate is priced over the staged budget
+    cands = {c["name"]: c for c in rows[-1]["candidates"]}
+    assert rows[-1]["chosen"] == "native", rows
+    assert cands["native"]["fits"] is True
+    assert cands["native"]["predicted_bytes"] <= rows[-1]["budget_bytes"]
+    assert cands["native"]["predicted_bytes"] < iter_pred
+    if "iterative" in cands:  # the materialized rung: priced over budget
+        assert cands["iterative"]["fits"] is False, cands["iterative"]
+
+
+# -- the satellites ---------------------------------------------------------
+
+
+def test_redundancy_scorer_device_matches_host(rng):
+    """PR 15's selection sketch scoring, moved on-device: the jitted
+    batched centered-cosine must match the host scorer to float noise,
+    and GP_AGG_DEVICE_SCORE=0 must restore the host path exactly."""
+    from spark_gp_tpu.models import aggregation as agg
+
+    sketches = rng.normal(size=(24, 64))
+    sketches[3] = sketches[7]  # one exact duplicate pair
+    host = agg.redundancy_matrix_host(sketches)
+    device = agg.redundancy_matrix(sketches)
+    np.testing.assert_allclose(device, host, rtol=1e-12, atol=1e-12)
+    assert device[3, 7] > 0.999
+    os.environ["GP_AGG_DEVICE_SCORE"] = "0"
+    try:
+        forced_host = agg.redundancy_matrix(sketches)
+    finally:
+        os.environ.pop("GP_AGG_DEVICE_SCORE", None)
+    assert np.array_equal(forced_host, host)
+
+
+def test_sweep_matvec_rows(rng):
+    """benchmarks/pallas_sweep.py's fused-matvec lane: importable, one
+    labeled row per size, finite timings (interpret mode on CPU)."""
+    sys.path.insert(0, os.path.join(ROOT, "benchmarks"))
+    try:
+        import pallas_sweep
+    finally:
+        sys.path.pop(0)
+    rows = pallas_sweep.sweep_matvec(sizes=(16,), iters=1)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["lane"] == "matvec" and row["n"] == 16
+    assert row["pallas_us_per_matvec"] > 0
+    assert row["scan_us_per_matvec"] > 0
+
+
+def test_no_gram_materialization_inside_solver_engine():
+    """tools/check_solver_pins.py's matfree extension as a tier-1 gate:
+    a gram_from_cache / prepare_gram_cache call inside ops/iterative.py
+    or ops/pallas_matvec.py fails here before it silently rebuilds the
+    [E, s, s] buffer the lane exists to avoid."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_solver_pins
+    finally:
+        sys.path.pop(0)
+    violations = check_solver_pins.find_matvec_pins(
+        os.path.join(ROOT, "spark_gp_tpu")
+    )
+    assert violations == [], (
+        "gram-materializing calls inside the solver engine files:\n"
+        + "\n".join(f"{p}:{n}: {l}" for p, n, l in violations)
+    )
+    assert check_solver_pins.main([os.path.join(ROOT, "spark_gp_tpu")]) == 0

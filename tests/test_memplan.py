@@ -116,7 +116,7 @@ def test_fit_plan_presizes_iterative_no_oom(problem):
     assert rows[0]["chosen"] == "iterative" and rows[0]["fits"] is True
     assert rows[0]["raw_bytes"] <= rows[0]["predicted_bytes"] <= limit
     names = [c["name"] for c in rows[0]["candidates"]]
-    assert names == ["native", "iterative", "segmented"]
+    assert names == ["native", "iterative", "matfree", "segmented"]
     # the iterative rung changes numerics within its documented bar:
     # objective-level parity (theta itself is ill-determined on this
     # workload's flat amplitude ridge at a 3-iteration budget)
@@ -146,15 +146,19 @@ def test_fit_kill_switch_restores_reactive_ladder(problem):
 
 def test_fit_plan_miss_counted_when_nothing_fits(problem):
     x, y = problem
-    # a budget even the segmented dispatch exceeds: the plan records a
-    # fits=False decision, the dispatch OOMs, and the reactive ladder
-    # backstops through the host rung — plan.miss is the alert trail
+    # a budget even the smallest staged dispatch (the matfree rung's
+    # skinny workspace) exceeds: the plan records a fits=False decision,
+    # the dispatch OOMs, and the reactive ladder backstops through the
+    # host rung — plan.miss is the alert trail
     e = num_experts_for(x.shape[0], EXPERT)
-    seg_raw = memplan.fit_dispatch_bytes(
-        e, EXPERT, x.shape[1], _itemsize(), "segmented"
+    smallest_raw = min(
+        memplan.fit_dispatch_bytes(
+            e, EXPERT, x.shape[1], _itemsize(), rung
+        )
+        for rung in ("segmented", "matfree")
     )
     before = _counters()
-    with chaos.memory_limit_bytes(seg_raw / 2.0) as fired:
+    with chaos.memory_limit_bytes(smallest_raw / 2.0) as fired:
         model = _gp().fit(x, y)
     after = _counters()
     assert fired[0] >= 1

@@ -155,6 +155,10 @@ def test_rehearsal_artifact_every_lane_valid():
         assert lane["timed_out"] is False, (name, lane)
     # the bench lane actually measured (CPU platform recorded)
     assert summary["lanes"]["BENCH"]["platform"] == "cpu"
+    # the pallas lane carried the fused gram·vector streaming rows
+    # (ISSUE 20): sweep_matvec ran inside the same subprocess
+    assert summary["lanes"]["PALLAS"]["matvec_rows"] is True
+    assert summary["env"]["PALLAS_SWEEP_MATVEC_SIZES"] == "32,64"
     # the rehearsal env is the CPU tiny-config contract
     assert summary["env"]["JAX_PLATFORMS"] == "cpu"
     assert summary["env"]["GP_WATCHER_REHEARSAL"] == "1"

@@ -112,11 +112,64 @@ def find_pins(package_root: str) -> list:
     return sorted(violations)
 
 
+#: method names that MATERIALIZE a gram block — banned inside the solver
+#: engine files: the matfree lane's contract is that ops/iterative.py /
+#: ops/pallas_matvec.py only ever touch the operator through injected
+#: matvec/diag/column closures, and one ``kernel.gram_from_cache(...)``
+#: (or ``prepare_gram_cache``) call inside a matvec path silently
+#: rebuilds the [E, s, s] buffer the lane exists to avoid
+_BANNED_GRAM_TAILS = ("gram_from_cache", "prepare_gram_cache")
+
+#: solver-engine files (relative to the package root) held to the
+#: no-materialization contract
+_MATFREE_ENGINE_FILES = (
+    os.path.join("ops", "iterative.py"),
+    os.path.join("ops", "pallas_matvec.py"),
+)
+
+
+def find_matvec_pins(package_root: str) -> list:
+    """``(relative_path, lineno, stripped_line)`` for every
+    gram-materializing CALL (``*.gram_from_cache`` /
+    ``prepare_gram_cache``) inside the solver engine files — the
+    structural twin of :func:`find_pins` for the matfree lane's
+    never-materialize contract.  ``# solver-pin-ok`` opts out, same as
+    the factorization ban."""
+    violations = []
+    package_root = os.path.abspath(package_root)
+    for rel_file in _MATFREE_ENGINE_FILES:
+        path = os.path.join(package_root, rel_file)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        lines = source.splitlines()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] not in _BANNED_GRAM_TAILS:
+                continue
+            line = (
+                lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+            )
+            if _ALLOW in line:
+                continue
+            rel = os.path.relpath(path, os.path.dirname(package_root))
+            violations.append((rel, node.lineno, line.strip()))
+    return sorted(violations)
+
+
 def main(argv=None) -> int:
     root = (argv or sys.argv[1:]) or [
         os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "spark_gp_tpu")
     ]
+    status = 0
     violations = find_pins(root[0])
     if violations:
         print(
@@ -128,8 +181,20 @@ def main(argv=None) -> int:
         )
         for rel, lineno, line in violations:
             print(f"  {rel}:{lineno}: {line}", file=sys.stderr)
-        return 1
-    return 0
+        status = 1
+    matvec_violations = find_matvec_pins(root[0])
+    if matvec_violations:
+        print(
+            "gram-materializing calls inside the solver engine files — "
+            "the matfree lane touches operators only through injected "
+            "matvec/diag/column closures (ops/pallas_matvec.py); mark a "
+            f"deliberate exemption with '# {_ALLOW}':",
+            file=sys.stderr,
+        )
+        for rel, lineno, line in matvec_violations:
+            print(f"  {rel}:{lineno}: {line}", file=sys.stderr)
+        status = 1
+    return status
 
 
 if __name__ == "__main__":
